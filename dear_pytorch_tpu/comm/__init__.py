@@ -1,0 +1,22 @@
+"""Communication backend: topology discovery, process bootstrap, collectives.
+
+TPU-native replacement for the reference's ``common/comm_core`` C++/CUDA
+extension (communicator.cpp, comm_core.cpp): NCCL+MPI become XLA collectives
+over ICI/DCN, MPI_Init/hostfiles become ``jax.distributed.initialize`` +
+device enumeration, and CUDA side streams become XLA async collectives.
+"""
+
+from dear_pytorch_tpu.comm.backend import (  # noqa: F401
+    init,
+    is_initialized,
+    shutdown,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    device_count,
+    barrier,
+    global_mesh,
+    set_global_mesh,
+)
+from dear_pytorch_tpu.comm.communicator import Communicator  # noqa: F401

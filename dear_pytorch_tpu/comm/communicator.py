@@ -1,0 +1,154 @@
+"""Eager `Communicator` — API mirror of the reference's C++ class.
+
+The reference's ``Communicator(nstreams)`` (common/comm_core/src/
+communicator.h:85-95, communicator.cpp:25-128) owns N CUDA streams, each with
+its own NCCL communicator, enqueues one collective per call on a round-robin
+stream, and returns the stream index as a handle; `synchronize()` /
+`syncStream(h)` block the host on the comm streams.
+
+On TPU there are no user-visible streams: JAX dispatch is already
+asynchronous (a collective call returns an unmaterialized `jax.Array`
+future), and XLA runs collectives on dedicated hardware queues. This mirror
+therefore maps:
+
+  stream handle            -> an integer keying the pending result array
+  enqueue on side stream   -> async dispatch of a jitted shard_map collective
+  cudaStreamSynchronize    -> `jax.block_until_ready` on the pending arrays
+  cudaStreamQuery          -> `jax.Array.is_ready()`
+  destroy()/reload()       -> drop / reset pending state (no comms to rebuild;
+                              XLA owns the ICI rings)
+
+All methods operate on *stacked* arrays of shape ``(world, ...)`` — one slice
+per device — matching the per-rank tensors of the reference's test harness
+(common/comm_core/tests/test_comm.py). Results are returned (JAX is
+functional; nothing is updated in place).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from dear_pytorch_tpu.comm import backend, collectives as C
+from dear_pytorch_tpu.comm.backend import DP_AXIS
+
+
+def _multi_bcast_one(x, fn, min_elems, axis_name):
+    return C.multi_bcast([x], fn, min_elems, axis_name)[0]
+
+
+class Communicator:
+    """Round-robin async collective issuer over the global mesh."""
+
+    def __init__(
+        self,
+        nstreams: int = 1,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        axis_name: str = DP_AXIS,
+    ):
+        self.nstreams = max(1, int(nstreams))
+        self.mesh = mesh or backend.global_mesh()
+        self.axis_name = axis_name
+        # handle -> arrays still in flight on that "stream". A reused handle
+        # appends (NCCL enqueue-on-busy-stream queues; it doesn't cancel), so
+        # synchronize() is a true fence over everything issued.
+        self._pending: Dict[int, List[jax.Array]] = {}
+        self._next_handle = 0
+        self._destroyed = False
+        # Per-op callables are built once and reused so that spmd_call's
+        # jit cache (keyed on fn identity) hits on every call after the first.
+        self._ops: Dict[tuple, Callable] = {}
+
+    # -- internals ----------------------------------------------------------
+
+    def _op(self, base: Callable, **static) -> Callable:
+        key = (base, tuple(sorted(static.items())))
+        fn = self._ops.get(key)
+        if fn is None:
+            fn = partial(base, axis_name=self.axis_name, **static)
+            self._ops[key] = fn
+        return fn
+
+    def _issue(self, fn: Callable, *stacked) -> tuple[jax.Array, int]:
+        if self._destroyed:
+            raise RuntimeError("Communicator destroyed; call reload()")
+        out = C.spmd_call(fn, *stacked, mesh=self.mesh, axis_name=self.axis_name)
+        handle = self._next_handle % self.nstreams
+        self._next_handle += 1
+        self._pending.setdefault(handle, []).append(out)
+        return out, handle
+
+    # -- collectives (names follow comm_core.cpp:22-37 exports) -------------
+
+    def reduce(self, stacked, root: int = 0):
+        return self._issue(self._op(C.reduce, root=root), stacked)
+
+    def bcast(self, stacked, root: int = 0):
+        return self._issue(self._op(C.broadcast, root=root), stacked)
+
+    def allReduce(self, stacked):
+        return self._issue(self._op(C.all_reduce), stacked)
+
+    def allReduceRB(self, stacked, root: int = 0):
+        return self._issue(self._op(C.all_reduce_rb, root=root), stacked)
+
+    def allReduceRSAG(self, stacked):
+        return self._issue(self._op(C.all_reduce_rsag), stacked)
+
+    def reduceScatter(self, stacked):
+        """stacked (world, n) with n % world == 0 -> (world, n // world)."""
+        return self._issue(self._op(C.reduce_scatter), stacked)
+
+    def allGather(self, stacked):
+        """stacked (world, n) -> (world, n * world)."""
+        return self._issue(self._op(C.all_gather), stacked)
+
+    def sendrecv(self, stacked, peer_of: Sequence[int]):
+        peers = tuple(int(p) for p in peer_of)
+        return self._issue(self._op(C.send_recv, peer_of=peers), stacked)
+
+    def multiBcast(self, stacked_list, fn: Callable, min_elems: int = 512 * 512):
+        outs = []
+        handle = None
+        op = self._op(_multi_bcast_one, fn=fn, min_elems=min_elems)
+        for s in stacked_list:
+            out, handle = self._issue(op, s)
+            outs.append(out)
+        return outs, handle
+
+    # -- synchronization (communicator.cpp:103-128) --------------------------
+
+    def synchronize(self) -> None:
+        """Block until every outstanding collective has completed
+        (cudaStreamSynchronize over all streams, :103-110)."""
+        for arrs in self._pending.values():
+            for arr in arrs:
+                jax.block_until_ready(arr)
+        self._pending.clear()
+
+    def syncStream(self, handle: int) -> None:
+        """Block on everything issued on one handle (:111-116)."""
+        for arr in self._pending.pop(handle, []):
+            jax.block_until_ready(arr)
+
+    def getNumOfFreeStreams(self) -> int:
+        """Poll completion (cudaStreamQuery loop, :118-128)."""
+        busy = sum(
+            1
+            for arrs in self._pending.values()
+            if any(hasattr(a, "is_ready") and not a.is_ready() for a in arrs)
+        )
+        return self.nstreams - busy
+
+    # -- lifecycle (communicator.cpp:68-95) ----------------------------------
+
+    def destroy(self) -> None:
+        self.synchronize()
+        self._destroyed = True
+
+    def reload(self) -> None:
+        self._pending.clear()
+        self._next_handle = 0
+        self._destroyed = False

@@ -1,0 +1,44 @@
+"""Test configuration: emulate an 8-device TPU slice on the CPU backend.
+
+The reference had no fake-cluster story — multi-node behavior was only
+testable on a real 16×4-GPU cluster under mpirun (SURVEY.md §4). The XLA CPU
+backend gives us a true multi-device world on one host: real ReduceScatter /
+AllGather / AllReduce semantics, deterministic, CI-friendly.
+
+Must run before any `import jax` in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/TPU platform
+os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"  # sitecustomize sets TPU_WORKER_HOSTNAMES
+
+import jax  # noqa: E402
+
+# jax may already be imported by sitecustomize with JAX_PLATFORMS=axon baked
+# in; the config update works as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    """Global 1-D data-parallel mesh over the 8 emulated devices."""
+    from dear_pytorch_tpu.comm import backend
+
+    m = backend.init()
+    yield m
+
+
+@pytest.fixture(scope="session")
+def world(mesh):
+    return mesh.shape["dp"]
+
+
+@pytest.fixture
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(10)  # seed mirrors test_comm.py:6

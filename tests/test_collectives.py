@@ -1,0 +1,196 @@
+"""Collective correctness — asserting port of the reference's manual harness.
+
+The reference validates collectives by eyeballing printed norms under mpirun
+(common/comm_core/tests/test_comm.py, launched by test.sh:29). Each of those
+checks appears here as a real assertion on an 8-device emulated mesh:
+
+  allreduce          test_comm.py:11-20
+  reducescatter      test_comm.py:22-37  (RS+AG round trip vs allReduce)
+  decoupleallreduce  test_comm.py:39-53  (THE key invariant: decomposed == fused)
+  bcast              test_comm.py:55-64
+  reduce             test_comm.py:85-120
+  sendrecv           test_comm.py:122-146
+"""
+
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.comm import collectives as C
+from dear_pytorch_tpu.comm.communicator import Communicator
+
+
+def _stacked(rng, world, n=1024, dtype=np.float32):
+    """One distinct tensor per rank, like each mpirun rank's torch.rand."""
+    return rng.standard_normal((world, n)).astype(dtype)
+
+
+def test_allreduce(mesh, world, rng):
+    x = _stacked(rng, world)
+    out = C.spmd_call(C.all_reduce, x, mesh=mesh)
+    expected = np.broadcast_to(x.sum(axis=0), x.shape)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+def test_reduce_scatter_then_all_gather_roundtrip(mesh, world, rng):
+    # test_comm.py:22-37 — RS followed by AG must equal allReduce.
+    n = 64 * world
+    x = _stacked(rng, world, n)
+    shards = C.spmd_call(C.reduce_scatter, x, mesh=mesh)
+    assert shards.shape == (world, n // world)
+    # each rank's shard is the sum over ranks of its slice
+    full_sum = x.sum(axis=0)
+    for r in range(world):
+        np.testing.assert_allclose(
+            np.asarray(shards)[r], full_sum[r * (n // world) : (r + 1) * (n // world)],
+            rtol=1e-5,
+        )
+    gathered = C.spmd_call(C.all_gather, np.asarray(shards), mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(gathered), np.broadcast_to(full_sum, (world, n)), rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("decomposed", ["rsag", "rb"])
+def test_decoupled_allreduce_equals_fused(mesh, world, rng, decomposed):
+    """test_comm.py:39-53 — the DeAR core invariant, with a non-divisible
+    length to exercise the internal padding path (communicator.cpp:204-213)."""
+    n = 1000 + 7  # not a multiple of world
+    x = _stacked(rng, world, n)
+    fused = C.spmd_call(C.all_reduce, x, mesh=mesh)
+    if decomposed == "rsag":
+        dec = C.spmd_call(C.all_reduce_rsag, x, mesh=mesh)
+    else:
+        dec = C.spmd_call(lambda t: C.all_reduce_rb(t, 0), x, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(fused), rtol=1e-5)
+
+
+def test_broadcast(mesh, world, rng):
+    x = _stacked(rng, world, 256)
+    for root in (0, world - 1):
+        out = C.spmd_call(lambda t, r=root: C.broadcast(t, r), x, mesh=mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.broadcast_to(x[root], x.shape), rtol=1e-6
+        )
+
+
+def test_reduce_root_semantics(mesh, world, rng):
+    x = _stacked(rng, world, 128)
+    root = 1 % world
+    out = np.asarray(C.spmd_call(lambda t: C.reduce(t, root), x, mesh=mesh))
+    np.testing.assert_allclose(out[root], x.sum(axis=0), rtol=1e-5)
+    for r in range(world):
+        if r != root:  # non-root buffers untouched (ncclReduce in-place)
+            np.testing.assert_allclose(out[r], x[r], rtol=1e-6)
+
+
+def test_send_recv_ring(mesh, world, rng):
+    # test_comm.py:122-146 — pairwise exchange; here a rotation ring.
+    x = _stacked(rng, world, 64)
+    peers = [(i + 1) % world for i in range(world)]
+    out = np.asarray(C.spmd_call(lambda t: C.send_recv(t, peers), x, mesh=mesh))
+    for i in range(world):
+        np.testing.assert_allclose(out[(i + 1) % world], x[i], rtol=1e-6)
+
+
+def test_multi_bcast_matches_local_compute(mesh, world, rng):
+    xs = [rng.standard_normal((world, 600_000)).astype(np.float32),
+          rng.standard_normal((world, 32)).astype(np.float32)]
+    fn = lambda t: t * 2.0 + 1.0
+
+    def run(a, b):
+        return tuple(C.multi_bcast([a, b], fn, min_elems=512 * 512))
+
+    out = C.spmd_call(run, xs[0], xs[1], mesh=mesh)
+    # big tensor: owner rank 0 computes fn on ITS slice, result broadcast
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.broadcast_to(fn(xs[0][0]), xs[0].shape), rtol=1e-5
+    )
+    # small tensor: computed locally per rank
+    np.testing.assert_allclose(np.asarray(out[1]), fn(xs[1]), rtol=1e-6)
+
+
+def test_pad_to_multiple():
+    import jax.numpy as jnp
+
+    assert C.padded_length(10, 8) == 16
+    assert C.padded_length(16, 8) == 16
+    assert C.padded_length(0, 8) == 0
+    x = jnp.arange(10, dtype=jnp.float32)
+    p = C.pad_to_multiple(x, 8)
+    assert p.shape == (16,)
+    np.testing.assert_allclose(np.asarray(p[:10]), np.arange(10))
+    np.testing.assert_allclose(np.asarray(p[10:]), 0)
+
+
+class TestCommunicator:
+    def test_allreduce_and_sync(self, mesh, world, rng):
+        comm = Communicator(nstreams=2, mesh=mesh)
+        x = _stacked(rng, world, 512)
+        out, handle = comm.allReduce(x)
+        assert 0 <= handle < 2
+        comm.synchronize()
+        np.testing.assert_allclose(
+            np.asarray(out), np.broadcast_to(x.sum(0), x.shape), rtol=1e-5
+        )
+
+    def test_round_robin_handles(self, mesh, world, rng):
+        comm = Communicator(nstreams=3, mesh=mesh)
+        handles = [comm.allReduce(_stacked(rng, world, 64))[1] for _ in range(5)]
+        assert handles == [0, 1, 2, 0, 1]
+        comm.syncStream(0)
+        comm.synchronize()
+        assert comm.getNumOfFreeStreams() == 3
+
+    def test_repeated_calls_hit_jit_cache(self, mesh, world, rng):
+        # Regression: per-call lambdas used to defeat spmd_call's fn-identity
+        # cache, recompiling on every collective.
+        comm = Communicator(mesh=mesh)
+        x = _stacked(rng, world, 32)
+        comm.allReduce(x)
+        before = len(C._spmd_cache)
+        for _ in range(5):
+            comm.allReduce(x)
+            comm.reduce(x, root=0)
+        comm.synchronize()
+        assert len(C._spmd_cache) == before + 1  # only the new reduce op
+
+    def test_synchronize_fences_reused_handles(self, mesh, world, rng):
+        # Regression: with nstreams=1, a second issue on handle 0 must not
+        # evict the first from the synchronize() fence.
+        comm = Communicator(nstreams=1, mesh=mesh)
+        a, h0 = comm.allReduce(_stacked(rng, world, 16))
+        b, h1 = comm.allReduce(_stacked(rng, world, 16))
+        assert h0 == h1 == 0
+        assert len(comm._pending[0]) == 2
+        comm.synchronize()
+        assert not comm._pending
+
+    def test_destroy_reload(self, mesh, world, rng):
+        comm = Communicator(mesh=mesh)
+        comm.destroy()
+        with pytest.raises(RuntimeError):
+            comm.allReduce(_stacked(rng, world, 8))
+        comm.reload()
+        out, _ = comm.allReduce(_stacked(rng, world, 8))
+        comm.synchronize()
+
+    def test_reduce_scatter_all_gather(self, mesh, world, rng):
+        comm = Communicator(mesh=mesh)
+        n = 16 * world
+        x = _stacked(rng, world, n)
+        shards, _ = comm.reduceScatter(x)
+        gathered, _ = comm.allGather(np.asarray(shards))
+        comm.synchronize()
+        np.testing.assert_allclose(
+            np.asarray(gathered), np.broadcast_to(x.sum(0), (world, n)), rtol=1e-5
+        )
+
+
+def test_backend_introspection(mesh, world):
+    import dear_pytorch_tpu as dear
+
+    assert dear.size() == 1  # single process under pytest
+    assert dear.rank() == 0
+    assert dear.device_count() == world == 8
+    dear.barrier()  # no-op single-process, must not raise
+    assert dear.global_mesh() is mesh
