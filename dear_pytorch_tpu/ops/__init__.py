@@ -1,0 +1,15 @@
+"""Core array-level operations: tensor fusion, fused updates, compression."""
+
+from dear_pytorch_tpu.ops.fusion import (  # noqa: F401
+    FusionPlan,
+    Bucket,
+    LeafSpec,
+    make_plan,
+    plan_by_threshold,
+    plan_by_nearby_layers,
+    plan_by_flags,
+    pack_bucket,
+    unpack_bucket,
+    pack_all,
+    unpack_all,
+)

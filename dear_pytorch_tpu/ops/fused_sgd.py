@@ -1,0 +1,94 @@
+"""Fused optimizers operating on flat (shard) buffers.
+
+The reference reimplements SGD inline over fused buffers so the update can
+run per-module just-in-time before the next forward (``_sgd``,
+dear/dear_dopt.py:310-336: weight decay, momentum with dampening, nesterov —
+torch.optim.SGD semantics). Only SGD is supported in its fused path; the
+wrapped optimizer's own ``step`` is never called.
+
+Here an optimizer is a pair of pure functions over flat arrays. Because the
+DeAR schedule keeps master params and optimizer state *sharded* (each device
+owns 1/world of every fusion buffer), any **elementwise** transform — SGD,
+momentum, Adam(W), RMSProp — works unchanged on shards, which generalizes the
+reference's SGD-only contract and yields ZeRO-1 for free (the reference only
+gestures at this via torch's ZeroRedundancyOptimizer,
+pytorch-ddp/imagenet_benchmark.py:10,67-68). Optax transforms can be adapted
+with `from_optax` as long as they are elementwise (no cross-parameter
+reductions like global-norm clipping).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ShardOptimizer(NamedTuple):
+    """Pure optimizer over flat buffers: `init(param)->state`,
+    `update(grad, state, param) -> (new_param, new_state)`."""
+
+    init: Callable[[jax.Array], Any]
+    update: Callable[[jax.Array, Any, jax.Array], tuple[jax.Array, Any]]
+
+
+def fused_sgd(
+    lr: float,
+    momentum: float = 0.0,
+    weight_decay: float = 0.0,
+    dampening: float = 0.0,
+    nesterov: bool = False,
+) -> ShardOptimizer:
+    """torch.optim.SGD semantics on flat buffers (dear/dear_dopt.py:310-336).
+
+    d_p = grad + wd * p
+    buf = momentum * buf + (1 - dampening) * d_p        (after first step)
+    d_p = d_p + momentum * buf   if nesterov else buf
+    p  -= lr * d_p
+    """
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError("nesterov requires momentum > 0 and zero dampening")
+
+    use_momentum = momentum != 0.0
+
+    def init(param: jax.Array):
+        if not use_momentum:
+            return ()
+        # (buf, initialized) — torch seeds the buffer with d_p on first use
+        return (jnp.zeros_like(param), jnp.zeros((), jnp.bool_))
+
+    def update(grad, state, param):
+        d_p = grad
+        if weight_decay:
+            d_p = d_p + weight_decay * param
+        if use_momentum:
+            buf, initialized = state
+            seeded = jnp.where(
+                initialized, momentum * buf + (1.0 - dampening) * d_p, d_p
+            )
+            d_p = d_p + momentum * seeded if nesterov else seeded
+            state = (seeded, jnp.ones((), jnp.bool_))
+        return param - lr * d_p, state
+
+    return ShardOptimizer(init, update)
+
+
+def from_optax(tx) -> ShardOptimizer:
+    """Adapt an optax GradientTransformation to flat shard buffers.
+
+    Valid only for elementwise transforms (adam, adamw, sgd, rmsprop, ...):
+    state and updates must depend on each element independently, so running
+    on a shard equals running on the full tensor. Cross-parameter transforms
+    (e.g. clip_by_global_norm) would silently compute shard-local norms —
+    use schedule mode 'allreduce' with full parameters for those.
+    """
+
+    def init(param):
+        return tx.init(param)
+
+    def update(grad, state, param):
+        updates, new_state = tx.update(grad, state, param)
+        return param + updates, new_state
+
+    return ShardOptimizer(init, update)

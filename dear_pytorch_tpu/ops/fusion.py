@@ -1,0 +1,352 @@
+"""Tensor fusion: bucketing a parameter pytree into flat, padded comm buffers.
+
+Functional redesign of the reference's mutable fusion machinery:
+
+  - ``TensorGroup`` push/pull buffers        (dear/tensorfusion.py:14-200)
+  - ``_generate_groups_with_threshold``      (dear/dear_dopt.py:109-139)
+  - ``_generate_groups_with_nearby_layers``  (dear/dear_dopt.py:94-107)
+  - ``_generate_groups_with_flags``          (dear/dopt_rsag_wt.py; 0/1
+    boundary vector splitting, tensorfusion.py:175-192)
+  - ``_prepare_tensor_fusion`` offset bookkeeping and pad/shard buffer
+    sizing (dear/dear_dopt.py:142-194)
+
+The reference allocates persistent CUDA buffers and copies gradients in from
+backward hooks. Here a *plan* is static metadata computed once from shapes
+(usable inside jit as trace-time constants), and pack/unpack are pure
+functions the compiler fuses into surrounding computation — there is no
+persistent buffer to manage and no copy-in race to get wrong.
+
+Layer atomicity: the reference buckets whole *modules* (a module's params
+always land in one bucket). Here a "layer" is a group of leaves sharing a
+parent path in the pytree (e.g. a flax module's ``{kernel, bias}``), and
+plans never split a layer across buckets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafSpec:
+    """Static description of one parameter tensor."""
+
+    name: str          # "/"-joined pytree path, e.g. "conv1/kernel"
+    layer: int         # index of the atomic layer (module) this leaf belongs to
+    shape: tuple[int, ...]
+    dtype: Any
+    size: int          # number of elements
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One fusion group: a contiguous run of layers packed into a flat buffer.
+
+    ``offsets[i]`` is the element offset of ``leaf_ids[i]`` inside the flat
+    buffer (the reference's per-param ``(group_idx, sub_idx, start, end)``
+    bookkeeping, dear/dear_dopt.py:176-184).
+    """
+
+    index: int
+    leaf_ids: tuple[int, ...]
+    offsets: tuple[int, ...]
+    size: int          # total elements (unpadded)
+    padded_size: int   # rounded up to a multiple of world
+    shard_size: int    # padded_size // world
+
+    @property
+    def pad(self) -> int:
+        return self.padded_size - self.size
+
+
+@dataclasses.dataclass(frozen=True)
+class FusionPlan:
+    """Complete static bucketing of a parameter pytree."""
+
+    leaves: tuple[LeafSpec, ...]
+    buckets: tuple[Bucket, ...]
+    world: int
+    treedef: Any = dataclasses.field(compare=False)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def total_size(self) -> int:
+        return sum(l.size for l in self.leaves)
+
+    def bucket_of_leaf(self, leaf_id: int) -> int:
+        for b in self.buckets:
+            if leaf_id in b.leaf_ids:
+                return b.index
+        raise KeyError(leaf_id)
+
+    def describe(self) -> str:
+        lines = [
+            f"FusionPlan: {len(self.leaves)} tensors, "
+            f"{self.num_buckets} buckets, world={self.world}"
+        ]
+        for b in self.buckets:
+            names = [self.leaves[i].name for i in b.leaf_ids]
+            mb = sum(
+                self.leaves[i].size * jnp.dtype(self.leaves[i].dtype).itemsize
+                for i in b.leaf_ids
+            ) / 2**20
+            lines.append(
+                f"  bucket {b.index}: {len(names)} tensors, {mb:.2f} MB "
+                f"(pad {b.pad}, shard {b.shard_size}) [{names[0]} .. {names[-1]}]"
+            )
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Layer grouping
+# ---------------------------------------------------------------------------
+
+
+def _leaf_specs(params) -> tuple[tuple[LeafSpec, ...], Any]:
+    """Flatten params into LeafSpecs in pytree (≈ forward) order, grouping
+    leaves that share a parent path into one atomic layer (the reference's
+    module granularity, dear/dear_dopt.py:196-240)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    layer_keys: dict[str, int] = {}
+    for path, leaf in flat:
+        name = _path_str(path)
+        parent = name.rsplit("/", 1)[0] if "/" in name else name
+        layer = layer_keys.setdefault(parent, len(layer_keys))
+        specs.append(
+            LeafSpec(
+                name=name,
+                layer=layer,
+                shape=tuple(leaf.shape),
+                dtype=jnp.result_type(leaf),
+                size=int(np.prod(leaf.shape)) if leaf.shape else 1,
+            )
+        )
+    return tuple(specs), treedef
+
+
+def _layers(specs: Sequence[LeafSpec]) -> list[list[int]]:
+    """Leaf ids grouped by atomic layer, in first-appearance order."""
+    out: dict[int, list[int]] = {}
+    for i, s in enumerate(specs):
+        out.setdefault(s.layer, []).append(i)
+    return [out[k] for k in sorted(out)]
+
+
+# ---------------------------------------------------------------------------
+# Partitioning strategies
+# ---------------------------------------------------------------------------
+
+
+def plan_by_threshold(
+    params, world: int, threshold_mb: Optional[float] = 25.0
+) -> "FusionPlan":
+    """Pack consecutive layers into buckets of at most `threshold_mb`.
+
+    Mirrors ``_generate_groups_with_threshold`` (dear/dear_dopt.py:109-139):
+    a running byte count packs layers in order; a layer that would push the
+    bucket past the threshold starts a new bucket (a single oversized layer
+    still gets its own bucket). ``threshold_mb=None`` -> one bucket holding
+    everything (the reference's THRESHOLD=None no-fusion-limit mode,
+    dopt_rsag.py:37).
+    """
+    specs, treedef = _leaf_specs(params)
+    if threshold_mb is None:
+        groups = [[i for layer in _layers(specs) for i in layer]] if specs else []
+        return _build_plan(specs, groups, world, treedef)
+    limit = threshold_mb * 2**20
+    groups: list[list[int]] = []
+    current: list[int] = []
+    current_bytes = 0.0
+    for layer in _layers(specs):
+        layer_bytes = sum(
+            specs[i].size * jnp.dtype(specs[i].dtype).itemsize for i in layer
+        )
+        if current and current_bytes + layer_bytes > limit:
+            groups.append(current)
+            current, current_bytes = [], 0.0
+        current.extend(layer)
+        current_bytes += layer_bytes
+    if current:
+        groups.append(current)
+    return _build_plan(specs, groups, world, treedef)
+
+
+def plan_by_nearby_layers(params, world: int, k: int = 4) -> "FusionPlan":
+    """Pack every `k` consecutive layers into one bucket
+    (``_generate_groups_with_nearby_layers``, dear/dear_dopt.py:94-107).
+    ``k=1`` disables fusion (one bucket per layer); ``k=-1`` fuses all
+    layers into a single bucket (the wait-time tuner's starting point,
+    dopt_rsag_wt.py)."""
+    specs, treedef = _leaf_specs(params)
+    layers = _layers(specs)
+    if k == -1:
+        k = max(1, len(layers))
+    groups = [
+        [i for layer in layers[j : j + k] for i in layer]
+        for j in range(0, len(layers), k)
+    ]
+    return _build_plan(specs, groups, world, treedef)
+
+
+def plan_by_flags(params, world: int, flags: Sequence[int]) -> "FusionPlan":
+    """Split at layer boundaries where ``flags[layer] == 1``
+    (``update_groups_with_flags`` / ``_generate_groups_with_flags``,
+    tensorfusion.py:175-192, dopt_rsag_wt.py). ``flags`` has one entry per
+    atomic layer; flag=1 means "this layer STARTS a new bucket"."""
+    specs, treedef = _leaf_specs(params)
+    layers = _layers(specs)
+    if len(flags) != len(layers):
+        raise ValueError(
+            f"flags has {len(flags)} entries for {len(layers)} layers"
+        )
+    groups: list[list[int]] = []
+    current: list[int] = []
+    for flag, layer in zip(flags, layers):
+        if flag and current:
+            groups.append(current)
+            current = []
+        current.extend(layer)
+    if current:
+        groups.append(current)
+    return _build_plan(specs, groups, world, treedef)
+
+
+def make_plan(
+    params,
+    world: int,
+    threshold_mb: Optional[float] = 25.0,
+    nearby_layers: Optional[int] = None,
+    flags: Optional[Sequence[int]] = None,
+) -> "FusionPlan":
+    """One-stop plan builder with the reference's precedence: explicit flags
+    beat nearby-layer count beats MB threshold (dear/dear_dopt.py:89-139)."""
+    if flags is not None:
+        return plan_by_flags(params, world, flags)
+    if nearby_layers is not None:
+        return plan_by_nearby_layers(params, world, nearby_layers)
+    return plan_by_threshold(params, world, threshold_mb)
+
+
+def _build_plan(specs, groups, world, treedef) -> FusionPlan:
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    buckets = []
+    seen: set[int] = set()
+    for idx, leaf_ids in enumerate(groups):
+        offsets = []
+        off = 0
+        for i in leaf_ids:
+            if i in seen:
+                raise ValueError(f"leaf {i} assigned to two buckets")
+            seen.add(i)
+            offsets.append(off)
+            off += specs[i].size
+        padded = -(-off // world) * world if off else 0
+        buckets.append(
+            Bucket(
+                index=idx,
+                leaf_ids=tuple(leaf_ids),
+                offsets=tuple(offsets),
+                size=off,
+                padded_size=padded,
+                shard_size=padded // world,
+            )
+        )
+    if len(seen) != len(specs):
+        missing = [s.name for i, s in enumerate(specs) if i not in seen]
+        raise ValueError(f"leaves not covered by any bucket: {missing}")
+    return FusionPlan(
+        leaves=tuple(specs), buckets=tuple(buckets), world=world, treedef=treedef
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pack / unpack (pure; XLA fuses these into neighbouring ops)
+# ---------------------------------------------------------------------------
+
+
+def pack_bucket(
+    leaves: Sequence[jax.Array], plan: FusionPlan, bucket: int, dtype=None
+) -> jax.Array:
+    """Flatten + concatenate + zero-pad one bucket's leaves into the flat
+    padded comm buffer (the reference's ``push_tensor`` copy-in,
+    tensorfusion.py:85-115, plus ``_get_pad_tensor`` padding,
+    dear_dopt.py:186-194)."""
+    b = plan.buckets[bucket]
+    parts = []
+    for leaf_id in b.leaf_ids:
+        x = leaves[leaf_id].reshape(-1)
+        parts.append(x.astype(dtype) if dtype is not None else x)
+    if b.pad:
+        pad_dtype = parts[0].dtype if parts else (dtype or jnp.float32)
+        parts.append(jnp.zeros((b.pad,), dtype=pad_dtype))
+    return jnp.concatenate(parts) if parts else jnp.zeros((0,))
+
+
+def unpack_bucket(
+    buf: jax.Array, plan: FusionPlan, bucket: int
+) -> dict[int, jax.Array]:
+    """Slice a flat (padded) buffer back into `{leaf_id: tensor}` views
+    (``pull_alltensors``, tensorfusion.py:117-127)."""
+    b = plan.buckets[bucket]
+    out = {}
+    for leaf_id, off in zip(b.leaf_ids, b.offsets):
+        spec = plan.leaves[leaf_id]
+        out[leaf_id] = jax.lax.dynamic_slice_in_dim(buf, off, spec.size).reshape(
+            spec.shape
+        )
+    return out
+
+
+def pack_all(tree, plan: FusionPlan, dtype=None) -> list[jax.Array]:
+    """Pack every bucket from a pytree with the plan's structure."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if len(leaves) != len(plan.leaves):
+        raise ValueError(
+            f"tree has {len(leaves)} leaves, plan expects {len(plan.leaves)}"
+        )
+    return [pack_bucket(leaves, plan, b.index, dtype) for b in plan.buckets]
+
+
+def unpack_all(buffers: Sequence[jax.Array], plan: FusionPlan):
+    """Rebuild the original pytree from per-bucket flat buffers, restoring
+    each leaf's shape and dtype."""
+    if len(buffers) != plan.num_buckets:
+        raise ValueError(
+            f"{len(buffers)} buffers for {plan.num_buckets} buckets"
+        )
+    flat: list[Optional[jax.Array]] = [None] * len(plan.leaves)
+    for b, buf in zip(plan.buckets, buffers):
+        pieces = unpack_bucket(buf, plan, b.index)
+        for leaf_id, x in pieces.items():
+            flat[leaf_id] = x.astype(plan.leaves[leaf_id].dtype)
+    return jax.tree_util.tree_unflatten(plan.treedef, flat)
+
+
+def shard_spec(plan: FusionPlan) -> list[tuple[int]]:
+    """Per-bucket shard shapes ``(shard_size,)`` — what reduce-scatter emits
+    and what the sharded optimizer state is shaped like."""
+    return [(b.shard_size,) for b in plan.buckets]

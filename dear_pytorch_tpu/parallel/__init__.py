@@ -1,0 +1,7 @@
+"""Parallel training schedules: DeAR decoupled RS+AG, baselines, seq-parallel."""
+
+from dear_pytorch_tpu.parallel.dear import (  # noqa: F401
+    DearState,
+    TrainStep,
+    build_train_step,
+)

@@ -1,0 +1,308 @@
+"""The DeAR schedule: decoupled reduce-scatter + all-gather data parallelism.
+
+This is the TPU-native heart of the framework, replacing the reference's
+``_DistributedOptimizer`` (dear/dear_dopt.py:56-378), which wires the
+schedule out of eager-mode machinery: per-param backward hooks launch an
+async reduce-scatter when a fusion bucket fills (:242-272), ``step()`` syncs
+reduce-scatters and kicks the first all-gather (:348-372), and per-module
+forward *pre*-hooks of the NEXT iteration sync the gather, apply a fused SGD
+just-in-time, and prefetch the next bucket's gather (:274-308).
+
+Functional redesign. Master parameters and optimizer state live as
+*shards* — each device owns 1/world of every fusion buffer (which is exactly
+the reduce-scatter output, and makes ZeRO-1 sharding inherent rather than an
+option). One jitted train step:
+
+    per bucket g:  full_g   = all_gather(param_shard_g)        # feeds fwd
+    params         = unpack(full_0..G)
+    loss, grads    = value_and_grad(loss_fn)(params, batch)
+    per bucket g:  grad_shard_g = reduce_scatter(grads_g) / N  # fed by bwd
+    per bucket g:  param_shard_g, opt_g = update(grad_shard_g, ...)
+
+The data dependencies reproduce DeAR's overlap by construction: bucket g's
+all-gather is needed only by layer-group g's forward, so XLA's latency-hiding
+scheduler runs gather g+1 while layer-group g computes (the reference's
+"prefetch next bucket" hook, dear_dopt.py:283-287); each bucket's
+reduce-scatter depends only on that bucket's grads, so it overlaps the rest
+of the backward (the reference's backward-hook launches). The cross-iteration
+pipelining (reference applies updates of step i-1 during step i's forward) is
+carried functionally: shards updated at the end of step i are gathered at the
+top of step i+1 — same pipeline, but step 0 trains on correctly-reduced
+gradients, fixing the reference's documented quirk of training iteration 0 on
+unreduced local gradients (dear_dopt.py:278,367-371).
+
+Baseline schedules (same builder, ``mode=``):
+  'allreduce' — per-bucket fused all-reduce after backward, full params and
+                replicated optimizer everywhere (MG-WFBP/DDP/Horovod shape;
+                mgwfbp/dopt.py:690, pytorch-ddp/imagenet_benchmark.py:65)
+  'rsag'      — per-bucket all-reduce decomposed as RS+AG inline
+                (WFBP's allReduceRSAG, wfbp/dopt.py:675-701)
+  'rb'        — per-bucket reduce-to-root + broadcast (dear/dopt_rb.py)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dear_pytorch_tpu.comm import backend
+from dear_pytorch_tpu.comm import collectives as C
+from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.ops import fusion as F
+from dear_pytorch_tpu.ops.fused_sgd import ShardOptimizer, fused_sgd
+
+MODES = ("dear", "allreduce", "rsag", "rb")
+#: Ablation switches (reference `exclude_parts`, dear/dear_dopt.py:75-76,
+#: dear/batch.sh:18-43). Time-breakdown instruments — numerics are garbage
+#: when a phase is excluded, exactly as in the reference.
+EXCLUDABLE = ("reducescatter", "allgather")
+
+
+class DearState(NamedTuple):
+    """Carried training state.
+
+    ``buffers[g]`` is bucket g's flat padded master-param buffer. In 'dear'
+    mode its global array is sharded along dim 0 (each device owns its
+    reduce-scatter slice); in baseline modes it is replicated. ``opt_state``
+    mirrors that layout. ``step`` is a replicated scalar.
+    """
+
+    buffers: tuple
+    opt_state: tuple
+    step: jax.Array
+
+
+class TrainStep(NamedTuple):
+    """What `build_train_step` returns."""
+
+    init: Callable[[Any], DearState]
+    step: Callable[[DearState, Any], tuple[DearState, dict]]
+    gather_params: Callable[[DearState], Any]
+    plan: F.FusionPlan
+    mesh: jax.sharding.Mesh
+
+
+def _opt_bucket_specs(axis_name: str, bucket_padded: int, opt_state_leaf):
+    """Spec for one bucket's optimizer-state leaf: leaves shaped exactly like
+    the bucket's flat buffer hold per-element state and shard with it;
+    anything else (momentum 'initialized' flag, adam count) is replicated.
+
+    Limitation (documented): a genuinely replicated 1-D leaf whose length
+    coincides with this bucket's padded size is indistinguishable by shape
+    and would be sharded; pass ``opt_spec_fn`` to `build_train_step` to
+    override for such optimizers.
+    """
+    if (
+        getattr(opt_state_leaf, "ndim", None) == 1
+        and opt_state_leaf.shape[0] == bucket_padded
+    ):
+        return jax.P(axis_name)
+    return jax.P()
+
+
+def build_train_step(
+    loss_fn: Callable,
+    params_template,
+    *,
+    optimizer: Optional[ShardOptimizer] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    axis_name: str = DP_AXIS,
+    mode: str = "dear",
+    threshold_mb: Optional[float] = 25.0,
+    nearby_layers: Optional[int] = None,
+    flags: Optional[Sequence[int]] = None,
+    plan: Optional[F.FusionPlan] = None,
+    exclude_parts: Sequence[str] = (),
+    comm_dtype=None,
+    has_aux: bool = False,
+    donate: bool = True,
+    opt_spec_fn: Optional[Callable[[int, Any], Any]] = None,
+) -> TrainStep:
+    """Build the jitted DeAR (or baseline) data-parallel train step.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch) -> loss`` (or ``(loss, aux)`` with
+        ``has_aux=True``); computed per device on its local batch shard.
+      params_template: pytree giving shapes/dtypes (actual values are used by
+        `init`).
+      optimizer: a `ShardOptimizer`; defaults to fused SGD lr=0.01 (the
+        reference benchmarks' default, dear/imagenet_benchmark.py).
+      mode: 'dear' | 'allreduce' | 'rsag' | 'rb'.
+      threshold_mb / nearby_layers / flags / plan: bucketing controls
+        (defaults mirror THRESHOLD=25 MB, dear/dear_dopt.py:42-44).
+      exclude_parts: subset of {'reducescatter','allgather'} — skip that
+        collective for time-breakdown ablations ('dear' mode only).
+      comm_dtype: cast gradients to this dtype for communication (e.g.
+        jnp.bfloat16); update math stays in the param dtype.
+      donate: donate the state argument so buffers are updated in place.
+      opt_spec_fn: optional ``(bucket_index, state_leaf) -> PartitionSpec``
+        override for optimizer-state sharding (see `_opt_bucket_specs`).
+    """
+    if mode not in MODES:
+        raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+    for e in exclude_parts:
+        if e not in EXCLUDABLE:
+            raise ValueError(f"exclude_parts entries must be in {EXCLUDABLE}")
+    if exclude_parts and mode != "dear":
+        raise ValueError("exclude_parts is a 'dear'-mode ablation")
+    mesh = mesh or backend.global_mesh()
+    world = mesh.shape[axis_name]
+    optimizer = optimizer or fused_sgd(lr=0.01)
+    if plan is None:
+        plan = F.make_plan(
+            params_template,
+            world,
+            threshold_mb=threshold_mb,
+            nearby_layers=nearby_layers,
+            flags=flags,
+        )
+    if plan.world != world:
+        raise ValueError(
+            f"plan was built for world={plan.world} but mesh axis "
+            f"{axis_name!r} has size {world}"
+        )
+    sharded = mode == "dear"
+    excl = frozenset(exclude_parts)
+
+    # ---- per-device step body (runs inside shard_map) ----------------------
+
+    def device_step(state: DearState, batch):
+        idx = lax.axis_index(axis_name)
+        if sharded:
+            if "allgather" in excl:  # ablation: fake the gather with zeros
+                full_bufs = [
+                    lax.dynamic_update_slice_in_dim(
+                        jnp.zeros((b.padded_size,), s.dtype),
+                        s,
+                        idx * b.shard_size,
+                        axis=0,
+                    )
+                    for b, s in zip(plan.buckets, state.buffers)
+                ]
+            else:
+                full_bufs = [
+                    C.all_gather(s, axis_name) for s in state.buffers
+                ]
+        else:
+            full_bufs = list(state.buffers)
+
+        params = F.unpack_all(full_bufs, plan)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        if has_aux:
+            (loss, aux), grads = grad_fn(params, batch)
+        else:
+            loss, grads = grad_fn(params, batch)
+            aux = None
+
+        grad_bufs = F.pack_all(grads, plan, dtype=comm_dtype)
+
+        new_buffers, new_opt = [], []
+        for g, b in enumerate(plan.buckets):
+            gbuf = grad_bufs[g]
+            if sharded:
+                if "reducescatter" in excl:  # ablation: local slice, no comm
+                    gshard = lax.dynamic_slice_in_dim(
+                        gbuf, idx * b.shard_size, b.shard_size
+                    )
+                else:
+                    gshard = C.reduce_scatter(gbuf, axis_name)
+                grad = gshard.astype(state.buffers[g].dtype) / world
+            elif mode == "allreduce":
+                grad = C.all_reduce(gbuf, axis_name).astype(
+                    state.buffers[g].dtype
+                ) / world
+            elif mode == "rsag":
+                grad = C.all_reduce_rsag(gbuf, axis_name).astype(
+                    state.buffers[g].dtype
+                ) / world
+            else:  # 'rb': two-phase reduce-to-root + broadcast (dopt_rb.py)
+                reduced = C.reduce(gbuf, 0, axis_name)
+                grad = C.broadcast(reduced, 0, axis_name).astype(
+                    state.buffers[g].dtype
+                ) / world
+            new_p, new_o = optimizer.update(grad, state.opt_state[g], state.buffers[g])
+            new_buffers.append(new_p)
+            new_opt.append(new_o)
+
+        metrics = {"loss": lax.pmean(loss, axis_name)}
+        if aux is not None:
+            metrics["aux"] = lax.pmean(aux, axis_name)
+        next_state = DearState(
+            tuple(new_buffers), tuple(new_opt), state.step + 1
+        )
+        return next_state, metrics
+
+    # ---- shard_map wiring --------------------------------------------------
+
+    buf_spec = jax.P(axis_name) if sharded else jax.P()
+
+    def _opt_specs(opt_state):
+        if not sharded:
+            return jax.tree.map(lambda _: jax.P(), opt_state)
+        out = []
+        for b, bucket_state in zip(plan.buckets, opt_state):
+            if opt_spec_fn is not None:
+                out.append(
+                    jax.tree.map(lambda l, i=b.index: opt_spec_fn(i, l), bucket_state)
+                )
+            else:
+                out.append(
+                    jax.tree.map(
+                        lambda l, p=b.padded_size: _opt_bucket_specs(axis_name, p, l),
+                        bucket_state,
+                    )
+                )
+        return tuple(out)
+
+    def _state_specs(state: DearState) -> DearState:
+        return DearState(
+            buffers=tuple(buf_spec for _ in state.buffers),
+            opt_state=_opt_specs(state.opt_state),
+            step=jax.P(),
+        )
+
+    def _batch_specs(batch):
+        return jax.tree.map(lambda _: jax.P(axis_name), batch)
+
+    def init(params) -> DearState:
+        bufs = tuple(F.pack_all(params, plan))
+        opt = tuple(optimizer.init(b) for b in bufs)
+        step0 = jnp.zeros((), jnp.int32)
+        state = DearState(bufs, opt, step0)
+        specs = _state_specs(state)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, jax.sharding.NamedSharding(mesh, s)),
+            state,
+            specs,
+        )
+
+    _compiled: dict = {}
+
+    def step(state: DearState, batch):
+        key = jax.tree.structure((state, batch))
+        fn = _compiled.get(key)
+        if fn is None:
+            state_specs = _state_specs(state)
+            mapped = jax.shard_map(
+                device_step,
+                mesh=mesh,
+                in_specs=(state_specs, _batch_specs(batch)),
+                out_specs=(state_specs, jax.P()),
+                check_vma=False,
+            )
+            fn = jax.jit(mapped, donate_argnums=(0,) if donate else ())
+            _compiled[key] = fn
+        return fn(state, batch)
+
+    def gather_params(state: DearState):
+        """Materialize the full parameter pytree (for eval / checkpointing).
+        Equivalent to the reference reading back `model.parameters()` after
+        the lazy per-module updates have run. In 'dear' mode the buffers are
+        sharded global arrays; XLA inserts the gather automatically."""
+        return F.unpack_all(list(state.buffers), plan)
+
+    return TrainStep(init=init, step=step, gather_params=gather_params,
+                     plan=plan, mesh=mesh)

@@ -1,0 +1,248 @@
+"""Numerical equivalence of every schedule vs a single-device baseline.
+
+The reference could only eyeball norms on a live cluster (test_comm.py) and
+rely on MNIST convergence. Here we assert: DeAR (decoupled RS+AG, sharded
+state), 'rsag', 'rb', and 'allreduce' schedules all reproduce plain
+full-batch SGD to floating-point tolerance, step for step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.ops.fused_sgd import fused_sgd, from_optax
+from dear_pytorch_tpu.parallel import build_train_step
+
+
+def _mlp_params(key):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "dense1": {
+            "kernel": jax.random.normal(k1, (12, 32)) * 0.1,
+            "bias": jnp.zeros((32,)),
+        },
+        "dense2": {
+            "kernel": jax.random.normal(k2, (32, 16)) * 0.1,
+            "bias": jnp.zeros((16,)),
+        },
+        "out": {
+            "kernel": jax.random.normal(k3, (16, 4)) * 0.1,
+            "bias": jnp.zeros((4,)),
+        },
+    }
+
+
+def _forward(params, x):
+    h = jnp.tanh(x @ params["dense1"]["kernel"] + params["dense1"]["bias"])
+    h = jnp.tanh(h @ params["dense2"]["kernel"] + params["dense2"]["bias"])
+    return h @ params["out"]["kernel"] + params["out"]["bias"]
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    logits = _forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(logp * jax.nn.one_hot(y, 4), axis=-1))
+
+
+def _data(key, n=64):
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (n, 12))
+    y = jax.random.randint(ky, (n,), 0, 4)
+    return x, y
+
+
+def _baseline(params, batches, lr=0.1, momentum=0.9, steps=5):
+    """Plain full-batch SGD+momentum (torch semantics) on one device."""
+    opt = fused_sgd(lr=lr, momentum=momentum)
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    states = [opt.init(p.reshape(-1)) for p in flat]
+    losses = []
+    for b in batches[:steps]:
+        loss, grads = jax.value_and_grad(_loss_fn)(params, b)
+        losses.append(float(loss))
+        gflat = jax.tree_util.tree_leaves(grads)
+        new_flat = []
+        for i, (p, g) in enumerate(zip(flat, gflat)):
+            newp, states[i] = opt.update(
+                g.reshape(-1), states[i], p.reshape(-1)
+            )
+            new_flat.append(newp.reshape(p.shape))
+        flat = new_flat
+        params = jax.tree_util.tree_unflatten(treedef, flat)
+    return params, losses
+
+
+@pytest.fixture(scope="module")
+def problem():
+    key = jax.random.PRNGKey(0)
+    params = _mlp_params(key)
+    batches = [_data(jax.random.PRNGKey(100 + i)) for i in range(5)]
+    ref_params, ref_losses = _baseline(params, batches)
+    return params, batches, ref_params, ref_losses
+
+
+@pytest.mark.parametrize("mode", ["dear", "allreduce", "rsag", "rb"])
+def test_schedule_matches_baseline(mesh, world, problem, mode):
+    params, batches, ref_params, ref_losses = problem
+    ts = build_train_step(
+        _loss_fn,
+        params,
+        optimizer=fused_sgd(lr=0.1, momentum=0.9),
+        mesh=mesh,
+        mode=mode,
+        threshold_mb=0.0008,  # tiny threshold -> several buckets
+        donate=False,
+    )
+    assert ts.plan.num_buckets >= 2
+    state = ts.init(params)
+    losses = []
+    for b in batches:
+        state, metrics = ts.step(state, b)
+        losses.append(float(metrics["loss"]))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+    got = ts.gather_params(state)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        got,
+        ref_params,
+    )
+    assert int(state.step) == 5
+
+
+def test_dear_state_is_sharded(mesh, world, problem):
+    params, batches, _, _ = problem
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, mode="dear", threshold_mb=None, donate=False
+    )
+    state = ts.init(params)
+    buf = state.buffers[0]
+    # global padded buffer, sharded across dp: each device holds 1/world
+    shard_bytes = buf.addressable_shards[0].data.size
+    assert shard_bytes == buf.size // world
+    # optimizer state: no momentum configured -> empty tuples
+    ts2 = build_train_step(
+        _loss_fn,
+        params,
+        optimizer=fused_sgd(lr=0.1, momentum=0.9),
+        mesh=mesh,
+        mode="dear",
+        threshold_mb=None,
+        donate=False,
+    )
+    st2 = ts2.init(params)
+    mom = st2.opt_state[0][0]
+    assert mom.addressable_shards[0].data.size == mom.size // world
+
+
+def test_no_fusion_mode(mesh, world, problem):
+    # nearby_layers=1: one bucket per layer (reference no-TF ablation)
+    params, batches, ref_params, ref_losses = problem
+    ts = build_train_step(
+        _loss_fn,
+        params,
+        optimizer=fused_sgd(lr=0.1, momentum=0.9),
+        mesh=mesh,
+        mode="dear",
+        nearby_layers=1,
+        donate=False,
+    )
+    assert ts.plan.num_buckets == 3
+    state = ts.init(params)
+    for b in batches[:2]:
+        state, metrics = ts.step(state, b)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), ref_losses[1], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_exclude_parts_runs(mesh, world, problem):
+    # ablation instruments must execute (numerics intentionally garbage)
+    params, batches, _, _ = problem
+    for excl in (("reducescatter",), ("allgather",)):
+        ts = build_train_step(
+            _loss_fn,
+            params,
+            mesh=mesh,
+            mode="dear",
+            threshold_mb=None,
+            exclude_parts=excl,
+            donate=False,
+        )
+        state = ts.init(params)
+        state, metrics = ts.step(state, batches[0])
+        assert np.isfinite(float(metrics["loss"]))
+    with pytest.raises(ValueError):
+        build_train_step(
+            _loss_fn, params, mesh=mesh, mode="allreduce",
+            exclude_parts=("allgather",),
+        )
+    with pytest.raises(ValueError):
+        build_train_step(_loss_fn, params, mesh=mesh, mode="bogus")
+
+
+def test_optax_adamw_on_shards(mesh, world, problem):
+    import optax
+
+    params, batches, _, _ = problem
+    tx = optax.adamw(1e-3)
+    ts = build_train_step(
+        _loss_fn,
+        params,
+        optimizer=from_optax(tx),
+        mesh=mesh,
+        mode="dear",
+        threshold_mb=0.0008,
+        donate=False,
+    )
+    state = ts.init(params)
+    for b in batches:
+        state, m = ts.step(state, b)
+
+    # parity vs full-tree optax on one device
+    opt_state = tx.init(params)
+    p = params
+    for b in batches:
+        g = jax.grad(_loss_fn)(p, b)
+        upd, opt_state = tx.update(g, opt_state, p)
+        p = optax.apply_updates(p, upd)
+    got = ts.gather_params(state)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+        ),
+        got,
+        p,
+    )
+
+
+def test_comm_dtype_bf16(mesh, world, problem):
+    params, batches, _, _ = problem
+    ts = build_train_step(
+        _loss_fn,
+        params,
+        optimizer=fused_sgd(lr=0.1),
+        mesh=mesh,
+        mode="dear",
+        threshold_mb=None,
+        comm_dtype=jnp.bfloat16,
+        donate=False,
+    )
+    state = ts.init(params)
+    state, m = ts.step(state, batches[0])
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_donation(mesh, world, problem):
+    params, batches, _, _ = problem
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, mode="dear", threshold_mb=None, donate=True
+    )
+    state = ts.init(params)
+    state2, _ = ts.step(state, batches[0])
+    # donated: the old state's buffers are invalidated
+    assert state.buffers[0].is_deleted()
+    assert not state2.buffers[0].is_deleted()

@@ -1,0 +1,117 @@
+"""Property tests for the fusion engine (reference had none — SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.ops import fusion
+
+
+def _params(rng, sizes):
+    """A nested dict pytree with layer-grouped kernel/bias leaves."""
+    tree = {}
+    for i, n in enumerate(sizes):
+        tree[f"layer{i:02d}"] = {
+            "kernel": jnp.asarray(rng.standard_normal((n, 4)), jnp.float32),
+            "bias": jnp.asarray(rng.standard_normal((4,)), jnp.float32),
+        }
+    return tree
+
+
+def test_roundtrip_threshold(rng):
+    params = _params(rng, [8, 16, 128, 3, 700, 9])
+    plan = fusion.plan_by_threshold(params, world=8, threshold_mb=0.002)
+    bufs = fusion.pack_all(params, plan)
+    for b, buf in zip(plan.buckets, bufs):
+        assert buf.shape == (b.padded_size,)
+        assert b.padded_size % 8 == 0
+        assert b.shard_size * 8 == b.padded_size
+    out = fusion.unpack_all(bufs, plan)
+    jax.tree.map(np.testing.assert_array_equal, out, params)
+
+
+def test_threshold_none_single_bucket(rng):
+    params = _params(rng, [8, 16, 32])
+    plan = fusion.plan_by_threshold(params, world=4, threshold_mb=None)
+    assert plan.num_buckets == 1
+    assert plan.buckets[0].size == plan.total_size
+
+
+def test_layer_atomicity(rng):
+    # kernel+bias of one layer must never be split across buckets
+    params = _params(rng, [100, 100, 100, 100])
+    plan = fusion.plan_by_threshold(params, world=2, threshold_mb=0.0001)
+    for b in plan.buckets:
+        layers = {plan.leaves[i].layer for i in b.leaf_ids}
+        for other in plan.buckets:
+            if other.index != b.index:
+                assert layers.isdisjoint(
+                    {plan.leaves[i].layer for i in other.leaf_ids}
+                )
+
+
+def test_nearby_layers(rng):
+    params = _params(rng, [4] * 10)
+    plan = fusion.plan_by_nearby_layers(params, world=2, k=4)
+    # 10 layers, k=4 -> buckets of 4,4,2 layers = 8,8,4 leaves
+    assert [len(b.leaf_ids) for b in plan.buckets] == [8, 8, 4]
+    plan1 = fusion.plan_by_nearby_layers(params, world=2, k=1)
+    assert plan1.num_buckets == 10
+    plan_all = fusion.plan_by_nearby_layers(params, world=2, k=-1)
+    assert plan_all.num_buckets == 1
+
+
+def test_flags(rng):
+    params = _params(rng, [4] * 6)
+    flags = [0, 0, 1, 0, 1, 0]  # split before layers 2 and 4
+    plan = fusion.plan_by_flags(params, world=2, flags=flags)
+    assert plan.num_buckets == 3
+    assert [len(b.leaf_ids) // 2 for b in plan.buckets] == [2, 2, 2]
+    with pytest.raises(ValueError):
+        fusion.plan_by_flags(params, world=2, flags=[0, 1])
+
+
+def test_offsets_contiguous(rng):
+    params = _params(rng, [5, 7, 11])
+    plan = fusion.plan_by_threshold(params, world=8, threshold_mb=None)
+    b = plan.buckets[0]
+    expect = 0
+    for leaf_id, off in zip(b.leaf_ids, b.offsets):
+        assert off == expect
+        expect += plan.leaves[leaf_id].size
+    assert b.size == expect
+
+
+def test_make_plan_precedence(rng):
+    params = _params(rng, [4] * 6)
+    p = fusion.make_plan(params, 2, threshold_mb=1.0, nearby_layers=2)
+    assert p.num_buckets == 3  # nearby wins over threshold
+    p = fusion.make_plan(params, 2, nearby_layers=2, flags=[1] * 6)
+    assert p.num_buckets == 6  # flags win over nearby
+
+
+def test_pack_inside_jit(rng):
+    params = _params(rng, [16, 8])
+    plan = fusion.make_plan(params, world=4, threshold_mb=None)
+
+    @jax.jit
+    def f(p):
+        bufs = fusion.pack_all(p, plan)
+        return fusion.unpack_all(bufs, plan)
+
+    out = f(params)
+    jax.tree.map(np.testing.assert_array_equal, out, params)
+
+
+def test_scalar_and_empty_edge_cases(rng):
+    params = {"a": {"w": jnp.float32(3.0)}, "b": {"w": jnp.ones((3,))}}
+    plan = fusion.make_plan(params, world=8, threshold_mb=None)
+    assert plan.total_size == 4
+    bufs = fusion.pack_all(params, plan)
+    assert bufs[0].shape == (8,)  # padded 4 -> 8
+    out = fusion.unpack_all(bufs, plan)
+    assert np.asarray(out["a"]["w"]) == 3.0
+
+    with pytest.raises(ValueError):
+        fusion.make_plan(params, world=0)
