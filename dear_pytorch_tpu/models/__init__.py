@@ -1,0 +1,95 @@
+"""Model zoo — by-name instantiation parity with the reference benchmarks.
+
+The reference CNN benchmark instantiates ``torchvision.models.<name>()`` from
+a ``--model`` string plus a vendored InceptionV4 (reference
+dear/imagenet_benchmark.py:88-95, dear/inceptionv4.py); the BERT benchmark
+builds HF ``BertForPreTraining`` from local JSON configs
+(dear/bert_benchmark.py:63-86). `get_model(name)` covers the union of the
+names the reference sweep uses (benchmarks.py:21-28) and the rest of each
+family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from dear_pytorch_tpu.models.bert import (  # noqa: F401
+    BERT_BASE,
+    BERT_LARGE,
+    BertConfig,
+    BertForPreTraining,
+    bert_pretraining_loss,
+)
+from dear_pytorch_tpu.models.densenet import (  # noqa: F401
+    DenseNet121,
+    DenseNet169,
+    DenseNet201,
+)
+from dear_pytorch_tpu.models.inception import InceptionV4  # noqa: F401
+from dear_pytorch_tpu.models.mnist import MnistNet  # noqa: F401
+from dear_pytorch_tpu.models.resnet import (  # noqa: F401
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from dear_pytorch_tpu.models.vgg import VGG11, VGG16, VGG19  # noqa: F401
+
+_CNN_REGISTRY: dict[str, Callable] = {
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
+    "densenet121": DenseNet121,
+    "densenet169": DenseNet169,
+    "densenet201": DenseNet201,
+    "inceptionv4": InceptionV4,
+    "vgg11": VGG11,
+    "vgg16": VGG16,
+    "vgg19": VGG19,
+    "mnistnet": MnistNet,
+}
+
+_BERT_REGISTRY: dict[str, Any] = {
+    "bert_base": BERT_BASE,
+    "bert": BERT_LARGE,       # the reference calls BERT-Large just "bert"
+    "bert_large": BERT_LARGE,
+}
+
+
+def cnn_names() -> list[str]:
+    return sorted(_CNN_REGISTRY)
+
+
+def bert_names() -> list[str]:
+    return sorted(_BERT_REGISTRY)
+
+
+def get_model(name: str, *, dtype=jnp.float32, **kwargs):
+    """Instantiate a model by benchmark name.
+
+    CNN names return a flax module taking NHWC images; BERT names return
+    ``BertForPreTraining`` for the matching config. Raises KeyError with the
+    valid names otherwise.
+    """
+    key = name.lower()
+    if key in _CNN_REGISTRY:
+        return _CNN_REGISTRY[key](dtype=dtype, **kwargs)
+    if key in _BERT_REGISTRY:
+        cfg = _BERT_REGISTRY[key]
+        if dtype is not jnp.float32:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, dtype=dtype)
+        return BertForPreTraining(cfg, **kwargs)
+    raise KeyError(
+        f"unknown model {name!r}; CNNs: {cnn_names()}, BERT: {bert_names()}"
+    )
+
+
+def is_bert(name: str) -> bool:
+    return name.lower() in _BERT_REGISTRY

@@ -1,0 +1,52 @@
+"""Synthetic benchmark data — the reference trains its benchmarks on fake
+data (``torch.randn(bs,3,224,224)`` + random labels,
+reference dear/imagenet_benchmark.py:97-103; random token ids,
+dear/bert_benchmark.py:90-99). NHWC here."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def synthetic_image_batch(rng: jax.Array, batch_size: int,
+                          image_size: int = 224, num_classes: int = 1000,
+                          dtype=jnp.float32):
+    """(images [B,H,W,3], labels [B]) — fake ImageNet batch."""
+    k1, k2 = jax.random.split(rng)
+    images = jax.random.normal(
+        k1, (batch_size, image_size, image_size, 3), dtype=dtype)
+    labels = jax.random.randint(k2, (batch_size,), 0, num_classes)
+    return {"image": images, "label": labels}
+
+
+def synthetic_bert_batch(rng: jax.Array, batch_size: int, seq_len: int = 64,
+                         vocab_size: int = 30522,
+                         masked_fraction: float = 0.15):
+    """Random BERT pre-training batch mirroring the reference's generator
+    (dear/bert_benchmark.py:90-99): random input ids, full attention mask,
+    random masked-lm labels on a masked subset (-1 elsewhere, the criterion's
+    ignore_index), random next-sentence labels."""
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    input_ids = jax.random.randint(k1, (batch_size, seq_len), 0, vocab_size)
+    token_type_ids = jnp.zeros((batch_size, seq_len), jnp.int32)
+    attention_mask = jnp.ones((batch_size, seq_len), jnp.int32)
+    is_masked = jax.random.uniform(k2, (batch_size, seq_len)) < masked_fraction
+    mlm_labels = jnp.where(
+        is_masked, jax.random.randint(k3, (batch_size, seq_len), 0, vocab_size),
+        -1)
+    nsp_labels = jax.random.randint(k4, (batch_size,), 0, 2)
+    return {
+        "input_ids": input_ids,
+        "token_type_ids": token_type_ids,
+        "attention_mask": attention_mask,
+        "masked_lm_labels": mlm_labels,
+        "next_sentence_labels": nsp_labels,
+    }
+
+
+def synthetic_mnist_batch(rng: jax.Array, batch_size: int):
+    k1, k2 = jax.random.split(rng)
+    images = jax.random.normal(k1, (batch_size, 28, 28, 1))
+    labels = jax.random.randint(k2, (batch_size,), 0, 10)
+    return {"image": images, "label": labels}

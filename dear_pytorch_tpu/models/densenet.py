@@ -1,0 +1,88 @@
+"""DenseNet — TPU-native NHWC flax implementation.
+
+Parity target: ``torchvision.models.densenet201`` as used by the reference
+sweep (reference benchmarks.py:21-28: densenet201 bs32;
+dear/imagenet_benchmark.py:88-95 instantiates by name).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DenseLayer(nn.Module):
+    """BN-ReLU-Conv1x1 (bottleneck 4k) -> BN-ReLU-Conv3x3 (k), concat."""
+
+    growth_rate: int
+    norm: Any
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x):
+        y = self.norm(name="bn1")(x)
+        y = nn.relu(y)
+        y = self.conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                      name="conv1")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.growth_rate, (3, 3), use_bias=False, name="conv2")(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class TransitionLayer(nn.Module):
+    out_features: int
+    norm: Any
+    conv: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.norm(name="bn")(x)
+        x = nn.relu(x)
+        x = self.conv(self.out_features, (1, 1), use_bias=False, name="conv")(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    block_sizes: Sequence[int]
+    growth_rate: int = 32
+    num_classes: int = 1000
+    num_init_features: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_init_features, (7, 7), strides=(2, 2),
+                 use_bias=False, name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        features = self.num_init_features
+        for i, n_layers in enumerate(self.block_sizes):
+            for j in range(n_layers):
+                x = DenseLayer(self.growth_rate, norm=norm, conv=conv,
+                               name=f"block{i + 1}_layer{j + 1}")(x)
+            features += n_layers * self.growth_rate
+            if i != len(self.block_sizes) - 1:
+                features //= 2
+                x = TransitionLayer(features, norm=norm, conv=conv,
+                                    name=f"transition{i + 1}")(x)
+        x = norm(name="final_bn")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+DenseNet121 = partial(DenseNet, block_sizes=(6, 12, 24, 16))
+DenseNet169 = partial(DenseNet, block_sizes=(6, 12, 32, 32))
+DenseNet201 = partial(DenseNet, block_sizes=(6, 12, 48, 32))

@@ -1,0 +1,141 @@
+"""InceptionV4 — TPU-native NHWC flax implementation.
+
+Parity target: the reference vendors a standard ``inceptionv4.py``
+(reference dear/inceptionv4.py, 358 LoC, byte-identical copies in wfbp/ and
+mgwfbp/) used by the sweep at bs64 (benchmarks.py:21-28). Architecture per
+Szegedy et al. 2016 (Inception-v4): stem, 4x InceptionA, ReductionA,
+7x InceptionB, ReductionB, 3x InceptionC, pooled classifier.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: Any = "SAME"
+    norm: Any = None
+    conv: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.conv(self.features, self.kernel, strides=self.strides,
+                      padding=self.padding, use_bias=False, name="conv")(x)
+        x = self.norm(name="bn")(x)
+        return nn.relu(x)
+
+
+class InceptionV4(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-3, dtype=self.dtype)
+        cbr = partial(ConvBN, norm=norm, conv=conv)
+
+        def maxpool(y, k=(3, 3), s=(2, 2), padding="VALID"):
+            return nn.max_pool(y, k, strides=s, padding=padding)
+
+        def avgpool_same(y):
+            return nn.avg_pool(y, (3, 3), strides=(1, 1), padding="SAME")
+
+        x = x.astype(self.dtype)
+        # ---- stem -----------------------------------------------------------
+        x = cbr(32, (3, 3), strides=(2, 2), padding="VALID", name="stem1")(x)
+        x = cbr(32, (3, 3), padding="VALID", name="stem2")(x)
+        x = cbr(64, (3, 3), name="stem3")(x)
+        x = jnp.concatenate(
+            [maxpool(x),
+             cbr(96, (3, 3), strides=(2, 2), padding="VALID", name="stem4b")(x)],
+            axis=-1)
+        b1 = cbr(64, (1, 1), name="stem5a1")(x)
+        b1 = cbr(96, (3, 3), padding="VALID", name="stem5a2")(b1)
+        b2 = cbr(64, (1, 1), name="stem5b1")(x)
+        b2 = cbr(64, (7, 1), name="stem5b2")(b2)
+        b2 = cbr(64, (1, 7), name="stem5b3")(b2)
+        b2 = cbr(96, (3, 3), padding="VALID", name="stem5b4")(b2)
+        x = jnp.concatenate([b1, b2], axis=-1)
+        x = jnp.concatenate(
+            [cbr(192, (3, 3), strides=(2, 2), padding="VALID", name="stem6a")(x),
+             maxpool(x)],
+            axis=-1)
+
+        # ---- 4 x Inception-A ------------------------------------------------
+        for i in range(4):
+            n = f"mixedA{i + 1}_"
+            x = jnp.concatenate([
+                cbr(96, (1, 1), name=n + "b0")(x),
+                cbr(96, (1, 1), name=n + "b1b")(
+                    avgpool_same(x)),
+                cbr(96, (3, 3), name=n + "b2b")(
+                    cbr(64, (1, 1), name=n + "b2a")(x)),
+                cbr(96, (3, 3), name=n + "b3c")(
+                    cbr(96, (3, 3), name=n + "b3b")(
+                        cbr(64, (1, 1), name=n + "b3a")(x))),
+            ], axis=-1)
+
+        # ---- Reduction-A ----------------------------------------------------
+        x = jnp.concatenate([
+            maxpool(x),
+            cbr(384, (3, 3), strides=(2, 2), padding="VALID", name="redA_b1")(x),
+            cbr(256, (3, 3), strides=(2, 2), padding="VALID", name="redA_b2c")(
+                cbr(224, (3, 3), name="redA_b2b")(
+                    cbr(192, (1, 1), name="redA_b2a")(x))),
+        ], axis=-1)
+
+        # ---- 7 x Inception-B ------------------------------------------------
+        for i in range(7):
+            n = f"mixedB{i + 1}_"
+            x = jnp.concatenate([
+                cbr(384, (1, 1), name=n + "b0")(x),
+                cbr(128, (1, 1), name=n + "b1b")(avgpool_same(x)),
+                cbr(256, (1, 7), name=n + "b2c")(
+                    cbr(224, (7, 1), name=n + "b2b")(
+                        cbr(192, (1, 1), name=n + "b2a")(x))),
+                cbr(256, (7, 1), name=n + "b3e")(
+                    cbr(224, (1, 7), name=n + "b3d")(
+                        cbr(224, (7, 1), name=n + "b3c")(
+                            cbr(192, (1, 7), name=n + "b3b")(
+                                cbr(192, (1, 1), name=n + "b3a")(x))))),
+            ], axis=-1)
+
+        # ---- Reduction-B ----------------------------------------------------
+        x = jnp.concatenate([
+            maxpool(x),
+            cbr(192, (3, 3), strides=(2, 2), padding="VALID", name="redB_b1b")(
+                cbr(192, (1, 1), name="redB_b1a")(x)),
+            cbr(320, (3, 3), strides=(2, 2), padding="VALID", name="redB_b2d")(
+                cbr(320, (7, 1), name="redB_b2c")(
+                    cbr(256, (1, 7), name="redB_b2b")(
+                        cbr(256, (1, 1), name="redB_b2a")(x)))),
+        ], axis=-1)
+
+        # ---- 3 x Inception-C ------------------------------------------------
+        for i in range(3):
+            n = f"mixedC{i + 1}_"
+            b2 = cbr(384, (1, 1), name=n + "b2a")(x)
+            b3 = cbr(512, (1, 3), name=n + "b3b")(
+                cbr(448, (3, 1), name=n + "b3bb")(
+                    cbr(384, (1, 1), name=n + "b3a")(x)))
+            x = jnp.concatenate([
+                cbr(256, (1, 1), name=n + "b0")(x),
+                cbr(256, (1, 1), name=n + "b1b")(avgpool_same(x)),
+                cbr(256, (1, 3), name=n + "b2b")(b2),
+                cbr(256, (3, 1), name=n + "b2c")(b2),
+                cbr(256, (1, 3), name=n + "b3c")(b3),
+                cbr(256, (3, 1), name=n + "b3d")(b3),
+            ], axis=-1)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,32 @@
+"""MNIST convnet — the user-facing example model.
+
+Parity target: the reference example's ``Net`` (reference
+examples/mnist/pytorch_mnist.py:60-78: conv 10x5x5, conv 20x5x5 + dropout,
+fc 50, fc 10), used by the convergence smoke test (SURVEY.md §4.3).
+NHWC, functional dropout via an explicit rng.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistNet(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", name="conv1")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(20, (5, 5), padding="VALID", name="conv2")(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(10, name="fc2")(x)
+        return nn.log_softmax(x.astype(jnp.float32))

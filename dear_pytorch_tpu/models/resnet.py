@@ -1,0 +1,124 @@
+"""ResNet family — TPU-native (NHWC, bfloat16-friendly) flax implementation.
+
+Capability parity with the reference's torchvision CNN benchmarks
+(reference dear/imagenet_benchmark.py:88-95 instantiates
+``torchvision.models.<name>()`` by string). The reference sweep uses
+resnet50 (benchmarks.py:21-28); we provide the standard v1.5 family.
+
+TPU-first choices (not a torchvision translation):
+  - NHWC layout (XLA's native TPU conv layout; torchvision is NCHW).
+  - ``dtype`` threads a compute dtype (use bfloat16 on TPU); params stay
+    fp32 masters, casts happen at op boundaries so the MXU sees bf16.
+  - BatchNorm is folded into flax's BatchNorm with running stats carried
+    explicitly (functional state, no module mutation).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (ResNet v1.5: stride on the 3x3)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            use_bias=False, name="conv2",
+        )(y)
+        y = self.norm(name="bn2")(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = self.norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, name="downsample_conv",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 block (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            use_bias=False, name="conv1",
+        )(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), use_bias=False, name="conv2")(y)
+        y = self.norm(name="bn2", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides),
+                use_bias=False, name="downsample_conv",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: ModuleDef = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                 name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(
+                    self.width * 2**i, strides=strides, conv=conv, norm=norm,
+                    name=f"stage{i + 1}_block{j + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3), block=BottleneckBlock)

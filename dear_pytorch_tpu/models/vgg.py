@@ -1,0 +1,47 @@
+"""VGG — TPU-native NHWC flax implementation (torchvision-name parity:
+vgg16/vgg19 are accepted by the reference benchmark's by-name instantiation,
+reference dear/imagenet_benchmark.py:88-95)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFG = {
+    "vgg11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "vgg16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"),
+    "vgg19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        i = 0
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                i += 1
+                x = nn.relu(conv(v, (3, 3), name=f"conv{i}")(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc3")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = partial(VGG, cfg=_CFG["vgg11"])
+VGG16 = partial(VGG, cfg=_CFG["vgg16"])
+VGG19 = partial(VGG, cfg=_CFG["vgg19"])

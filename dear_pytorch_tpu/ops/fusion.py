@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dear_pytorch_tpu.comm.collectives import padded_length
+
 
 def _path_str(path) -> str:
     parts = []
@@ -200,6 +202,8 @@ def plan_by_nearby_layers(params, world: int, k: int = 4) -> "FusionPlan":
     ``k=1`` disables fusion (one bucket per layer); ``k=-1`` fuses all
     layers into a single bucket (the wait-time tuner's starting point,
     dopt_rsag_wt.py)."""
+    if k < 1 and k != -1:
+        raise ValueError(f"nearby_layers must be >= 1 or -1 (fuse all), got {k}")
     specs, treedef = _leaf_specs(params)
     layers = _layers(specs)
     if k == -1:
@@ -264,7 +268,7 @@ def _build_plan(specs, groups, world, treedef) -> FusionPlan:
             seen.add(i)
             offsets.append(off)
             off += specs[i].size
-        padded = -(-off // world) * world if off else 0
+        padded = padded_length(off, world)
         buckets.append(
             Bucket(
                 index=idx,
@@ -344,9 +348,3 @@ def unpack_all(buffers: Sequence[jax.Array], plan: FusionPlan):
         for leaf_id, x in pieces.items():
             flat[leaf_id] = x.astype(plan.leaves[leaf_id].dtype)
     return jax.tree_util.tree_unflatten(plan.treedef, flat)
-
-
-def shard_spec(plan: FusionPlan) -> list[tuple[int]]:
-    """Per-bucket shard shapes ``(shard_size,)`` — what reduce-scatter emits
-    and what the sharded optimizer state is shaped like."""
-    return [(b.shard_size,) for b in plan.buckets]
