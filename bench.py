@@ -1,0 +1,107 @@
+"""Headline benchmark: ResNet-50 end-to-end training throughput per chip.
+
+Reproduces the reference's measurement protocol (dear/imagenet_benchmark.py:
+151-172): 10 warmup batches, then 5 timed runs of 10 batches each; reports
+images/sec as mean over runs. Runs the full DeAR train step (pack →
+reduce-scatter → fused-SGD → all-gather schedule; trivial collectives at
+world=1) with bf16 compute / f32 master params — the TPU-first configuration.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+``vs_baseline`` is relative to BASELINE_IMG_SEC, the first end-to-end
+measurement of this framework on the session's single TPU v5e chip (round 1);
+the reference publishes no numbers of its own (BASELINE.md), so progress is
+tracked against our own round-1 throughput.
+
+Timing protocol for the axon tunnel (remote device): dispatch each timed
+run's steps asynchronously and fetch ONE scalar that depends on the last
+step; per-step host syncs would add ~60ms RPC latency each and
+``block_until_ready`` on a remote buffer may return early.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Round-1 pin: ResNet-50 bs=64 bf16 train step, TPU v5 lite (1 chip),
+# ~33.5 ms/step.
+BASELINE_IMG_SEC = 1910.0
+
+BATCH_SIZE = 64
+WARMUP_BATCHES = 10
+NUM_ITERS = 5
+NUM_BATCHES_PER_ITER = 10
+
+
+def main() -> None:
+    from dear_pytorch_tpu import models
+    from dear_pytorch_tpu.comm import backend
+    from dear_pytorch_tpu.models import data
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import dear as D
+
+    mesh = backend.init()
+    model = models.get_model("resnet50", dtype=jnp.bfloat16)
+    batch = data.synthetic_image_batch(
+        jax.random.PRNGKey(0), BATCH_SIZE, dtype=jnp.bfloat16
+    )
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, batch["image"], train=False
+    )
+    params = variables["params"]
+    model_state = {"batch_stats": variables["batch_stats"]}
+
+    def loss_fn(p, mstate, b):
+        logits, new_state = model.apply(
+            {"params": p, **mstate}, b["image"], train=True,
+            mutable=["batch_stats"],
+        )
+        return data.softmax_xent(logits, b["label"]), new_state
+
+    ts = D.build_train_step(
+        loss_fn,
+        params,
+        mesh=mesh,
+        mode="dear",
+        threshold_mb=25.0,
+        optimizer=fused_sgd(lr=0.01, momentum=0.9),
+        comm_dtype=jnp.bfloat16,
+        model_state_template=model_state,
+    )
+    state = ts.init(params, model_state)
+
+    for _ in range(WARMUP_BATCHES):
+        state, metrics = ts.step(state, batch)
+    float(metrics["loss"])  # drain the pipeline once before timing
+
+    times = []
+    for _ in range(NUM_ITERS):
+        t0 = time.perf_counter()
+        for _ in range(NUM_BATCHES_PER_ITER):
+            state, metrics = ts.step(state, batch)
+        float(metrics["loss"])  # one device->host scalar fetch per run
+        times.append(time.perf_counter() - t0)
+
+    img_secs = [BATCH_SIZE * NUM_BATCHES_PER_ITER / t for t in times]
+    value = float(np.mean(img_secs))
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_bs64_train_img_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "img/s",
+                "vs_baseline": round(value / BASELINE_IMG_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Benchmark harness — TPU port of the reference's synthetic benchmarks
+(reference dear/imagenet_benchmark.py, dear/bert_benchmark.py) and batch
+driver (reference benchmarks.py).
+
+Entry points:
+  python -m dear_pytorch_tpu.benchmarks.imagenet --model resnet50 ...
+  python -m dear_pytorch_tpu.benchmarks.bert --model bert ...
+  python -m dear_pytorch_tpu.benchmarks.driver          # full sweep
+"""
+
+from dear_pytorch_tpu.benchmarks.runner import (  # noqa: F401
+    BenchResult,
+    run_timed,
+)

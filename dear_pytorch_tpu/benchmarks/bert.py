@@ -1,0 +1,145 @@
+"""Synthetic BERT pre-training benchmark (reference dear/bert_benchmark.py).
+
+Trains ``BertForPreTraining`` (Base or Large, the reference's JSON configs)
+on random token batches with the MLM+NSP criterion and prints sentences/sec
+in the reference's format.
+
+Example:
+  python -m dear_pytorch_tpu.benchmarks.bert \
+      --model bert --batch-size 32 --sentence-len 64 --fp16
+"""
+
+from __future__ import annotations
+
+import argparse
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from dear_pytorch_tpu import models
+from dear_pytorch_tpu.benchmarks import runner
+from dear_pytorch_tpu.comm import backend
+from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.models import data
+from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+from dear_pytorch_tpu.parallel import dear as D
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU Synthetic BERT Benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--model", type=str, default="bert",
+                   help=f"one of {models.bert_names()} "
+                        "('bert' = BERT-Large, reference naming)")
+    p.add_argument("--sentence-len", type=int, default=128,
+                   help="input sentence length (the reference launcher "
+                        "uses 64, dear/horovod_mpi_cj.sh:6)")
+    p.add_argument("--num-hidden-layers", type=int, default=None,
+                   help="override encoder depth (scaling studies / smoke "
+                        "tests); default = the model's config")
+    runner.add_common_args(p)
+    p.set_defaults(batch_size=8, base_lr=2e-5, momentum=0.0)
+    return p
+
+
+def main(argv=None) -> runner.BenchResult:
+    args = build_parser().parse_args(argv)
+    mesh = backend.init()
+    world = backend.dp_size(mesh)
+
+    dtype = jnp.bfloat16 if args.fp16 else jnp.float32
+    model = models.get_model(args.model, dtype=dtype)
+    if args.num_hidden_layers is not None:
+        import dataclasses
+
+        model = models.BertForPreTraining(
+            dataclasses.replace(
+                model.config, num_hidden_layers=args.num_hidden_layers
+            )
+        )
+    cfg = model.config
+
+    global_bs = args.batch_size * world
+    batch = data.synthetic_bert_batch(
+        jax.random.PRNGKey(0), global_bs, seq_len=args.sentence_len,
+        vocab_size=cfg.vocab_size,
+    )
+    sharding = jax.sharding.NamedSharding(mesh, jax.P(DP_AXIS))
+    batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, batch["input_ids"], train=False
+    )["params"]
+
+    def loss_fn(p, b, rng):
+        logits, nsp = model.apply(
+            {"params": p}, b["input_ids"], b["token_type_ids"],
+            b["attention_mask"], train=True, rngs={"dropout": rng},
+        )
+        return models.bert_pretraining_loss(
+            logits.astype(jnp.float32), nsp.astype(jnp.float32),
+            b["masked_lm_labels"], b["next_sentence_labels"],
+        )
+
+    if args.compressor != "none" or args.density < 1.0:
+        warnings.warn(
+            "compressor/density are accepted for CLI parity but ignored by "
+            "the DeAR schedule (reference behavior)."
+        )
+
+    ts = D.build_train_step(
+        loss_fn,
+        params,
+        mesh=mesh,
+        mode=args.mode,
+        threshold_mb=runner.threshold_mb(args),
+        nearby_layers=args.nearby_layers,
+        exclude_parts=runner.parse_exclude_parts(args.exclude_parts),
+        optimizer=fused_sgd(lr=args.base_lr, momentum=args.momentum),
+        comm_dtype=jnp.bfloat16 if args.fp16 else None,
+        rng_seed=42,
+    )
+    state = ts.init(params)
+
+    name = {"bert": "BERT Large", "bert_large": "BERT Large",
+            "bert_base": "BERT Base"}[args.model.lower()]
+    runner.log(f"{name} Pretraining, Sentence len: {args.sentence_len}")
+    runner.log(f"Batch size: {args.batch_size} (per device), "
+               f"{global_bs} global")
+    runner.log(f"Number of {runner.device_name()}s: {world}")
+    runner.log(f"Schedule: {args.mode}; "
+               f"fusion: {ts.plan.num_buckets} bucket(s)")
+
+    holder = {"state": state, "metrics": None}
+
+    def step_fn():
+        holder["state"], holder["metrics"] = ts.step(holder["state"], batch)
+
+    def sync():
+        # One device->host scalar fetch drains the in-order pipeline (see
+        # bench.py's tunnel note).
+        float(holder["metrics"]["loss"])
+
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+    try:
+        result = runner.run_timed(
+            step_fn,
+            batch_size=args.batch_size,
+            num_warmup_batches=args.num_warmup_batches,
+            num_batches_per_iter=args.num_batches_per_iter,
+            num_iters=args.num_iters,
+            unit="sen",
+            sync=sync,
+        )
+    finally:
+        if args.profile_dir:
+            jax.profiler.stop_trace()
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Shared benchmark runner: the reference's measurement protocol, TPU-native.
+
+Protocol parity (reference dear/imagenet_benchmark.py:151-172):
+  - ``num_warmup_batches`` untimed steps (also absorbs jit compilation),
+  - ``num_iters`` timed runs of ``num_batches_per_iter`` steps each,
+  - per-iter throughput; final mean ± 1.96σ; a ``Total ... <DEV>(s): N +-C``
+    line whose shape the batch driver scrapes (reference benchmarks.py:119-128).
+
+TPU-native differences (deliberate):
+  - One *process* drives all chips (SPMD); "Number of TPUs" is the device
+    world, not the process count. Throughput-per-device keeps the reference's
+    per-GPU meaning.
+  - A timed run is jitted end-to-end; a single `block_until_ready` per timed
+    run replaces per-step ``cuda.synchronize`` (which would serialize the
+    pipelined schedule XLA builds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from dear_pytorch_tpu.comm import backend
+
+
+@dataclasses.dataclass
+class BenchResult:
+    unit: str                  # 'img' or 'sen'
+    device: str                # 'TPU' (or 'CPU' in emulation)
+    world: int
+    per_device_mean: float
+    per_device_conf: float     # 1.96 sigma
+    iter_time_mean: float
+    iter_time_conf: float
+    per_iter: list[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def total_mean(self) -> float:
+        return self.world * self.per_device_mean
+
+    @property
+    def total_conf(self) -> float:
+        return self.world * self.per_device_conf
+
+
+def log(s: str, nl: bool = True) -> None:
+    """Rank-0 printing (reference dear/imagenet_benchmark.py:139-142)."""
+    if backend.rank() != 0:
+        return
+    print(s, end="\n" if nl else "", flush=True)
+
+
+def device_name() -> str:
+    plat = jax.devices()[0].platform
+    return {"tpu": "TPU", "cpu": "CPU", "gpu": "GPU"}.get(plat, plat.upper())
+
+
+def run_timed(
+    step_fn: Callable[[], Any],
+    *,
+    batch_size: int,
+    num_warmup_batches: int = 10,
+    num_batches_per_iter: int = 10,
+    num_iters: int = 5,
+    unit: str = "img",
+    sync: Optional[Callable[[], None]] = None,
+) -> BenchResult:
+    """Run the warmup + timed-iteration protocol around ``step_fn``.
+
+    ``step_fn`` performs one training step (async dispatch is fine);
+    ``sync`` blocks until all dispatched work finished (defaults to
+    `jax.effects_barrier`-free no-op — pass one!).
+    """
+    dev = device_name()
+    world = backend.device_count()
+
+    log("Running warmup...")
+    for _ in range(num_warmup_batches):
+        step_fn()
+    if sync is not None:
+        sync()
+
+    log("Running benchmark...")
+    per_iter, iter_times = [], []
+    for x in range(num_iters):
+        t0 = time.perf_counter()
+        for _ in range(num_batches_per_iter):
+            step_fn()
+        if sync is not None:
+            sync()
+        dt = time.perf_counter() - t0
+        thr = batch_size * num_batches_per_iter / dt
+        log(f"Iter #{x}: {thr:.1f} {unit}/sec per {dev}")
+        per_iter.append(thr)
+        iter_times.append(dt / num_batches_per_iter)
+
+    res = BenchResult(
+        unit=unit,
+        device=dev,
+        world=world,
+        per_device_mean=float(np.mean(per_iter)),
+        per_device_conf=float(1.96 * np.std(per_iter)),
+        iter_time_mean=float(np.mean(iter_times)),
+        iter_time_conf=float(1.96 * np.std(iter_times)),
+        per_iter=per_iter,
+    )
+    log(f"Iteration time: {res.iter_time_mean:.3f} +-{res.iter_time_conf:.3f}")
+    log(f"{unit.capitalize()}/sec per {dev}: "
+        f"{res.per_device_mean:.1f} +-{res.per_device_conf:.1f}")
+    log(f"Total {unit}/sec on {res.world} {dev}(s): "
+        f"{res.total_mean:.1f} +-{res.total_conf:.1f}")
+    return res
+
+
+def add_common_args(parser) -> None:
+    """The reference benchmarks' shared CLI surface
+    (dear/imagenet_benchmark.py:24-56), minus CUDA-isms, plus the unified
+    ``--mode`` switch that replaces the reference's edit-an-import-line
+    backend selection (dear/imagenet_benchmark.py:14-16)."""
+    parser.add_argument("--fp16", action="store_true", default=False,
+                        help="bfloat16 compute (TPU mixed precision)")
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="input batch size PER DEVICE")
+    parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-iters", type=int, default=5)
+    parser.add_argument("--mode", type=str, default="dear",
+                        choices=["dear", "allreduce", "rsag", "rb"],
+                        help="communication schedule (replaces the "
+                             "reference's per-directory baselines)")
+    parser.add_argument("--threshold", type=float, default=25.0,
+                        help="tensor-fusion threshold in MB "
+                             "(reference THRESHOLD, dear/dopt_rsag.py:37); "
+                             "<=0 disables the limit (single bucket)")
+    parser.add_argument("--nearby-layers", type=int, default=None,
+                        help="fuse every k layers instead of by threshold")
+    parser.add_argument("--exclude-parts", type=str, default="",
+                        help="comma list of {reducescatter,allgather} "
+                             "(time-breakdown ablations, dear/batch.sh)")
+    parser.add_argument("--compressor", type=str, default="none",
+                        help="gradient compressor (reference "
+                             "dear/compression.py registry)")
+    parser.add_argument("--density", type=float, default=1.0,
+                        help="sparsification density for topk-family "
+                             "compressors")
+    parser.add_argument("--base-lr", type=float, default=0.01)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="write a jax.profiler trace of the timed "
+                             "region here")
+
+
+def parse_exclude_parts(s: str) -> tuple[str, ...]:
+    parts = tuple(p.strip() for p in s.split(",") if p.strip())
+    for p in parts:
+        if p not in ("reducescatter", "allgather"):
+            raise SystemExit(f"--exclude-parts: unknown part {p!r}")
+    return parts
+
+
+def threshold_mb(args) -> Optional[float]:
+    return None if args.threshold is None or args.threshold <= 0 else float(args.threshold)

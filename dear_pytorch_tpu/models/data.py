@@ -9,6 +9,14 @@ import jax
 import jax.numpy as jnp
 
 
+def softmax_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy in fp32 (the CNN benchmarks' criterion,
+    reference dear/imagenet_benchmark.py: ``F.cross_entropy``)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jax.nn.one_hot(labels, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
 def synthetic_image_batch(rng: jax.Array, batch_size: int,
                           image_size: int = 224, num_classes: int = 1000,
                           dtype=jnp.float32):

@@ -23,7 +23,8 @@ class MnistNet(nn.Module):
         x = nn.Conv(10, (5, 5), padding="VALID", name="conv1")(x)
         x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
         x = nn.Conv(20, (5, 5), padding="VALID", name="conv2")(x)
-        x = nn.Dropout(0.5, deterministic=not train)(x)
+        # channel dropout (Dropout2d semantics: whole feature maps drop)
+        x = nn.Dropout(0.5, broadcast_dims=(1, 2), deterministic=not train)(x)
         x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(50, name="fc1")(x))

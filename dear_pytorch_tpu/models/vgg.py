@@ -37,7 +37,9 @@ class VGG(nn.Module):
                 x = nn.relu(conv(v, (3, 3), name=f"conv{i}")(x))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
         x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc3")(x)
         return x.astype(jnp.float32)
 

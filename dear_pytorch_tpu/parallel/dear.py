@@ -67,18 +67,23 @@ class DearState(NamedTuple):
     ``buffers[g]`` is bucket g's flat padded master-param buffer. In 'dear'
     mode its global array is sharded along dim 0 (each device owns its
     reduce-scatter slice); in baseline modes it is replicated. ``opt_state``
-    mirrors that layout. ``step`` is a replicated scalar.
+    mirrors that layout. ``step`` is a replicated scalar. ``model_state``
+    holds non-trained model collections (BatchNorm running stats etc.),
+    replicated; float leaves are cross-replica averaged each step (the
+    reference, like DDP, keeps BN stats replica-local and divergent — here
+    they stay consistent, which also makes them trivially checkpointable).
     """
 
     buffers: tuple
     opt_state: tuple
     step: jax.Array
+    model_state: Any = ()
 
 
 class TrainStep(NamedTuple):
     """What `build_train_step` returns."""
 
-    init: Callable[[Any], DearState]
+    init: Callable[..., DearState]  # (params, model_state=None) -> DearState
     step: Callable[[DearState, Any], tuple[DearState, dict]]
     gather_params: Callable[[DearState], Any]
     plan: F.FusionPlan
@@ -120,6 +125,8 @@ def build_train_step(
     has_aux: bool = False,
     donate: bool = True,
     opt_spec_fn: Optional[Callable[[int, Any], Any]] = None,
+    model_state_template=None,
+    rng_seed: Optional[int] = None,
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -137,6 +144,18 @@ def build_train_step(
         collective for time-breakdown ablations ('dear' mode only).
       comm_dtype: cast gradients to this dtype for communication (e.g.
         jnp.bfloat16); update math stays in the param dtype.
+      model_state_template: pytree of non-trained model collections (e.g.
+        flax ``batch_stats``). When given, ``loss_fn`` is called as
+        ``loss_fn(params, model_state, batch)`` and must return
+        ``(loss, new_model_state)`` (with ``has_aux=True``:
+        ``(loss, (new_model_state, aux))``). Float leaves of the returned
+        state are averaged across replicas; integer/bool leaves are maxed
+        (deterministic consensus). Other leaves must already be replicated —
+        divergence there is NOT detected (``check_vma=False``).
+      rng_seed: when given, ``loss_fn`` receives a per-step, per-device PRNG
+        key as its last positional argument (folded from seed, step counter,
+        and device index) — use for dropout. Without it, stochastic layers
+        need a key closed over by ``loss_fn`` (constant across steps).
       donate: donate the state argument so buffers are updated in place.
       opt_spec_fn: optional ``(bucket_index, state_leaf) -> PartitionSpec``
         override for optimizer-state sharding (see `_opt_bucket_specs`).
@@ -166,6 +185,7 @@ def build_train_step(
         )
     sharded = mode == "dear"
     excl = frozenset(exclude_parts)
+    has_model_state = model_state_template is not None
 
     # ---- per-device step body (runs inside shard_map) ----------------------
 
@@ -190,12 +210,43 @@ def build_train_step(
             full_bufs = list(state.buffers)
 
         params = F.unpack_all(full_bufs, plan)
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
-        if has_aux:
-            (loss, aux), grads = grad_fn(params, batch)
+        if rng_seed is not None:
+            step_rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(rng_seed), state.step),
+                idx,
+            )
+            extra_args: tuple = (step_rng,)
         else:
-            loss, grads = grad_fn(params, batch)
-            aux = None
+            extra_args = ()
+        # Canonicalize every loss_fn variant to (loss, (model_state, aux)).
+        def canonical_loss(p):
+            if has_model_state:
+                loss, out = loss_fn(p, state.model_state, batch, *extra_args)
+                ms, aux = out if has_aux else (out, None)
+                return loss, (ms, aux)
+            if has_aux:
+                loss, aux = loss_fn(p, batch, *extra_args)
+                return loss, ((), aux)
+            return loss_fn(p, batch, *extra_args), ((), None)
+
+        (loss, (new_model_state, aux)), grads = jax.value_and_grad(
+            canonical_loss, has_aux=True
+        )(params)
+        if has_model_state:
+            # Keep replicated state consistent across replicas (each saw a
+            # different batch shard): average float stats, max-consensus
+            # integer/bool counters.
+            def _sync_leaf(x):
+                dt = jnp.result_type(x)
+                if jnp.issubdtype(dt, jnp.floating):
+                    return lax.pmean(x, axis_name)
+                if jnp.issubdtype(dt, jnp.integer) or dt == jnp.bool_:
+                    return lax.pmax(x, axis_name)
+                return x
+
+            new_model_state = jax.tree.map(_sync_leaf, new_model_state)
+        else:
+            new_model_state = state.model_state
 
         grad_bufs = F.pack_all(grads, plan, dtype=comm_dtype)
 
@@ -231,7 +282,8 @@ def build_train_step(
         if aux is not None:
             metrics["aux"] = lax.pmean(aux, axis_name)
         next_state = DearState(
-            tuple(new_buffers), tuple(new_opt), state.step + 1
+            tuple(new_buffers), tuple(new_opt), state.step + 1,
+            new_model_state,
         )
         return next_state, metrics
 
@@ -262,16 +314,25 @@ def build_train_step(
             buffers=tuple(buf_spec for _ in state.buffers),
             opt_state=_opt_specs(state.opt_state),
             step=jax.P(),
+            model_state=jax.tree.map(lambda _: jax.P(), state.model_state),
         )
 
     def _batch_specs(batch):
         return jax.tree.map(lambda _: jax.P(axis_name), batch)
 
-    def init(params) -> DearState:
+    def init(params, model_state=None) -> DearState:
+        if model_state is not None and not has_model_state:
+            raise ValueError(
+                "init() got model_state but build_train_step was called "
+                "without model_state_template — the loss_fn would never "
+                "see it"
+            )
+        if has_model_state and model_state is None:
+            model_state = model_state_template
         bufs = tuple(F.pack_all(params, plan))
         opt = tuple(optimizer.init(b) for b in bufs)
         step0 = jnp.zeros((), jnp.int32)
-        state = DearState(bufs, opt, step0)
+        state = DearState(bufs, opt, step0, model_state if has_model_state else ())
         specs = _state_specs(state)
         return jax.tree.map(
             lambda x, s: jax.device_put(x, jax.sharding.NamedSharding(mesh, s)),

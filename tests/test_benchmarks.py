@@ -1,0 +1,56 @@
+"""Benchmark harness tests: CLI surface, protocol, and the scrape-able
+output contract (reference benchmarks.py:119-128 greps the
+``Total ... <DEV>(s): N +-C`` line)."""
+
+import re
+
+import pytest
+
+from dear_pytorch_tpu.benchmarks import bert as bert_bench
+from dear_pytorch_tpu.benchmarks import imagenet as imagenet_bench
+
+
+TINY = ["--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+        "--num-iters", "2"]
+
+
+def test_imagenet_cli_output_contract(mesh, capsys):
+    res = imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4"] + TINY
+    )
+    out = capsys.readouterr().out
+    m = re.search(r"Total img/sec on (\d+) \w+\(s\): ([\d.]+) \+-([\d.]+)",
+                  out)
+    assert m, out
+    assert int(m.group(1)) == 8
+    assert abs(float(m.group(2)) - res.total_mean) < 0.1
+    assert "Running warmup..." in out and "Running benchmark..." in out
+    # per-device x world == total
+    assert res.total_mean == pytest.approx(8 * res.per_device_mean)
+
+
+def test_imagenet_modes_and_ablations(mesh):
+    # baseline schedule + exclude-parts ablation parse & run
+    imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4", "--mode", "allreduce"]
+        + TINY
+    )
+    imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4",
+         "--exclude-parts", "allgather"] + TINY
+    )
+    with pytest.raises(SystemExit):
+        imagenet_bench.main(
+            ["--model", "mnistnet", "--exclude-parts", "bogus"] + TINY
+        )
+
+
+def test_bert_cli_output_contract(mesh, capsys):
+    res = bert_bench.main(
+        ["--model", "bert_base", "--num-hidden-layers", "1",
+         "--sentence-len", "16", "--batch-size", "2"] + TINY
+    )
+    out = capsys.readouterr().out
+    assert re.search(r"Total sen/sec on 8 \w+\(s\): ", out), out
+    assert "BERT Base Pretraining, Sentence len: 16" in out
+    assert res.unit == "sen"

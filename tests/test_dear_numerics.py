@@ -246,3 +246,92 @@ def test_donation(mesh, world, problem):
     # donated: the old state's buffers are invalidated
     assert state.buffers[0].is_deleted()
     assert not state2.buffers[0].is_deleted()
+
+
+def test_model_state_batchnorm(mesh, world):
+    """Non-trained model collections (BN running stats) are carried through
+    the step, updated, and cross-replica averaged (the reference/DDP leave
+    them replica-local; see DearState docstring)."""
+    import flax.linen as nn
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            x = nn.Dense(8)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            return nn.Dense(4)(x)
+
+    model = TinyBN()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 12)) * 3.0 + 1.0
+    y = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 4)
+    variables = model.init({"params": jax.random.PRNGKey(2)}, x, train=False)
+    params = variables["params"]
+    mstate = {"batch_stats": variables["batch_stats"]}
+
+    def loss_fn(p, ms, b):
+        bx, by = b
+        logits, new_state = model.apply(
+            {"params": p, **ms}, bx, train=True, mutable=["batch_stats"]
+        )
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.sum(logp * jax.nn.one_hot(by, 4), axis=-1))
+        return loss, new_state
+
+    ts = build_train_step(
+        loss_fn,
+        params,
+        optimizer=fused_sgd(lr=0.05),
+        mesh=mesh,
+        mode="dear",
+        threshold_mb=None,
+        model_state_template=mstate,
+        donate=False,
+    )
+    state = ts.init(params, mstate)
+    losses = []
+    for i in range(4):
+        state, m = ts.step(state, (x, y))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    stats = state.model_state["batch_stats"]["BatchNorm_0"]
+    mean = np.asarray(stats["mean"])
+    assert np.abs(mean).sum() > 0  # running stats actually moved
+    # Replica consistency: every device's copy of the nominally replicated
+    # stats must be identical (guards the pmean in _sync_leaf; with
+    # check_vma=False, divergence would otherwise be silent).
+    shards = [np.asarray(s.data) for s in stats["mean"].addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+    # ... and equal to the pmean of per-device batch stats, not any single
+    # device's local value: devices saw different batch shards, so a missing
+    # pmean could not produce shard-identical values matched here.
+    assert len(shards) == 8
+
+
+def test_init_rejects_unexpected_model_state(mesh):
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(_loss_fn, params, mesh=mesh, threshold_mb=None,
+                          donate=False)
+    with pytest.raises(ValueError, match="model_state"):
+        ts.init(params, {"batch_stats": {}})
+
+
+def test_rng_seed_varies_per_step(mesh):
+    """With rng_seed, loss_fn receives a fresh per-step key (dropout masks
+    change across steps)."""
+    params = {"w": {"kernel": jnp.ones((4, 4))}}
+
+    def loss2(p, b, rng):
+        mask = jax.random.bernoulli(rng, 0.5, (4,))
+        return jnp.sum((b * mask) @ p["w"]["kernel"])
+
+    ts = build_train_step(loss2, params, mesh=mesh, threshold_mb=None,
+                          rng_seed=7, donate=False)
+    state = ts.init(params)
+    b = jnp.ones((8, 4))
+    losses = []
+    for _ in range(3):
+        state, m = ts.step(state, b)
+        losses.append(float(m["loss"]))
+    # distinct dropout masks -> losses differ across steps with prob ~1
+    assert len(set(losses)) > 1, losses
