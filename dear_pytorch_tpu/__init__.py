@@ -41,5 +41,15 @@ from dear_pytorch_tpu.comm.backend import (  # noqa: F401
 from dear_pytorch_tpu.comm.communicator import Communicator  # noqa: F401
 from dear_pytorch_tpu.comm import collectives  # noqa: F401
 from dear_pytorch_tpu.comm.collectives import allreduce  # noqa: F401
+from dear_pytorch_tpu import api  # noqa: F401
+from dear_pytorch_tpu.api import (  # noqa: F401
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from dear_pytorch_tpu.parallel import (  # noqa: F401
+    DearState,
+    TrainStep,
+    build_train_step,
+)
 
 __version__ = "0.1.0"

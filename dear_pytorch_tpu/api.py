@@ -1,0 +1,61 @@
+"""Horovod-style user API (reference dear/__init__.py:3-9 surface).
+
+``init/rank/size/allreduce`` live in `comm.backend` / `comm.collectives`;
+this module adds the start-state consistency helpers
+(reference dear/dear_dopt.py:400-544):
+
+  - `broadcast_parameters(params, root_rank=0)`
+  - `broadcast_optimizer_state(state, root_rank=0)`
+
+On a single-controller SPMD runtime these have much less to do than under
+MPI: within one process every device receives its arrays from the same host
+values, so there is nothing to make consistent. Across *processes*
+(multi-host), each process initializes its own host copy — possibly with a
+different RNG stream — and these helpers broadcast the root's values through
+the device fabric (`multihost_utils.broadcast_one_to_all`), restoring the
+reference's "rank 0 decides the initial state" contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from dear_pytorch_tpu.comm import backend
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Make every process's copy of ``params`` equal to ``root_rank``'s
+    (reference dear_dopt.py:400-425: async bcast per tensor + synchronize).
+
+    Identity in single-process runs. ``root_rank`` must be 0 for now: the
+    underlying fabric broadcast is rooted at process 0 (the reference also
+    always passes 0 in its benchmarks).
+    """
+    if root_rank != 0:
+        raise NotImplementedError(
+            "broadcast root other than process 0 is not supported"
+        )
+    if jax.process_count() == 1:
+        return params
+    from jax.experimental import multihost_utils  # pragma: no cover
+
+    return multihost_utils.broadcast_one_to_all(params)
+
+
+def broadcast_optimizer_state(state: Any, root_rank: int = 0) -> Any:
+    """Broadcast a (Dear)State or any optimizer pytree from the root process
+    (reference dear_dopt.py:428-544 — which must wrap scalars into tensors;
+    a pytree broadcast needs no such special-casing)."""
+    return broadcast_parameters(state, root_rank)
+
+
+def world_info() -> dict:
+    """Convenience snapshot used by launchers/logs."""
+    return {
+        "process_index": backend.rank(),
+        "process_count": backend.size(),
+        "local_devices": backend.local_device_count(),
+        "global_devices": backend.device_count(),
+    }

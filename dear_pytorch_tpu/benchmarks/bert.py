@@ -12,7 +12,6 @@ Example:
 from __future__ import annotations
 
 import argparse
-import warnings
 
 import jax
 import jax.numpy as jnp
@@ -22,8 +21,6 @@ from dear_pytorch_tpu.benchmarks import runner
 from dear_pytorch_tpu.comm import backend
 from dear_pytorch_tpu.comm.backend import DP_AXIS
 from dear_pytorch_tpu.models import data
-from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
-from dear_pytorch_tpu.parallel import dear as D
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -47,6 +44,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> runner.BenchResult:
     args = build_parser().parse_args(argv)
+    runner.apply_platform_env()
     mesh = backend.init()
     world = backend.dp_size(mesh)
 
@@ -84,23 +82,9 @@ def main(argv=None) -> runner.BenchResult:
             b["masked_lm_labels"], b["next_sentence_labels"],
         )
 
-    if args.compressor != "none" or args.density < 1.0:
-        warnings.warn(
-            "compressor/density are accepted for CLI parity but ignored by "
-            "the DeAR schedule (reference behavior)."
-        )
-
-    ts = D.build_train_step(
-        loss_fn,
-        params,
-        mesh=mesh,
-        mode=args.mode,
-        threshold_mb=runner.threshold_mb(args),
-        nearby_layers=args.nearby_layers,
-        exclude_parts=runner.parse_exclude_parts(args.exclude_parts),
-        optimizer=fused_sgd(lr=args.base_lr, momentum=args.momentum),
-        comm_dtype=jnp.bfloat16 if args.fp16 else None,
-        rng_seed=42,
+    dear_cfg = runner.config_from_args(args)
+    ts, stepper = runner.build_stepper(
+        dear_cfg, loss_fn, params, mesh, mgwfbp=args.mgwfbp,
     )
     state = ts.init(params)
 
@@ -116,7 +100,9 @@ def main(argv=None) -> runner.BenchResult:
     holder = {"state": state, "metrics": None}
 
     def step_fn():
-        holder["state"], holder["metrics"] = ts.step(holder["state"], batch)
+        holder["state"], holder["metrics"] = stepper.step(
+            holder["state"], batch
+        )
 
     def sync():
         # One device->host scalar fetch drains the in-order pipeline (see

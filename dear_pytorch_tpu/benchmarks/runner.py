@@ -47,6 +47,23 @@ class BenchResult:
         return self.world * self.per_device_conf
 
 
+def apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS / DEAR_NUM_CPU_DEVICES before backend init.
+
+    Some PJRT plugin environments initialize their (possibly remote) client
+    even when the env var asks for CPU; `jax.config.update` before first
+    device contact is the reliable switch. The batch driver sets these for
+    emulated cells."""
+    import os
+
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        jax.config.update("jax_platforms", plats)
+    n = os.environ.get("DEAR_NUM_CPU_DEVICES")
+    if n:
+        jax.config.update("jax_num_cpu_devices", int(n))
+
+
 def log(s: str, nl: bool = True) -> None:
     """Rank-0 printing (reference dear/imagenet_benchmark.py:139-142)."""
     if backend.rank() != 0:
@@ -147,6 +164,19 @@ def add_common_args(parser) -> None:
     parser.add_argument("--density", type=float, default=1.0,
                         help="sparsification density for topk-family "
                              "compressors")
+    parser.add_argument("--gtopk", action="store_true", default=False,
+                        help="gTop-k recursive-halving sparse allreduce "
+                             "(with a top-k-family --compressor)")
+    parser.add_argument("--mgwfbp", action="store_true", default=False,
+                        help="analytic MG-WFBP bucket sizing: measure ICI "
+                             "alpha-beta, estimate layer times, merge "
+                             "buckets per the INFOCOM'19 model (reference "
+                             "wfbp/dopt.py:380-486)")
+    parser.add_argument("--autotune", type=str, default=None,
+                        choices=["bo", "wait_time"],
+                        help="runtime fusion tuning: Bayesian optimization "
+                             "over the threshold (reference dopt_rsag_bo) "
+                             "or wait-time split flags (dopt_rsag_wt)")
     parser.add_argument("--base-lr", type=float, default=0.01)
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--profile-dir", type=str, default=None,
@@ -164,3 +194,97 @@ def parse_exclude_parts(s: str) -> tuple[str, ...]:
 
 def threshold_mb(args) -> Optional[float]:
     return None if args.threshold is None or args.threshold <= 0 else float(args.threshold)
+
+
+def config_from_args(args, *, fp16_comm: bool = True):
+    """CLI args -> `DearConfig` (env DEAR_* vars fill anything the CLI does
+    not own, e.g. weight_decay/nesterov), with the reference's
+    accepted-but-inactive warnings."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from dear_pytorch_tpu.config import DearConfig
+
+    use_compression = args.compressor != "none" and args.mode == "allreduce"
+    if args.compressor != "none" and not use_compression:
+        # DeAR proper accepts-and-ignores the compression surface
+        # (reference dear/dear_dopt.py:381-398 warning)
+        warnings.warn(
+            f"--compressor is ignored by the {args.mode!r} schedule "
+            "(reference behavior); use --mode allreduce."
+        )
+    if args.density < 1.0 and args.compressor == "none":
+        warnings.warn(
+            "--density without --compressor has no effect (dense gradients)"
+        )
+    return DearConfig.from_env(
+        mode=args.mode,
+        threshold_mb=threshold_mb(args),
+        nearby_layers=args.nearby_layers,
+        exclude_parts=parse_exclude_parts(args.exclude_parts),
+        autotune=args.autotune,
+        compressor=args.compressor if use_compression else None,
+        density=args.density,
+        gtopk=args.gtopk and use_compression,
+        lr=args.base_lr,
+        momentum=args.momentum,
+        comm_dtype=jnp.bfloat16 if (args.fp16 and fp16_comm) else None,
+        rng_seed=42,
+    )
+
+
+def build_stepper(cfg, loss_fn, params, mesh, *, model_state=None,
+                  mgwfbp=False):
+    """(train_step, stepper) from a `DearConfig` — the single construction
+    path shared by the CNN and BERT CLIs. ``stepper.step(state, batch)`` is
+    what the timed loop calls (the AutoTuner when tuning, the TrainStep
+    otherwise)."""
+    from dear_pytorch_tpu.parallel import dear as D
+
+    if mgwfbp and cfg.autotune:
+        raise SystemExit("--mgwfbp and --autotune are mutually exclusive: "
+                         "both own the fusion plan")
+    kwargs = dict(cfg.build_kwargs(), mesh=mesh,
+                  model_state_template=model_state)
+    if cfg.autotune:
+        from dear_pytorch_tpu.tuning import AutoTuner
+
+        tuned = AutoTuner(
+            loss_fn, params,
+            strategy=cfg.autotune,
+            threshold_mb=cfg.threshold_mb or 25.0,
+            bound=cfg.bo_bound, max_trials=cfg.bo_trials,
+            interval=cfg.bo_interval, cycle_time_s=cfg.cycle_time_s,
+            log=log, **kwargs,
+        )
+        return tuned.ts, tuned
+
+    plan = None
+    if mgwfbp:
+        from dear_pytorch_tpu.tuning import (
+            estimate_layer_backward_times,
+            plan_mgwfbp,
+        )
+        from dear_pytorch_tpu.utils import CommunicationProfiler
+
+        alpha, beta = CommunicationProfiler(mesh).fit(
+            sizes=[2 ** k for k in range(10, 21, 2)], repeats=3
+        )
+        log(f"MG-WFBP: measured alpha={alpha:.2e}s beta={beta:.2e}s/B")
+        plan = plan_mgwfbp(
+            params, mesh.shape["dp"],
+            layer_times=estimate_layer_backward_times(params),
+            alpha=alpha, beta=beta,
+        )
+        log(f"MG-WFBP plan: {plan.num_buckets} buckets")
+
+    ts = D.build_train_step(
+        loss_fn, params,
+        threshold_mb=cfg.threshold_mb,
+        nearby_layers=cfg.nearby_layers,
+        flags=cfg.flags,
+        plan=plan,
+        **kwargs,
+    )
+    return ts, ts

@@ -1,0 +1,148 @@
+"""One typed configuration for the whole framework.
+
+The reference scatters its knobs across three uncoordinated layers —
+module-level constants edited in source (THRESHOLD / NUM_NEARBY_LAYERS /
+NSTREAMS / CYCLE_TIME, reference dear/dopt_rsag.py:37-40), per-benchmark
+argparse, and launcher env vars (dear/horovod_mpi_cj.sh:2-12) — and selects
+the communication backend by editing an import line
+(dear/imagenet_benchmark.py:14-16). `DearConfig` is the single source of
+truth replacing all three: constructible in code, from env vars
+(``DEAR_<FIELD>``), or from the benchmark CLIs, and consumed by
+`build_train_step` via `.build_kwargs()`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+
+_COMM_DTYPES = {
+    "": None, "none": None,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "f32": jnp.float32, "float32": jnp.float32,
+    "f16": jnp.float16, "float16": jnp.float16,
+}
+
+
+@dataclasses.dataclass
+class DearConfig:
+    """Every train-step knob in one place (defaults = the reference's)."""
+
+    # schedule (replaces the reference's one-directory-per-method layout)
+    mode: str = "dear"                      # dear | allreduce | rsag | rb
+    exclude_parts: tuple = ()               # ('reducescatter'|'allgather')*
+
+    # tensor fusion (dear/dopt_rsag.py:37-40)
+    threshold_mb: Optional[float] = 25.0
+    nearby_layers: Optional[int] = None
+    flags: Optional[Sequence[int]] = None
+
+    # auto-tuning
+    autotune: Optional[str] = None          # None | 'bo' | 'wait_time'
+    bo_bound: tuple = (1.0, 256.0)          # dopt_rsag_bo.py:101
+    bo_trials: int = 10                     # tuner.py:9
+    bo_interval: int = 5                    # tuner.py:34
+    cycle_time_s: float = 5e-3              # dopt_rsag_wt.py CYCLE_TIME
+
+    # compression (dear/compression.py registry; allreduce-schedule only)
+    compressor: Optional[str] = None
+    density: float = 1.0
+    gtopk: bool = False
+
+    # optimizer
+    lr: float = 0.01
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    nesterov: bool = False
+
+    # precision
+    comm_dtype: Any = None                  # e.g. jnp.bfloat16
+    compute_bf16: bool = False
+
+    # misc
+    rng_seed: Optional[int] = None
+    donate: bool = True
+
+    def __post_init__(self):
+        if self.mode not in ("dear", "allreduce", "rsag", "rb"):
+            raise ValueError(f"bad mode {self.mode!r}")
+        if self.autotune not in (None, "bo", "wait_time"):
+            raise ValueError(f"bad autotune {self.autotune!r}")
+        if not 0.0 < self.density <= 1.0:
+            raise ValueError(f"density must be in (0, 1], got {self.density}")
+
+    # -- construction --------------------------------------------------------
+
+    _ENV_PREFIX = "DEAR_"
+
+    @classmethod
+    def from_env(cls, **overrides) -> "DearConfig":
+        """Read ``DEAR_<FIELD>`` env vars (the launcher-facing layer;
+        replaces configs/envs.conf + shell exports)."""
+        kwargs: dict = {}
+        for f in dataclasses.fields(cls):
+            env = os.environ.get(cls._ENV_PREFIX + f.name.upper())
+            if env is None:
+                continue
+            kwargs[f.name] = cls._parse(f.name, env)
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    @staticmethod
+    def _parse(name: str, raw: str):
+        raw = raw.strip()
+        if name in ("threshold_mb",):
+            return None if raw.lower() in ("none", "") else float(raw)
+        if name in ("nearby_layers", "bo_trials", "bo_interval"):
+            return None if raw.lower() in ("none", "") else int(raw)
+        if name in ("lr", "momentum", "weight_decay", "density",
+                    "cycle_time_s"):
+            return float(raw)
+        if name in ("gtopk", "nesterov", "donate", "compute_bf16"):
+            return raw.lower() in ("1", "true", "yes")
+        if name == "comm_dtype":
+            return _COMM_DTYPES[raw.lower()]
+        if name == "exclude_parts":
+            return tuple(p for p in raw.split(",") if p)
+        if name == "flags":
+            return [int(x) for x in raw.split(",")]
+        if name == "bo_bound":
+            lo, hi = raw.split(",")
+            return (float(lo), float(hi))
+        if name in ("autotune", "compressor", "mode"):
+            return None if raw.lower() in ("none", "") else raw
+        return raw
+
+    # -- consumption ---------------------------------------------------------
+
+    def optimizer(self):
+        from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+
+        return fused_sgd(
+            lr=self.lr, momentum=self.momentum,
+            weight_decay=self.weight_decay, nesterov=self.nesterov,
+        )
+
+    def build_kwargs(self) -> dict:
+        """kwargs for `parallel.build_train_step` (fusion plan args are
+        separate because the autotuner owns them when enabled)."""
+        return dict(
+            mode=self.mode,
+            exclude_parts=self.exclude_parts,
+            optimizer=self.optimizer(),
+            comm_dtype=self.comm_dtype,
+            compressor=self.compressor,
+            density=self.density,
+            gtopk=self.gtopk,
+            rng_seed=self.rng_seed,
+            donate=self.donate,
+        )
+
+    def describe(self) -> str:
+        pairs = dataclasses.asdict(self)
+        return "DearConfig(" + ", ".join(
+            f"{k}={v!r}" for k, v in pairs.items()
+        ) + ")"

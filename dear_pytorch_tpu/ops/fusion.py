@@ -238,6 +238,20 @@ def plan_by_flags(params, world: int, flags: Sequence[int]) -> "FusionPlan":
     return _build_plan(specs, groups, world, treedef)
 
 
+def plan_by_groups(
+    params, world: int, layer_groups: Sequence[Sequence[int]]
+) -> "FusionPlan":
+    """Plan from explicit groups of atomic-layer indices (each group a
+    contiguous run in forward order). Used by analytic bucket-sizing
+    strategies (MG-WFBP) that decide merges themselves."""
+    specs, treedef = _leaf_specs(params)
+    layers = _layers(specs)
+    groups = [
+        [i for li in grp for i in layers[li]] for grp in layer_groups if grp
+    ]
+    return _build_plan(specs, groups, world, treedef)
+
+
 def make_plan(
     params,
     world: int,

@@ -51,6 +51,7 @@ from jax import lax
 from dear_pytorch_tpu.comm import backend
 from dear_pytorch_tpu.comm import collectives as C
 from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.ops import compression as Z
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.ops.fused_sgd import ShardOptimizer, fused_sgd
 
@@ -78,6 +79,9 @@ class DearState(NamedTuple):
     opt_state: tuple
     step: jax.Array
     model_state: Any = ()
+    #: per-bucket compressor residual/error-feedback state; per-device by
+    #: construction (global shape (world, padded), sharded on the dp axis)
+    comp_state: tuple = ()
 
 
 class TrainStep(NamedTuple):
@@ -127,6 +131,9 @@ def build_train_step(
     opt_spec_fn: Optional[Callable[[int, Any], Any]] = None,
     model_state_template=None,
     rng_seed: Optional[int] = None,
+    compressor: Optional[str] = None,
+    density: float = 1.0,
+    gtopk: bool = False,
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -156,6 +163,14 @@ def build_train_step(
         key as its last positional argument (folded from seed, step counter,
         and device index) — use for dropout. Without it, stochastic layers
         need a key closed over by ``loss_fn`` (constant across steps).
+      compressor / density / gtopk: gradient compression for the 'allreduce'
+        (WFBP-family) schedule — the reference applies compression only
+        there, and DeAR proper ignores it (dear/dear_dopt.py:381-398).
+        ``compressor`` is a name from `ops.compression.compressors`;
+        ``density`` the kept fraction for the top-k family; ``gtopk=True``
+        uses the recursive-halving gTop-k reduction (wfbp/dopt.py:50-107)
+        instead of allgather-accumulate. Sign compressors perform majority
+        vote; their "gradient" is ±1 (signSGD — scale lives in the lr).
       donate: donate the state argument so buffers are updated in place.
       opt_spec_fn: optional ``(bucket_index, state_leaf) -> PartitionSpec``
         override for optimizer-state sharding (see `_opt_bucket_specs`).
@@ -186,6 +201,15 @@ def build_train_step(
     sharded = mode == "dear"
     excl = frozenset(exclude_parts)
     has_model_state = model_state_template is not None
+    comp = Z.get_compressor(compressor)
+    compressed = comp.name != "none"
+    if compressed and mode != "allreduce":
+        raise ValueError(
+            "gradient compression is an 'allreduce'-schedule (WFBP-family) "
+            "feature; the DeAR schedule ignores it (reference parity)"
+        )
+    if gtopk and comp.name not in Z.SPARSE:
+        raise ValueError("gtopk requires a top-k-family compressor")
 
     # ---- per-device step body (runs inside shard_map) ----------------------
 
@@ -250,7 +274,7 @@ def build_train_step(
 
         grad_bufs = F.pack_all(grads, plan, dtype=comm_dtype)
 
-        new_buffers, new_opt = [], []
+        new_buffers, new_opt, new_comp = [], [], []
         for g, b in enumerate(plan.buckets):
             gbuf = grad_bufs[g]
             if sharded:
@@ -261,6 +285,30 @@ def build_train_step(
                 else:
                     gshard = C.reduce_scatter(gbuf, axis_name)
                 grad = gshard.astype(state.buffers[g].dtype) / world
+            elif compressed:
+                pdtype = state.buffers[g].dtype
+                res_entry = state.comp_state[g]
+                stateless = isinstance(res_entry, tuple)
+                res = () if stateless else res_entry.reshape(
+                    res_entry.shape[1:]
+                )
+                payload, new_res = comp.compress(
+                    gbuf.astype(pdtype), res, density
+                )
+                if comp.name in Z.SIGN:
+                    grad = Z.sign_majority_vote_allreduce(
+                        payload, b.padded_size, pdtype, axis_name
+                    )
+                elif gtopk:
+                    grad = Z.gtopk_sparse_allreduce(
+                        payload, b.padded_size, pdtype, axis_name,
+                        Z._k_of(b.padded_size, density),
+                    )
+                else:
+                    grad = Z.sparse_allreduce(
+                        payload, b.padded_size, pdtype, axis_name
+                    )
+                new_comp.append(() if stateless else new_res[None, :])
             elif mode == "allreduce":
                 grad = C.all_reduce(gbuf, axis_name).astype(
                     state.buffers[g].dtype
@@ -284,6 +332,7 @@ def build_train_step(
         next_state = DearState(
             tuple(new_buffers), tuple(new_opt), state.step + 1,
             new_model_state,
+            tuple(new_comp) if compressed else state.comp_state,
         )
         return next_state, metrics
 
@@ -315,6 +364,9 @@ def build_train_step(
             opt_state=_opt_specs(state.opt_state),
             step=jax.P(),
             model_state=jax.tree.map(lambda _: jax.P(), state.model_state),
+            comp_state=jax.tree.map(
+                lambda _: jax.P(axis_name), state.comp_state
+            ),
         )
 
     def _batch_specs(batch):
@@ -332,7 +384,17 @@ def build_train_step(
         bufs = tuple(F.pack_all(params, plan))
         opt = tuple(optimizer.init(b) for b in bufs)
         step0 = jnp.zeros((), jnp.int32)
-        state = DearState(bufs, opt, step0, model_state if has_model_state else ())
+        if compressed:
+            stateful = not isinstance(comp.init(1, jnp.float32), tuple)
+            comp_state = tuple(
+                jnp.zeros((world, b.padded_size), buf.dtype)
+                if stateful else ()
+                for b, buf in zip(plan.buckets, bufs)
+            )
+        else:
+            comp_state = ()
+        state = DearState(bufs, opt, step0,
+                          model_state if has_model_state else (), comp_state)
         specs = _state_specs(state)
         return jax.tree.map(
             lambda x, s: jax.device_put(x, jax.sharding.NamedSharding(mesh, s)),

@@ -1,0 +1,22 @@
+"""Runtime auto-tuning of the tensor-fusion size.
+
+Two strategies, mirroring the reference's (SURVEY.md §2.4):
+  - Bayesian optimization over the fusion threshold
+    (`bo.Tuner`; reference dear/tuner.py + dopt_rsag_bo.py)
+  - wait-time heuristic deriving bucket-split flags from layer timing
+    (`wait_time`; reference dear/dopt_rsag_wt.py)
+
+`autotune.AutoTuner` drives either against a live training loop,
+re-bucketing (and re-jitting) when a new plan is adopted.
+"""
+
+from dear_pytorch_tpu.tuning.autotune import AutoTuner  # noqa: F401
+from dear_pytorch_tpu.tuning.bo import BayesianOptimizer, Tuner  # noqa: F401
+from dear_pytorch_tpu.tuning.mgwfbp import (  # noqa: F401
+    mgwfbp_layer_groups,
+    plan_mgwfbp,
+)
+from dear_pytorch_tpu.tuning.wait_time import (  # noqa: F401
+    estimate_layer_backward_times,
+    wait_time_flags,
+)

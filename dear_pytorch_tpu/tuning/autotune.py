@@ -1,0 +1,217 @@
+"""Live re-bucketing: drive a training loop while a tuner changes the fusion
+plan under it.
+
+Reference flow (dear/dopt_rsag_bo.py): every tuner interval the BO tuner
+proposes a new threshold; rank 0's choice is broadcast for consistency
+(dopt_rsag_bo.py:153, via mpi4py), fusion buffers are freed and regenerated
+(:163-171), and training continues — momentum state survives because torch
+keeps it per-parameter.
+
+Here a plan change means a re-jit (bucket shapes are trace-time constants).
+`AutoTuner` rebuilds the train step with the proposed plan and *repacks* the
+carried state: master buffers and any per-element optimizer-state leaves are
+unpacked to parameter granularity under the old plan and repacked under the
+new one, so SGD momentum (etc.) survives re-bucketing exactly as it does in
+the reference. Rank consistency is free: the tuner runs on deterministic
+timing input per process and the plan is host metadata compiled into the
+SPMD program (single-controller; no broadcast needed on one host, and on
+multi-host the measured time of rank 0 can be fed to `Tuner` directly).
+
+Compilation cost accounting matches the reference's protocol: the first
+measurement window after each rebuild is discarded as warmup
+(tuner.py:62-64 via `Tuner.notify_rebuild`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from dear_pytorch_tpu.ops import fusion as F
+from dear_pytorch_tpu.parallel import dear as D
+from dear_pytorch_tpu.tuning.bo import Tuner
+from dear_pytorch_tpu.tuning.wait_time import (
+    estimate_layer_backward_times,
+    wait_time_flags,
+)
+
+
+def _repack_bucket_states(old_states, old_plan, new_plan):
+    """Repack per-bucket optimizer-state pytrees across plans.
+
+    Leaves whose shape is ``(old_padded_size,)`` are treated as per-element
+    state: unpacked to parameter granularity and repacked per the new plan.
+    Any other leaf (scalars like momentum's 'initialized' flag, adam counts)
+    is carried from old bucket 0 into every new bucket — valid when such
+    leaves are bucket-independent, which holds for step-count/flag style
+    state (documented limitation).
+    """
+    if not old_states:
+        return ()
+    treedef = jax.tree.structure(old_states[0])
+    per_bucket_flat = [jax.tree.leaves(s) for s in old_states]
+    n_leaves = len(per_bucket_flat[0])
+
+    new_flat_per_bucket = [[] for _ in new_plan.buckets]
+    for li in range(n_leaves):
+        elementwise = all(
+            getattr(per_bucket_flat[bi][li], "shape", None)
+            == (old_plan.buckets[bi].padded_size,)
+            for bi in range(len(old_plan.buckets))
+        )
+        if elementwise:
+            pieces = {}
+            for bi, b in enumerate(old_plan.buckets):
+                unpacked = F.unpack_bucket(per_bucket_flat[bi][li], old_plan, bi)
+                pieces.update(unpacked)
+            leaves_list = [pieces[i] for i in range(len(old_plan.leaves))]
+            for nbi, nb in enumerate(new_plan.buckets):
+                new_flat_per_bucket[nbi].append(
+                    F.pack_bucket(leaves_list, new_plan, nbi)
+                )
+        else:
+            for nbi in range(len(new_plan.buckets)):
+                new_flat_per_bucket[nbi].append(per_bucket_flat[0][li])
+    return tuple(
+        jax.tree.unflatten(treedef, flat) for flat in new_flat_per_bucket
+    )
+
+
+def repack_state(
+    state: D.DearState, old_ts: D.TrainStep, new_ts: D.TrainStep
+) -> D.DearState:
+    """Carry a `DearState` across a plan change (buffers + optimizer state +
+    step + model state; compressor residuals reset, as the reference resets
+    its buffers on regeneration)."""
+    params = F.unpack_all(list(state.buffers), old_ts.plan)
+    fresh = new_ts.init(params, *(
+        (state.model_state,) if state.model_state != () else ()
+    ))
+    new_opt = _repack_bucket_states(
+        list(state.opt_state), old_ts.plan, new_ts.plan
+    )
+    # install repacked values with the fresh state's shardings
+    new_opt = jax.tree.map(
+        lambda v, ref: jax.device_put(v, ref.sharding), new_opt,
+        fresh.opt_state,
+    )
+    step = jax.device_put(state.step, fresh.step.sharding)
+    return D.DearState(fresh.buffers, new_opt, step, fresh.model_state,
+                       fresh.comp_state)
+
+
+class AutoTuner:
+    """Training-loop driver with runtime fusion tuning.
+
+    strategy='bo': Bayesian optimization over the MB threshold
+      (reference dopt_rsag_bo.py; bound (1, 256) MB, 10 trials).
+    strategy='wait_time': start with one all-layers bucket
+      (num_nearby_layers=-1, dopt_rsag_wt.py) and after ``warmup_steps``
+      switch to flags derived from per-layer backward times.
+    """
+
+    def __init__(
+        self,
+        loss_fn: Callable,
+        params_template,
+        *,
+        strategy: str = "bo",
+        threshold_mb: float = 25.0,
+        bound: tuple[float, float] = (1.0, 256.0),
+        max_trials: int = 10,
+        interval: int = 5,
+        cycle_time_s: float = 5e-3,
+        warmup_steps: int = 5,
+        layer_times: Optional[Sequence[float]] = None,
+        log: Callable[[str], None] = lambda s: None,
+        clock=None,
+        **build_kwargs: Any,
+    ):
+        if strategy not in ("bo", "wait_time"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        self.strategy = strategy
+        self._loss_fn = loss_fn
+        self._template = params_template
+        self._build_kwargs = dict(build_kwargs)
+        self._build_kwargs.pop("threshold_mb", None)
+        self._log = log
+        self.rebuilds = 0
+
+        if strategy == "bo":
+            kw = {} if clock is None else {"clock": clock}
+            self.tuner: Optional[Tuner] = Tuner(
+                x=threshold_mb, bound=bound, max_num_steps=max_trials,
+                interval=interval, log=log, **kw,
+            )
+            self.ts = D.build_train_step(
+                loss_fn, params_template, threshold_mb=threshold_mb,
+                **self._build_kwargs,
+            )
+        else:
+            self.tuner = None
+            self._cycle = cycle_time_s
+            self._warmup_steps = warmup_steps
+            self._layer_times = layer_times
+            self._switched = False
+            # all layers in one bucket to start (nearby_layers=-1)
+            self.ts = D.build_train_step(
+                loss_fn, params_template, nearby_layers=-1,
+                **self._build_kwargs,
+            )
+        self._host_step = 0
+
+    def init(self, params, model_state=None):
+        args = (params,) if model_state is None else (params, model_state)
+        return self.ts.init(*args)
+
+    def _rebuild(self, state, **plan_kwargs):
+        from dear_pytorch_tpu.utils.checkpoint import plan_fingerprint
+
+        old_ts = self.ts
+        new_ts = D.build_train_step(
+            self._loss_fn, self._template, **plan_kwargs,
+            **self._build_kwargs,
+        )
+        if plan_fingerprint(new_ts.plan) == plan_fingerprint(old_ts.plan):
+            # a different threshold that bucketizes identically: skip the
+            # repack/re-jit AND keep the current (still valid) measurement
+            # window
+            self._log(f"autotune: plan unchanged by {plan_kwargs}; no rebuild")
+            return state
+        state = repack_state(state, old_ts, new_ts)
+        self.ts = new_ts
+        self.rebuilds += 1
+        if self.tuner is not None:
+            self.tuner.notify_rebuild()
+        self._log(
+            f"autotune: re-bucketed to {new_ts.plan.num_buckets} buckets "
+            f"({plan_kwargs})"
+        )
+        return state
+
+    def step(self, state, batch):
+        state, metrics = self.ts.step(state, batch)
+        self._host_step += 1
+        if self.strategy == "bo":
+            if not self.tuner.finished:
+                # drain the async pipeline before the tuner samples its
+                # clock: otherwise it would time host dispatch, not the
+                # device step (a scalar fetch is also tunnel-safe where
+                # block_until_ready on remote buffers is not)
+                float(metrics["loss"])
+            proposal = self.tuner.step()
+            if proposal is not None:
+                state = self._rebuild(state, threshold_mb=float(proposal))
+        elif not self._switched and self._host_step >= self._warmup_steps:
+            times = (
+                self._layer_times
+                if self._layer_times is not None
+                else estimate_layer_backward_times(self.ts.plan)
+            )
+            flags = wait_time_flags(times, self._cycle)
+            self._switched = True
+            if sum(flags) > 1:  # one bucket already == current plan
+                state = self._rebuild(state, flags=flags)
+        return state, metrics

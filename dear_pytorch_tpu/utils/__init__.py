@@ -1,0 +1,15 @@
+"""Profiling, tracing, and performance-model utilities (reference L3:
+dear/profiling.py, dear/chrome_profiler.py, dear/utils.py)."""
+
+from dear_pytorch_tpu.utils.chrome_trace import TraceWriter, timeline  # noqa: F401
+from dear_pytorch_tpu.utils.perf_model import (  # noqa: F401
+    allgather_perf_model,
+    fit_alpha_beta,
+    predict_allreduce_time,
+    topk_perf_model,
+)
+from dear_pytorch_tpu.utils.profiling import (  # noqa: F401
+    CommunicationProfiler,
+    StepTimer,
+    measure_layerwise_backward,
+)

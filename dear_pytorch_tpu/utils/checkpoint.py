@@ -1,0 +1,113 @@
+"""Checkpoint / resume for `DearState` — a capability gap in the reference
+(SURVEY.md §5: "Checkpoint/resume: none at training level"), filled here
+with Orbax.
+
+The carried state is already fully explicit (sharded master buffers,
+optimizer state, step counter, model collections, compressor residuals), so
+checkpointing is: save the pytree + a fingerprint of the fusion plan it was
+packed under. On restore the fingerprint is checked against the live train
+step's plan — restoring into a re-bucketed setup is an error with a pointer
+to `tuning.autotune.repack_state` (which converts between plans).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from dear_pytorch_tpu.ops import fusion as F
+from dear_pytorch_tpu.parallel import dear as D
+
+
+def plan_fingerprint(plan: F.FusionPlan) -> str:
+    """Stable hash of everything that determines buffer layout."""
+    desc = {
+        "world": plan.world,
+        "leaves": [(s.name, list(s.shape), str(s.dtype)) for s in plan.leaves],
+        "buckets": [
+            [list(b.leaf_ids), b.padded_size] for b in plan.buckets
+        ],
+    }
+    return hashlib.sha256(
+        json.dumps(desc, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+def _ckpt_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step:010d}")
+
+
+def save_checkpoint(
+    directory: str, state: D.DearState, plan: F.FusionPlan
+) -> str:
+    """Write a checkpoint for the state's current step; returns its path."""
+    import orbax.checkpoint as ocp
+
+    step = int(jax.device_get(state.step))
+    path = _ckpt_dir(directory, step)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.abspath(path), jax.device_get(state))
+    meta = {"plan": plan_fingerprint(plan), "step": step}
+    with open(os.path.join(directory, f"meta_{step:010d}.json"), "w") as f:
+        json.dump(meta, f)
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(name[len("step_"):])
+        for name in os.listdir(directory)
+        if name.startswith("step_")
+    ]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(
+    directory: str,
+    ts: D.TrainStep,
+    *,
+    step: Optional[int] = None,
+    template: Optional[D.DearState] = None,
+) -> D.DearState:
+    """Restore into the layout of ``ts`` (shardings taken from a template
+    state — ``ts.init`` output — or built fresh here).
+
+    Raises if the checkpoint was written under a different fusion plan.
+    """
+    import orbax.checkpoint as ocp
+
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    meta_path = os.path.join(directory, f"meta_{step:010d}.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    live = plan_fingerprint(ts.plan)
+    if meta["plan"] != live:
+        raise ValueError(
+            f"checkpoint step {step} was packed under plan {meta['plan']} "
+            f"but the train step uses plan {live}; rebuild the step with "
+            "the original plan, or restore there and carry across with "
+            "tuning.autotune.repack_state"
+        )
+    ckptr = ocp.PyTreeCheckpointer()
+    raw = ckptr.restore(os.path.abspath(_ckpt_dir(directory, step)))
+    # orbax returns lists for tuples; re-impose the DearState structure
+    if template is None:
+        raise ValueError("pass template=ts.init(...) output for shardings")
+    flat_raw = jax.tree.leaves(raw)
+    treedef = jax.tree.structure(template)
+    restored = jax.tree.unflatten(treedef, flat_raw)
+    return jax.tree.map(
+        lambda v, ref: jax.device_put(np.asarray(v), ref.sharding),
+        restored,
+        template,
+    )

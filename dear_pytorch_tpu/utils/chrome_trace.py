@@ -1,0 +1,111 @@
+"""Chrome-trace (about://tracing / Perfetto) event writer + jax.profiler hook.
+
+Reference equivalents: dear/chrome_profiler.py (custom JSON event writer
+with a background writer thread, enabled by the ``WFSGD_TIMELINE`` env var —
+configs/envs.conf) and nothing else; on TPU the primary tracing tool is
+`jax.profiler` (native Perfetto/TensorBoard), so this module offers both:
+
+  - `TraceWriter`: lightweight host-side event log in Chrome trace format —
+    step markers, rebuild events, tuner decisions; things jax.profiler does
+    not name. Background thread drains a queue so the training loop never
+    blocks on file IO (chrome_profiler.py:13-117's design, reimplemented).
+  - `timeline(...)`: context manager that starts a jax.profiler trace when
+    the ``DEAR_TIMELINE`` env var (or an explicit path) is set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+import jax
+
+
+class TraceWriter:
+    """Asynchronous Chrome-trace JSON writer.
+
+    Events use the 'X' (complete) phase: name, ts/dur in microseconds.
+    `event()` may be called from the training loop at any rate; a daemon
+    thread serializes to disk. Call `close()` (or use as context manager)
+    to flush.
+    """
+
+    def __init__(self, path: str, pid: int = 0):
+        self._path = path
+        self._pid = pid
+        self._q: queue.Queue = queue.Queue()
+        self._events: list[dict] = []
+        self._t0 = time.perf_counter()
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def event(self, name: str, start_us: float, dur_us: float,
+              tid: int = 0, **args) -> None:
+        self._q.put({
+            "name": name, "ph": "X", "ts": start_us, "dur": dur_us,
+            "pid": self._pid, "tid": tid, "args": args,
+        })
+
+    @contextlib.contextmanager
+    def span(self, name: str, tid: int = 0, **args):
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            self.event(name, t0, self._now_us() - t0, tid=tid, **args)
+
+    def instant(self, name: str, **args) -> None:
+        self._q.put({
+            "name": name, "ph": "i", "ts": self._now_us(), "s": "g",
+            "pid": self._pid, "tid": 0, "args": args,
+        })
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            self._events.append(item)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=5)
+        with open(self._path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+TIMELINE_ENV = "DEAR_TIMELINE"
+
+
+@contextlib.contextmanager
+def timeline(path: Optional[str] = None):
+    """Start a jax.profiler trace if a path is given or ``DEAR_TIMELINE`` is
+    set (the reference's WFSGD_TIMELINE switch); no-op otherwise."""
+    path = path or os.environ.get(TIMELINE_ENV)
+    if not path:
+        yield None
+        return
+    jax.profiler.start_trace(path)
+    try:
+        yield path
+    finally:
+        jax.profiler.stop_trace()

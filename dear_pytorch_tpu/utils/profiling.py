@@ -1,0 +1,195 @@
+"""Step timing, layer-wise backward measurement, and collective α-β sweeps.
+
+Reference equivalents (dear/profiling.py): ``Profiling`` wraps a model with
+per-parameter backward hooks + ``cuda.synchronize`` timestamps (:11-95),
+``benchmark()`` drives 50 iterations to produce layer-wise backward times
+(:98-129) feeding MG-WFBP, and ``CommunicationProfiler`` sweeps collective
+latency vs size (:132-165).
+
+Under XLA there are no backward hooks — the graph is compiled whole. The
+TPU-native equivalents:
+  - `StepTimer`: wall-clock stats over whole steps (the only
+    externally-observable unit under jit), mean ± 1.96σ like the harness.
+  - `measure_layerwise_backward`: per-layer backward times via suffix
+    truncation — time grad(loss) w.r.t. the parameter suffix starting at
+    each layer (earlier layers frozen); consecutive differences isolate one
+    layer's backward+weight-grad cost. L jit compiles, measurement-grade
+    (offline), but real measured numbers on real hardware — the role the
+    reference's hook-based ``benchmark()`` plays for MG-WFBP.
+  - `CommunicationProfiler`: times `all_reduce` (or RS/AG) on the mesh over
+    a size sweep and fits (α, β) with `perf_model.fit_alpha_beta`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dear_pytorch_tpu.comm import backend
+from dear_pytorch_tpu.comm import collectives as C
+from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.ops import fusion as F
+from dear_pytorch_tpu.utils import perf_model
+
+
+class StepTimer:
+    """Collect per-step wall times; report mean/std/CI like the reference
+    harness (dear/imagenet_benchmark.py:165-172)."""
+
+    def __init__(self):
+        self.times: list[float] = []
+        self._t: Optional[float] = None
+
+    def __enter__(self):
+        self._t = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t)
+
+    def tick(self) -> None:
+        """Lap timer: call once per step."""
+        now = time.perf_counter()
+        if self._t is not None:
+            self.times.append(now - self._t)
+        self._t = now
+
+    @property
+    def mean(self) -> float:
+        return float(np.mean(self.times)) if self.times else 0.0
+
+    @property
+    def ci95(self) -> float:
+        return float(1.96 * np.std(self.times)) if self.times else 0.0
+
+    def summary(self) -> str:
+        return f"{self.mean:.4f} +-{self.ci95:.4f} s over {len(self.times)} steps"
+
+
+def measure_layerwise_backward(
+    loss_fn: Callable,
+    params,
+    batch,
+    *,
+    repeats: int = 5,
+    warmup: int = 2,
+) -> list[float]:
+    """Per-layer backward-time measurements in forward order (seconds).
+
+    For each atomic layer i, times ``grad(loss)`` taken w.r.t. layers
+    ``i..L-1`` with layers ``0..i-1`` held constant; the difference between
+    successive measurements is the marginal cost of extending backprop
+    through layer i — the per-layer number MG-WFBP consumes
+    (reference benchmark(), dear/profiling.py:98-129).
+    """
+    plan = F.plan_by_nearby_layers(params, world=1, k=1)
+    n_layers = len({s.layer for s in plan.leaves})
+    leaves = list(jax.tree.leaves(params))
+    treedef = jax.tree.structure(params)
+
+    totals = []
+    for start in range(n_layers):
+        train_ids = [i for i, s in enumerate(plan.leaves)
+                     if s.layer >= start]
+        frozen_ids = [i for i, s in enumerate(plan.leaves)
+                      if s.layer < start]
+
+        def split_loss(train_leaves, frozen_leaves):
+            flat = [None] * len(leaves)
+            for j, i in enumerate(train_ids):
+                flat[i] = train_leaves[j]
+            for j, i in enumerate(frozen_ids):
+                flat[i] = frozen_leaves[j]
+            return loss_fn(jax.tree.unflatten(treedef, flat), batch)
+
+        g = jax.jit(jax.grad(split_loss))
+        train_leaves = [leaves[i] for i in train_ids]
+        frozen_leaves = [jax.lax.stop_gradient(leaves[i])
+                         for i in frozen_ids]
+        for _ in range(warmup):
+            jax.block_until_ready(g(train_leaves, frozen_leaves))
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = g(train_leaves, frozen_leaves)
+        jax.block_until_ready(out)
+        totals.append((time.perf_counter() - t0) / repeats)
+
+    # totals[start] = fwd + backward through layers >= start; marginal cost
+    # of layer i = totals[i] - totals[i+1] (clamped: timing noise)
+    times = []
+    for i in range(n_layers):
+        nxt = totals[i + 1] if i + 1 < n_layers else min(totals)
+        times.append(max(totals[i] - nxt, 1e-7))
+    return times
+
+
+class CommunicationProfiler:
+    """Collective latency vs message size on the mesh (reference
+    dear/profiling.py:132-165), fitted to t = α + β·bytes."""
+
+    def __init__(
+        self,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        axis_name: str = DP_AXIS,
+        collective: str = "all_reduce",
+        dtype=jnp.float32,
+    ):
+        self.mesh = mesh or backend.global_mesh()
+        self.axis_name = axis_name
+        self.dtype = dtype
+        ops = {
+            "all_reduce": C.all_reduce,
+            "reduce_scatter": C.reduce_scatter,
+            "all_gather": C.all_gather,
+            "all_reduce_rsag": C.all_reduce_rsag,
+        }
+        if collective not in ops:
+            raise KeyError(f"collective must be one of {sorted(ops)}")
+        self._op = ops[collective]
+        self.collective = collective
+
+    def benchmark(
+        self,
+        sizes: Optional[Sequence[int]] = None,
+        *,
+        repeats: int = 10,
+        warmup: int = 3,
+    ) -> tuple[list[int], list[float]]:
+        """Time the collective for each element count; returns
+        (sizes_bytes, times_s)."""
+        world = self.mesh.shape[self.axis_name]
+        if sizes is None:
+            sizes = [2 ** k for k in range(10, 25, 2)]
+        sizes = [F.padded_length(s, world) for s in sizes]
+        itemsize = jnp.dtype(self.dtype).itemsize
+
+        sizes_bytes, times = [], []
+        for n in sizes:
+            x = jnp.ones((world, n), self.dtype)
+            op = self._op
+            axis = self.axis_name
+
+            def run(t):
+                return op(t, axis)
+
+            # one compile per size (shape-specialized), excluded from timing
+            out = C.spmd_call(run, x, mesh=self.mesh, axis_name=axis)
+            for _ in range(warmup):
+                out = C.spmd_call(run, x, mesh=self.mesh, axis_name=axis)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                out = C.spmd_call(run, x, mesh=self.mesh, axis_name=axis)
+            jax.block_until_ready(out)
+            times.append((time.perf_counter() - t0) / repeats)
+            sizes_bytes.append(n * itemsize)
+        return sizes_bytes, times
+
+    def fit(self, **kwargs) -> tuple[float, float]:
+        """Run the sweep and return fitted (α, β)."""
+        sizes_bytes, times = self.benchmark(**kwargs)
+        return perf_model.fit_alpha_beta(sizes_bytes, times)

@@ -54,3 +54,19 @@ def test_bert_cli_output_contract(mesh, capsys):
     assert re.search(r"Total sen/sec on 8 \w+\(s\): ", out), out
     assert "BERT Base Pretraining, Sentence len: 16" in out
     assert res.unit == "sen"
+
+
+def test_imagenet_autotune_bo(mesh):
+    # BO autotune drives the live re-bucketing machinery from the CLI
+    imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4", "--autotune", "bo",
+         "--num-warmup-batches", "6", "--num-batches-per-iter", "6",
+         "--num-iters", "2"]
+    )
+
+
+def test_imagenet_compressed_allreduce(mesh):
+    imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4", "--mode", "allreduce",
+         "--compressor", "eftopk", "--density", "0.1"] + TINY
+    )

@@ -1,0 +1,118 @@
+"""Config system + batch driver tests."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from dear_pytorch_tpu.benchmarks import driver
+from dear_pytorch_tpu.config import DearConfig
+
+
+def test_config_defaults_mirror_reference():
+    cfg = DearConfig()
+    assert cfg.threshold_mb == 25.0       # dear/dopt_rsag.py THRESHOLD
+    assert cfg.bo_bound == (1.0, 256.0)   # dopt_rsag_bo.py bound
+    assert cfg.bo_trials == 10            # tuner.py num_trials
+    assert cfg.cycle_time_s == 5e-3       # dopt_rsag_wt.py CYCLE_TIME
+    kw = cfg.build_kwargs()
+    assert kw["mode"] == "dear" and kw["compressor"] is None
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        DearConfig(mode="bogus")
+    with pytest.raises(ValueError):
+        DearConfig(density=0.0)
+    with pytest.raises(ValueError):
+        DearConfig(autotune="magic")
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("DEAR_MODE", "allreduce")
+    monkeypatch.setenv("DEAR_THRESHOLD_MB", "none")
+    monkeypatch.setenv("DEAR_COMPRESSOR", "eftopk")
+    monkeypatch.setenv("DEAR_DENSITY", "0.05")
+    monkeypatch.setenv("DEAR_GTOPK", "true")
+    monkeypatch.setenv("DEAR_COMM_DTYPE", "bf16")
+    monkeypatch.setenv("DEAR_EXCLUDE_PARTS", "")
+    cfg = DearConfig.from_env()
+    assert cfg.mode == "allreduce"
+    assert cfg.threshold_mb is None
+    assert cfg.compressor == "eftopk" and cfg.density == 0.05 and cfg.gtopk
+    assert cfg.comm_dtype is jnp.bfloat16
+    # overrides beat env
+    cfg2 = DearConfig.from_env(mode="dear", compressor=None, gtopk=False)
+    assert cfg2.mode == "dear"
+
+
+def test_config_usable_by_train_step(mesh):
+    from dear_pytorch_tpu.parallel import build_train_step
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+    import jax
+
+    cfg = DearConfig(lr=0.1, momentum=0.9, threshold_mb=None, rng_seed=None)
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(_loss_fn, params, mesh=mesh,
+                          threshold_mb=cfg.threshold_mb, donate=False,
+                          **{k: v for k, v in cfg.build_kwargs().items()
+                             if k != "donate"})
+    state = ts.init(params)
+    state, m = ts.step(state, _data(jax.random.PRNGKey(1)))
+    assert float(m["loss"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def test_extract_log(tmp_path):
+    log = tmp_path / "x.log"
+    log.write_text(
+        "Running benchmark...\n"
+        "Total img/sec on 8 CPU(s): 123.4 +-5.6\n"
+        "Total img/sec on 8 CPU(s): 150.0 +-2.0\n"
+    )
+    assert driver.extract_log(str(log)) == (150.0, 2.0)
+    assert driver.extract_log(str(tmp_path / "missing.log")) is None
+
+
+def test_cell_cmd_routing():
+    cmd = driver.cell_cmd("bert_base", 8, "dear", [])
+    assert "dear_pytorch_tpu.benchmarks.bert" in cmd
+    cmd = driver.cell_cmd("resnet50", 64, "mgwfbp", [])
+    assert "dear_pytorch_tpu.benchmarks.imagenet" in cmd
+    assert "--mgwfbp" in cmd
+
+
+def test_driver_sweep_resume_and_report(tmp_path):
+    """Full driver pass with pre-seeded logs: every cell resume-skips, so the
+    sweep exercises scrape + aggregation without subprocesses."""
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    (logdir / "mnistnet-bs4-dear.log").write_text(
+        "Total img/sec on 8 CPU(s): 111.0 +-1.0\n")
+    (logdir / "mnistnet-bs4-allreduce.log").write_text(
+        "Total img/sec on 8 CPU(s): 99.0 +-1.0\n")
+    report = driver.main([
+        "--logdir", str(logdir), "--tasks", "mnistnet:4",
+        "--methods", "dear,allreduce",
+    ])
+    assert report["mnistnet"]["dear"]["all"] == [111.0, 1.0]
+    data = json.load(open(logdir / "reports.json"))
+    assert data["mnistnet"]["allreduce"]["all"] == [99.0, 1.0]
+
+
+@pytest.mark.slow
+def test_driver_runs_real_subprocess(tmp_path):
+    """One real emulated cell end-to-end (subprocess + scrape)."""
+    report = driver.main([
+        "--logdir", str(tmp_path), "--tasks", "mnistnet:4",
+        "--methods", "dear", "--emulate", "--nworkers", "4",
+        "--warmup", "1", "--batches", "2", "--iters", "2",
+        "--timeout", "420",
+    ])
+    cell = report["mnistnet"]["dear"]["4"]
+    assert cell is not None and cell[0] > 0
