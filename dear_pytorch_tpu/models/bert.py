@@ -138,7 +138,12 @@ class BertForPreTraining(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 train: bool = True):
+                 train: bool = True, position_offset=0, pool_fn=None):
+        """``position_offset`` shifts position ids (a sequence-parallel shard
+        at global offset r*S_local passes that offset); ``pool_fn(x)``
+        overrides the default ``x[:, 0]`` CLS pooling (under sequence
+        parallelism the CLS token lives on shard 0 only — see
+        parallel.sp.sp_cls_pool)."""
         cfg = self.config
         B, S = input_ids.shape
         if token_type_ids is None:
@@ -151,7 +156,7 @@ class BertForPreTraining(nn.Module):
                             embedding_init=embed_init, dtype=cfg.dtype,
                             name="word_embeddings")
         x = word_emb(input_ids)
-        pos_ids = jnp.arange(S)[None, :]
+        pos_ids = position_offset + jnp.arange(S)[None, :]
         x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                          embedding_init=embed_init, dtype=cfg.dtype,
                          name="position_embeddings")(pos_ids)
@@ -180,9 +185,10 @@ class BertForPreTraining(nn.Module):
         logits = logits + self.param(
             "mlm_bias", nn.initializers.zeros, (cfg.padded_vocab_size,))
         # --- NSP head: pooled [CLS] -> 2 classes -----------------------------
+        pooled_in = pool_fn(x) if pool_fn is not None else x[:, 0]
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                                   kernel_init=embed_init, name="pooler")(
-            x[:, 0]))
+            pooled_in))
         nsp = nn.Dense(2, dtype=jnp.float32, kernel_init=embed_init,
                        name="nsp_classifier")(pooled)
         return logits.astype(jnp.float32), nsp.astype(jnp.float32)

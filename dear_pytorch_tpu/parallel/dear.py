@@ -134,6 +134,8 @@ def build_train_step(
     compressor: Optional[str] = None,
     density: float = 1.0,
     gtopk: bool = False,
+    batch_spec_fn: Optional[Callable[[Any], Any]] = None,
+    mean_axes: Optional[Sequence[str]] = None,
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -171,6 +173,21 @@ def build_train_step(
         uses the recursive-halving gTop-k reduction (wfbp/dopt.py:50-107)
         instead of allgather-accumulate. Sign compressors perform majority
         vote; their "gradient" is ±1 (signSGD — scale lives in the lr).
+      axis_name: one mesh axis name, or a TUPLE of axis names — e.g.
+        ``('dp', 'sp')`` for combined data + sequence parallelism. Gradients
+        reduce-scatter over every listed axis (the ZeRO shard degree is the
+        product), and ``loss_fn`` may itself use collectives over an
+        individual axis (e.g. ring attention over 'sp').
+      batch_spec_fn: ``batch -> PartitionSpec pytree`` overriding the
+        default "shard every leaf's dim 0 over axis_name" input layout —
+        required for dp×sp, where the batch dim shards over 'dp' and the
+        sequence dim over 'sp'.
+      mean_axes: the axes over which per-device losses are independent
+        equal-weight samples (gradients are AVERAGED over these; summed over
+        the rest). Defaults to all of ``axis_name``. For dp×sp pass
+        ``('dp',)``: the sp group jointly computes ONE loss (each device
+        holding partial gradients that must sum), while dp replicas hold
+        different samples (gradients average).
       donate: donate the state argument so buffers are updated in place.
       opt_spec_fn: optional ``(bucket_index, state_leaf) -> PartitionSpec``
         override for optimizer-state sharding (see `_opt_bucket_specs`).
@@ -183,7 +200,17 @@ def build_train_step(
     if exclude_parts and mode != "dear":
         raise ValueError("exclude_parts is a 'dear'-mode ablation")
     mesh = mesh or backend.global_mesh()
-    world = mesh.shape[axis_name]
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    axis_name = axes if len(axes) > 1 else axes[0]
+    world = 1
+    for a in axes:
+        world *= mesh.shape[a]
+    mean_axes = tuple(mean_axes) if mean_axes is not None else axes
+    if not set(mean_axes) <= set(axes):
+        raise ValueError(f"mean_axes {mean_axes} not a subset of {axes}")
+    mean_world = 1
+    for a in mean_axes:
+        mean_world *= mesh.shape[a]
     optimizer = optimizer or fused_sgd(lr=0.01)
     if plan is None:
         plan = F.make_plan(
@@ -207,6 +234,13 @@ def build_train_step(
         raise ValueError(
             "gradient compression is an 'allreduce'-schedule (WFBP-family) "
             "feature; the DeAR schedule ignores it (reference parity)"
+        )
+    if compressed and mean_axes != axes:
+        raise ValueError(
+            "compressed reductions divide by the full axis product and do "
+            "not support mean_axes != axis_name (e.g. sequence-parallel "
+            "partial-gradient sums); use dense schedules on multi-axis "
+            "meshes with mean_axes"
         )
     if gtopk and comp.name not in Z.SPARSE:
         raise ValueError("gtopk requires a top-k-family compressor")
@@ -284,7 +318,7 @@ def build_train_step(
                     )
                 else:
                     gshard = C.reduce_scatter(gbuf, axis_name)
-                grad = gshard.astype(state.buffers[g].dtype) / world
+                grad = gshard.astype(state.buffers[g].dtype) / mean_world
             elif compressed:
                 pdtype = state.buffers[g].dtype
                 res_entry = state.comp_state[g]
@@ -312,16 +346,16 @@ def build_train_step(
             elif mode == "allreduce":
                 grad = C.all_reduce(gbuf, axis_name).astype(
                     state.buffers[g].dtype
-                ) / world
+                ) / mean_world
             elif mode == "rsag":
                 grad = C.all_reduce_rsag(gbuf, axis_name).astype(
                     state.buffers[g].dtype
-                ) / world
+                ) / mean_world
             else:  # 'rb': two-phase reduce-to-root + broadcast (dopt_rb.py)
                 reduced = C.reduce(gbuf, 0, axis_name)
                 grad = C.broadcast(reduced, 0, axis_name).astype(
                     state.buffers[g].dtype
-                ) / world
+                ) / mean_world
             new_p, new_o = optimizer.update(grad, state.opt_state[g], state.buffers[g])
             new_buffers.append(new_p)
             new_opt.append(new_o)
@@ -370,6 +404,8 @@ def build_train_step(
         )
 
     def _batch_specs(batch):
+        if batch_spec_fn is not None:
+            return batch_spec_fn(batch)
         return jax.tree.map(lambda _: jax.P(axis_name), batch)
 
     def init(params, model_state=None) -> DearState:

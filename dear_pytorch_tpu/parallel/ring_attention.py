@@ -1,0 +1,191 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+The reference has NO long-context support (SURVEY.md §2.9/§5: sequence
+length is only a padding knob, dear/bert_benchmark.py:32-33); this module is
+a capability extension the task brief makes first-class. Design follows the
+blockwise/ring formulation (Liu et al., "Ring Attention with Blockwise
+Transformers for Near-Infinite Context", 2023): each device owns one
+sequence block of Q, K, V; K/V blocks rotate around the mesh axis via
+`lax.ppermute` while each device accumulates its Q block's attention with a
+numerically-stable online softmax — comm of the next block overlaps the
+current block's compute (XLA async collective + loop pipelining), memory
+stays O(S/P) per device, and the result is EXACT attention (not an
+approximation).
+
+All math in fp32 regardless of input dtype (softmax stability on bf16
+inputs); output is cast back.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30  # finite "-inf": keeps the online-softmax alpha well-defined
+
+
+def _block_attend(q, k, v, *, scale, mask):
+    """One block pair: returns (block_max [B,H,Sq], p [B,H,Sq,Sk], pv)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                      # [B,H,Sq]
+    m = jnp.maximum(m, _NEG_BIG)                 # fully-masked rows stay finite
+    p = jnp.exp(s - m[..., None])                # masked entries -> exp(-inf)=0
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m, p, pv
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Exact attention for per-device sequence shards (call inside
+    shard_map over ``axis_name``).
+
+    Args:
+      q/k/v: local blocks ``[B, S_local, H, D]``; the global sequence is the
+        concatenation of blocks in mesh-axis order.
+      causal: apply a causal mask over GLOBAL positions.
+      scale: defaults to ``D ** -0.5``.
+      kv_mask: optional key-validity mask ``[B, S_local]`` (1 = attend) for
+        this device's K/V block — padding masks; rotates with K/V.
+
+    Returns: local attention output ``[B, S_local, H, D]`` (q's dtype).
+    """
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = D ** -0.5 if scale is None else scale
+    qf = q.astype(jnp.float32)
+
+    q_pos = idx * S + jnp.arange(S)              # global positions of q rows
+    kvm0 = (
+        jnp.ones((B, S), jnp.bool_) if kv_mask is None
+        else kv_mask.astype(jnp.bool_)
+    )
+
+    def body(step, carry):
+        kb, vb, kvm, m, l, o = carry
+        owner = (idx - step) % world             # whose block we hold now
+        k_pos = owner * S + jnp.arange(S)
+        mask = kvm[:, None, None, :]                     # [B,1,1,Sk]
+        if causal:
+            cm = k_pos[None, :] <= q_pos[:, None]        # [Sq, Sk]
+            mask = mask & cm[None, None]
+        bm, p, pv = _block_attend(qf, kb, vb, scale=scale, mask=mask)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)               # [B,H,Sq]
+        l_new = l * alpha + jnp.sum(p, axis=-1) * jnp.exp(bm - m_new)
+        o_new = (
+            o * alpha.transpose(0, 2, 1)[..., None]
+            + pv * jnp.exp(bm - m_new).transpose(0, 2, 1)[..., None]
+        )
+        # rotate K/V (and the key mask) to the next device; overlapped with
+        # the next block's compute by XLA's async collectives
+        perm = [(i, (i + 1) % world) for i in range(world)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        kvm = lax.ppermute(kvm, axis_name, perm)
+        return kb, vb, kvm, m_new, l_new, o_new
+
+    m0 = jnp.full((B, H, S), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    o0 = jnp.zeros((B, S, H, D), jnp.float32)
+    _, _, _, m, l, o = lax.fori_loop(
+        0, world, body, (k.astype(jnp.float32), v.astype(jnp.float32),
+                         kvm0, m0, l0, o0)
+    )
+    l = jnp.maximum(l, 1e-30)                    # guard: all-masked rows
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Single-device reference attention (same math, no ring) — used by
+    tests and as the Ulysses per-head-group kernel."""
+    D = q.shape[-1]
+    scale = D ** -0.5 if scale is None else scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Sq, Sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(Sk)[None, :] <= jnp.arange(Sq)[:, None]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_impl(axis_name: str, causal: bool = False):
+    """Adapter matching the model zoo's ``attention_impl`` contract
+    (models/bert.py BertSelfAttention: ``impl(q, k, v, mask, dropout_rng=,
+    dropout_rate=, dtype=)``) so a BERT built with this impl trains with
+    sequence parallelism over ``axis_name``. ``mask`` is the [B, S_local]
+    attention (padding) mask shard. Attention-prob dropout is not applied in
+    the ring (deterministic attention; residual dropout still applies)."""
+
+    def impl(q, k, v, mask, dropout_rng=None, dropout_rate=0.0, dtype=None):
+        kv_mask = None
+        if mask is not None:
+            # model masks are ADDITIVE [B,1,1,S] (0 = attend, big negative =
+            # masked); ring wants boolean key validity [B, S]
+            kv_mask = mask.reshape(mask.shape[0], mask.shape[-1]) > -1.0
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              kv_mask=kv_mask)
+
+    return impl
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    attn_fn=None,
+) -> jax.Array:
+    """DeepSpeed-Ulysses style sequence parallelism (Jacobs et al., 2023):
+    all-to-all resharding from sequence-sharded ``[B, S/P, H, D]`` to
+    head-sharded ``[B, S, H/P, D]``, full attention per head group, and
+    all-to-all back. Two all-to-alls instead of a P-step ring — better when
+    H >= P and the full sequence fits per device.
+    """
+    world = lax.axis_size(axis_name)
+    B, S, H, D = q.shape
+    if H % world:
+        raise ValueError(f"heads ({H}) must divide by axis size ({world})")
+
+    def seq_to_heads(x):
+        # [B, S_loc, H, D] -> [B, S_loc, P, H/P, D] -> a2a over P (gathering
+        # sequence, scattering heads) -> [B, S_glob, H/P, D]
+        x = x.reshape(B, S, world, H // world, D)
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                           tiled=False)
+        return x.reshape(B, S * world, H // world, D)
+
+    def heads_to_seq(x):
+        x = x.reshape(B, world, S, H // world, D)
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                           tiled=False)
+        return x.reshape(B, S, H, D)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    attn = attn_fn or partial(full_attention, causal=causal, scale=scale)
+    out = attn(qh, kh, vh)
+    return heads_to_seq(out)

@@ -1,0 +1,142 @@
+"""Sequence-parallel (long-context) training integration.
+
+Absent from the reference (SURVEY.md §2.9: no sequence/context parallelism
+anywhere) — this module makes it first-class: a dp×sp mesh where the batch
+dim shards over 'dp' and the sequence dim over 'sp', ring attention (or
+Ulysses) inside the model, and the DeAR decoupled RS+AG schedule reducing
+gradients over BOTH axes (summed over sp — partial gradients of one shared
+loss — averaged over dp; `build_train_step(mean_axes=('dp',))`).
+
+Helpers here close the three gaps a plain model has under sequence
+sharding:
+  - position embeddings need the shard's global offset (`sp_position_offset`)
+  - CLS pooling needs the token living on sp rank 0 (`sp_cls_pool`)
+  - token-mean losses need global (not per-shard) normalization
+    (`sp_bert_loss`)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dear_pytorch_tpu.comm.backend import DP_AXIS, SP_AXIS
+from dear_pytorch_tpu.parallel.ring_attention import (
+    make_ring_attention_impl,
+)
+
+
+def sp_position_offset(seq_local: int, axis_name: str = SP_AXIS):
+    """Global position of this shard's first token."""
+    return lax.axis_index(axis_name) * seq_local
+
+
+def sp_cls_pool(axis_name: str = SP_AXIS) -> Callable:
+    """Pool the GLOBAL first token under sequence sharding: shard 0
+    contributes its ``x[:, 0]``; a psum broadcasts it to the whole sp group
+    (differentiable; on TPU this is one small all-reduce)."""
+
+    def pool(x):
+        idx = lax.axis_index(axis_name)
+        cls = jnp.where(idx == 0, 1.0, 0.0).astype(x.dtype) * x[:, 0]
+        return lax.psum(cls, axis_name)
+
+    return pool
+
+
+def sp_bert_loss(logits, nsp_logits, masked_lm_labels, next_sentence_labels,
+                 axis_name: str = SP_AXIS, ignore_index: int = -1):
+    """BERT pre-training criterion under sequence sharding.
+
+    Gradient accounting: the train step SUMS per-device partial gradients
+    over the sp axis (``mean_axes=('dp',)``), so every piece of the loss
+    must appear on exactly one device's differentiation path per occurrence:
+
+      - MLM: each device contributes its local token NLL sum divided by the
+        GLOBAL valid count (psum'd, gradient-stopped denominator) — token
+        gradients counted once, normalization global.
+      - NSP: pooled/classifier compute is replicated across sp (psum-pooled
+        CLS); the term enters the grad path on sp rank 0 ONLY, so its
+        weight gradients are counted once. (The cotangent through the psum
+        pool reaches the encoder only via rank 0's CLS token — also once.)
+
+    The returned VALUE is the true replicated loss on every rank (attached
+    with a stop_gradient correction), so metrics read normally.
+    """
+    idx = lax.axis_index(axis_name)
+    V = logits.shape[-1]
+    flat_logits = logits.reshape(-1, V)
+    flat_labels = masked_lm_labels.reshape(-1)
+    valid = flat_labels != ignore_index
+    safe = jnp.where(valid, flat_labels, 0)
+    logp = jax.nn.log_softmax(flat_logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[:, None], axis=-1)[:, 0]
+    local_num = jnp.sum(nll * valid)
+    den = jax.lax.stop_gradient(
+        lax.psum(jnp.sum(valid), axis_name)
+    )
+    den = jnp.maximum(den, 1)
+    nsp_logp = jax.nn.log_softmax(nsp_logits, axis=-1)
+    nsp_loss = -jnp.mean(
+        jnp.take_along_axis(nsp_logp,
+                            next_sentence_labels.reshape(-1, 1), axis=-1))
+
+    loss_grad_path = local_num / den + jnp.where(idx == 0, nsp_loss, 0.0)
+    true_loss = lax.psum(jax.lax.stop_gradient(local_num), axis_name) / den \
+        + jax.lax.stop_gradient(nsp_loss)
+    return loss_grad_path + jax.lax.stop_gradient(
+        true_loss - loss_grad_path
+    )
+
+
+def bert_sp_batch_specs(batch, dp_axis: str = DP_AXIS,
+                        sp_axis: str = SP_AXIS):
+    """PartitionSpecs for a synthetic BERT batch dict on a dp×sp mesh:
+    [B, S] leaves shard (dp, sp); [B] leaves shard (dp,)."""
+    def spec(x):
+        if getattr(x, "ndim", 0) >= 2:
+            return jax.P(dp_axis, sp_axis)
+        return jax.P(dp_axis)
+
+    return jax.tree.map(spec, batch)
+
+
+def make_sp_bert_loss_fn(model, *, sp_axis: str = SP_AXIS,
+                         seq_local: Optional[int] = None,
+                         train: bool = True):
+    """``loss_fn(params, batch, rng)`` for `build_train_step` on a dp×sp
+    mesh: ring attention over ``sp_axis``, offset positions, psum-pooled
+    CLS, sp-global criterion. The model must have been built with
+    ``attention_impl=make_ring_attention_impl(sp_axis)``.
+    """
+
+    def loss_fn(params, batch, rng=None):
+        ids = batch["input_ids"]
+        offset = sp_position_offset(ids.shape[1] if seq_local is None
+                                    else seq_local, sp_axis)
+        rngs = {"dropout": rng} if rng is not None else None
+        logits, nsp = model.apply(
+            {"params": params}, ids, batch["token_type_ids"],
+            batch["attention_mask"], train=train, rngs=rngs,
+            position_offset=offset, pool_fn=sp_cls_pool(sp_axis),
+        )
+        return sp_bert_loss(
+            logits.astype(jnp.float32), nsp.astype(jnp.float32),
+            batch["masked_lm_labels"], batch["next_sentence_labels"],
+            sp_axis,
+        )
+
+    return loss_fn
+
+
+def sp_bert_model(config, sp_axis: str = SP_AXIS):
+    """A `BertForPreTraining` whose attention runs as a ring over
+    ``sp_axis``."""
+    from dear_pytorch_tpu.models.bert import BertForPreTraining
+
+    return BertForPreTraining(
+        config, attention_impl=make_ring_attention_impl(sp_axis)
+    )

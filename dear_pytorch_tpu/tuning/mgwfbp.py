@@ -70,6 +70,12 @@ def mgwfbp_layer_groups(
 
     tc = [comm_time(b) for b in p]
 
+    # bucket_low[head] = lowest (earliest-forward) member of head's bucket;
+    # the bucket's collective can only fire once THAT member's gradient is
+    # ready (backward produces lower indices later, so ready[low] is the
+    # latest ready time in the bucket)
+    bucket_low = list(range(L))
+
     def comm_starts():
         """comm_start[l] for the current merge state (0-byte buckets are
         already merged into a later-indexed head)."""
@@ -79,7 +85,8 @@ def mgwfbp_layer_groups(
             if p[l] == 0.0:
                 starts[l] = starts[l + 1]
                 continue
-            s = ready[l] if prev_end is None else max(prev_end, ready[l])
+            fire = ready[bucket_low[l]]
+            s = fire if prev_end is None else max(prev_end, fire)
             starts[l] = s
             prev_end = s + tc[l]
         return starts
@@ -105,6 +112,7 @@ def mgwfbp_layer_groups(
             p[l] = 0.0
             tc[head] = comm_time(p[head])
             tc[l] = 0.0
+            bucket_low[head] = l
             current.append(l)
         else:
             groups.append(current)

@@ -98,14 +98,16 @@ def restore_checkpoint(
             "the original plan, or restore there and carry across with "
             "tuning.autotune.repack_state"
         )
-    ckptr = ocp.PyTreeCheckpointer()
-    raw = ckptr.restore(os.path.abspath(_ckpt_dir(directory, step)))
-    # orbax returns lists for tuples; re-impose the DearState structure
     if template is None:
         raise ValueError("pass template=ts.init(...) output for shardings")
-    flat_raw = jax.tree.leaves(raw)
-    treedef = jax.tree.structure(template)
-    restored = jax.tree.unflatten(treedef, flat_raw)
+    ckptr = ocp.PyTreeCheckpointer()
+    # restore INTO the template's structure: a structureless restore returns
+    # a dict whose alphabetical key order would scramble DearState fields
+    # (model_state/comp_state vs opt_state/step)
+    restored = ckptr.restore(
+        os.path.abspath(_ckpt_dir(directory, step)),
+        item=jax.device_get(template),
+    )
     return jax.tree.map(
         lambda v, ref: jax.device_put(np.asarray(v), ref.sharding),
         restored,

@@ -135,6 +135,7 @@ def main(argv=None):
         return float(dear.allreduce(correct / len(test_x)))
 
     steps_per_epoch = len(train_x) // args.batch_size
+    acc = evaluate(state)  # defined even with --epochs 0
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
         perm = jax.random.permutation(

@@ -77,3 +77,72 @@ def test_broadcast_helpers_single_process():
     assert out is params  # identity in single-process runs
     with pytest.raises(NotImplementedError):
         dear.broadcast_parameters(params, root_rank=1)
+
+
+def test_checkpoint_roundtrip_with_model_state(mesh, tmp_path):
+    """Non-empty model_state (BN stats) must survive restore with fields in
+    the right slots (guards the orbax dict-ordering scramble)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            x = nn.Dense(8)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(4)(x)
+
+    model = TinyBN()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 12)) + 2.0
+    y = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 4)
+    variables = model.init({"params": jax.random.PRNGKey(2)}, x, train=False)
+    params = variables["params"]
+    mstate = {"batch_stats": variables["batch_stats"]}
+
+    def loss_fn(p, ms, b):
+        bx, by = b
+        logits, new_state = model.apply(
+            {"params": p, **ms}, bx, train=True, mutable=["batch_stats"]
+        )
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.sum(logp * jax.nn.one_hot(by, 4), axis=-1)
+        ), new_state
+
+    ts = build_train_step(loss_fn, params, mesh=mesh, threshold_mb=None,
+                          optimizer=fused_sgd(lr=0.05),
+                          model_state_template=mstate, donate=False)
+    state = ts.init(params, mstate)
+    for _ in range(3):
+        state, _ = ts.step(state, (x, y))
+
+    d = str(tmp_path / "bn_ckpts")
+    ckpt.save_checkpoint(d, state, ts.plan)
+    restored = ckpt.restore_checkpoint(
+        d, ts, template=ts.init(params, mstate)
+    )
+    assert int(jax.device_get(restored.step)) == 3  # step in the right slot
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
+        ),
+        restored, state,
+    )
+
+
+def test_compressed_multi_axis_rejected():
+    import jax.numpy as jnp
+
+    devices = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh2d = jax.sharding.Mesh(devices, ("dp", "sp"))
+    params = {"w": {"kernel": jnp.ones((4, 4))}}
+
+    def loss_fn(p, b):
+        return jnp.sum((b @ p["w"]["kernel"]) ** 2)
+
+    with pytest.raises(ValueError, match="mean_axes"):
+        build_train_step(
+            loss_fn, params, mesh=mesh2d, mode="allreduce",
+            axis_name=("dp", "sp"), mean_axes=("dp",),
+            compressor="eftopk", density=0.5,
+        )
