@@ -1,0 +1,13 @@
+"""Native host runtime: C++ data pipeline + timers (csrc/dear_runtime.cpp),
+with a pure-numpy fallback when no C++ toolchain is available."""
+
+from dear_pytorch_tpu.runtime.pipeline import (  # noqa: F401
+    NumpyPipeline,
+    Pipeline,
+    SyntheticSpec,
+    bert_spec,
+    image_spec,
+    mnist_spec,
+    native_available,
+    now_ns,
+)

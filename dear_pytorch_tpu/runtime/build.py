@@ -1,0 +1,93 @@
+"""Build/load the native runtime shared library.
+
+Compiles ``csrc/dear_runtime.cpp`` with the system C++ toolchain on first
+use (no pybind11 in this environment — plain C ABI + ctypes) and caches the
+.so next to the package. Thread-safe; failures degrade to the numpy
+fallback in `runtime.pipeline`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc", "dear_runtime.cpp",
+)
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+
+
+class Segment(ctypes.Structure):
+    """Mirror of the C Segment struct (csrc/dear_runtime.cpp)."""
+
+    _fields_ = [
+        ("offset", ctypes.c_uint64),
+        ("count", ctypes.c_uint64),
+        ("kind", ctypes.c_int32),
+        ("p0", ctypes.c_double),
+        ("p1", ctypes.c_double),
+    ]
+
+
+KIND_NORMAL_F32 = 0
+KIND_UNIFORM_I32 = 1
+KIND_CONST_I32 = 2
+KIND_UNIFORM_F32 = 3
+KIND_BERNOULLI_MASKED_I32 = 4
+
+
+def _compile() -> Optional[str]:
+    if not os.path.exists(_SRC):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    so = os.path.join(_BUILD_DIR, f"dear_runtime_{tag}.so")
+    if os.path.exists(so):
+        return so
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", so + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(so + ".tmp", so)
+        return so
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, or None if unbuildable (numpy fallback kicks in)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _compile()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.dear_now_ns.restype = ctypes.c_uint64
+        lib.dear_pipeline_create.restype = ctypes.c_void_p
+        lib.dear_pipeline_create.argtypes = [
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.POINTER(Segment), ctypes.c_int,
+        ]
+        lib.dear_pipeline_acquire.restype = ctypes.c_int
+        lib.dear_pipeline_acquire.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ]
+        lib.dear_pipeline_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dear_pipeline_produced.restype = ctypes.c_uint64
+        lib.dear_pipeline_produced.argtypes = [ctypes.c_void_p]
+        lib.dear_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
