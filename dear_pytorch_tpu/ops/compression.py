@@ -261,7 +261,7 @@ def sparse_allreduce(payload, n: int, dtype, axis_name: str) -> jax.Array:
 
 def gtopk_sparse_allreduce(
     payload, n: int, dtype, axis_name: str, k: int
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
     """gTop-k: global top-k of the summed sparse gradients via
     recursive-halving pairwise exchange (reference
     ``gtopk_sparse_recursive_allreduce``, wfbp/dopt.py:50-107, built on
@@ -271,6 +271,12 @@ def gtopk_sparse_allreduce(
     scatter-add, reselect top-k. After log2(world) rounds every device holds
     the same top-k approximation of the global sum. Comm volume per device:
     2k * log2(world). Requires power-of-two world (asserted).
+
+    Returns ``(dense_mean, kept_indices)`` — the globally-kept index set is
+    what error-feedback compressors need to re-add locally-sent-but-
+    globally-rejected coordinates to their residual (the reference's
+    ``included_indexes`` re-add, wfbp/dopt.py:726-728); without it those
+    coordinates' gradient mass is silently discarded.
     """
     world = lax.axis_size(axis_name)
     if world & (world - 1):
@@ -289,7 +295,7 @@ def gtopk_sparse_allreduce(
         )
         values, indices = _topk_select(merged, k)
     dense = _sparse_to_dense(values, indices, n, dtype)
-    return dense / world
+    return dense / world, indices
 
 
 def sign_majority_vote_allreduce(

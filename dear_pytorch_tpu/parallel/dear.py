@@ -334,10 +334,29 @@ def build_train_step(
                         payload, b.padded_size, pdtype, axis_name
                     )
                 elif gtopk:
-                    grad = Z.gtopk_sparse_allreduce(
+                    grad, kept_idx = Z.gtopk_sparse_allreduce(
                         payload, b.padded_size, pdtype, axis_name,
                         Z._k_of(b.padded_size, density),
                     )
+                    if not stateless:
+                        # Error feedback under gTop-k: coordinates this
+                        # device SENT (zeroed out of its residual) but the
+                        # global top-k REJECTED would otherwise lose their
+                        # gradient mass permanently. Re-add them to the
+                        # residual (reference wfbp/dopt.py:726-728).
+                        kept_mask = (
+                            jnp.zeros((b.padded_size,), jnp.bool_)
+                            .at[kept_idx].set(True)
+                        )
+                        sent_idx = payload["indices"]
+                        rejected = jnp.where(
+                            kept_mask[sent_idx],
+                            jnp.zeros_like(payload["values"]),
+                            payload["values"],
+                        )
+                        new_res = new_res.at[sent_idx].add(
+                            rejected.astype(new_res.dtype)
+                        )
                 else:
                     grad = Z.sparse_allreduce(
                         payload, b.padded_size, pdtype, axis_name

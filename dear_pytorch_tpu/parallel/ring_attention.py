@@ -28,8 +28,8 @@ from jax import lax
 _NEG_BIG = -1e30  # finite "-inf": keeps the online-softmax alpha well-defined
 
 
-def _block_attend(q, k, v, *, scale, mask):
-    """One block pair: returns (block_max [B,H,Sq], p [B,H,Sq,Sk], pv)."""
+def _block_attend(q, k, *, scale, mask):
+    """One block pair: returns (block_max [B,H,Sq], p [B,H,Sq,Sk])."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * scale
     if mask is not None:
@@ -37,8 +37,7 @@ def _block_attend(q, k, v, *, scale, mask):
     m = jnp.max(s, axis=-1)                      # [B,H,Sq]
     m = jnp.maximum(m, _NEG_BIG)                 # fully-masked rows stay finite
     p = jnp.exp(s - m[..., None])                # masked entries -> exp(-inf)=0
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return m, p, pv
+    return m, p
 
 
 def ring_attention(
@@ -50,6 +49,8 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_mask: Optional[jax.Array] = None,
+    dropout_rng: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
 ) -> jax.Array:
     """Exact attention for per-device sequence shards (call inside
     shard_map over ``axis_name``).
@@ -61,6 +62,13 @@ def ring_attention(
       scale: defaults to ``D ** -0.5``.
       kv_mask: optional key-validity mask ``[B, S_local]`` (1 = attend) for
         this device's K/V block — padding masks; rotates with K/V.
+      dropout_rng / dropout_rate: attention-prob dropout (the dense model's
+        ``attention_probs_dropout_prob``). Applied blockwise with a mask
+        derived per (q-block, k-block) pair — drop the unnormalized block
+        probs feeding the output accumulator while the softmax normalizer
+        accumulates UNdropped sums, which is exactly inverted dropout on the
+        normalized probs. The sample stream differs from the dense twin's
+        (block-folded keys), so outputs match in distribution, not bitwise.
 
     Returns: local attention output ``[B, S_local, H, D]`` (q's dtype).
     """
@@ -84,7 +92,19 @@ def ring_attention(
         if causal:
             cm = k_pos[None, :] <= q_pos[:, None]        # [Sq, Sk]
             mask = mask & cm[None, None]
-        bm, p, pv = _block_attend(qf, kb, vb, scale=scale, mask=mask)
+        bm, p = _block_attend(qf, kb, scale=scale, mask=mask)
+        if dropout_rng is not None and dropout_rate > 0.0:
+            # one mask per global (q-block, k-block) pair: each pair is
+            # visited exactly once around the ring
+            block_rng = jax.random.fold_in(
+                jax.random.fold_in(dropout_rng, idx), owner
+            )
+            keep = jax.random.bernoulli(block_rng, 1.0 - dropout_rate,
+                                        p.shape)
+            p_out = p * keep / (1.0 - dropout_rate)
+        else:
+            p_out = p
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p_out, vb.astype(jnp.float32))
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)               # [B,H,Sq]
         l_new = l * alpha + jnp.sum(p, axis=-1) * jnp.exp(bm - m_new)
@@ -135,8 +155,8 @@ def make_ring_attention_impl(axis_name: str, causal: bool = False):
     (models/bert.py BertSelfAttention: ``impl(q, k, v, mask, dropout_rng=,
     dropout_rate=, dtype=)``) so a BERT built with this impl trains with
     sequence parallelism over ``axis_name``. ``mask`` is the [B, S_local]
-    attention (padding) mask shard. Attention-prob dropout is not applied in
-    the ring (deterministic attention; residual dropout still applies)."""
+    attention (padding) mask shard. Attention-prob dropout is applied
+    blockwise inside the ring (see `ring_attention`)."""
 
     def impl(q, k, v, mask, dropout_rng=None, dropout_rate=0.0, dtype=None):
         kv_mask = None
@@ -145,7 +165,8 @@ def make_ring_attention_impl(axis_name: str, causal: bool = False):
             # masked); ring wants boolean key validity [B, S]
             kv_mask = mask.reshape(mask.shape[0], mask.shape[-1]) > -1.0
         return ring_attention(q, k, v, axis_name, causal=causal,
-                              kv_mask=kv_mask)
+                              kv_mask=kv_mask, dropout_rng=dropout_rng,
+                              dropout_rate=dropout_rate)
 
     return impl
 

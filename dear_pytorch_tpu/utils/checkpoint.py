@@ -18,7 +18,6 @@ import os
 from typing import Optional
 
 import jax
-import numpy as np
 
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.parallel import dear as D
@@ -51,10 +50,14 @@ def save_checkpoint(
     step = int(jax.device_get(state.step))
     path = _ckpt_dir(directory, step)
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.abspath(path), jax.device_get(state))
-    meta = {"plan": plan_fingerprint(plan), "step": step}
-    with open(os.path.join(directory, f"meta_{step:010d}.json"), "w") as f:
-        json.dump(meta, f)
+    # Hand Orbax the live (possibly sharded) arrays: each process writes its
+    # addressable shards. A jax.device_get here would fail on non-addressable
+    # shards in multi-host runs and replicate everything through host RAM.
+    ckptr.save(os.path.abspath(path), state)
+    if jax.process_index() == 0:  # one writer for the sidecar on shared fs
+        meta = {"plan": plan_fingerprint(plan), "step": step}
+        with open(os.path.join(directory, f"meta_{step:010d}.json"), "w") as f:
+            json.dump(meta, f)
     return path
 
 
@@ -101,15 +104,13 @@ def restore_checkpoint(
     if template is None:
         raise ValueError("pass template=ts.init(...) output for shardings")
     ckptr = ocp.PyTreeCheckpointer()
-    # restore INTO the template's structure: a structureless restore returns
-    # a dict whose alphabetical key order would scramble DearState fields
-    # (model_state/comp_state vs opt_state/step)
-    restored = ckptr.restore(
+    # restore INTO the template's structure (a structureless restore returns
+    # a dict whose alphabetical key order would scramble DearState fields)
+    # and ONTO the template's shardings: each process reads only its own
+    # shards — no host-RAM replication, multi-host safe.
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+    return ckptr.restore(
         os.path.abspath(_ckpt_dir(directory, step)),
-        item=jax.device_get(template),
-    )
-    return jax.tree.map(
-        lambda v, ref: jax.device_put(np.asarray(v), ref.sharding),
-        restored,
-        template,
+        item=template,
+        restore_args=restore_args,
     )

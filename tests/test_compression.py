@@ -128,7 +128,7 @@ def test_gtopk_matches_topk_of_sum(mesh, world, rng):
     def per_device(t):
         comp = Z.get_compressor("topk")
         payload, _ = comp.compress(t, comp.init(n, t.dtype), density=k / n)
-        return Z.gtopk_sparse_allreduce(payload, n, t.dtype, DP_AXIS, k)
+        return Z.gtopk_sparse_allreduce(payload, n, t.dtype, DP_AXIS, k)[0]
 
     got = np.asarray(C.spmd_call(per_device, x, mesh=mesh))
     # every device agrees
@@ -206,3 +206,46 @@ def test_compression_rejected_outside_allreduce(mesh):
     with pytest.raises(ValueError, match="top-k"):
         build_train_step(loss_fn, params, mesh=mesh, mode="allreduce",
                          compressor="signum", gtopk=True)
+
+
+def test_gtopk_error_feedback_preserves_rejected_mass(mesh, world):
+    """Coordinates a device SENT but the global top-k REJECTED must return
+    to its error-feedback residual (reference wfbp/dopt.py:726-728) —
+    without the re-add their gradient mass is silently discarded."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+
+    n = 32
+    params = {"w": jnp.zeros((n,), jnp.float32)}
+    # device d's gradient: value (d+1) at indices {2d, 2d+1}. Local top-2
+    # sends exactly those; the global top-2 keeps only the last device's
+    # {2(w-1), 2(w-1)+1}.
+    c = np.zeros((world, n), np.float32)
+    for d in range(world):
+        c[d, 2 * d] = d + 1.0
+        c[d, 2 * d + 1] = d + 1.0
+    batch = jnp.asarray(c)
+
+    def loss_fn(p, b):
+        return jnp.sum(p["w"] * b[0])
+
+    ts = build_train_step(
+        loss_fn, params, mesh=mesh, mode="allreduce",
+        compressor="eftopk", density=2 / n, gtopk=True,
+        threshold_mb=None, donate=False,
+        optimizer=fused_sgd(lr=0.1),
+    )
+    state = ts.init(params)
+    state, _ = ts.step(state, batch)
+    res = np.asarray(state.comp_state[0])  # (world, padded)
+    for d in range(world - 1):  # globally rejected: mass back in residual
+        np.testing.assert_allclose(
+            res[d, 2 * d : 2 * d + 2], c[d, 2 * d : 2 * d + 2], rtol=1e-6
+        )
+    w = world - 1  # globally kept: applied to params, NOT residualized
+    np.testing.assert_allclose(res[w, 2 * w : 2 * w + 2], 0.0, atol=1e-7)
+    # nothing leaked anywhere else
+    mask = np.zeros((world, n), bool)
+    for d in range(world - 1):
+        mask[d, 2 * d : 2 * d + 2] = True
+    np.testing.assert_allclose(res[:, :n][~mask], 0.0, atol=1e-7)

@@ -50,6 +50,14 @@ def test_checkpoint_roundtrip_and_plan_guard(mesh, tmp_path):
 
     template = ts.init(params)
     restored = ckpt.restore_checkpoint(d, ts, template=template)
+    # restore lands ON the template's shardings (multi-host safe: no
+    # host-replicated detour through device_get)
+    def _check_sharding(r, t):
+        assert r.sharding.is_equivalent_to(t.sharding, r.ndim), (
+            r.sharding, t.sharding,
+        )
+
+    jax.tree.map(_check_sharding, restored, template)
     # exact roundtrip of every leaf (incl. sharded buffers and momentum)
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(

@@ -135,3 +135,39 @@ def test_ulysses_rejects_indivisible_heads(mesh):
 
     with pytest.raises(ValueError, match="heads"):
         _run_sharded(fn, *(jnp.zeros((B, S, 4, D)),) * 3, mesh=mesh)
+
+
+def test_ring_attention_dropout_matches_blockwise_reference(mesh):
+    """Attention-prob dropout in the ring == inverted dropout on the dense
+    softmax probs with the ring's per-(q-block, k-block) masks. Regression
+    for the silently-ignored dropout_rate (the dense model's
+    attention_probs_dropout_prob must be active under sp too)."""
+    q, k, v = _qkv(jax.random.PRNGKey(4))
+    world = mesh.shape[DP_AXIS]
+    rate = 0.3
+    drng = jax.random.PRNGKey(42)
+
+    def fn(qb, kb, vb):
+        out = ring_attention(qb[0], kb[0], vb[0], DP_AXIS,
+                             dropout_rng=drng, dropout_rate=rate)
+        return out[None]
+
+    got = _run_sharded(fn, q, k, v, mesh)
+
+    # dense reconstruction with the identical blockwise keep masks
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+    probs = np.asarray(jax.nn.softmax(s, axis=-1))
+    s_loc = S // world
+    keep = np.zeros((B, H, S, S), np.float32)
+    for i in range(world):          # q-block (device) index
+        for j in range(world):      # k-block (owner) index
+            blk = jax.random.bernoulli(
+                jax.random.fold_in(jax.random.fold_in(drng, i), j),
+                1.0 - rate, (B, H, s_loc, s_loc),
+            )
+            keep[:, :, i * s_loc:(i + 1) * s_loc,
+                 j * s_loc:(j + 1) * s_loc] = np.asarray(blk)
+    want = np.einsum("bhqk,bkhd->bqhd", probs * keep / (1.0 - rate),
+                     np.asarray(v))
+    assert keep.mean() < 0.95  # dropout actually dropped something
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
