@@ -22,6 +22,7 @@ switch does it):
   rb          reduce + broadcast decomposition         (dear/dopt_rb.py)
   mgwfbp      analytic MG-WFBP bucket sizing           (mgwfbp/)
   eftopk      compressed allreduce, 1% density         (wfbp sparse path)
+  bytescheduler  partitioned priority allreduce, 4 MB  (bytescheduler/)
 
 On machines without multiple accelerators pass ``--emulate N`` to run each
 cell on N virtual CPU devices (the reference could only sweep nworkers on a
@@ -53,6 +54,8 @@ METHOD_ARGS: dict[str, list[str]] = {
     "mgwfbp": ["--mode", "dear", "--mgwfbp"],
     "eftopk": ["--mode", "allreduce", "--threshold", "25",
                "--compressor", "eftopk", "--density", "0.01"],
+    "bytescheduler": ["--mode", "bytescheduler", "--threshold", "25",
+                      "--partition", "4"],
 }
 
 #: reference sweep workloads (benchmarks.py:21-28)

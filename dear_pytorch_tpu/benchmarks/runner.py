@@ -146,9 +146,14 @@ def add_common_args(parser) -> None:
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=5)
     parser.add_argument("--mode", type=str, default="dear",
-                        choices=["dear", "allreduce", "rsag", "rb"],
+                        choices=["dear", "allreduce", "rsag", "rb",
+                                 "bytescheduler"],
                         help="communication schedule (replaces the "
                              "reference's per-directory baselines)")
+    parser.add_argument("--partition", type=float, default=4.0,
+                        help="bytescheduler partition size in MB "
+                             "(reference bytescheduler --partition, "
+                             "imagenet_benchmark.py:37-38)")
     parser.add_argument("--threshold", type=float, default=25.0,
                         help="tensor-fusion threshold in MB "
                              "(reference THRESHOLD, dear/dopt_rsag.py:37); "
@@ -231,6 +236,7 @@ def config_from_args(args, *, fp16_comm: bool = True):
         momentum=args.momentum,
         comm_dtype=jnp.bfloat16 if (args.fp16 and fp16_comm) else None,
         rng_seed=42,
+        partition_mb=args.partition,
     )
 
 

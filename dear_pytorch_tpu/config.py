@@ -32,8 +32,9 @@ class DearConfig:
     """Every train-step knob in one place (defaults = the reference's)."""
 
     # schedule (replaces the reference's one-directory-per-method layout)
-    mode: str = "dear"                      # dear | allreduce | rsag | rb
+    mode: str = "dear"            # dear | allreduce | rsag | rb | bytescheduler
     exclude_parts: tuple = ()               # ('reducescatter'|'allgather')*
+    partition_mb: float = 4.0               # bytescheduler chunk size (MB)
 
     # tensor fusion (dear/dopt_rsag.py:37-40)
     threshold_mb: Optional[float] = 25.0
@@ -67,7 +68,8 @@ class DearConfig:
     donate: bool = True
 
     def __post_init__(self):
-        if self.mode not in ("dear", "allreduce", "rsag", "rb"):
+        if self.mode not in ("dear", "allreduce", "rsag", "rb",
+                             "bytescheduler"):
             raise ValueError(f"bad mode {self.mode!r}")
         if self.autotune not in (None, "bo", "wait_time"):
             raise ValueError(f"bad autotune {self.autotune!r}")
@@ -99,7 +101,7 @@ class DearConfig:
         if name in ("nearby_layers", "bo_trials", "bo_interval"):
             return None if raw.lower() in ("none", "") else int(raw)
         if name in ("lr", "momentum", "weight_decay", "density",
-                    "cycle_time_s"):
+                    "cycle_time_s", "partition_mb"):
             return float(raw)
         if name in ("gtopk", "nesterov", "donate", "compute_bf16"):
             return raw.lower() in ("1", "true", "yes")
@@ -139,6 +141,7 @@ class DearConfig:
             gtopk=self.gtopk,
             rng_seed=self.rng_seed,
             donate=self.donate,
+            partition_mb=self.partition_mb,
         )
 
     def describe(self) -> str:

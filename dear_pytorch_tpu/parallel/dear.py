@@ -38,6 +38,23 @@ Baseline schedules (same builder, ``mode=``):
   'rsag'      — per-bucket all-reduce decomposed as RS+AG inline
                 (WFBP's allReduceRSAG, wfbp/dopt.py:675-701)
   'rb'        — per-bucket reduce-to-root + broadcast (dear/dopt_rb.py)
+  'bytescheduler' — allreduce with tensor PARTITIONING + priority-shaped
+                dependencies (ByteScheduler, SOSP'19; reference
+                bytescheduler/imagenet_benchmark.py:73-82, --partition
+                :37-38). Each bucket's flat gradient splits into
+                ``partition_mb``-sized chunks; every chunk is an
+                INDEPENDENT reduction (as an RS+AG pair — XLA's
+                all-reduce combiner would re-fuse small all-reduces
+                and undo the partitioning). The reference enforces
+                priority with a credit-based userspace scheduler over
+                async NCCL ops; here priority is carried by dependency
+                shape — chunk order follows layer order, chunks never
+                depend on each other, so XLA's scheduler is free to
+                run early-layer chunks first and overlap the rest with
+                compute. (The reference's cross-iteration preemption
+                has no analog inside one jitted step; the dear mode's
+                gather-next-step pipelining is the XLA-native way to
+                get that effect.)
 """
 
 from __future__ import annotations
@@ -55,7 +72,7 @@ from dear_pytorch_tpu.ops import compression as Z
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.ops.fused_sgd import ShardOptimizer, fused_sgd
 
-MODES = ("dear", "allreduce", "rsag", "rb")
+MODES = ("dear", "allreduce", "rsag", "rb", "bytescheduler")
 #: Ablation switches (reference `exclude_parts`, dear/dear_dopt.py:75-76,
 #: dear/batch.sh:18-43). Time-breakdown instruments — numerics are garbage
 #: when a phase is excluded, exactly as in the reference.
@@ -92,6 +109,11 @@ class TrainStep(NamedTuple):
     gather_params: Callable[[DearState], Any]
     plan: F.FusionPlan
     mesh: jax.sharding.Mesh
+    #: AOT access to the jitted step: ``lower(state, batch)`` returns the
+    #: `jax.stages.Lowered` (``.compile().as_text()`` = optimized HLO;
+    #: ``.compile().cost_analysis()`` = FLOPs for MFU accounting). Same cache
+    #: as ``step`` — no double compile.
+    lower: Callable[[DearState, Any], Any] = None
 
 
 def _opt_bucket_specs(axis_name: str, bucket_padded: int, opt_state_leaf):
@@ -136,6 +158,7 @@ def build_train_step(
     gtopk: bool = False,
     batch_spec_fn: Optional[Callable[[Any], Any]] = None,
     mean_axes: Optional[Sequence[str]] = None,
+    partition_mb: float = 4.0,
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -182,6 +205,9 @@ def build_train_step(
         default "shard every leaf's dim 0 over axis_name" input layout —
         required for dp×sp, where the batch dim shards over 'dp' and the
         sequence dim over 'sp'.
+      partition_mb: 'bytescheduler' mode's chunk size (MB of the comm
+        dtype; the reference's ``--partition`` /
+        ``BYTESCHEDULER_PARTITION``). Ignored by other modes.
       mean_axes: the axes over which per-device losses are independent
         equal-weight samples (gradients are AVERAGED over these; summed over
         the rest). Defaults to all of ``axis_name``. For dp×sp pass
@@ -366,6 +392,22 @@ def build_train_step(
                 grad = C.all_reduce(gbuf, axis_name).astype(
                     state.buffers[g].dtype
                 ) / mean_world
+            elif mode == "bytescheduler":
+                # Fixed-size partitions, one independent reduction each;
+                # chunk order == layer order == priority order. Transport is
+                # the RS+AG decomposition, not plain all-reduce: XLA's
+                # all-reduce combiner re-fuses small neighboring all-reduces
+                # into one op (the compiler has its own bucketer), which
+                # would silently undo the partitioning — RS/AG pairs are not
+                # combined, so the per-chunk schedule survives compilation.
+                part = max(int(partition_mb * 2**20) // gbuf.dtype.itemsize, 1)
+                pieces = [
+                    C.all_reduce_rsag(gbuf[i:i + part], axis_name)
+                    for i in range(0, b.padded_size, part)
+                ]
+                grad = jnp.concatenate(pieces).astype(
+                    state.buffers[g].dtype
+                ) / mean_world
             elif mode == "rsag":
                 grad = C.all_reduce_rsag(gbuf, axis_name).astype(
                     state.buffers[g].dtype
@@ -459,7 +501,7 @@ def build_train_step(
 
     _compiled: dict = {}
 
-    def step(state: DearState, batch):
+    def _jitted(state: DearState, batch):
         key = jax.tree.structure((state, batch))
         fn = _compiled.get(key)
         if fn is None:
@@ -473,7 +515,13 @@ def build_train_step(
             )
             fn = jax.jit(mapped, donate_argnums=(0,) if donate else ())
             _compiled[key] = fn
-        return fn(state, batch)
+        return fn
+
+    def step(state: DearState, batch):
+        return _jitted(state, batch)(state, batch)
+
+    def lower(state: DearState, batch):
+        return _jitted(state, batch).lower(state, batch)
 
     def gather_params(state: DearState):
         """Materialize the full parameter pytree (for eval / checkpointing).
@@ -483,4 +531,4 @@ def build_train_step(
         return F.unpack_all(list(state.buffers), plan)
 
     return TrainStep(init=init, step=step, gather_params=gather_params,
-                     plan=plan, mesh=mesh)
+                     plan=plan, mesh=mesh, lower=lower)

@@ -1,0 +1,100 @@
+"""Optimized-HLO introspection: parse the scheduled entry computation and
+answer reachability questions about collectives vs compute.
+
+Why this exists: DeAR's performance claim is that per-bucket collectives
+overlap compute (RS under backward, AG under forward — reference
+dear/dear_dopt.py:242-308 wires it with CUDA streams and hooks). In this
+functional redesign the overlap is carried by the DEPENDENCY STRUCTURE of
+one XLA program: bucket g's all-gather must feed only layer-group g's
+forward, and bucket g's reduce-scatter must depend only on bucket g's
+gradients. Whether a backend then runs them concurrently is the scheduler's
+job (TPU's latency-hiding scheduler materializes async start/done pairs;
+the CPU emulation runs them synchronously) — but if the graph SERIALIZES
+them (e.g. a spurious token threads gather g into gather g+1, or all
+buckets collapse into one fused collective), no scheduler can overlap, on
+any backend. `tests/test_overlap.py` asserts the structure.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import NamedTuple
+
+
+class HloOp(NamedTuple):
+    name: str            # SSA name without the leading %
+    kind: str            # HLO opcode, e.g. 'all-gather', 'fusion', 'dot'
+    operands: tuple      # operand SSA names (direct only)
+    line: str            # full text line
+    index: int           # position in the scheduled entry sequence
+
+
+_OP_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*[^=]*?\s([\w\-]+)\(")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+
+def parse_entry(text: str) -> list[HloOp]:
+    """Ops of the ENTRY computation, in printed (scheduled) order."""
+    m = re.search(r"ENTRY [^{]*\{(.*?)\n\}", text, re.S)
+    if not m:
+        raise ValueError("no ENTRY computation found in HLO text")
+    ops = []
+    for raw in m.group(1).splitlines():
+        om = _OP_RE.match(raw)
+        if not om:
+            continue
+        name, kind = om.group(1), om.group(2)
+        # operands: %refs inside the top-level operand parens ONLY —
+        # attribute payloads after the closing paren (control-predecessors=,
+        # to_apply=, calls=) are NOT data operands and must not count as
+        # dependency edges (the scheduler pins ordering of independent ops
+        # via control-predecessors; treating those as ancestors would make
+        # the independence tests measure the wrong thing)
+        start = om.end() - 1          # position of the opening '('
+        depth = 0
+        end = len(raw)
+        for i in range(start, len(raw)):
+            if raw[i] == "(":
+                depth += 1
+            elif raw[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        refs = tuple(_OPERAND_RE.findall(raw[start:end]))
+        ops.append(HloOp(name, kind, refs, raw.strip(), len(ops)))
+    return ops
+
+
+def ancestors(ops: list[HloOp], name: str) -> set:
+    """Transitive operand closure (everything ``name`` depends on)."""
+    by_name = {o.name: o for o in ops}
+    seen: set = set()
+    stack = list(by_name[name].operands)
+    while stack:
+        n = stack.pop()
+        if n in seen or n not in by_name:
+            continue
+        seen.add(n)
+        stack.extend(by_name[n].operands)
+    return seen
+
+
+def find(ops: list[HloOp], kind_substr: str) -> list[HloOp]:
+    """Ops whose opcode contains ``kind_substr``, counting each async
+    collective ONCE: '-done' halves are dropped (unless explicitly asked
+    for), so 'all-gather' matches sync 'all-gather' and async
+    'all-gather-start' without double-counting on backends that split
+    collectives into start/done pairs."""
+    return [
+        o for o in ops
+        if kind_substr in o.kind
+        and (kind_substr.endswith("-done") or not o.kind.endswith("-done"))
+    ]
+
+
+COMPUTE_KINDS = ("fusion", "dot", "convolution")
+
+
+def compute_ops(ops: list[HloOp]) -> list[HloOp]:
+    return [o for o in ops if o.kind in COMPUTE_KINDS]
