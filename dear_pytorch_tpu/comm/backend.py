@@ -58,8 +58,12 @@ def _multiprocess_env_configured() -> bool:
     """
     if _env_flag("DEAR_DISABLE_DISTRIBUTED"):
         return False
+    n = _env_int("JAX_NUM_PROCESSES", "DEAR_NUM_PROCESSES")
+    if n is not None and n > 1:
+        return True
     for k in (
         "JAX_COORDINATOR_ADDRESS",
+        "DEAR_COORDINATOR_ADDRESS",
         "COORDINATOR_ADDRESS",
         "TPU_WORKER_HOSTNAMES",
         "MEGASCALE_COORDINATOR_ADDRESS",
@@ -69,6 +73,45 @@ def _multiprocess_env_configured() -> bool:
         if v and v not in ("localhost", "127.0.0.1"):
             return True
     return False
+
+
+def _env_int(*names: str) -> Optional[int]:
+    """First set variable among ``names`` parsed as int, with an error that
+    names the offending variable (a bare int() ValueError from deep inside
+    bootstrap detection is undebuggable on a remote host)."""
+    for k in names:
+        v = os.environ.get(k, "").strip()
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                raise ValueError(
+                    f"{k}={v!r} is not an integer (launcher contract: "
+                    "see launch/README.md)"
+                ) from None
+    return None
+
+
+def _initialize_kwargs() -> dict:
+    """Explicit bootstrap parameters from the launcher contract.
+
+    TPU pods need none of these (`jax.distributed.initialize()`
+    auto-detects peers from slice metadata); CPU/GPU clusters and the
+    launch/ scripts export ``JAX_COORDINATOR_ADDRESS`` +
+    ``JAX_NUM_PROCESSES`` + ``JAX_PROCESS_ID`` (or the ``DEAR_``-prefixed
+    equivalents), replacing the reference's mpirun -np/-hostfile pair
+    (dear/horovod_mpi_cj.sh:33-41, configs/cluster*).
+    """
+    kwargs: dict = {}
+    np_ = _env_int("JAX_NUM_PROCESSES", "DEAR_NUM_PROCESSES")
+    pid = _env_int("JAX_PROCESS_ID", "DEAR_PROCESS_ID")
+    addr = os.environ.get("DEAR_COORDINATOR_ADDRESS")
+    if np_ is not None and pid is not None:
+        kwargs["num_processes"] = np_
+        kwargs["process_id"] = pid
+    if addr:
+        kwargs["coordinator_address"] = addr
+    return kwargs
 
 
 def init(
@@ -99,7 +142,7 @@ def init(
         # (jax.devices/process_count would lock in a single-process world).
         if _multiprocess_env_configured():
             try:
-                jax.distributed.initialize()
+                jax.distributed.initialize(**_initialize_kwargs())
             except Exception as exc:  # pragma: no cover - env-specific
                 # A silently degraded "multi-host" run where every host
                 # trains alone is worse than a crash. Allow opt-in fallback

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Start N local processes joined into ONE jax.distributed cluster over
+# localhost — the smallest real multi-controller world. Debugs launch logic
+# and multi-process code paths without hardware; the same env contract
+# works host-per-process on a real CPU/GPU cluster.
+#
+# Usage: ./launch/cpu_cluster.sh <nprocs> -- <command...>
+set -euo pipefail
+
+if [ "$#" -lt 3 ]; then
+    echo "usage: $0 <nprocs> -- <command...>" >&2
+    exit 2
+fi
+NPROCS=$1; shift
+[ "${1:-}" = "--" ] && shift
+
+PORT=$(( 20000 + RANDOM % 20000 ))
+PIDS=()
+for (( i=0; i<NPROCS; i++ )); do
+    JAX_PLATFORMS=cpu \
+    JAX_COORDINATOR_ADDRESS="127.0.0.1:${PORT}" \
+    JAX_NUM_PROCESSES="$NPROCS" \
+    JAX_PROCESS_ID="$i" \
+    DEAR_DISABLE_DISTRIBUTED= \
+    "$@" &
+    PIDS+=($!)
+done
+
+rc=0
+for pid in "${PIDS[@]}"; do
+    wait "$pid" || rc=$?
+done
+exit "$rc"

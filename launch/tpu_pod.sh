@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Launch a command on every host of a TPU pod slice (one process per host).
+# TPU-native replacement for the reference's mpirun launcher
+# (dear/horovod_mpi_cj.sh): no hostfile, no NCCL env — peers are discovered
+# from slice metadata by jax.distributed.initialize inside dear.init().
+#
+# Usage:
+#   ./launch/tpu_pod.sh <tpu-name> <zone> [--project <p>] -- <command...>
+set -euo pipefail
+
+if [ "$#" -lt 4 ]; then
+    echo "usage: $0 <tpu-name> <zone> [--project <p>] -- <command...>" >&2
+    exit 2
+fi
+
+TPU_NAME=$1; ZONE=$2; shift 2
+PROJECT_ARG=()
+if [ "${1:-}" = "--project" ]; then
+    PROJECT_ARG=(--project "$2"); shift 2
+fi
+[ "${1:-}" = "--" ] && shift
+
+# Run from the repo checkout on each worker; DEAR_* env vars present in the
+# local shell are forwarded (the launcher-facing config layer, config.py),
+# each value shell-quoted so spaces/metacharacters survive the ssh command.
+DEAR_ENV=""
+while IFS='=' read -r k v; do
+    DEAR_ENV+="export ${k}=$(printf %q "$v"); "
+done < <(env | grep '^DEAR_[A-Z_]*=' || true)
+
+exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+    --zone="$ZONE" "${PROJECT_ARG[@]}" --worker=all \
+    --command="${DEAR_ENV} cd \$HOME/dear_pytorch_tpu && $*"

@@ -1,0 +1,55 @@
+"""Launch a real 2-process CPU cluster (jax.distributed over localhost) and
+run tests/mp_worker.py in every rank — the CI-able replacement for the
+reference's mpirun-only multi-node checks (common/comm_core/tests/
+test_comm.py, runnable only on a GPU cluster). Covers the multi-process
+branches of init/barrier/broadcast_parameters/allreduce and a cross-process
+dear train step."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+NPROCS = 2
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(300)
+def test_two_process_cluster():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "mp_worker.py")
+    port = _free_port()
+    procs = []
+    for pid in range(NPROCS):
+        env = dict(os.environ)
+        env.pop("DEAR_DISABLE_DISTRIBUTED", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = str(NPROCS)
+        env["JAX_PROCESS_ID"] = str(pid)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out[-3000:]}"
+        assert f"MP_WORKER_OK rank={pid}/{NPROCS}" in out, out[-3000:]
