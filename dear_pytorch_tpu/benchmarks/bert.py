@@ -97,11 +97,17 @@ def main(argv=None) -> runner.BenchResult:
     runner.log(f"Schedule: {args.mode}; "
                f"fusion: {ts.plan.num_buckets} bucket(s)")
 
+    from dear_pytorch_tpu.runtime import pipeline as RP
+
+    spec = RP.bert_spec(global_bs, args.sentence_len,
+                        vocab=cfg.vocab_size)
+    next_batch, close = runner.make_batch_source(args, spec, sharding, batch)
+
     holder = {"state": state, "metrics": None}
 
     def step_fn():
         holder["state"], holder["metrics"] = stepper.step(
-            holder["state"], batch
+            holder["state"], next_batch()
         )
 
     def sync():
@@ -124,6 +130,7 @@ def main(argv=None) -> runner.BenchResult:
     finally:
         if args.profile_dir:
             jax.profiler.stop_trace()
+        close()
     return result
 
 

@@ -96,11 +96,19 @@ def main(argv=None) -> runner.BenchResult:
     runner.log(f"Schedule: {args.mode}; "
                f"fusion: {ts.plan.num_buckets} bucket(s)")
 
+    from dear_pytorch_tpu.runtime import pipeline as RP
+
+    spec = (
+        RP.mnist_spec(global_bs) if args.model.lower() == "mnistnet"
+        else RP.image_spec(global_bs, image_size=image_size)
+    )
+    next_batch, close = runner.make_batch_source(args, spec, sharding, batch)
+
     holder = {"state": state, "metrics": None}
 
     def step_fn():
         holder["state"], holder["metrics"] = stepper.step(
-            holder["state"], batch
+            holder["state"], next_batch()
         )
 
     def sync():
@@ -123,6 +131,7 @@ def main(argv=None) -> runner.BenchResult:
     finally:
         if args.profile_dir:
             jax.profiler.stop_trace()
+        close()
     return result
 
 

@@ -154,6 +154,15 @@ def add_common_args(parser) -> None:
                         help="bytescheduler partition size in MB "
                              "(reference bytescheduler --partition, "
                              "imagenet_benchmark.py:37-38)")
+    parser.add_argument("--pipeline", type=str, default="none",
+                        choices=["none", "native", "numpy"],
+                        help="input pipeline: 'none' re-feeds one "
+                             "pre-generated batch (the reference's "
+                             "fixed-fake-data protocol, "
+                             "imagenet_benchmark.py:97-103); 'native' "
+                             "streams fresh batches from the C++ "
+                             "ring-buffer producers (csrc/dear_runtime.cpp); "
+                             "'numpy' uses the pure-python fallback")
     parser.add_argument("--threshold", type=float, default=25.0,
                         help="tensor-fusion threshold in MB "
                              "(reference THRESHOLD, dear/dopt_rsag.py:37); "
@@ -169,6 +178,11 @@ def add_common_args(parser) -> None:
     parser.add_argument("--density", type=float, default=1.0,
                         help="sparsification density for topk-family "
                              "compressors")
+    parser.add_argument("--momentum-correction", type=float, default=0.0,
+                        help="DGC-style momentum correction coefficient "
+                             "for sparse compressed training (reference "
+                             "wfbp/dopt.py:769-775; disables optimizer "
+                             "momentum while active)")
     parser.add_argument("--gtopk", action="store_true", default=False,
                         help="gTop-k recursive-halving sparse allreduce "
                              "(with a top-k-family --compressor)")
@@ -187,6 +201,47 @@ def add_common_args(parser) -> None:
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="write a jax.profiler trace of the timed "
                              "region here")
+
+
+def make_batch_source(args, spec, sharding, template_batch):
+    """``(next_batch, close)`` for the timed loop, honoring ``--pipeline``.
+
+    'none' returns the constant pre-staged ``template_batch`` every step
+    (the reference's fixed-fake-data measurement protocol). 'native'/'numpy'
+    stream fresh host batches from `runtime.Pipeline` — produced by C++
+    ring-buffer threads (or the numpy fallback) while the previous step
+    runs — and stage each onto the mesh with ``jax.device_put``.
+    """
+    if args.pipeline == "none":
+        return (lambda: template_batch), (lambda: None)
+
+    import jax
+
+    from dear_pytorch_tpu.runtime import pipeline as RP
+
+    if args.pipeline == "native":
+        if not RP.native_available():
+            raise SystemExit(
+                "--pipeline native: the native runtime library is not "
+                "available (csrc/dear_runtime.cpp failed to build?)"
+            )
+        pl = RP.Pipeline(spec)
+    else:
+        pl = RP.NumpyPipeline(spec)
+
+    # stage in the template's dtypes: under --fp16 the template is bf16 and
+    # staging the pipeline's f32 fields raw would double the host->device
+    # bytes — exactly the transfer cost this flag exists to measure
+    tmpl_dtypes = {k: v.dtype for k, v in template_batch.items()}
+
+    def next_batch():
+        host = pl.next()
+        return {
+            k: jax.device_put(v.astype(tmpl_dtypes[k]), sharding)
+            for k, v in host.items()
+        }
+
+    return next_batch, pl.close
 
 
 def parse_exclude_parts(s: str) -> tuple[str, ...]:
@@ -232,6 +287,9 @@ def config_from_args(args, *, fp16_comm: bool = True):
         compressor=args.compressor if use_compression else None,
         density=args.density,
         gtopk=args.gtopk and use_compression,
+        momentum_correction=(
+            args.momentum_correction if use_compression else 0.0
+        ),
         lr=args.base_lr,
         momentum=args.momentum,
         comm_dtype=jnp.bfloat16 if (args.fp16 and fp16_comm) else None,

@@ -52,6 +52,7 @@ class DearConfig:
     compressor: Optional[str] = None
     density: float = 1.0
     gtopk: bool = False
+    momentum_correction: float = 0.0        # DGC mc coefficient (sparse only)
 
     # optimizer
     lr: float = 0.01
@@ -101,7 +102,7 @@ class DearConfig:
         if name in ("nearby_layers", "bo_trials", "bo_interval"):
             return None if raw.lower() in ("none", "") else int(raw)
         if name in ("lr", "momentum", "weight_decay", "density",
-                    "cycle_time_s", "partition_mb"):
+                    "cycle_time_s", "partition_mb", "momentum_correction"):
             return float(raw)
         if name in ("gtopk", "nesterov", "donate", "compute_bf16"):
             return raw.lower() in ("1", "true", "yes")
@@ -123,8 +124,12 @@ class DearConfig:
     def optimizer(self):
         from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
 
+        # with momentum correction the LOCAL pre-sparsification velocity
+        # carries the momentum; the reference's step likewise bypasses its
+        # SGD momentum buffer (wfbp/dopt.py:934-942)
+        momentum = 0.0 if self.momentum_correction > 0 else self.momentum
         return fused_sgd(
-            lr=self.lr, momentum=self.momentum,
+            lr=self.lr, momentum=momentum,
             weight_decay=self.weight_decay, nesterov=self.nesterov,
         )
 
@@ -139,6 +144,7 @@ class DearConfig:
             compressor=self.compressor,
             density=self.density,
             gtopk=self.gtopk,
+            momentum_correction=self.momentum_correction,
             rng_seed=self.rng_seed,
             donate=self.donate,
             partition_mb=self.partition_mb,

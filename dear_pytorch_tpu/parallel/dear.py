@@ -156,6 +156,7 @@ def build_train_step(
     compressor: Optional[str] = None,
     density: float = 1.0,
     gtopk: bool = False,
+    momentum_correction: float = 0.0,
     batch_spec_fn: Optional[Callable[[Any], Any]] = None,
     mean_axes: Optional[Sequence[str]] = None,
     partition_mb: float = 4.0,
@@ -196,6 +197,16 @@ def build_train_step(
         uses the recursive-halving gTop-k reduction (wfbp/dopt.py:50-107)
         instead of allgather-accumulate. Sign compressors perform majority
         vote; their "gradient" is ±1 (signSGD — scale lives in the lr).
+      momentum_correction: DGC-style momentum correction for SPARSE
+        compressed training (Lin et al. 2018; reference wfbp/dopt.py:769-775
+        local velocity accumulation, :946-951 post-step mask). When > 0, a
+        LOCAL velocity ``u = mc·u + g`` is sparsified instead of the raw
+        gradient, and ``u`` is cleared at the coordinates actually sent —
+        momentum for rarely-sent coordinates keeps accumulating locally
+        instead of being lost to sparsification. The optimizer should then
+        be momentum-free (the velocity already carries it); the reference
+        likewise bypasses its SGD momentum buffer when correction is on
+        (wfbp/dopt.py:934-942).
       axis_name: one mesh axis name, or a TUPLE of axis names — e.g.
         ``('dp', 'sp')`` for combined data + sequence parallelism. Gradients
         reduce-scatter over every listed axis (the ZeRO shard degree is the
@@ -270,6 +281,12 @@ def build_train_step(
         )
     if gtopk and comp.name not in Z.SPARSE:
         raise ValueError("gtopk requires a top-k-family compressor")
+    if momentum_correction and comp.name not in Z.SPARSE:
+        raise ValueError(
+            "momentum_correction requires a sparse (top-k-family) "
+            "compressor (reference wfbp/dopt.py:769: mc applies on the "
+            "sparse path only)"
+        )
 
     # ---- per-device step body (runs inside shard_map) ----------------------
 
@@ -347,14 +364,26 @@ def build_train_step(
                 grad = gshard.astype(state.buffers[g].dtype) / mean_world
             elif compressed:
                 pdtype = state.buffers[g].dtype
-                res_entry = state.comp_state[g]
+                centry = state.comp_state[g]
+                if momentum_correction:
+                    res_entry, vel_entry = centry["res"], centry["vel"]
+                else:
+                    res_entry, vel_entry = centry, None
                 stateless = isinstance(res_entry, tuple)
                 res = () if stateless else res_entry.reshape(
                     res_entry.shape[1:]
                 )
-                payload, new_res = comp.compress(
-                    gbuf.astype(pdtype), res, density
-                )
+                gin = gbuf.astype(pdtype)
+                if momentum_correction:
+                    # local velocity accumulates momentum BEFORE
+                    # sparsification (wfbp/dopt.py:769-775)
+                    vel = (
+                        momentum_correction
+                        * vel_entry.reshape(vel_entry.shape[1:])
+                        + gin
+                    )
+                    gin = vel
+                payload, new_res = comp.compress(gin, res, density)
                 if comp.name in Z.SIGN:
                     grad = Z.sign_majority_vote_allreduce(
                         payload, b.padded_size, pdtype, axis_name
@@ -387,7 +416,14 @@ def build_train_step(
                     grad = Z.sparse_allreduce(
                         payload, b.padded_size, pdtype, axis_name
                     )
-                new_comp.append(() if stateless else new_res[None, :])
+                new_centry = () if stateless else new_res[None, :]
+                if momentum_correction:
+                    # clear velocity at SENT coordinates (the reference's
+                    # post-step `buf *= zero_condition`, wfbp/dopt.py:946-951
+                    # with compression.py:42-48)
+                    vel = vel.at[payload["indices"]].set(0.0)
+                    new_centry = {"res": new_centry, "vel": vel[None, :]}
+                new_comp.append(new_centry)
             elif mode == "allreduce":
                 grad = C.all_reduce(gbuf, axis_name).astype(
                     state.buffers[g].dtype
@@ -483,10 +519,21 @@ def build_train_step(
         step0 = jnp.zeros((), jnp.int32)
         if compressed:
             stateful = not isinstance(comp.init(1, jnp.float32), tuple)
+
+            def centry(b, buf):
+                res = (
+                    jnp.zeros((world, b.padded_size), buf.dtype)
+                    if stateful else ()
+                )
+                if momentum_correction:
+                    return {
+                        "res": res,
+                        "vel": jnp.zeros((world, b.padded_size), buf.dtype),
+                    }
+                return res
+
             comp_state = tuple(
-                jnp.zeros((world, b.padded_size), buf.dtype)
-                if stateful else ()
-                for b, buf in zip(plan.buckets, bufs)
+                centry(b, buf) for b, buf in zip(plan.buckets, bufs)
             )
         else:
             comp_state = ()

@@ -16,6 +16,12 @@ from dear_pytorch_tpu.tuning.mgwfbp import (  # noqa: F401
     mgwfbp_layer_groups,
     plan_mgwfbp,
 )
+from dear_pytorch_tpu.tuning.sparse_groups import (  # noqa: F401
+    asc_layer_groups,
+    mgs_layer_groups,
+    plan_asc,
+    plan_mgs,
+)
 from dear_pytorch_tpu.tuning.wait_time import (  # noqa: F401
     estimate_layer_backward_times,
     wait_time_flags,

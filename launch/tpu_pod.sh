@@ -28,6 +28,8 @@ while IFS='=' read -r k v; do
     DEAR_ENV+="export ${k}=$(printf %q "$v"); "
 done < <(env | grep '^DEAR_[A-Z_]*=' || true)
 
+CMD=$(printf '%q ' "$@")  # preserve argument quoting on the remote shell
+
 exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
     --zone="$ZONE" "${PROJECT_ARG[@]}" --worker=all \
-    --command="${DEAR_ENV} cd \$HOME/dear_pytorch_tpu && $*"
+    --command="${DEAR_ENV} cd \$HOME/dear_pytorch_tpu && ${CMD}"

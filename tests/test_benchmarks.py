@@ -70,3 +70,29 @@ def test_imagenet_compressed_allreduce(mesh):
         ["--model", "mnistnet", "--batch-size", "4", "--mode", "allreduce",
          "--compressor", "eftopk", "--density", "0.1"] + TINY
     )
+
+
+@pytest.mark.parametrize("pl", ["native", "numpy"])
+def test_imagenet_streaming_pipeline(mesh, pl):
+    """--pipeline native|numpy feeds the timed loop fresh ring-buffer
+    batches instead of one re-fed array; throughput must stay in the same
+    regime as batch re-feed (catches a stalled producer or a host-side
+    serialization)."""
+    base = imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4"] + TINY
+    )
+    res = imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4", "--pipeline", pl]
+        + TINY
+    )
+    assert res.total_mean > 0
+    assert res.total_mean > base.total_mean / 5, (res, base)
+
+
+def test_bert_streaming_pipeline(mesh):
+    res = bert_bench.main(
+        ["--model", "bert_base", "--num-hidden-layers", "1",
+         "--sentence-len", "16", "--batch-size", "2",
+         "--pipeline", "native"] + TINY
+    )
+    assert res.unit == "sen" and res.total_mean > 0
