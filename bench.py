@@ -1,18 +1,23 @@
-"""Headline benchmark: ResNet-50 end-to-end training throughput per chip.
+"""Headline benchmarks: ResNet-50 and BERT-Base end-to-end training
+throughput per chip, with MFU accounting.
 
 Reproduces the reference's measurement protocol (dear/imagenet_benchmark.py:
-151-172): 10 warmup batches, then 5 timed runs of 10 batches each; reports
-images/sec as mean over runs. Runs the full DeAR train step (pack →
-reduce-scatter → fused-SGD → all-gather schedule; trivial collectives at
-world=1) with bf16 compute / f32 master params — the TPU-first configuration.
+151-172, dear/bert_benchmark.py:160-175): warmup batches, then timed runs of
+N batches each; reports work-items/sec as mean over runs. Runs the full DeAR
+train step (pack → reduce-scatter → fused-SGD → all-gather schedule; trivial
+collectives at world=1) with bf16 compute / f32 master params — the
+TPU-first configuration.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+Prints ONE JSON line (the driver contract), primary metric first:
+  {"metric": "resnet50_bs64_train_img_sec_per_chip", "value": N,
+   "unit": "img/s", "vs_baseline": N, "mfu": F,
+   "extra_metrics": [{"metric": "bert_base_sen_sec_per_chip", ...}]}
 
 ``vs_baseline`` is relative to BASELINE_IMG_SEC, the first end-to-end
-measurement of this framework on the session's single TPU v5e chip (round 1);
-the reference publishes no numbers of its own (BASELINE.md), so progress is
-tracked against our own round-1 throughput.
+measurement of this framework on the session's single TPU v5e chip (round
+1); the reference publishes no numbers of its own (BASELINE.md), so progress
+is tracked against our own round-1 throughput. ``mfu`` = achieved FLOP/s
+(XLA cost analysis of the compiled step) over the chip's bf16 peak.
 
 Timing protocol for the axon tunnel (remote device): dispatch each timed
 run's steps asynchronously and fetch ONE scalar that depends on the last
@@ -23,6 +28,7 @@ step; per-step host syncs would add ~60ms RPC latency each and
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -33,24 +39,77 @@ import numpy as np
 # Round-1 pin: ResNet-50 bs=64 bf16 train step, TPU v5 lite (1 chip),
 # ~33.5 ms/step.
 BASELINE_IMG_SEC = 1910.0
+# BERT pin: first driver-captured measurement (this round); vs_baseline is
+# tracked against it from the next round on.
+BASELINE_BERT_SEN_SEC = None
 
-BATCH_SIZE = 64
-WARMUP_BATCHES = 10
-NUM_ITERS = 5
-NUM_BATCHES_PER_ITER = 10
+#: bf16 peak FLOP/s per chip by device-kind substring (v5e ≈ 197 TFLOP/s).
+PEAK_FLOPS = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6": 918e12,
+}
+
+SMOKE = bool(os.environ.get("DEAR_BENCH_SMOKE"))  # tiny shapes, CPU-safe
+
+WARMUP_BATCHES = 2 if SMOKE else 10
+NUM_ITERS = 2 if SMOKE else 5
+NUM_BATCHES_PER_ITER = 2 if SMOKE else 10
 
 
-def main() -> None:
+def _peak_flops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return 0.0  # unknown device: mfu reported as null
+
+
+def _compile_once(ts, state, batch):
+    """(step_fn, flops): ONE AOT compilation serving both the timed loop
+    and cost analysis (ts.step's jit dispatch would compile a second,
+    identical executable)."""
+    compiled = ts.lower(state, batch).compile()
+    try:
+        flops = float(compiled.cost_analysis().get("flops", 0.0))
+    except Exception:
+        flops = 0.0
+    return compiled, flops
+
+
+def _timed(step_fn, state, batch, items_per_batch: int):
+    """(value items/s, secs/step, state) under the async-dispatch protocol."""
+    metrics = None
+    for _ in range(WARMUP_BATCHES):
+        state, metrics = step_fn(state, batch)
+    float(metrics["loss"])  # drain the pipeline once before timing
+    times = []
+    for _ in range(NUM_ITERS):
+        t0 = time.perf_counter()
+        for _ in range(NUM_BATCHES_PER_ITER):
+            state, metrics = step_fn(state, batch)
+        float(metrics["loss"])  # one device->host scalar fetch per run
+        times.append(time.perf_counter() - t0)
+    rates = [items_per_batch * NUM_BATCHES_PER_ITER / t for t in times]
+    secs_per_step = float(np.mean(times)) / NUM_BATCHES_PER_ITER
+    return float(np.mean(rates)), secs_per_step, state
+
+
+def bench_resnet(mesh):
     from dear_pytorch_tpu import models
-    from dear_pytorch_tpu.comm import backend
     from dear_pytorch_tpu.models import data
     from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
     from dear_pytorch_tpu.parallel import dear as D
 
-    mesh = backend.init()
-    model = models.get_model("resnet50", dtype=jnp.bfloat16)
+    batch_size = 8 if SMOKE else 64
+    model = models.get_model(
+        "resnet18" if SMOKE else "resnet50", dtype=jnp.bfloat16
+    )
     batch = data.synthetic_image_batch(
-        jax.random.PRNGKey(0), BATCH_SIZE, dtype=jnp.bfloat16
+        jax.random.PRNGKey(0), batch_size,
+        image_size=64 if SMOKE else 224, dtype=jnp.bfloat16,
     )
     variables = model.init(
         {"params": jax.random.PRNGKey(0)}, batch["image"], train=False
@@ -76,31 +135,98 @@ def main() -> None:
         model_state_template=model_state,
     )
     state = ts.init(params, model_state)
+    step_fn, flops = _compile_once(ts, state, batch)
+    value, secs_per_step, _ = _timed(step_fn, state, batch, batch_size)
+    return {
+        "metric": "resnet50_bs64_train_img_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "img/s",
+        "vs_baseline": round(value / BASELINE_IMG_SEC, 3),
+        "mfu": _mfu(flops, secs_per_step),
+    }
 
-    for _ in range(WARMUP_BATCHES):
-        state, metrics = ts.step(state, batch)
-    float(metrics["loss"])  # drain the pipeline once before timing
 
-    times = []
-    for _ in range(NUM_ITERS):
-        t0 = time.perf_counter()
-        for _ in range(NUM_BATCHES_PER_ITER):
-            state, metrics = ts.step(state, batch)
-        float(metrics["loss"])  # one device->host scalar fetch per run
-        times.append(time.perf_counter() - t0)
+def bench_bert(mesh):
+    """BERT-Base pretraining throughput (the reference's second headline,
+    dear/bert_benchmark.py:160-175; sentence length from the launcher,
+    horovod_mpi_cj.sh:6)."""
+    from dear_pytorch_tpu import models
+    from dear_pytorch_tpu.models import data
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import dear as D
 
-    img_secs = [BATCH_SIZE * NUM_BATCHES_PER_ITER / t for t in times]
-    value = float(np.mean(img_secs))
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_bs64_train_img_sec_per_chip",
-                "value": round(value, 2),
-                "unit": "img/s",
-                "vs_baseline": round(value / BASELINE_IMG_SEC, 3),
-            }
+    batch_size = 4 if SMOKE else 32
+    seq_len = 32 if SMOKE else 64
+    model = models.get_model("bert_base", dtype=jnp.bfloat16)
+    if SMOKE:
+        import dataclasses
+
+        model = models.BertForPreTraining(
+            dataclasses.replace(model.config, num_hidden_layers=2)
         )
+    cfg = model.config
+    batch = data.synthetic_bert_batch(
+        jax.random.PRNGKey(0), batch_size, seq_len=seq_len,
+        vocab_size=cfg.vocab_size,
     )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, batch["input_ids"], train=False
+    )["params"]
+
+    def loss_fn(p, b, rng):
+        logits, nsp = model.apply(
+            {"params": p}, b["input_ids"], b["token_type_ids"],
+            b["attention_mask"], train=True, rngs={"dropout": rng},
+        )
+        return models.bert_pretraining_loss(
+            logits.astype(jnp.float32), nsp.astype(jnp.float32),
+            b["masked_lm_labels"], b["next_sentence_labels"],
+        )
+
+    ts = D.build_train_step(
+        loss_fn,
+        params,
+        mesh=mesh,
+        mode="dear",
+        threshold_mb=25.0,
+        optimizer=fused_sgd(lr=2e-5, momentum=0.0),
+        comm_dtype=jnp.bfloat16,
+        rng_seed=42,
+    )
+    state = ts.init(params)
+    step_fn, flops = _compile_once(ts, state, batch)
+    value, secs_per_step, _ = _timed(step_fn, state, batch, batch_size)
+    out = {
+        "metric": "bert_base_sen_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "sen/s",
+        "mfu": _mfu(flops, secs_per_step),
+    }
+    if BASELINE_BERT_SEN_SEC:
+        out["vs_baseline"] = round(value / BASELINE_BERT_SEN_SEC, 3)
+    return out
+
+
+def _mfu(flops: float, secs_per_step: float):
+    peak = _peak_flops()
+    if not (flops and peak and secs_per_step):
+        return None
+    return round(flops / secs_per_step / peak, 4)
+
+
+def main() -> None:
+    from dear_pytorch_tpu.comm import backend
+
+    mesh = backend.init()
+    resnet = bench_resnet(mesh)
+    try:
+        bert = bench_bert(mesh)
+    except Exception as exc:  # second metric must not sink the primary
+        bert = {"metric": "bert_base_sen_sec_per_chip",
+                "error": f"{type(exc).__name__}: {exc}"[:200]}
+    out = dict(resnet)
+    out["extra_metrics"] = [bert]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

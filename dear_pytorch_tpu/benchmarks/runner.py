@@ -237,7 +237,7 @@ def make_batch_source(args, spec, sharding, template_batch):
     def next_batch():
         host = pl.next()
         return {
-            k: jax.device_put(v.astype(tmpl_dtypes[k]), sharding)
+            k: jax.device_put(v.astype(tmpl_dtypes[k], copy=False), sharding)
             for k, v in host.items()
         }
 

@@ -149,6 +149,23 @@ def _leaf_specs(params) -> tuple[tuple[LeafSpec, ...], Any]:
     return tuple(specs), treedef
 
 
+def layer_sizes(
+    params, *, in_bytes: bool = True, comm_itemsize: Optional[int] = None
+) -> list[float]:
+    """Per-atomic-layer sizes in forward order — bytes (optionally at a
+    fixed comm itemsize) or element counts. Shared by every analytic
+    bucketizer (MG-WFBP / ASC / MGS) so their layer accounting can never
+    drift apart."""
+    specs, _ = _leaf_specs(params)
+    acc: dict[int, float] = {}
+    for s in specs:
+        unit = (
+            (comm_itemsize or jnp.dtype(s.dtype).itemsize) if in_bytes else 1
+        )
+        acc[s.layer] = acc.get(s.layer, 0.0) + s.size * unit
+    return [acc[k] for k in sorted(acc)]
+
+
 def _layers(specs: Sequence[LeafSpec]) -> list[list[int]]:
     """Leaf ids grouped by atomic layer, in first-appearance order."""
     out: dict[int, list[int]] = {}

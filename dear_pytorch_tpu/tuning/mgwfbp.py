@@ -141,12 +141,7 @@ def plan_mgwfbp(
     ``alpha``/``beta``: measured ICI model
     (`utils.profiling.CommunicationProfiler.fit`).
     """
-    specs, _ = F._leaf_specs(params)
-    layer_bytes: dict[int, float] = {}
-    for s in specs:
-        itemsize = comm_itemsize or jnp.dtype(s.dtype).itemsize
-        layer_bytes[s.layer] = layer_bytes.get(s.layer, 0.0) + s.size * itemsize
-    sizes = [layer_bytes[k] for k in sorted(layer_bytes)]
+    sizes = F.layer_sizes(params, in_bytes=True, comm_itemsize=comm_itemsize)
     if len(sizes) != len(layer_times):
         raise ValueError(
             f"{len(layer_times)} layer times for {len(sizes)} layers"

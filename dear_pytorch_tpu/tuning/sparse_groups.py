@@ -27,8 +27,6 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-import jax.numpy as jnp
-
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.utils import perf_model
 
@@ -121,8 +119,12 @@ def mgs_layer_groups(
         return perf_model.topk_perf_model(int(n), topk_s)
 
     def t_ag(n):
-        # sparse all-gather of 2k entries per device (values + indices)
-        k = max(n * density, 1.0) if n else 0.0
+        # sparse all-gather of 2k entries per device (values + indices);
+        # merged-away buckets (n == 0) cost NOTHING — charging them the
+        # (world-1)·α startup would inflate every later merge decision
+        if not n:
+            return 0.0
+        k = max(n * density, 1.0)
         return perf_model.allgather_perf_model(
             2.0 * k * itemsize * world, world, alpha, beta
         )
@@ -186,17 +188,6 @@ def mgs_layer_groups(
     return [sorted(g) for g in reversed(groups)]
 
 
-def _layer_sizes(params, *, in_bytes: bool, comm_itemsize: Optional[int]):
-    specs, _ = F._leaf_specs(params)
-    acc: dict[int, float] = {}
-    for s in specs:
-        unit = (
-            (comm_itemsize or jnp.dtype(s.dtype).itemsize) if in_bytes else 1
-        )
-        acc[s.layer] = acc.get(s.layer, 0.0) + s.size * unit
-    return [acc[k] for k in sorted(acc)]
-
-
 def plan_asc(
     params,
     world: int,
@@ -207,7 +198,7 @@ def plan_asc(
     comm_itemsize: Optional[int] = None,
 ) -> F.FusionPlan:
     """`FusionPlan` with ASC bucket boundaries."""
-    sizes = _layer_sizes(params, in_bytes=True, comm_itemsize=comm_itemsize)
+    sizes = F.layer_sizes(params, in_bytes=True, comm_itemsize=comm_itemsize)
     if len(sizes) != len(layer_times):
         raise ValueError(
             f"{len(layer_times)} layer times for {len(sizes)} layers"
@@ -229,7 +220,7 @@ def plan_mgs(
 ) -> F.FusionPlan:
     """`FusionPlan` with MGS-SGD bucket boundaries (use with the sparse
     compressed-allreduce schedule)."""
-    sizes = _layer_sizes(params, in_bytes=False, comm_itemsize=None)
+    sizes = F.layer_sizes(params, in_bytes=False)
     if len(sizes) != len(layer_times):
         raise ValueError(
             f"{len(layer_times)} layer times for {len(sizes)} layers"
