@@ -43,28 +43,11 @@ BASELINE_IMG_SEC = 1910.0
 # tracked against it from the next round on.
 BASELINE_BERT_SEN_SEC = None
 
-#: bf16 peak FLOP/s per chip by device-kind substring (v5e ≈ 197 TFLOP/s).
-PEAK_FLOPS = {
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v4": 275e12,
-    "v5p": 459e12,
-    "v6": 918e12,
-}
-
 SMOKE = bool(os.environ.get("DEAR_BENCH_SMOKE"))  # tiny shapes, CPU-safe
 
 WARMUP_BATCHES = 2 if SMOKE else 10
 NUM_ITERS = 2 if SMOKE else 5
 NUM_BATCHES_PER_ITER = 2 if SMOKE else 10
-
-
-def _peak_flops() -> float:
-    kind = jax.devices()[0].device_kind.lower()
-    for key, peak in PEAK_FLOPS.items():
-        if key in kind:
-            return peak
-    return 0.0  # unknown device: mfu reported as null
 
 
 def _compile_once(ts, state, batch):
@@ -208,10 +191,10 @@ def bench_bert(mesh):
 
 
 def _mfu(flops: float, secs_per_step: float):
-    peak = _peak_flops()
-    if not (flops and peak and secs_per_step):
-        return None
-    return round(flops / secs_per_step / peak, 4)
+    from dear_pytorch_tpu.utils import perf_model
+
+    value = perf_model.mfu(flops, secs_per_step, jax.devices()[0])
+    return round(value, 4) if value else None
 
 
 def main() -> None:

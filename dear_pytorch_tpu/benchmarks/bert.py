@@ -131,6 +131,10 @@ def main(argv=None) -> runner.BenchResult:
         if args.profile_dir:
             jax.profiler.stop_trace()
         close()
+    if args.mfu:
+        # the autotuner may have re-bucketed: use its CURRENT step
+        runner.log_mfu(getattr(stepper, "ts", ts), holder["state"], batch,
+                       result)
     return result
 
 

@@ -201,6 +201,12 @@ def add_common_args(parser) -> None:
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="write a jax.profiler trace of the timed "
                              "region here")
+    parser.add_argument("--mfu", action="store_true", default=False,
+                        help="report model FLOPs utilization from XLA cost "
+                             "analysis (the reference's nvprof FLOPs "
+                             "accounting, horovod/prof.sh + "
+                             "extract_profilings.py; costs one extra AOT "
+                             "compile)")
 
 
 def make_batch_source(args, spec, sharding, template_batch):
@@ -242,6 +248,30 @@ def make_batch_source(args, spec, sharding, template_batch):
         }
 
     return next_batch, pl.close
+
+
+def log_mfu(ts, state, batch, result: BenchResult) -> Optional[float]:
+    """Log achieved FLOP/s + MFU for the compiled train step (enable with
+    ``--mfu``). Uses the step's mean iteration time from ``result``."""
+    from dear_pytorch_tpu.utils import perf_model
+
+    try:
+        cost = ts.lower(state, batch).compile().cost_analysis()
+        flops = float(cost.get("flops", 0.0))
+    except Exception as exc:  # cost analysis is best-effort on all backends
+        log(f"MFU: unavailable ({type(exc).__name__}: {exc})")
+        return None
+    secs = result.iter_time_mean
+    value = perf_model.mfu(flops, secs, jax.devices()[0])
+    achieved = flops / secs if secs else 0.0
+    if value:
+        log(f"MFU: {100 * value:.1f}% "
+            f"({flops / 1e9:.2f} GFLOP/step, {achieved / 1e12:.1f} TFLOP/s)")
+    else:
+        log(f"FLOP/step: {flops / 1e9:.2f} GFLOP "
+            f"({achieved / 1e12:.2f} TFLOP/s; peak unknown for "
+            f"{device_name()})")
+    return value
 
 
 def parse_exclude_parts(s: str) -> tuple[str, ...]:

@@ -34,6 +34,37 @@ def fit_alpha_beta(
     return max(float(alpha), 0.0), max(float(beta), 0.0)
 
 
+#: bf16 peak FLOP/s per chip by device-kind substring.
+DEVICE_PEAK_FLOPS = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6": 918e12,
+}
+
+
+def device_peak_flops(device) -> float:
+    """bf16 peak FLOP/s for a jax.Device (0.0 when unknown — callers should
+    then report MFU as unavailable rather than guessing)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in DEVICE_PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return 0.0
+
+
+def mfu(flops_per_step: float, secs_per_step: float, device) -> float:
+    """Model FLOPs utilization: achieved FLOP/s over the chip's bf16 peak
+    (the accounting the reference derives from nvprof dumps,
+    horovod/prof.sh:1-2 + extract_profilings.py:3-11 — here XLA cost
+    analysis makes it exact and free)."""
+    peak = device_peak_flops(device)
+    if not (flops_per_step and peak and secs_per_step):
+        return 0.0
+    return flops_per_step / secs_per_step / peak
+
+
 def topk_perf_model(n: int, s: float = 2.18e-9) -> float:
     """Cost model of a top-k over n elements, s·n·log2 n (reference
     dear/utils.py:95-102)."""
