@@ -514,7 +514,19 @@ def build_train_step(
             )
         if has_model_state and model_state is None:
             model_state = model_state_template
+        if has_model_state and donate:
+            # Deep-copy on device: model_state would otherwise alias the
+            # CALLER's arrays and the donated step would delete them out
+            # from under the caller on the first step. (device_put
+            # may_alias=False does not reliably unlink donation on all
+            # backends.)
+            model_state = jax.tree.map(jnp.copy, model_state)
         bufs = tuple(F.pack_all(params, plan))
+        if donate:
+            # pack_all can hand back a CALLER array unchanged (single-leaf
+            # 1-D bucket with zero pad: reshape(-1) and a 1-element concat
+            # are both identity) — same donation hazard as model_state.
+            bufs = tuple(jnp.copy(b) for b in bufs)
         opt = tuple(optimizer.init(b) for b in bufs)
         step0 = jnp.zeros((), jnp.int32)
         if compressed:

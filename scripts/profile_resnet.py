@@ -1,0 +1,156 @@
+"""Where does the ResNet-50 step time go? (run on the real chip)
+
+Builds the bench-identical DeAR step and reports a component breakdown:
+forward-only, forward+backward, full step in dear / allreduce / no-comm
+modes, host dispatch rate vs device completion rate (the axon tunnel adds
+per-dispatch RPC latency that an on-host run would not see), XLA cost
+analysis (FLOPs, HBM bytes), and an optional jax.profiler trace.
+
+Usage:  python scripts/profile_resnet.py [--trace-dir DIR] [--batch 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, warmup=5, iters=20, fetch=None):
+    """Mean seconds per call under async dispatch + single final fetch."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    if fetch is not None:
+        fetch(out)
+    else:
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--trace-dir", type=str, default=None)
+    args = ap.parse_args()
+
+    from dear_pytorch_tpu import models
+    from dear_pytorch_tpu.comm import backend
+    from dear_pytorch_tpu.models import data
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import dear as D
+    from dear_pytorch_tpu.utils import perf_model
+
+    mesh = backend.init()
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind}  peak bf16: "
+          f"{perf_model.device_peak_flops(dev) / 1e12:.0f} TFLOP/s")
+
+    model = models.get_model("resnet50", dtype=jnp.bfloat16)
+    batch = data.synthetic_image_batch(
+        jax.random.PRNGKey(0), args.batch, dtype=jnp.bfloat16
+    )
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, batch["image"], train=False
+    )
+    params = variables["params"]
+    model_state = {"batch_stats": variables["batch_stats"]}
+
+    def loss_fn(p, mstate, b):
+        logits, new_state = model.apply(
+            {"params": p, **mstate}, b["image"], train=True,
+            mutable=["batch_stats"],
+        )
+        return data.softmax_xent(logits, b["label"]), new_state
+
+    # ---- forward only ------------------------------------------------------
+    fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
+    t_fwd = timed(fwd, variables, batch["image"])
+    print(f"forward only          : {t_fwd * 1e3:7.2f} ms "
+          f"({args.batch / t_fwd:8.1f} img/s)")
+
+    # ---- forward + backward (no comm, no optimizer) ------------------------
+    grad_fn = jax.jit(
+        jax.grad(
+            lambda p, ms, b: loss_fn(p, ms, b)[0], argnums=0
+        )
+    )
+    t_bwd = timed(grad_fn, params, model_state, batch)
+    print(f"fwd+bwd (grads only)  : {t_bwd * 1e3:7.2f} ms "
+          f"({args.batch / t_bwd:8.1f} img/s)")
+
+    # ---- full steps per mode ----------------------------------------------
+    results = {}
+    for mode in ("dear", "allreduce"):
+        ts = D.build_train_step(
+            loss_fn, params, mesh=mesh, mode=mode, threshold_mb=25.0,
+            optimizer=fused_sgd(lr=0.01, momentum=0.9),
+            comm_dtype=jnp.bfloat16, model_state_template=model_state,
+        )
+        state = ts.init(params, model_state)
+        compiled = ts.lower(state, batch).compile()
+        cost = {}
+        try:
+            cost = compiled.cost_analysis()
+        except Exception:
+            pass
+
+        holder = {"s": state, "m": None}
+
+        def step():
+            holder["s"], holder["m"] = compiled(holder["s"], batch)
+            return holder["m"]["loss"]
+
+        # device completion rate (async dispatch + one fetch)
+        t_step = timed(step, fetch=lambda x: float(x))
+        # host dispatch rate (never waits) — tunnel RPC ceiling
+        t0 = time.perf_counter()
+        for _ in range(20):
+            step()
+        t_dispatch = (time.perf_counter() - t0) / 20
+        float(holder["m"]["loss"])
+
+        flops = float(cost.get("flops", 0.0))
+        mfu = perf_model.mfu(flops, t_step, dev)
+        results[mode] = (t_step, t_dispatch, flops, mfu)
+        print(f"full step [{mode:9s}] : {t_step * 1e3:7.2f} ms "
+              f"({args.batch / t_step:8.1f} img/s)  "
+              f"dispatch {t_dispatch * 1e3:6.2f} ms/step  "
+              f"flops/step {flops / 1e9:6.1f} G  MFU {100 * mfu:5.1f}%  "
+              f"HBM {float(cost.get('bytes accessed', 0)) / 1e9:5.2f} GB")
+
+    t_step, t_disp, flops, _ = results["dear"]
+    print("\nbreakdown (dear step):")
+    print(f"  fwd+bwd compute     {t_bwd * 1e3:7.2f} ms "
+          f"({100 * t_bwd / t_step:5.1f}% of step)")
+    print(f"  pack/opt/comm rest  {(t_step - t_bwd) * 1e3:7.2f} ms")
+    if t_disp > 0.8 * t_step:
+        print("  !! host dispatch rate ~= step rate: the TUNNEL/dispatch "
+              "path, not the device, likely bounds throughput")
+
+    if args.trace_dir:
+        ts = D.build_train_step(
+            loss_fn, params, mesh=mesh, mode="dear", threshold_mb=25.0,
+            optimizer=fused_sgd(lr=0.01, momentum=0.9),
+            comm_dtype=jnp.bfloat16, model_state_template=model_state,
+        )
+        state = ts.init(params, model_state)
+        for _ in range(3):
+            state, m = ts.step(state, batch)
+        float(m["loss"])
+        with jax.profiler.trace(args.trace_dir):
+            for _ in range(10):
+                state, m = ts.step(state, batch)
+            float(m["loss"])
+        print(f"trace written to {args.trace_dir}")
+
+
+if __name__ == "__main__":
+    main()

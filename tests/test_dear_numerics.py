@@ -335,3 +335,68 @@ def test_rng_seed_varies_per_step(mesh):
         losses.append(float(m["loss"]))
     # distinct dropout masks -> losses differ across steps with prob ~1
     assert len(set(losses)) > 1, losses
+
+
+def test_init_does_not_alias_caller_arrays(mesh):
+    """ts.init must COPY what it stages: a same-device device_put aliases,
+    and the donated step would delete the caller's arrays (e.g. the
+    batch_stats pytree the user still holds) on the first step."""
+    import flax.linen as nn
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            x = nn.Dense(8)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(4)(x)
+
+    model = TinyBN()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 12))
+    y = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 4)
+    variables = model.init({"params": jax.random.PRNGKey(2)}, x, train=False)
+    params, mstate = variables["params"], {"batch_stats": variables["batch_stats"]}
+
+    def loss_fn(p, ms, b):
+        bx, by = b
+        logits, new_state = model.apply(
+            {"params": p, **ms}, bx, train=True, mutable=["batch_stats"]
+        )
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.sum(logp * jax.nn.one_hot(by, 4), axis=-1)
+        ), new_state
+
+    ts = build_train_step(loss_fn, params, mesh=mesh, threshold_mb=None,
+                          optimizer=fused_sgd(lr=0.05),
+                          model_state_template=mstate, donate=True)
+    state = ts.init(params, mstate)
+    state, _ = ts.step(state, (x, y))
+    # the caller's originals survive the donated step
+    np.asarray(jax.tree.leaves(mstate)[0])
+    np.asarray(jax.tree.leaves(params)[0])
+    # and a SECOND independent training run can start from them
+    state2 = ts.init(params, mstate)
+    state2, m2 = ts.step(state2, (x, y))
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_init_does_not_alias_single_leaf_1d_params(mesh):
+    """pack_all's reshape(-1) + 1-element concat are identity for a
+    single-leaf 1-D unpadded bucket, so the packed buffer can BE the
+    caller's array — init must unlink it before the donated step."""
+    w = jnp.ones((8,))
+    params = {"scale": {"w": w}}
+
+    def loss_fn(p, b):
+        return jnp.sum(p["scale"]["w"] * b[0])
+
+    ts = build_train_step(loss_fn, params, mesh=mesh, mode="allreduce",
+                          threshold_mb=None, donate=True,
+                          optimizer=fused_sgd(lr=0.1))
+    state = ts.init(params)
+    batch = jnp.ones((8, 8))
+    state, _ = ts.step(state, batch)
+    np.asarray(w)  # caller's array survives
+    state2 = ts.init(params)
+    state2, m = ts.step(state2, batch)
+    assert np.isfinite(float(m["loss"]))
