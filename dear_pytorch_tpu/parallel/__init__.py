@@ -1,7 +1,14 @@
-"""Parallel training schedules: DeAR decoupled RS+AG, baselines, seq-parallel."""
+"""Parallel training schedules: DeAR decoupled RS+AG, baselines,
+sequence parallelism (ring attention / Ulysses), GSPMD tensor parallelism."""
 
 from dear_pytorch_tpu.parallel.dear import (  # noqa: F401
     DearState,
     TrainStep,
     build_train_step,
+)
+from dear_pytorch_tpu.parallel.tp import (  # noqa: F401
+    BERT_TP_RULES,
+    TpTrainStep,
+    make_tp_train_step,
+    param_specs_from_rules,
 )

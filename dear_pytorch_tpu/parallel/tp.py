@@ -1,0 +1,195 @@
+"""Tensor parallelism via GSPMD sharding annotations.
+
+Absent from the reference (SURVEY.md §2.9: data-parallel flavors only; "the
+new framework's design should leave room") — this module adds
+megatron-style tensor parallelism the TPU-native way: no model changes, no
+manual collectives. Pick a dp×tp `Mesh`, annotate each weight with a
+`PartitionSpec` (attention/MLP matrices split over 'tp', everything else
+replicated), jit the train step with those shardings, and XLA's SPMD
+partitioner inserts the activation all-reduces exactly where Megatron-LM
+places them by hand (after the row-parallel matmuls) — the
+"annotate-and-let-XLA-insert-collectives" recipe, vs the reference's
+explicit NCCL choreography for its (data-parallel-only) schedules.
+
+Gradient flow falls out for free: batch sharded over 'dp' + params
+replicated over 'dp' makes XLA reduce gradients over 'dp'; params sharded
+over 'tp' keep per-shard gradients unreduced over 'tp'. The optimizer
+update runs sharded in place (each device updates only its weight shards).
+
+This composes with, but does not use, the DeAR bucket schedule: tp-sharded
+parameters never need the gradient all-reduce DeAR decouples. Use
+`build_train_step` (dp / dp×sp) when the model is replicated; use this when
+the model itself must shard.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from dear_pytorch_tpu.comm.backend import DP_AXIS, TP_AXIS
+
+
+class TpState(NamedTuple):
+    params: Any
+    momentum: Any
+    step: jax.Array
+
+
+class TpTrainStep(NamedTuple):
+    init: Callable[[Any], TpState]
+    step: Callable[[TpState, Any], tuple[TpState, dict]]
+    lower: Callable[[TpState, Any], Any]
+    param_specs: Any
+    mesh: jax.sharding.Mesh
+
+
+#: (regex on the param path, PartitionSpec factory) — first match wins.
+#: Megatron placement for the transformer stack (Shoeybi et al. 2019):
+#:   column-parallel (split OUTPUT features): qkv projections, MLP up.
+#:   row-parallel (split INPUT features): attention output proj, MLP down.
+#: Biases of column-parallel layers split with the features; row-parallel
+#: biases stay replicated (added after the all-reduce).
+BERT_TP_RULES: tuple = (
+    # qkv: DenseGeneral h -> (heads, head_dim); split the HEADS dim
+    (r"attention/(query|key|value)/kernel$",
+     lambda tp: jax.P(None, tp, None)),
+    (r"attention/(query|key|value)/bias$", lambda tp: jax.P(tp, None)),
+    # attention out: DenseGeneral (heads, head_dim) -> h; row-parallel
+    (r"attention/output/kernel$", lambda tp: jax.P(tp, None, None)),
+    # MLP up (column) / down (row); `output` needs the layer_N/ prefix to
+    # not swallow attention/output (matched above, first wins)
+    (r"intermediate/kernel$", lambda tp: jax.P(None, tp)),
+    (r"intermediate/bias$", lambda tp: jax.P(tp)),
+    (r"layer_\d+/output/kernel$", lambda tp: jax.P(tp, None)),
+    # vocab-parallel embedding (tied MLM decoder shards with it)
+    (r"word_embeddings/embedding$", lambda tp: jax.P(tp, None)),
+)
+
+
+def _path_str(path) -> str:
+    return "/".join(getattr(k, "key", str(k)) for k in path)
+
+
+def param_specs_from_rules(
+    params, rules: Sequence = BERT_TP_RULES, tp_axis: str = TP_AXIS
+):
+    """PartitionSpec pytree: rules matched against each leaf path; anything
+    unmatched (layernorms, position embeddings, heads) is replicated."""
+
+    def spec(path, leaf):
+        name = _path_str(path)
+        for pat, factory in rules:
+            if re.search(pat, name):
+                s = factory(tp_axis)
+                if len(s) > getattr(leaf, "ndim", 0):
+                    raise ValueError(
+                        f"rule {pat!r} spec {s} has more dims than "
+                        f"{name} {getattr(leaf, 'shape', ())}"
+                    )
+                return s
+        return jax.P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def validate_tp_divisibility(params, specs, mesh) -> None:
+    """Every tp-sharded dim must divide by the axis size (XLA would pad
+    silently; a training framework should refuse instead)."""
+
+    def check(path, leaf, spec):
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            size = mesh.shape[axis]
+            if leaf.shape[dim] % size:
+                raise ValueError(
+                    f"{_path_str(path)} dim {dim} ({leaf.shape[dim]}) does "
+                    f"not divide by mesh axis {axis!r} ({size})"
+                )
+
+    jax.tree_util.tree_map_with_path(check, params, specs)
+
+
+def make_tp_train_step(
+    loss_fn: Callable,
+    params_template,
+    *,
+    mesh: jax.sharding.Mesh,
+    rules: Sequence = BERT_TP_RULES,
+    lr: float = 0.01,
+    momentum: float = 0.9,
+    dp_axis: str = DP_AXIS,
+    tp_axis: str = TP_AXIS,
+    batch_spec: Optional[Any] = None,
+    donate: bool = True,
+) -> TpTrainStep:
+    """Jitted dp×tp train step.
+
+    ``loss_fn(params, batch) -> scalar`` — written for the GLOBAL batch and
+    full logical params, exactly as in single-device code; sharding comes
+    entirely from the annotations. SGD+momentum runs sharded (each device
+    updates only the weight shards it owns).
+    """
+    specs = param_specs_from_rules(params_template, rules, tp_axis)
+    validate_tp_divisibility(params_template, specs, mesh)
+    pshard = jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s), specs
+    )
+    bspec = batch_spec if batch_spec is not None else jax.P(dp_axis)
+    bshard = jax.sharding.NamedSharding(mesh, bspec)
+    rshard = jax.sharding.NamedSharding(mesh, jax.P())
+
+    state_shardings = TpState(
+        params=pshard, momentum=pshard,
+        step=rshard,
+    )
+
+    def init(params) -> TpState:
+        if donate:
+            # device_put is a no-op for leaves already carrying an
+            # equivalent sharding; without a copy the donated step would
+            # delete the CALLER's params (same hazard as dear.py's init)
+            params = jax.tree.map(jnp.copy, params)
+        state = TpState(
+            params=params,
+            momentum=jax.tree.map(jnp.zeros_like, params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return jax.tree.map(jax.device_put, state, state_shardings)
+
+    def _step(state: TpState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+
+        def upd(p, m, g):
+            m = momentum * m + g
+            return p - lr * m, m
+
+        new = jax.tree.map(upd, state.params, state.momentum, grads)
+        new_p = jax.tree.map(lambda t: t[0], new,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        new_m = jax.tree.map(lambda t: t[1], new,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        return (
+            TpState(new_p, new_m, state.step + 1),
+            {"loss": loss},
+        )
+
+    jitted = jax.jit(
+        _step,
+        in_shardings=(state_shardings, bshard),
+        out_shardings=(state_shardings, rshard),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def step(state, batch):
+        return jitted(state, batch)
+
+    def lower(state, batch):
+        return jitted.lower(state, batch)
+
+    return TpTrainStep(init=init, step=step, lower=lower,
+                       param_specs=specs, mesh=mesh)
