@@ -37,6 +37,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-hidden-layers", type=int, default=None,
                    help="override encoder depth (scaling studies / smoke "
                         "tests); default = the model's config")
+    p.add_argument("--flash-attention", action="store_true", default=False,
+                   help="use the Pallas flash-attention kernel "
+                        "(ops/flash_attention.py); falls back to dense "
+                        "attention wherever attention dropout is active")
     runner.add_common_args(p)
     p.set_defaults(batch_size=8, base_lr=2e-5, momentum=0.0)
     return p
@@ -50,13 +54,32 @@ def main(argv=None) -> runner.BenchResult:
 
     dtype = jnp.bfloat16 if args.fp16 else jnp.float32
     model = models.get_model(args.model, dtype=dtype)
-    if args.num_hidden_layers is not None:
+    attention_impl = None
+    if args.flash_attention:
+        from dear_pytorch_tpu.ops import make_flash_attention_impl
+
+        attention_impl = make_flash_attention_impl()
+    if args.num_hidden_layers is not None or attention_impl is not None:
         import dataclasses
 
-        model = models.BertForPreTraining(
-            dataclasses.replace(
-                model.config, num_hidden_layers=args.num_hidden_layers
+        cfg_over = model.config
+        if args.num_hidden_layers is not None:
+            cfg_over = dataclasses.replace(
+                cfg_over, num_hidden_layers=args.num_hidden_layers
             )
+        if attention_impl is not None and cfg_over.attention_probs_dropout_prob:
+            # the impl falls back to dense attention wherever attention
+            # dropout is active — benchmarking the kernel requires
+            # disabling it, and silently measuring the fallback would be
+            # worse than changing the config
+            runner.log("flash-attention: attention_probs_dropout_prob "
+                       f"{cfg_over.attention_probs_dropout_prob} -> 0.0 "
+                       "(kernel has no prob-dropout path)")
+            cfg_over = dataclasses.replace(
+                cfg_over, attention_probs_dropout_prob=0.0
+            )
+        model = models.BertForPreTraining(
+            cfg_over, attention_impl=attention_impl
         )
     cfg = model.config
 

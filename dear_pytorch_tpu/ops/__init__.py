@@ -1,5 +1,10 @@
-"""Core array-level operations: tensor fusion, fused updates, compression."""
+"""Core array-level operations: tensor fusion, fused updates, compression,
+Pallas attention kernels."""
 
+from dear_pytorch_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    make_flash_attention_impl,
+)
 from dear_pytorch_tpu.ops.fusion import (  # noqa: F401
     FusionPlan,
     Bucket,

@@ -1,0 +1,317 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+The hot op of every transformer in the zoo. XLA's fused attention is good;
+a hand-tiled kernel is better where it counts on TPU: the whole
+score-softmax-weighted-sum pipeline stays in VMEM per (query-block,
+key-block) tile, the S×S score matrix is never materialized in HBM
+(memory O(S·D) instead of O(S²)), and the MXU sees back-to-back
+[bq,D]×[D,bk] / [bq,bk]×[bk,D] matmuls (Dao et al. 2022, blockwise online
+softmax — same math as `parallel.ring_attention`, which distributes ACROSS
+chips what this kernel tiles WITHIN one).
+
+Backward is the standard flash recomputation: forward saves only the
+softmax log-sum-exp per row; dQ and dK/dV are computed by two kernels that
+rebuild each P-tile on the fly.
+
+Everything runs under `interpret=True` off-TPU, so the CPU test mesh
+exercises the exact kernel code path. Reference integration point: the
+model zoo's ``attention_impl`` contract (models/bert.py BertSelfAttention);
+the reference framework has no custom kernels at all — its attention is
+whatever HF/torch emits (SURVEY.md §2.8).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_BIG = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: grid (BH, Sq/bq); K/V rows resident per grid row
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                scale, causal, bq, bk, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+    m = jnp.full((bq,), _NEG_BIG, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    acc = jnp.zeros((bq, q.shape[-1]), jnp.float32)
+
+    nblocks = seq_k // bk
+    if causal:
+        # only key blocks at or before this query block contribute
+        nblocks_eff = jnp.minimum(nblocks, (qi + 1) * bq // bk + 1)
+    else:
+        nblocks_eff = nblocks
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)   # [bk, D]
+        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = q @ k.T                                              # [bq, bk]
+        kv_ok = mask_ref[0, pl.ds(j * bk, bk)] > 0               # [bk]
+        valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
+        if causal:
+            q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
+            k_pos = j * bk + jax.lax.iota(jnp.int32, bk)
+            valid = valid & (k_pos[None, :] <= q_pos[:, None])
+        s = jnp.where(valid, s, -jnp.inf)
+        bm = jnp.maximum(jnp.max(s, axis=-1), _NEG_BIG)
+        p = jnp.exp(s - bm[:, None])                             # [bq, bk]
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        corr = jnp.exp(bm - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1) * corr
+        acc = acc * alpha[:, None] + (p @ v) * corr[:, None]
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, nblocks_eff, body, (m, l, acc))
+    l = jnp.maximum(l, 1e-30)                                    # all-masked
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, scale, causal, bq, bk, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)                 # [bq, D]
+    lse = lse_ref[0]                                   # [bq]
+    delta = delta_ref[0]                               # [bq]
+    dq = jnp.zeros_like(q)
+
+    nblocks = seq_k // bk
+    nblocks_eff = (
+        jnp.minimum(nblocks, (qi + 1) * bq // bk + 1) if causal else nblocks
+    )
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = q @ k.T
+        kv_ok = mask_ref[0, pl.ds(j * bk, bk)] > 0
+        valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
+        if causal:
+            q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
+            k_pos = j * bk + jax.lax.iota(jnp.int32, bk)
+            valid = valid & (k_pos[None, :] <= q_pos[:, None])
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)     # [bq, bk]
+        dp = do @ v.T                                            # [bq, bk]
+        ds = p * (dp - delta[:, None])
+        return dq + ds @ k                                       # [bq, D]
+
+    dq = jax.lax.fori_loop(0, nblocks_eff, body, dq)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, causal, bq, bk,
+                    seq_q):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                   # [bk, D]
+    v = v_ref[0].astype(jnp.float32)
+    kv_ok = mask_ref[0] > 0                            # [bk]
+    dk = jnp.zeros_like(k)
+    dv = jnp.zeros_like(v)
+
+    nblocks = seq_q // bq
+    # causal: query blocks strictly before this key block contribute nothing
+    start = (ki * bk) // bq if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq)]
+        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        s = q @ k.T                                              # [bq, bk]
+        valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
+        if causal:
+            q_pos = i * bq + jax.lax.iota(jnp.int32, bq)
+            k_pos = ki * bk + jax.lax.iota(jnp.int32, bk)
+            valid = valid & (k_pos[None, :] <= q_pos[:, None])
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        dv = dv + p.T @ do                                       # [bk, D]
+        dk = dk + ds.T @ q        # q is pre-scaled: d(s)/d(k) = q_raw*scale
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(start, nblocks, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side wrappers + custom VJP over [BH, S, D]
+# ---------------------------------------------------------------------------
+
+
+def _pick_block(s: int, pref: int = 128) -> int:
+    b = min(s, pref)
+    while s % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, kv_mask, scale, causal):
+    o, _ = _flash_fwd_impl(q, k, v, kv_mask, scale, causal)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, kv_mask, scale, causal):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    grid = (bh, sq // bq)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, seq_k=sk
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
+            pl.BlockSpec((1, sk), lambda i, j: (i, 0)),         # mask
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, kv_mask)
+    return o, lse
+
+
+def _flash_fwd(q, k, v, kv_mask, scale, causal):
+    o, lse = _flash_fwd_impl(q, k, v, kv_mask, scale, causal)
+    return o, (q, k, v, kv_mask, o, lse)
+
+
+def _flash_bwd(scale, causal, res, do):
+    q, k, v, kv_mask, o, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, seq_k=sk),
+        grid=(bh, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
+            pl.BlockSpec((1, sk), lambda i, j: (i, 0)),         # mask
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # lse
+            pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, kv_mask, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, seq_q=sq),
+        grid=(bh, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v
+            pl.BlockSpec((1, bk), lambda i, j: (i, j)),         # mask
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),         # lse
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),         # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, kv_mask, do, lse, delta)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Tiled exact attention over ``[B, S, H, D]`` inputs.
+
+    ``kv_mask``: optional key-validity mask ``[B, S_k]`` (True = attend).
+    Differentiable (flash backward). Sequence lengths must divide by the
+    chosen block (128 or the largest power-of-two divisor).
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = D ** -0.5 if scale is None else scale
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Sk), jnp.int32)
+    # [B,S,H,D] -> [B*H, S, D]; mask -> [B*H, Sk]
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    mask_bh = jnp.repeat(kv_mask.astype(jnp.int32), H, axis=0)
+    o = _flash(fold(q), fold(k), fold(v), mask_bh, scale, causal)
+    return o.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+
+
+def make_flash_attention_impl():
+    """Model-zoo ``attention_impl`` (models/bert.py contract) backed by the
+    kernel. Attention-prob dropout is not expressible in the tiled kernel
+    yet — with an active dropout rate the impl falls back to the dense
+    XLA path so training semantics never silently change."""
+    from dear_pytorch_tpu.models.bert import dot_product_attention
+
+    def impl(q, k, v, mask, dropout_rng=None, dropout_rate=0.0, dtype=None):
+        if dropout_rng is not None and dropout_rate > 0.0:
+            return dot_product_attention(
+                q, k, v, mask, dropout_rng=dropout_rng,
+                dropout_rate=dropout_rate, dtype=dtype,
+            )
+        kv_mask = None
+        if mask is not None:
+            # model masks are ADDITIVE [B,1,1,S]; kernel wants validity
+            kv_mask = mask.reshape(mask.shape[0], mask.shape[-1]) > -1.0
+        return flash_attention(q, k, v, kv_mask=kv_mask)
+
+    return impl

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from dear_pytorch_tpu.comm.backend import DP_AXIS, TP_AXIS
+from dear_pytorch_tpu.ops.fusion import _path_str
 
 
 class TpState(NamedTuple):
@@ -68,10 +69,6 @@ BERT_TP_RULES: tuple = (
     # vocab-parallel embedding (tied MLM decoder shards with it)
     (r"word_embeddings/embedding$", lambda tp: jax.P(tp, None)),
 )
-
-
-def _path_str(path) -> str:
-    return "/".join(getattr(k, "key", str(k)) for k in path)
 
 
 def param_specs_from_rules(
