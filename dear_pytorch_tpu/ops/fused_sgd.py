@@ -74,6 +74,19 @@ def fused_sgd(
     return ShardOptimizer(init, update)
 
 
+def sgd_momentum_tree_update(params, momentum_tree, grads, *, lr: float,
+                             momentum: float):
+    """(new_params, new_momentum) for pytree-shaped SGD+momentum — the
+    update used by the GSPMD/pipeline train steps (tp.py / pp.py), where
+    sharded per-leaf updates run in place and the flat-buffer fused path
+    does not apply."""
+    new_m = jax.tree.map(
+        lambda m, g: momentum * m + g, momentum_tree, grads
+    )
+    new_p = jax.tree.map(lambda p, m: p - lr * m, params, new_m)
+    return new_p, new_m
+
+
 def from_optax(tx) -> ShardOptimizer:
     """Adapt an optax GradientTransformation to flat shard buffers.
 

@@ -6,6 +6,16 @@ from dear_pytorch_tpu.parallel.dear import (  # noqa: F401
     TrainStep,
     build_train_step,
 )
+from dear_pytorch_tpu.parallel.ep import (  # noqa: F401
+    EP_RULES,
+    MoeMlp,
+    aux_load_balance_loss,
+)
+from dear_pytorch_tpu.parallel.pp import (  # noqa: F401
+    PpTrainStep,
+    make_pp_train_step,
+    stack_stage_params,
+)
 from dear_pytorch_tpu.parallel.tp import (  # noqa: F401
     BERT_TP_RULES,
     TpTrainStep,

@@ -1,0 +1,105 @@
+"""Expert parallelism: GShard-style mixture-of-experts over an 'ep' axis.
+
+Absent from the reference (SURVEY.md §2.9) — completes the framework's
+parallelism axes (dp / sp / tp / pp / ep). The formulation is the canonical
+TPU one (GShard, Lepikhin et al. 2020; Switch, Fedus et al. 2021): routing
+becomes dense einsums against one-hot dispatch/combine tensors with a
+STATIC per-expert capacity, so shapes stay fixed for XLA; the expert
+weights carry a leading expert dim sharded over 'ep', and the SPMD
+partitioner turns the dispatch einsums into the all-to-alls that
+CUDA MoE frameworks schedule by hand.
+
+Training runs through `parallel.tp.make_tp_train_step` with `EP_RULES`
+(the machinery is generic: rules + annotations + jit), e.g.::
+
+    step = make_tp_train_step(loss_fn, params, mesh=mesh,
+                              rules=EP_RULES, tp_axis='ep')
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+EP_AXIS = "ep"
+
+#: partition rules for `tp.make_tp_train_step(rules=EP_RULES, tp_axis='ep')`
+EP_RULES: tuple = (
+    (r"(^|/)wi$", lambda ep: jax.P(ep, None, None)),
+    (r"(^|/)wo$", lambda ep: jax.P(ep, None, None)),
+    # router stays replicated (matched by the default rule)
+)
+
+
+class MoeMlp(nn.Module):
+    """Top-1 (switch) routed MLP with static capacity.
+
+    Input ``[T, H]`` (flatten batch/sequence first). Tokens beyond an
+    expert's capacity are dropped (output 0 for them — the standard switch
+    behavior; pick ``capacity_factor`` >= num_experts to make dropping
+    impossible in tests).
+    """
+
+    num_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        T, H = x.shape
+        E = self.num_experts
+        C = max(int(self.capacity_factor * T / E), 1)
+
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (H, E), jnp.float32
+        )
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (E, H, self.mlp_dim),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (E, self.mlp_dim, H),
+            jnp.float32,
+        )
+
+        logits = x.astype(jnp.float32) @ router              # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                  # [T]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # [T, E]
+        # position of each token within its expert's queue (0-based); the
+        # `- onehot` keeps non-selected entries at 0 so the row-sum is just
+        # the selected expert's position
+        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot       # [T, E]
+        pos_sel = jnp.sum(pos, axis=-1)                          # [T]
+        # overflow positions (>= C) one-hot to an all-zero row: the token
+        # is dropped without any explicit mask
+        pos_oh = jax.nn.one_hot(
+            pos_sel.astype(jnp.int32), C, dtype=jnp.float32
+        )                                                        # [T, C]
+        dispatch = onehot[:, :, None] * pos_oh[:, None, :]       # [T, E, C]
+        gate = jnp.sum(probs * onehot, axis=-1)                  # [T]
+        combine = dispatch * gate[:, None, None]                 # [T, E, C]
+
+        xin = jnp.einsum("tec,th->ech", dispatch,
+                         x.astype(jnp.float32))                  # [E, C, H]
+        h = jax.nn.gelu(
+            jnp.einsum("ech,ehf->ecf", xin, wi.astype(jnp.float32))
+        )
+        out_e = jnp.einsum("ecf,efh->ech", h, wo.astype(jnp.float32))
+        y = jnp.einsum("tec,ech->th", combine, out_e)
+        return y.astype(x.dtype)
+
+
+def aux_load_balance_loss(x, router_kernel, num_experts: int) -> jax.Array:
+    """Switch transformer's load-balancing auxiliary loss (Fedus et al.
+    2021, eq. 4): E * <fraction routed to e> . <mean router prob for e>."""
+    logits = x.astype(jnp.float32) @ router_kernel
+    probs = jax.nn.softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(jnp.argmax(probs, -1), num_experts)
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    return num_experts * jnp.sum(frac * mean_prob)

@@ -1,0 +1,207 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a 'pp'
+mesh axis.
+
+Absent from the reference (SURVEY.md §2.9: data-parallel flavors only) —
+added here because a complete TPU framework must cover the model-sharding
+axes. The design is SPMD-native, not a scheduler translation: every device
+holds ONE stage's weights (a stacked stage pytree sharded over 'pp'), and
+one jitted program runs the whole pipeline as a `lax.fori_loop` over
+"ticks" in which each device applies its stage to the microbatch currently
+resident and hands the activation to the next stage with `lax.ppermute`.
+After ``M + L - 1`` ticks all ``M`` microbatches have crossed all ``L``
+stages. Autodiff runs backward through the loop (the transpose of
+`ppermute` is the reverse rotation), so the backward pipeline falls out of
+the forward program — no hand-written 1F1B schedule, XLA owns the overlap.
+
+Composes with dp: put 'pp' innermost in the mesh and shard the batch over
+'dp' as usual; gradients for stage weights stay per-stage (no reduction
+over 'pp'), reduce over 'dp' automatically via the partitioner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dear_pytorch_tpu.ops.fused_sgd import sgd_momentum_tree_update
+
+PP_AXIS = "pp"
+
+
+class PpState(NamedTuple):
+    params: Any          # stacked stage params, leaf dim 0 sharded over pp
+    momentum: Any
+    step: jax.Array
+
+
+class PpTrainStep(NamedTuple):
+    init: Callable[[Any], PpState]
+    step: Callable[[PpState, Any], tuple[PpState, dict]]
+    lower: Callable[[PpState, Any], Any]
+    mesh: jax.sharding.Mesh
+
+
+def stack_stage_params(stage_params_list):
+    """[per-stage pytree, ...] -> one pytree with a leading stage dim.
+    All stages must share a structure (same stage architecture — the GPipe
+    assumption); the leading dim is what shards over 'pp'."""
+    return jax.tree.map(
+        lambda *leaves: jnp.stack(leaves), *stage_params_list
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    my_params,
+    x: jax.Array,
+    *,
+    n_stages: int,
+    axis_name: str = PP_AXIS,
+):
+    """Run the microbatch pipeline INSIDE shard_map.
+
+    ``x``: this call's full local input ``[M, mb, ...]`` (M microbatches).
+    Every device receives the same x; stage 0 injects microbatches, the
+    last stage's outputs are collected and broadcast back to every device
+    (so the loss is computable everywhere — replicated, SPMD-style).
+
+    Returns ``[M, mb, ...]`` outputs of the final stage.
+    """
+    idx = lax.axis_index(axis_name)
+    M = x.shape[0]
+    n_ticks = M + n_stages - 1
+
+    out_shape = jax.eval_shape(stage_fn, my_params, x[0])
+    if tuple(out_shape.shape) != tuple(x.shape[1:]):
+        raise ValueError(
+            "GPipe stages must map activations to the same shape "
+            f"(stage out {tuple(out_shape.shape)} vs in {tuple(x.shape[1:])})"
+        )
+    outputs0 = jnp.zeros((M,) + tuple(out_shape.shape), out_shape.dtype)
+    # activation register: holds the stage output handed to the next stage
+    # between ticks (stage 0 reads injected microbatches from x instead)
+    act0 = jnp.zeros(tuple(out_shape.shape), out_shape.dtype)
+
+    def body(t, carry):
+        act, outputs = carry
+        mb = t - idx                      # microbatch this device works on
+        active = (mb >= 0) & (mb < M)
+        # stage 0 consumes the injected microbatch; others the register
+        mb_in = x[jnp.clip(mb, 0, M - 1)]
+        inp = jnp.where(idx == 0, mb_in.astype(act.dtype), act)
+        out = stage_fn(my_params, inp)
+        out = jnp.where(active, out, jnp.zeros_like(out))
+        # last stage: bank the finished microbatch
+        is_last = idx == n_stages - 1
+        outputs = lax.cond(
+            active & is_last,
+            lambda o: outputs.at[jnp.clip(mb, 0, M - 1)].set(o),
+            lambda o: outputs,
+            out,
+        )
+        # rotate activations forward one stage
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        act = lax.ppermute(out, axis_name, perm)
+        return act, outputs
+
+    _, outputs = lax.fori_loop(0, n_ticks, body, (act0, outputs0))
+    # every device needs the outputs for a replicated loss: the banked
+    # values live on the LAST stage only; share them around the ring
+    outputs = lax.psum(outputs, axis_name) / 1.0  # others contributed zeros
+    return outputs
+
+
+def make_pp_train_step(
+    stage_fn: Callable,
+    stage_params_list,
+    *,
+    mesh: jax.sharding.Mesh,
+    loss_fn: Callable,
+    n_microbatches: int,
+    lr: float = 0.01,
+    momentum: float = 0.9,
+    axis_name: str = PP_AXIS,
+    donate: bool = True,
+) -> PpTrainStep:
+    """Jitted pipeline-parallel train step.
+
+    ``stage_fn(stage_params, x) -> y`` — one stage's forward (all stages
+    share an architecture). ``loss_fn(final_outputs, batch) -> scalar``
+    consumes the depiped outputs ``[M, mb, ...]`` plus the original batch.
+    ``stage_params_list``: per-stage parameter pytrees (length = pp size).
+    """
+    n_stages = mesh.shape[axis_name]
+    if len(stage_params_list) != n_stages:
+        raise ValueError(
+            f"{len(stage_params_list)} stages for pp={n_stages} mesh axis"
+        )
+    # specs only need shapes — don't materialize a stacked copy here
+    stacked_shape = jax.eval_shape(stack_stage_params, stage_params_list)
+    pspec = jax.tree.map(lambda _: jax.P(axis_name), stacked_shape)
+    pshard = jax.tree.map(
+        lambda s: jax.sharding.NamedSharding(mesh, s), pspec
+    )
+    rshard = jax.sharding.NamedSharding(mesh, jax.P())
+    state_shardings = PpState(params=pshard, momentum=pshard,
+                              step=rshard)
+
+    def init(stage_params_list_or_stacked) -> PpState:
+        p = stage_params_list_or_stacked
+        if isinstance(p, (list, tuple)):
+            p = stack_stage_params(p)  # stacking already allocates fresh
+        elif donate:
+            # pre-stacked input aliases the caller's arrays: unlink before
+            # the donated step deletes them (see dear.py init)
+            p = jax.tree.map(jnp.copy, p)
+        state = PpState(
+            params=p,
+            momentum=jax.tree.map(jnp.zeros_like, p),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return jax.tree.map(jax.device_put, state, state_shardings)
+
+    def device_loss(stacked_block, batch):
+        # this device's stage params: strip the (length-1) stage dim of the
+        # sharded block
+        my_params = jax.tree.map(lambda l: l[0], stacked_block)
+        x = batch[0]
+        M = n_microbatches
+        if x.shape[0] % M:
+            raise ValueError(
+                f"batch ({x.shape[0]}) must divide by n_microbatches ({M})"
+            )
+        xm = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        outs = pipeline_apply(
+            stage_fn, my_params, xm, n_stages=n_stages, axis_name=axis_name
+        )
+        flat = outs.reshape((x.shape[0],) + outs.shape[2:])
+        return loss_fn(flat, batch)
+
+    def _step(state: PpState, batch):
+        def total_loss(params):
+            mapped = jax.shard_map(
+                device_loss,
+                mesh=mesh,
+                in_specs=(pspec, jax.P()),
+                out_specs=jax.P(),
+                check_vma=False,
+            )
+            return mapped(params, batch)
+
+        loss, grads = jax.value_and_grad(total_loss)(state.params)
+        new_p, new_m = sgd_momentum_tree_update(
+            state.params, state.momentum, grads, lr=lr, momentum=momentum
+        )
+        return PpState(new_p, new_m, state.step + 1), {"loss": loss}
+
+    jitted = jax.jit(_step, donate_argnums=(0,) if donate else ())
+
+    return PpTrainStep(
+        init=init,
+        step=lambda s, b: jitted(s, b),
+        lower=lambda s, b: jitted.lower(s, b),
+        mesh=mesh,
+    )

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from dear_pytorch_tpu.comm.backend import DP_AXIS, TP_AXIS
+from dear_pytorch_tpu.ops.fused_sgd import sgd_momentum_tree_update
 from dear_pytorch_tpu.ops.fusion import _path_str
 
 
@@ -160,16 +161,9 @@ def make_tp_train_step(
 
     def _step(state: TpState, batch):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
-
-        def upd(p, m, g):
-            m = momentum * m + g
-            return p - lr * m, m
-
-        new = jax.tree.map(upd, state.params, state.momentum, grads)
-        new_p = jax.tree.map(lambda t: t[0], new,
-                             is_leaf=lambda t: isinstance(t, tuple))
-        new_m = jax.tree.map(lambda t: t[1], new,
-                             is_leaf=lambda t: isinstance(t, tuple))
+        new_p, new_m = sgd_momentum_tree_update(
+            state.params, state.momentum, grads, lr=lr, momentum=momentum
+        )
         return (
             TpState(new_p, new_m, state.step + 1),
             {"loss": loss},

@@ -1,0 +1,93 @@
+"""Expert parallelism: the GShard einsum-dispatch MoE must equal direct
+per-token expert application (no-drop capacity), train correctly with
+expert weights sharded over 'ep', and show partitioner-inserted
+collectives."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dear_pytorch_tpu.parallel import ep as EP
+from dear_pytorch_tpu.parallel import tp as TP
+from dear_pytorch_tpu.utils import hlo
+
+T, H, F, E = 64, 16, 32, 8
+
+
+def _setup(capacity_factor=float(E)):
+    model = EP.MoeMlp(num_experts=E, mlp_dim=F,
+                      capacity_factor=capacity_factor)
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, H))
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    return model, params, x
+
+
+def test_moe_equals_direct_expert_application():
+    model, params, x = _setup()  # capacity == T: nothing can drop
+    got = model.apply({"params": params}, x)
+
+    logits = x @ params["router"]
+    expert = np.asarray(jnp.argmax(logits, axis=-1))
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    want = np.zeros((T, H), np.float32)
+    for t in range(T):
+        e = expert[t]
+        h = jax.nn.gelu(x[t] @ params["wi"][e])
+        want[t] = np.asarray(h @ params["wo"][e]) * probs[t, e]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_drops_overflow_tokens():
+    model, params, x = _setup(capacity_factor=0.25)  # C = 2 per expert
+    y = model.apply({"params": params}, x)
+    # dropped tokens produce exactly zero output
+    nonzero_rows = np.count_nonzero(
+        np.abs(np.asarray(y)).sum(axis=-1) > 1e-9
+    )
+    assert nonzero_rows <= E * 2
+
+
+def test_ep_sharded_training_matches_replicated():
+    model, params, x = _setup()
+    y = jax.random.normal(jax.random.PRNGKey(2), (T, H))
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        out = model.apply({"params": p}, bx)
+        return jnp.mean((out - by) ** 2)
+
+    def run(mesh):
+        ts = TP.make_tp_train_step(
+            loss_fn, params, mesh=mesh, rules=EP.EP_RULES, tp_axis="ep",
+            lr=0.05, momentum=0.9, donate=False,
+            batch_spec=jax.P(),  # tiny T: keep the batch replicated
+        )
+        state = ts.init(params)
+        losses = []
+        for _ in range(4):
+            state, m = ts.step(state, (x, y))
+            losses.append(float(m["loss"]))
+        return ts, state, losses
+
+    mesh1 = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:1]).reshape(1, 1), ("dp", "ep")
+    )
+    meshe = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:8]).reshape(1, 8), ("dp", "ep")
+    )
+    _, _, want = run(mesh1)
+    ts, state, got = run(meshe)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+    assert got[-1] < got[0]
+
+    # expert weights actually sharded 1 expert/device
+    wi = state.params["wi"]
+    assert tuple(wi.sharding.spec)[0] == "ep"
+    assert wi.addressable_shards[0].data.shape[0] == 1
+
+    # partitioner inserted cross-device collectives for the dispatch
+    text = ts.lower(state, (x, y)).compile().as_text()
+    ops = hlo.parse_entry(text)
+    kinds = {o.kind for o in ops}
+    assert kinds & {"all-to-all", "all-reduce", "all-gather",
+                    "reduce-scatter", "collective-permute"}, kinds
