@@ -2,6 +2,10 @@
 dear/profiling.py, dear/chrome_profiler.py, dear/utils.py)."""
 
 from dear_pytorch_tpu.utils.chrome_trace import TraceWriter, timeline  # noqa: F401
+from dear_pytorch_tpu.utils.guard import (  # noqa: F401
+    DivergenceError,
+    GuardedTrainer,
+)
 from dear_pytorch_tpu.utils.perf_model import (  # noqa: F401
     allgather_perf_model,
     fit_alpha_beta,

@@ -1,0 +1,170 @@
+"""Failure detection + recovery around the train step.
+
+The reference has NONE of this (SURVEY.md §5: any MPI/CUDA/NCCL error
+aborts the process via CHECK macros; its batch driver retries at whole-job
+granularity). On TPU the failure surface is different — device errors
+surface as Python exceptions from a blocked fetch, and the classic silent
+killer is numerical: a NaN/Inf loss that poisons every parameter within a
+few donated steps. `GuardedTrainer` wraps a `TrainStep` with:
+
+  - **divergence detection**: the loss is fetched and checked every
+    ``check_every`` steps (fetch = one scalar device->host sync; keep the
+    cadence coarse on remote devices),
+  - **rollback**: on a non-finite loss (or a raised step error) the state
+    restores from the newest periodic checkpoint and training continues,
+    skipping forward past the poisoned step,
+  - **periodic checkpoints**: every ``checkpoint_every`` steps through
+    `utils.checkpoint` (plan-fingerprinted, sharded, multi-host safe),
+  - **step-time accounting**: wall-clock EMA + max, so a hung collective
+    shows up in logs with the last-good step number.
+
+This is single-program recovery (the process survives). Whole-process
+elasticity (host loss on a pod) composes on top: the same periodic
+checkpoints are what a relaunched job restores from.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, Callable, Optional
+
+import jax
+
+from dear_pytorch_tpu.utils import checkpoint as ckpt
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+
+class DivergenceError(RuntimeError):
+    """Raised when training diverges and no checkpoint exists to restore."""
+
+
+class GuardedTrainer:
+    """Wrap ``ts`` (a `parallel.TrainStep`) with detection + recovery.
+
+    Usage::
+
+        trainer = GuardedTrainer(ts, directory, params)
+        for batch in batches:
+            state, metrics = trainer.step(state, batch)
+    """
+
+    def __init__(
+        self,
+        ts,
+        directory: str,
+        params_template,
+        *,
+        check_every: int = 50,
+        checkpoint_every: int = 500,
+        max_recoveries: int = 3,
+        on_rollback: Optional[Callable[[int, int], None]] = None,
+    ):
+        self.ts = ts
+        self.directory = directory
+        self.check_every = max(int(check_every), 1)
+        self.checkpoint_every = max(int(checkpoint_every), 1)
+        self.max_recoveries = max_recoveries
+        self.on_rollback = on_rollback
+        self._template = None
+        self._params_template = params_template
+        self.recoveries = 0          # CONSECUTIVE rollbacks without a new
+        self.steps_seen = 0          # healthy checkpoint in between
+        self.ema_step_s = None
+        self.max_step_s = 0.0
+        self._last_good_step = None
+        self._last_check_t = None
+
+    # -- internals -----------------------------------------------------------
+
+    def _template_state(self):
+        if self._template is None:
+            self._template = self.ts.init(self._params_template)
+        return self._template
+
+    def _save(self, state) -> None:
+        ckpt.save_checkpoint(self.directory, state, self.ts.plan)
+        self._last_good_step = int(jax.device_get(state.step))
+
+    def _restore(self, cause: Optional[BaseException] = None):
+        step = ckpt.latest_step(self.directory)
+        if step is None:
+            raise DivergenceError(
+                "training failed before the first checkpoint; nothing to "
+                "restore (see the chained cause; if it is a NaN loss, "
+                "lower the lr or reduce checkpoint_every)"
+            ) from cause
+        state = ckpt.restore_checkpoint(
+            self.directory, self.ts, template=self._template_state()
+        )
+        logger.warning("guard: rolled back to checkpoint step %d", step)
+        return state, step
+
+    def _check(self, metrics) -> bool:
+        loss = float(jax.device_get(metrics["loss"]))
+        return math.isfinite(loss)
+
+    # -- public --------------------------------------------------------------
+
+    def step(self, state, batch):
+        """One guarded step. May return a ROLLED-BACK state instead of the
+        stepped one when divergence or a device error is detected."""
+        error: Optional[BaseException] = None
+        try:
+            new_state, metrics = self.ts.step(state, batch)
+            self.steps_seen += 1
+            is_ckpt = self.steps_seen % self.checkpoint_every == 0
+            is_check = self.steps_seen % self.check_every == 0 or is_ckpt
+            # a checkpoint step ALWAYS verifies first: persisting an
+            # unchecked state could immortalize NaN-poisoned parameters
+            # (rollback would then restore the poison)
+            healthy = not is_check or self._check(metrics)
+        except (FloatingPointError, RuntimeError) as exc:
+            logger.error("guard: step raised %s: %s", type(exc).__name__, exc)
+            healthy, new_state, metrics, error = False, None, None, exc
+            is_check = is_ckpt = False
+
+        if is_check and healthy:
+            # timing across the sync interval: under async dispatch only a
+            # checked (fetched) step gives a meaningful wall-clock point
+            now = time.perf_counter()
+            if self._last_check_t is not None:
+                per_step = (now - self._last_check_t) / self.check_every
+                if (
+                    self.ema_step_s is not None
+                    and per_step > 10 * self.ema_step_s
+                ):
+                    logger.warning(
+                        "guard: %.2fs/step over the last interval (ema "
+                        "%.3fs) — possible hung collective; last "
+                        "checkpointed step: %s",
+                        per_step, self.ema_step_s, self._last_good_step,
+                    )
+                self.ema_step_s = (
+                    per_step if self.ema_step_s is None
+                    else 0.9 * self.ema_step_s + 0.1 * per_step
+                )
+                self.max_step_s = max(self.max_step_s, per_step)
+            self._last_check_t = now
+
+        if not healthy:
+            self.recoveries += 1
+            if self.recoveries > self.max_recoveries:
+                raise DivergenceError(
+                    f"diverged {self.recoveries} consecutive times "
+                    f"(max_recoveries={self.max_recoveries})"
+                ) from error
+            restored, at_step = self._restore(cause=error)
+            self._last_check_t = None  # restore time must not skew timing
+            if self.on_rollback is not None:
+                self.on_rollback(self.recoveries, at_step)
+            return restored, {"loss": float("nan"), "rolled_back": True}
+
+        if is_ckpt:
+            self._save(new_state)
+            # persisted healthy progress: a future rollback is a NEW
+            # incident, not a continuation of an old one
+            self.recoveries = 0
+        return new_state, metrics

@@ -1,0 +1,120 @@
+"""Failure detection + rollback: a poisoned batch that NaNs the loss must
+be detected, the state rolled back to the newest checkpoint, and training
+must continue to convergence — the recovery story the reference lacks
+entirely (its CHECK macros abort the process)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+from dear_pytorch_tpu.parallel import build_train_step
+from dear_pytorch_tpu.utils.guard import DivergenceError, GuardedTrainer
+
+from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+
+def _trainer(mesh, tmp_path, **kw):
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, threshold_mb=0.0008, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    kw.setdefault("check_every", 1)
+    kw.setdefault("checkpoint_every", 4)
+    return params, ts, GuardedTrainer(ts, str(tmp_path / "g"), params, **kw)
+
+
+def _poison(batch):
+    x, y = batch
+    return (x.at[0, 0].set(jnp.nan), y)
+
+
+def test_rollback_on_nan_and_recovery(mesh, tmp_path):
+    params, ts, tr = _trainer(mesh, tmp_path)
+    batches = [_data(jax.random.PRNGKey(100 + i)) for i in range(12)]
+    state = ts.init(params)
+    rollbacks = []
+    tr.on_rollback = lambda n, at: rollbacks.append((n, at))
+
+    losses = []
+    for i, b in enumerate(batches):
+        if i == 6:  # after the step-4 checkpoint
+            state, m = tr.step(state, _poison(b))
+            assert m.get("rolled_back"), m
+            continue
+        state, m = tr.step(state, b)
+        losses.append(float(m["loss"]))
+
+    assert rollbacks == [(1, 4)]
+    assert all(np.isfinite(losses)), losses
+    # post-rollback training continued and kept improving
+    assert losses[-1] < losses[0]
+    # the restored state was the step-4 checkpoint, not the poisoned one
+    assert int(jax.device_get(state.step)) > 4
+
+
+def test_divergence_before_first_checkpoint_raises(mesh, tmp_path):
+    params, ts, tr = _trainer(mesh, tmp_path, checkpoint_every=1000)
+    state = ts.init(params)
+    with pytest.raises(DivergenceError, match="first checkpoint"):
+        tr.step(state, _poison(_data(jax.random.PRNGKey(0))))
+
+
+def test_max_recoveries_enforced(mesh, tmp_path):
+    params, ts, tr = _trainer(mesh, tmp_path, max_recoveries=2,
+                              checkpoint_every=1)
+    state = ts.init(params)
+    good = _data(jax.random.PRNGKey(1))
+    state, _ = tr.step(state, good)  # step 1 -> checkpoint exists
+    bad = _poison(good)
+    state, m = tr.step(state, bad)
+    assert m.get("rolled_back")
+    state, m = tr.step(state, bad)
+    assert m.get("rolled_back")
+    with pytest.raises(DivergenceError, match="diverged"):
+        tr.step(state, bad)
+
+
+def test_step_time_accounting(mesh, tmp_path):
+    params, ts, tr = _trainer(mesh, tmp_path)
+    state = ts.init(params)
+    for i in range(3):
+        state, _ = tr.step(state, _data(jax.random.PRNGKey(i)))
+    assert tr.ema_step_s is not None and tr.ema_step_s > 0
+    assert tr.max_step_s >= tr.ema_step_s * 0.5
+
+
+def test_checkpoint_step_always_verifies_before_saving(mesh, tmp_path):
+    """A checkpoint step that is NOT a check step must still verify the
+    loss before persisting: saving an unchecked NaN state would make every
+    future rollback restore the poison."""
+    params, ts, tr = _trainer(mesh, tmp_path, check_every=100,
+                              checkpoint_every=2)
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+
+    state = ts.init(params)
+    good = _data(jax.random.PRNGKey(5))
+    state, _ = tr.step(state, good)          # 1
+    state, _ = tr.step(state, good)          # 2 -> checkpoint
+    assert ckpt.latest_step(str(tmp_path / "g")) == 2
+    state, _ = tr.step(state, good)          # 3
+    state, m = tr.step(state, _poison(good))  # 4: ckpt step, poisoned
+    assert m.get("rolled_back"), m
+    # the poisoned step-4 state was NOT persisted
+    assert ckpt.latest_step(str(tmp_path / "g")) == 2
+
+
+def test_recoveries_reset_after_healthy_checkpoint(mesh, tmp_path):
+    """max_recoveries bounds CONSECUTIVE rollbacks, not lifetime faults."""
+    params, ts, tr = _trainer(mesh, tmp_path, max_recoveries=1,
+                              checkpoint_every=1)
+    state = ts.init(params)
+    good = _data(jax.random.PRNGKey(6))
+    state, _ = tr.step(state, good)
+    for _ in range(3):  # three independent faults, healthy steps between
+        state, m = tr.step(state, _poison(good))
+        assert m.get("rolled_back")
+        state, m = tr.step(state, good)  # checkpoint -> counter reset
+        assert not m.get("rolled_back")
