@@ -60,6 +60,7 @@ class GuardedTrainer:
         check_every: int = 50,
         checkpoint_every: int = 500,
         max_recoveries: int = 3,
+        max_keep: int = 3,
         on_rollback: Optional[Callable[[int, int], None]] = None,
     ):
         self.ts = ts
@@ -67,6 +68,7 @@ class GuardedTrainer:
         self.check_every = max(int(check_every), 1)
         self.checkpoint_every = max(int(checkpoint_every), 1)
         self.max_recoveries = max_recoveries
+        self.max_keep = max(int(max_keep), 1)
         self.on_rollback = on_rollback
         self._template = None
         self._params_template = params_template
@@ -76,6 +78,7 @@ class GuardedTrainer:
         self.max_step_s = 0.0
         self._last_good_step = None
         self._last_check_t = None
+        self._last_check_steps = 0
 
     # -- internals -----------------------------------------------------------
 
@@ -87,6 +90,36 @@ class GuardedTrainer:
     def _save(self, state) -> None:
         ckpt.save_checkpoint(self.directory, state, self.ts.plan)
         self._last_good_step = int(jax.device_get(state.step))
+        self._prune()
+
+    def _prune(self) -> None:
+        """Keep the newest ``max_keep`` checkpoints (the guard only ever
+        restores the latest; unbounded retention would eventually fill the
+        filesystem and crash the very trainer meant to survive faults)."""
+        if jax.process_index() != 0:
+            return
+        import os
+        import shutil
+
+        try:
+            steps = sorted(
+                int(name[len("step_"):])
+                for name in os.listdir(self.directory)
+                if name.startswith("step_")
+            )
+        except OSError:
+            return
+        for s in steps[: -self.max_keep]:
+            shutil.rmtree(
+                os.path.join(self.directory, f"step_{s:010d}"),
+                ignore_errors=True,
+            )
+            try:
+                os.remove(
+                    os.path.join(self.directory, f"meta_{s:010d}.json")
+                )
+            except OSError:
+                pass
 
     def _restore(self, cause: Optional[BaseException] = None):
         step = ckpt.latest_step(self.directory)
@@ -99,6 +132,9 @@ class GuardedTrainer:
         state = ckpt.restore_checkpoint(
             self.directory, self.ts, template=self._template_state()
         )
+        # the template is only needed for structure/shardings during the
+        # restore; caching it would permanently double device memory
+        self._template = None
         logger.warning("guard: rolled back to checkpoint step %d", step)
         return state, step
 
@@ -122,16 +158,27 @@ class GuardedTrainer:
             # (rollback would then restore the poison)
             healthy = not is_check or self._check(metrics)
         except (FloatingPointError, RuntimeError) as exc:
+            if jax.process_count() > 1:
+                # a LOCAL exception must not trigger a local rollback on a
+                # multi-host run: the other processes would step on while
+                # this one restores, silently desynchronizing replicas.
+                # Crash instead — whole-job relaunch restores every process
+                # from the same periodic checkpoints (the NaN path below is
+                # safe: the checked loss is replicated, so every process
+                # makes the same decision).
+                raise
             logger.error("guard: step raised %s: %s", type(exc).__name__, exc)
             healthy, new_state, metrics, error = False, None, None, exc
             is_check = is_ckpt = False
 
         if is_check and healthy:
             # timing across the sync interval: under async dispatch only a
-            # checked (fetched) step gives a meaningful wall-clock point
+            # checked (fetched) step gives a meaningful wall-clock point;
+            # checkpoint steps also check, so use the ACTUAL step delta
             now = time.perf_counter()
-            if self._last_check_t is not None:
-                per_step = (now - self._last_check_t) / self.check_every
+            interval = self.steps_seen - self._last_check_steps
+            if self._last_check_t is not None and interval > 0:
+                per_step = (now - self._last_check_t) / interval
                 if (
                     self.ema_step_s is not None
                     and per_step > 10 * self.ema_step_s
@@ -148,6 +195,7 @@ class GuardedTrainer:
                 )
                 self.max_step_s = max(self.max_step_s, per_step)
             self._last_check_t = now
+            self._last_check_steps = self.steps_seen
 
         if not healthy:
             self.recoveries += 1

@@ -118,3 +118,22 @@ def test_recoveries_reset_after_healthy_checkpoint(mesh, tmp_path):
         assert m.get("rolled_back")
         state, m = tr.step(state, good)  # checkpoint -> counter reset
         assert not m.get("rolled_back")
+
+
+def test_checkpoint_retention_prunes_old(mesh, tmp_path):
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+
+    params, ts, tr = _trainer(mesh, tmp_path, checkpoint_every=1,
+                              max_keep=2)
+    state = ts.init(params)
+    good = _data(jax.random.PRNGKey(7))
+    for _ in range(5):
+        state, _ = tr.step(state, good)
+    import os
+
+    steps = sorted(
+        int(n[len("step_"):]) for n in os.listdir(str(tmp_path / "g"))
+        if n.startswith("step_")
+    )
+    assert steps == [4, 5]
+    assert ckpt.latest_step(str(tmp_path / "g")) == 5
