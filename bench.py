@@ -51,28 +51,34 @@ NUM_BATCHES_PER_ITER = 2 if SMOKE else 10
 
 
 def _compile_once(ts, state, batch):
-    """(step_fn, flops): ONE AOT compilation serving both the timed loop
-    and cost analysis (ts.step's jit dispatch would compile a second,
-    identical executable)."""
-    compiled = ts.lower(state, batch).compile()
+    """(iter_fn, flops_per_step): ONE AOT compilation of the scanned
+    NUM_BATCHES_PER_ITER-step program. One program per timed iteration:
+    dispatch cost amortizes over the scan, and XLA schedules step i+1's
+    all-gathers under step i's tail (DeAR's cross-iteration pipelining,
+    inside one executable)."""
+    runner = ts.multi_step(NUM_BATCHES_PER_ITER)
+    compiled = runner.lower(state, batch).compile()
     try:
+        # XLA cost analysis counts a scan (while-loop) BODY once, so the
+        # scanned program already reports one step's flops — no division
         flops = float(compiled.cost_analysis().get("flops", 0.0))
     except Exception:
         flops = 0.0
     return compiled, flops
 
 
-def _timed(step_fn, state, batch, items_per_batch: int):
-    """(value items/s, secs/step, state) under the async-dispatch protocol."""
+def _timed(iter_fn, state, batch, items_per_batch: int):
+    """(value items/s, secs/step, state); each ``iter_fn`` call runs
+    NUM_BATCHES_PER_ITER steps as one program."""
+    n_warm_iters = max(WARMUP_BATCHES // NUM_BATCHES_PER_ITER, 1)
     metrics = None
-    for _ in range(WARMUP_BATCHES):
-        state, metrics = step_fn(state, batch)
+    for _ in range(n_warm_iters):
+        state, metrics = iter_fn(state, batch)
     float(metrics["loss"])  # drain the pipeline once before timing
     times = []
     for _ in range(NUM_ITERS):
         t0 = time.perf_counter()
-        for _ in range(NUM_BATCHES_PER_ITER):
-            state, metrics = step_fn(state, batch)
+        state, metrics = iter_fn(state, batch)
         float(metrics["loss"])  # one device->host scalar fetch per run
         times.append(time.perf_counter() - t0)
     rates = [items_per_batch * NUM_BATCHES_PER_ITER / t for t in times]

@@ -114,6 +114,12 @@ class TrainStep(NamedTuple):
     #: ``.compile().cost_analysis()`` = FLOPs for MFU accounting). Same cache
     #: as ``step`` — no double compile.
     lower: Callable[[DearState, Any], Any] = None
+    #: ``multi_step(n)`` -> jitted ``(state, batch) -> (state, metrics)``
+    #: running n steps as ONE compiled `lax.scan` program: one dispatch per
+    #: n steps, and XLA sees step i+1's all-gathers after step i's update —
+    #: the cross-iteration AG-under-forward pipelining DeAR promises
+    #: materializes inside a single program instead of across dispatches.
+    multi_step: Callable[[int], Callable] = None
 
 
 def _opt_bucket_specs(axis_name: str, bucket_padded: int, opt_state_leaf):
@@ -582,6 +588,40 @@ def build_train_step(
     def lower(state: DearState, batch):
         return _jitted(state, batch).lower(state, batch)
 
+    _multi_compiled: dict = {}
+
+    def multi_step(n: int):
+        """One jitted program running ``n`` steps on the same batch (the
+        benchmark protocol) via `lax.scan`; returns the final state and the
+        LAST step's metrics. Amortizes dispatch and exposes cross-step
+        overlap to the scheduler. The jitted fn is cached per ``n`` so a
+        training loop calling ``ts.multi_step(8)(state, batch)`` repeatedly
+        does not retrace."""
+        cached = _multi_compiled.get(n)
+        if cached is not None:
+            return cached
+
+        def fn(state: DearState, batch):
+            state_specs = _state_specs(state)
+            mapped = jax.shard_map(
+                device_step,
+                mesh=mesh,
+                in_specs=(state_specs, _batch_specs(batch)),
+                out_specs=(state_specs, jax.P()),
+                check_vma=False,
+            )
+
+            def body(s, _):
+                s, m = mapped(s, batch)
+                return s, m
+
+            final, ms = jax.lax.scan(body, state, None, length=n)
+            return final, jax.tree.map(lambda x: x[-1], ms)
+
+        jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
+        _multi_compiled[n] = jitted
+        return jitted
+
     def gather_params(state: DearState):
         """Materialize the full parameter pytree (for eval / checkpointing).
         Equivalent to the reference reading back `model.parameters()` after
@@ -590,4 +630,5 @@ def build_train_step(
         return F.unpack_all(list(state.buffers), plan)
 
     return TrainStep(init=init, step=step, gather_params=gather_params,
-                     plan=plan, mesh=mesh, lower=lower)
+                     plan=plan, mesh=mesh, lower=lower,
+                     multi_step=multi_step)

@@ -67,7 +67,9 @@ def latest_step(directory: str) -> Optional[int]:
     steps = [
         int(name[len("step_"):])
         for name in os.listdir(directory)
-        if name.startswith("step_")
+        # exclude Orbax's atomic-write temp dirs
+        # (step_XXXXXXXXXX.orbax-checkpoint-tmp-N) left by a crash mid-save
+        if name.startswith("step_") and name[len("step_"):].isdigit()
     ]
     return max(steps) if steps else None
 

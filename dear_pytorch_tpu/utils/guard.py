@@ -102,13 +102,17 @@ class GuardedTrainer:
         import shutil
 
         try:
-            steps = sorted(
-                int(name[len("step_"):])
-                for name in os.listdir(self.directory)
-                if name.startswith("step_")
-            )
+            names = os.listdir(self.directory)
         except OSError:
             return
+        steps = sorted(
+            int(name[len("step_"):])
+            for name in names
+            # skip crash-leftover Orbax temp dirs
+            # (step_XXXXXXXXXX.orbax-checkpoint-tmp-N) and anything else
+            # that is not a finalized checkpoint
+            if name.startswith("step_") and name[len("step_"):].isdigit()
+        )
         for s in steps[: -self.max_keep]:
             shutil.rmtree(
                 os.path.join(self.directory, f"step_{s:010d}"),

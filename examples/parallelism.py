@@ -63,22 +63,26 @@ def main(argv=None) -> float:
     n = len(jax.devices())
     losses = []
 
-    if args.axis in ("dp", "sp", "tp"):
+    def tiny_bert(batch_rows, seq_len=32, heads=4):
         cfg = BertConfig(
-            num_hidden_layers=2, hidden_size=32, num_attention_heads=4,
-            intermediate_size=64, vocab_size=64, max_position_embeddings=32,
+            num_hidden_layers=2, hidden_size=32, num_attention_heads=heads,
+            intermediate_size=64, vocab_size=64,
+            max_position_embeddings=seq_len,
             hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
         )
         batch = mdata.synthetic_bert_batch(
-            jax.random.PRNGKey(2), 4, seq_len=32, vocab_size=64
+            jax.random.PRNGKey(2), batch_rows, seq_len=seq_len,
+            vocab_size=64,
         )
         params = BertForPreTraining(cfg).init(
             {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
             train=False,
         )["params"]
+        return cfg, batch, params
 
     if args.axis == "dp":
         mesh = dear.init()
+        cfg, batch, params = tiny_bert(n)  # rows must cover the dp axis
 
         def loss_fn(p, b):
             logits, nsp = BertForPreTraining(cfg).apply(
@@ -90,10 +94,6 @@ def main(argv=None) -> float:
                 b["masked_lm_labels"], b["next_sentence_labels"],
             )
 
-        # batch rows must cover the dp axis
-        batch = mdata.synthetic_bert_batch(
-            jax.random.PRNGKey(2), n, seq_len=32, vocab_size=64
-        )
         ts = build_train_step(loss_fn, params, mesh=mesh, mode="dear",
                               threshold_mb=0.05,
                               optimizer=fused_sgd(lr=0.01, momentum=0.9))
@@ -135,6 +135,7 @@ def main(argv=None) -> float:
         mesh = jax.sharding.Mesh(
             np.asarray(jax.devices()).reshape(2, n // 2), ("dp", "tp")
         )
+        cfg, batch, params = tiny_bert(4)
 
         def loss_fn(p, b):
             logits, nsp = BertForPreTraining(cfg).apply(

@@ -400,3 +400,29 @@ def test_init_does_not_alias_single_leaf_1d_params(mesh):
     state2 = ts.init(params)
     state2, m = ts.step(state2, batch)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_multi_step_equals_sequential_steps(mesh):
+    """ts.multi_step(n) (one scanned program) must equal n sequential
+    ts.step calls exactly — state and final metrics."""
+    params = _mlp_params(jax.random.PRNGKey(0))
+    batch = _data(jax.random.PRNGKey(50))
+    opt = fused_sgd(lr=0.05, momentum=0.9)
+
+    ts = build_train_step(_loss_fn, params, mesh=mesh, optimizer=opt,
+                          threshold_mb=0.0008, donate=False)
+    s_seq = ts.init(params)
+    for _ in range(4):
+        s_seq, m_seq = ts.step(s_seq, batch)
+
+    s_scan = ts.init(params)
+    s_scan, m_scan = ts.multi_step(4)(s_scan, batch)
+
+    assert float(m_scan["loss"]) == pytest.approx(float(m_seq["loss"]),
+                                                  rel=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        s_scan.buffers, s_seq.buffers,
+    )
