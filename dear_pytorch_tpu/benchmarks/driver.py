@@ -56,6 +56,9 @@ METHOD_ARGS: dict[str, list[str]] = {
                "--compressor", "eftopk", "--density", "0.01"],
     "bytescheduler": ["--mode", "bytescheduler", "--threshold", "25",
                       "--partition", "4"],
+    "eftopk-mc": ["--mode", "allreduce", "--threshold", "25",
+                  "--compressor", "eftopk", "--density", "0.01",
+                  "--momentum-correction", "0.9"],
 }
 
 #: reference sweep workloads (benchmarks.py:21-28)

@@ -566,19 +566,26 @@ def build_train_step(
 
     _compiled: dict = {}
 
+    def _mapped(state: DearState, batch):
+        """The shard_map-wrapped device step — single construction point
+        shared by the per-step and scanned-multi-step programs."""
+        state_specs = _state_specs(state)
+        return jax.shard_map(
+            device_step,
+            mesh=mesh,
+            in_specs=(state_specs, _batch_specs(batch)),
+            out_specs=(state_specs, jax.P()),
+            check_vma=False,
+        )
+
     def _jitted(state: DearState, batch):
         key = jax.tree.structure((state, batch))
         fn = _compiled.get(key)
         if fn is None:
-            state_specs = _state_specs(state)
-            mapped = jax.shard_map(
-                device_step,
-                mesh=mesh,
-                in_specs=(state_specs, _batch_specs(batch)),
-                out_specs=(state_specs, jax.P()),
-                check_vma=False,
+            fn = jax.jit(
+                _mapped(state, batch),
+                donate_argnums=(0,) if donate else (),
             )
-            fn = jax.jit(mapped, donate_argnums=(0,) if donate else ())
             _compiled[key] = fn
         return fn
 
@@ -602,14 +609,7 @@ def build_train_step(
             return cached
 
         def fn(state: DearState, batch):
-            state_specs = _state_specs(state)
-            mapped = jax.shard_map(
-                device_step,
-                mesh=mesh,
-                in_specs=(state_specs, _batch_specs(batch)),
-                out_specs=(state_specs, jax.P()),
-                check_vma=False,
-            )
+            mapped = _mapped(state, batch)
 
             def body(s, _):
                 s, m = mapped(s, batch)

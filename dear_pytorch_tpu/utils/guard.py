@@ -108,11 +108,17 @@ class GuardedTrainer:
         steps = sorted(
             int(name[len("step_"):])
             for name in names
-            # skip crash-leftover Orbax temp dirs
-            # (step_XXXXXXXXXX.orbax-checkpoint-tmp-N) and anything else
-            # that is not a finalized checkpoint
             if name.startswith("step_") and name[len("step_"):].isdigit()
         )
+        # crash-leftover Orbax atomic-write temp dirs
+        # (step_XXXXXXXXXX.orbax-checkpoint-tmp-N) are never restorable;
+        # delete them too, or a crash-restart loop fills the disk the
+        # retention policy exists to protect
+        for name in names:
+            if name.startswith("step_") and ".orbax-checkpoint-tmp" in name:
+                shutil.rmtree(
+                    os.path.join(self.directory, name), ignore_errors=True
+                )
         for s in steps[: -self.max_keep]:
             shutil.rmtree(
                 os.path.join(self.directory, f"step_{s:010d}"),

@@ -137,3 +137,17 @@ def test_checkpoint_retention_prunes_old(mesh, tmp_path):
     )
     assert steps == [4, 5]
     assert ckpt.latest_step(str(tmp_path / "g")) == 5
+
+
+def test_prune_removes_orbax_tmp_leftovers(mesh, tmp_path):
+    import os
+
+    params, ts, tr = _trainer(mesh, tmp_path, checkpoint_every=1,
+                              max_keep=2)
+    d = str(tmp_path / "g")
+    os.makedirs(d, exist_ok=True)
+    junk = os.path.join(d, "step_0000000001.orbax-checkpoint-tmp-42")
+    os.makedirs(junk)
+    state = ts.init(params)
+    state, _ = tr.step(state, _data(jax.random.PRNGKey(8)))
+    assert not os.path.exists(junk)
