@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import jax
@@ -51,11 +52,13 @@ NUM_BATCHES_PER_ITER = 2 if SMOKE else 10
 
 
 def _compile_once(ts, state, batch):
-    """(iter_fn, flops_per_step): ONE AOT compilation of the scanned
-    NUM_BATCHES_PER_ITER-step program. One program per timed iteration:
-    dispatch cost amortizes over the scan, and XLA schedules step i+1's
-    all-gathers under step i's tail (DeAR's cross-iteration pipelining,
-    inside one executable)."""
+    """(iter_fn, flops_per_step, peak_hbm_bytes): ONE AOT compilation of the
+    scanned NUM_BATCHES_PER_ITER-step program. One program per timed
+    iteration: dispatch cost amortizes over the scan, and XLA schedules step
+    i+1's all-gathers under step i's tail (DeAR's cross-iteration
+    pipelining, inside one executable)."""
+    from dear_pytorch_tpu.utils import perf_model
+
     runner = ts.multi_step(NUM_BATCHES_PER_ITER)
     compiled = runner.lower(state, batch).compile()
     try:
@@ -64,7 +67,7 @@ def _compile_once(ts, state, batch):
         flops = float(compiled.cost_analysis().get("flops", 0.0))
     except Exception:
         flops = 0.0
-    return compiled, flops
+    return compiled, flops, perf_model.peak_hbm_bytes(compiled)
 
 
 def _timed(iter_fn, state, batch, items_per_batch: int):
@@ -124,15 +127,18 @@ def bench_resnet(mesh):
         model_state_template=model_state,
     )
     state = ts.init(params, model_state)
-    step_fn, flops = _compile_once(ts, state, batch)
+    step_fn, flops, hbm = _compile_once(ts, state, batch)
     value, secs_per_step, _ = _timed(step_fn, state, batch, batch_size)
-    return {
+    out = {
         "metric": "resnet50_bs64_train_img_sec_per_chip",
         "value": round(value, 2),
         "unit": "img/s",
         "vs_baseline": round(value / BASELINE_IMG_SEC, 3),
         "mfu": _mfu(flops, secs_per_step),
     }
+    if hbm:
+        out["peak_hbm_gb"] = round(hbm / 2**30, 3)
+    return out
 
 
 def bench_bert(mesh):
@@ -183,7 +189,7 @@ def bench_bert(mesh):
         rng_seed=42,
     )
     state = ts.init(params)
-    step_fn, flops = _compile_once(ts, state, batch)
+    step_fn, flops, hbm = _compile_once(ts, state, batch)
     value, secs_per_step, _ = _timed(step_fn, state, batch, batch_size)
     out = {
         "metric": "bert_base_sen_sec_per_chip",
@@ -191,6 +197,8 @@ def bench_bert(mesh):
         "unit": "sen/s",
         "mfu": _mfu(flops, secs_per_step),
     }
+    if hbm:
+        out["peak_hbm_gb"] = round(hbm / 2**30, 3)
     if BASELINE_BERT_SEN_SEC:
         out["vs_baseline"] = round(value / BASELINE_BERT_SEN_SEC, 3)
     return out
@@ -203,16 +211,74 @@ def _mfu(flops: float, secs_per_step: float):
     return round(value, 4) if value else None
 
 
+class _Watchdog:
+    """Per-phase hang guard: the session's tunneled TPU backend is known to
+    hang indefinitely (device init / compile RPCs) when the tunnel drops. A
+    daemon thread + ``os._exit`` fires even while the main thread is stuck in
+    a C call, which a signal handler would not. Each phase gets its own
+    budget (``arm`` resets the clock), and once the primary metric exists a
+    late hang emits the partial result and exits 0 — a wedged second metric
+    must not sink the primary. Disable with DEAR_BENCH_WATCHDOG_SECS=0."""
+
+    def __init__(self):
+        self.secs = float(os.environ.get("DEAR_BENCH_WATCHDOG_SECS", "2400"))
+        self.primary = None
+        self._timer = None
+
+    def arm(self, phase: str, metric: str) -> None:
+        if self.secs <= 0:
+            return
+        self.disarm()
+
+        def fire():
+            sys.stderr.write(
+                f"bench.py watchdog: phase {phase!r} still running after "
+                f"{self.secs:.0f}s — device backend likely wedged (tunnel "
+                "down?); aborting\n"
+            )
+            sys.stderr.flush()
+            if self.primary is not None:
+                out = dict(self.primary)
+                out["extra_metrics"] = [{
+                    "metric": metric,
+                    "error": f"watchdog: {phase} wedged after "
+                             f"{self.secs:.0f}s",
+                }]
+                print(json.dumps(out), flush=True)
+                os._exit(0)
+            os._exit(3)
+
+        self._timer = threading.Timer(self.secs, fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def disarm(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
 def main() -> None:
+    from dear_pytorch_tpu.benchmarks import runner
     from dear_pytorch_tpu.comm import backend
 
+    # Honor JAX_PLATFORMS/DEAR_NUM_CPU_DEVICES via jax.config: this
+    # container's sitecustomize imports jax before us, so env-only platform
+    # selection is too late (and CPU smoke runs would hang in the tunneled
+    # backend's device init whenever the tunnel is down).
+    runner.apply_platform_env()
+    dog = _Watchdog()
+    dog.arm("resnet", "resnet50_bs64_train_img_sec_per_chip")
     mesh = backend.init()
     resnet = bench_resnet(mesh)
+    dog.primary = resnet
+    dog.arm("bert", "bert_base_sen_sec_per_chip")
     try:
         bert = bench_bert(mesh)
     except Exception as exc:  # second metric must not sink the primary
         bert = {"metric": "bert_base_sen_sec_per_chip",
                 "error": f"{type(exc).__name__}: {exc}"[:200]}
+    dog.disarm()
     out = dict(resnet)
     out["extra_metrics"] = [bert]
     print(json.dumps(out))

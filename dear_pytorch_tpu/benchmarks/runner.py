@@ -196,6 +196,10 @@ def add_common_args(parser) -> None:
                         help="runtime fusion tuning: Bayesian optimization "
                              "over the threshold (reference dopt_rsag_bo) "
                              "or wait-time split flags (dopt_rsag_wt)")
+    parser.add_argument("--accum-steps", type=int, default=1,
+                        help="gradient accumulation: split each per-device "
+                             "batch into this many scanned microbatches; "
+                             "collectives and the update run once per step")
     parser.add_argument("--base-lr", type=float, default=0.01)
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--profile-dir", type=str, default=None,
@@ -325,6 +329,7 @@ def config_from_args(args, *, fp16_comm: bool = True):
         comm_dtype=jnp.bfloat16 if (args.fp16 and fp16_comm) else None,
         rng_seed=42,
         partition_mb=args.partition,
+        accum_steps=args.accum_steps,
     )
 
 

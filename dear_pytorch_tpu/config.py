@@ -67,6 +67,7 @@ class DearConfig:
     # misc
     rng_seed: Optional[int] = None
     donate: bool = True
+    accum_steps: int = 1                    # gradient accumulation microbatches
 
     def __post_init__(self):
         if self.mode not in ("dear", "allreduce", "rsag", "rb",
@@ -101,6 +102,16 @@ class DearConfig:
             return None if raw.lower() in ("none", "") else float(raw)
         if name in ("nearby_layers", "bo_trials", "bo_interval"):
             return None if raw.lower() in ("none", "") else int(raw)
+        if name == "accum_steps":  # None is never legal here
+            try:
+                v = int(raw)
+            except ValueError:
+                v = 0
+            if v < 1:
+                raise ValueError(
+                    f"DEAR_ACCUM_STEPS must be a positive int, got {raw!r}"
+                )
+            return v
         if name in ("lr", "momentum", "weight_decay", "density",
                     "cycle_time_s", "partition_mb", "momentum_correction"):
             return float(raw)
@@ -148,6 +159,7 @@ class DearConfig:
             rng_seed=self.rng_seed,
             donate=self.donate,
             partition_mb=self.partition_mb,
+            accum_steps=self.accum_steps,
         )
 
     def describe(self) -> str:

@@ -166,6 +166,7 @@ def build_train_step(
     batch_spec_fn: Optional[Callable[[Any], Any]] = None,
     mean_axes: Optional[Sequence[str]] = None,
     partition_mb: float = 4.0,
+    accum_steps: int = 1,
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -225,6 +226,16 @@ def build_train_step(
       partition_mb: 'bytescheduler' mode's chunk size (MB of the comm
         dtype; the reference's ``--partition`` /
         ``BYTESCHEDULER_PARTITION``). Ignored by other modes.
+      accum_steps: gradient accumulation. The per-device batch splits into
+        ``accum_steps`` microbatches along every leaf's leading axis
+        (scanned sequentially), gradients average across microbatches, and
+        the collectives + optimizer update run ONCE per step — the large
+        effective batch sizes the reference reaches only by adding GPUs.
+        Model state (BN stats) threads through the microbatches; with
+        ``rng_seed`` each microbatch gets a distinct dropout key. Loss and
+        ``aux`` are MEANS over microbatches (matching the cross-device
+        `lax.pmean` convention) — aux must be a mean-like statistic, not a
+        count/sum, for its value to be independent of ``accum_steps``.
       mean_axes: the axes over which per-device losses are independent
         equal-weight samples (gradients are AVERAGED over these; summed over
         the rest). Defaults to all of ``axis_name``. For dp×sp pass
@@ -287,6 +298,9 @@ def build_train_step(
         )
     if gtopk and comp.name not in Z.SPARSE:
         raise ValueError("gtopk requires a top-k-family compressor")
+    if int(accum_steps) != accum_steps or accum_steps < 1:
+        raise ValueError(f"accum_steps must be a positive int, got {accum_steps}")
+    accum_steps = int(accum_steps)
     if momentum_correction and comp.name not in Z.SPARSE:
         raise ValueError(
             "momentum_correction requires a sparse (top-k-family) "
@@ -326,19 +340,59 @@ def build_train_step(
         else:
             extra_args = ()
         # Canonicalize every loss_fn variant to (loss, (model_state, aux)).
-        def canonical_loss(p):
+        def canonical_loss(p, mstate, b, extra):
             if has_model_state:
-                loss, out = loss_fn(p, state.model_state, batch, *extra_args)
+                loss, out = loss_fn(p, mstate, b, *extra)
                 ms, aux = out if has_aux else (out, None)
                 return loss, (ms, aux)
             if has_aux:
-                loss, aux = loss_fn(p, batch, *extra_args)
+                loss, aux = loss_fn(p, b, *extra)
                 return loss, ((), aux)
-            return loss_fn(p, batch, *extra_args), ((), None)
+            return loss_fn(p, b, *extra), ((), None)
 
-        (loss, (new_model_state, aux)), grads = jax.value_and_grad(
-            canonical_loss, has_aux=True
-        )(params)
+        vg = jax.value_and_grad(canonical_loss, has_aux=True)
+        if accum_steps == 1:
+            (loss, (new_model_state, aux)), grads = vg(
+                params, state.model_state, batch, extra_args
+            )
+        else:
+            # Microbatch scan: grads SUM across microbatches (divided once at
+            # the end), model state threads through, per-microbatch rng keys.
+            def _split(x):
+                if x.shape[0] % accum_steps:
+                    raise ValueError(
+                        f"batch leaf leading axis {x.shape[0]} is not "
+                        f"divisible by accum_steps={accum_steps} (note: this "
+                        "is the PER-DEVICE shard size)"
+                    )
+                return x.reshape(
+                    (accum_steps, x.shape[0] // accum_steps) + x.shape[1:]
+                )
+
+            mb_batch = jax.tree.map(_split, batch)
+
+            def mb_body(carry, xs):
+                ms, gacc = carry
+                b_i, i = xs
+                extra = (
+                    (jax.random.fold_in(extra_args[0], i),)
+                    if extra_args else ()
+                )
+                (loss_i, (ms_i, aux_i)), g_i = vg(params, ms, b_i, extra)
+                gacc = jax.tree.map(jnp.add, gacc, g_i)
+                return (ms_i, gacc), (loss_i, aux_i)
+
+            (new_model_state, gsum), (mb_losses, mb_auxs) = lax.scan(
+                mb_body,
+                (state.model_state, jax.tree.map(jnp.zeros_like, params)),
+                (mb_batch, jnp.arange(accum_steps)),
+            )
+            grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+            loss = jnp.mean(mb_losses)
+            aux = (
+                None if mb_auxs is None
+                else jax.tree.map(lambda a: jnp.mean(a, axis=0), mb_auxs)
+            )
         if has_model_state:
             # Keep replicated state consistent across replicas (each saw a
             # different batch shard): average float stats, max-consensus

@@ -42,12 +42,14 @@ def main():
     args = ap.parse_args()
 
     from dear_pytorch_tpu import models
+    from dear_pytorch_tpu.benchmarks import runner
     from dear_pytorch_tpu.comm import backend
     from dear_pytorch_tpu.models import data
     from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
     from dear_pytorch_tpu.parallel import dear as D
     from dear_pytorch_tpu.utils import perf_model
 
+    runner.apply_platform_env()  # sitecustomize pre-imports jax (see bench.py)
     mesh = backend.init()
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind}  peak bf16: "
