@@ -56,6 +56,7 @@ METHOD_ARGS: dict[str, list[str]] = {
                "--compressor", "eftopk", "--density", "0.01"],
     "bytescheduler": ["--mode", "bytescheduler", "--threshold", "25",
                       "--partition", "4"],
+    "fsdp": ["--mode", "fsdp", "--threshold", "25"],
     "eftopk-mc": ["--mode", "allreduce", "--threshold", "25",
                   "--compressor", "eftopk", "--density", "0.01",
                   "--momentum-correction", "0.9"],

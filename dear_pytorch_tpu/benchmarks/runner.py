@@ -147,9 +147,10 @@ def add_common_args(parser) -> None:
     parser.add_argument("--num-iters", type=int, default=5)
     parser.add_argument("--mode", type=str, default="dear",
                         choices=["dear", "allreduce", "rsag", "rb",
-                                 "bytescheduler"],
+                                 "bytescheduler", "fsdp"],
                         help="communication schedule (replaces the "
-                             "reference's per-directory baselines)")
+                             "reference's per-directory baselines; 'fsdp' "
+                             "= ZeRO-3 re-gather-in-backward)")
     parser.add_argument("--partition", type=float, default=4.0,
                         help="bytescheduler partition size in MB "
                              "(reference bytescheduler --partition, "
@@ -326,7 +327,13 @@ def config_from_args(args, *, fp16_comm: bool = True):
         ),
         lr=args.base_lr,
         momentum=args.momentum,
-        comm_dtype=jnp.bfloat16 if (args.fp16 and fp16_comm) else None,
+        # fsdp communicates both legs in gather_dtype (RS = gather transpose)
+        comm_dtype=(jnp.bfloat16
+                    if (args.fp16 and fp16_comm and args.mode != "fsdp")
+                    else None),
+        gather_dtype=(jnp.bfloat16
+                      if (args.fp16 and fp16_comm and args.mode == "fsdp")
+                      else None),
         rng_seed=42,
         partition_mb=args.partition,
         accum_steps=args.accum_steps,

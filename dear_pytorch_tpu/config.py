@@ -32,7 +32,7 @@ class DearConfig:
     """Every train-step knob in one place (defaults = the reference's)."""
 
     # schedule (replaces the reference's one-directory-per-method layout)
-    mode: str = "dear"            # dear | allreduce | rsag | rb | bytescheduler
+    mode: str = "dear"    # dear | allreduce | rsag | rb | bytescheduler | fsdp
     exclude_parts: tuple = ()               # ('reducescatter'|'allgather')*
     partition_mb: float = 4.0               # bytescheduler chunk size (MB)
 
@@ -62,6 +62,7 @@ class DearConfig:
 
     # precision
     comm_dtype: Any = None                  # e.g. jnp.bfloat16
+    gather_dtype: Any = None                # pre-gather cast (dear/fsdp)
     compute_bf16: bool = False
 
     # misc
@@ -71,7 +72,7 @@ class DearConfig:
 
     def __post_init__(self):
         if self.mode not in ("dear", "allreduce", "rsag", "rb",
-                             "bytescheduler"):
+                             "bytescheduler", "fsdp"):
             raise ValueError(f"bad mode {self.mode!r}")
         if self.autotune not in (None, "bo", "wait_time"):
             raise ValueError(f"bad autotune {self.autotune!r}")
@@ -117,7 +118,7 @@ class DearConfig:
             return float(raw)
         if name in ("gtopk", "nesterov", "donate", "compute_bf16"):
             return raw.lower() in ("1", "true", "yes")
-        if name == "comm_dtype":
+        if name in ("comm_dtype", "gather_dtype"):
             return _COMM_DTYPES[raw.lower()]
         if name == "exclude_parts":
             return tuple(p for p in raw.split(",") if p)
@@ -152,6 +153,7 @@ class DearConfig:
             exclude_parts=self.exclude_parts,
             optimizer=self.optimizer(),
             comm_dtype=self.comm_dtype,
+            gather_dtype=self.gather_dtype,
             compressor=self.compressor,
             density=self.density,
             gtopk=self.gtopk,

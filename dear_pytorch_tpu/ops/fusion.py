@@ -342,17 +342,26 @@ def pack_bucket(
 
 
 def unpack_bucket(
-    buf: jax.Array, plan: FusionPlan, bucket: int
+    buf: jax.Array, plan: FusionPlan, bucket: int, *, wrap=None, cast=False
 ) -> dict[int, jax.Array]:
     """Slice a flat (padded) buffer back into `{leaf_id: tensor}` views
-    (``pull_alltensors``, tensorfusion.py:117-127)."""
+    (``pull_alltensors``, tensorfusion.py:117-127).
+
+    ``wrap`` is applied to EVERY intermediate (slice, reshape, cast) — the
+    fsdp schedule injects `checkpoint_name` here so no unnamed alias of the
+    gathered weights is saveable as a remat residual. ``cast=True`` restores
+    each leaf's original dtype (what `unpack_all` does by default).
+    """
+    w = wrap if wrap is not None else (lambda x: x)
     b = plan.buckets[bucket]
     out = {}
     for leaf_id, off in zip(b.leaf_ids, b.offsets):
         spec = plan.leaves[leaf_id]
-        out[leaf_id] = jax.lax.dynamic_slice_in_dim(buf, off, spec.size).reshape(
-            spec.shape
-        )
+        x = w(jax.lax.dynamic_slice_in_dim(buf, off, spec.size))
+        x = w(x.reshape(spec.shape))
+        if cast and x.dtype != spec.dtype:
+            x = w(x.astype(spec.dtype))
+        out[leaf_id] = x
     return out
 
 
@@ -366,16 +375,18 @@ def pack_all(tree, plan: FusionPlan, dtype=None) -> list[jax.Array]:
     return [pack_bucket(leaves, plan, b.index, dtype) for b in plan.buckets]
 
 
-def unpack_all(buffers: Sequence[jax.Array], plan: FusionPlan):
+def unpack_all(buffers: Sequence[jax.Array], plan: FusionPlan, *, wrap=None,
+               cast=True):
     """Rebuild the original pytree from per-bucket flat buffers, restoring
-    each leaf's shape and dtype."""
+    each leaf's shape and (with ``cast=True``, the default) dtype. ``wrap``
+    and ``cast=False`` serve the fsdp schedule — see `unpack_bucket`."""
     if len(buffers) != plan.num_buckets:
         raise ValueError(
             f"{len(buffers)} buffers for {plan.num_buckets} buckets"
         )
     flat: list[Optional[jax.Array]] = [None] * len(plan.leaves)
     for b, buf in zip(plan.buckets, buffers):
-        pieces = unpack_bucket(buf, plan, b.index)
+        pieces = unpack_bucket(buf, plan, b.index, wrap=wrap, cast=cast)
         for leaf_id, x in pieces.items():
-            flat[leaf_id] = x.astype(plan.leaves[leaf_id].dtype)
+            flat[leaf_id] = x
     return jax.tree_util.tree_unflatten(plan.treedef, flat)

@@ -55,6 +55,21 @@ Baseline schedules (same builder, ``mode=``):
                 has no analog inside one jitted step; the dear mode's
                 gather-next-step pipelining is the XLA-native way to
                 get that effect.)
+  'fsdp'      — ZeRO-3 beyond the reference (which stops at ZeRO-1 via
+                ZeroRedundancyOptimizer, pytorch-ddp/imagenet_benchmark.py:
+                10,67-68): the loss is differentiated with respect to the
+                SHARDS, so the per-bucket reduce-scatter is literally the
+                AD transpose of the per-bucket all-gather, and a custom
+                rematerialization policy (`checkpoint_name` on every
+                gather/unpack intermediate + a policy denying those names
+                AND the cheap view/cast prims that alias them) re-gathers
+                each bucket in the backward pass instead of keeping full
+                parameters live across forward→backward. Numerics are
+                identical to 'dear'; peak memory drops by ~one full
+                parameter set on multi-bucket models. (XLA's CSE can in
+                principle re-merge the two identical gathers, reverting
+                memory — but not correctness — to 'dear' behavior; the
+                offload/remat machinery in current XLA preserves them.)
 """
 
 from __future__ import annotations
@@ -72,7 +87,7 @@ from dear_pytorch_tpu.ops import compression as Z
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.ops.fused_sgd import ShardOptimizer, fused_sgd
 
-MODES = ("dear", "allreduce", "rsag", "rb", "bytescheduler")
+MODES = ("dear", "allreduce", "rsag", "rb", "bytescheduler", "fsdp")
 #: Ablation switches (reference `exclude_parts`, dear/dear_dopt.py:75-76,
 #: dear/batch.sh:18-43). Time-breakdown instruments — numerics are garbage
 #: when a phase is excluded, exactly as in the reference.
@@ -167,6 +182,7 @@ def build_train_step(
     mean_axes: Optional[Sequence[str]] = None,
     partition_mb: float = 4.0,
     accum_steps: int = 1,
+    gather_dtype=None,
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -177,7 +193,8 @@ def build_train_step(
         `init`).
       optimizer: a `ShardOptimizer`; defaults to fused SGD lr=0.01 (the
         reference benchmarks' default, dear/imagenet_benchmark.py).
-      mode: 'dear' | 'allreduce' | 'rsag' | 'rb'.
+      mode: 'dear' | 'allreduce' | 'rsag' | 'rb' | 'bytescheduler' | 'fsdp'
+        (see the module docstring for each schedule).
       threshold_mb / nearby_layers / flags / plan: bucketing controls
         (defaults mirror THRESHOLD=25 MB, dear/dear_dopt.py:42-44).
       exclude_parts: subset of {'reducescatter','allgather'} — skip that
@@ -236,6 +253,13 @@ def build_train_step(
         ``aux`` are MEANS over microbatches (matching the cross-device
         `lax.pmean` convention) — aux must be a mean-like statistic, not a
         count/sum, for its value to be independent of ``accum_steps``.
+      gather_dtype: cast master shards to this dtype BEFORE the per-bucket
+        all-gather ('dear'/'fsdp' modes) — e.g. ``jnp.bfloat16`` halves the
+        gather bytes when the model computes in bf16 anyway (the cast the
+        model would apply per-layer happens once, pre-communication).
+        Updates still read the f32 masters. In 'fsdp' mode this also sets
+        the reduce-scatter dtype (the RS is the gather's AD transpose), so
+        ``comm_dtype`` must be None there.
       mean_axes: the axes over which per-device losses are independent
         equal-weight samples (gradients are AVERAGED over these; summed over
         the rest). Defaults to all of ``axis_name``. For dp×sp pass
@@ -279,8 +303,17 @@ def build_train_step(
             f"plan was built for world={plan.world} but mesh axis "
             f"{axis_name!r} has size {world}"
         )
-    sharded = mode == "dear"
+    sharded = mode in ("dear", "fsdp")
     excl = frozenset(exclude_parts)
+    if gather_dtype is not None and not sharded:
+        raise ValueError("gather_dtype applies to the sharded ('dear'/'fsdp') "
+                         "schedules only")
+    if mode == "fsdp" and comm_dtype is not None:
+        raise ValueError(
+            "'fsdp' communicates both legs in gather_dtype (the "
+            "reduce-scatter is the all-gather's AD transpose); comm_dtype "
+            "must be None"
+        )
     has_model_state = model_state_template is not None
     comp = Z.get_compressor(compressor)
     compressed = comp.name != "none"
@@ -312,12 +345,18 @@ def build_train_step(
 
     def device_step(state: DearState, batch):
         idx = lax.axis_index(axis_name)
-        if sharded:
+
+        def cast_shard(s):
+            return s.astype(gather_dtype) if gather_dtype is not None else s
+
+        if mode == "fsdp":
+            params = None  # gathered inside the differentiated fn
+        elif sharded:
             if "allgather" in excl:  # ablation: fake the gather with zeros
                 full_bufs = [
                     lax.dynamic_update_slice_in_dim(
-                        jnp.zeros((b.padded_size,), s.dtype),
-                        s,
+                        jnp.zeros((b.padded_size,), cast_shard(s).dtype),
+                        cast_shard(s),
                         idx * b.shard_size,
                         axis=0,
                     )
@@ -325,12 +364,16 @@ def build_train_step(
                 ]
             else:
                 full_bufs = [
-                    C.all_gather(s, axis_name) for s in state.buffers
+                    C.all_gather(cast_shard(s), axis_name)
+                    for s in state.buffers
                 ]
+            # With gather_dtype, leaves STAY in gather_dtype (identical to
+            # the fsdp path): the model's own cast is then the identity,
+            # and the two sharded schedules see the same numerics.
+            params = F.unpack_all(full_bufs, plan,
+                                  cast=gather_dtype is None)
         else:
-            full_bufs = list(state.buffers)
-
-        params = F.unpack_all(full_bufs, plan)
+            params = F.unpack_all(list(state.buffers), plan)
         if rng_seed is not None:
             step_rng = jax.random.fold_in(
                 jax.random.fold_in(jax.random.PRNGKey(rng_seed), state.step),
@@ -350,10 +393,66 @@ def build_train_step(
                 return loss, ((), aux)
             return loss_fn(p, b, *extra), ((), None)
 
-        vg = jax.value_and_grad(canonical_loss, has_aux=True)
+        if mode == "fsdp":
+            from jax.ad_checkpoint import checkpoint_name
+
+            def _named(x):
+                return checkpoint_name(x, "dear_gathered")
+
+            def _named_unpack(bufs):
+                """Gather + unpack with EVERY intermediate named (wrap=):
+                the policy below excludes named values from the residual
+                set; one unnamed alias anywhere between gather and
+                consumption (a slice, reshape, or cast) would be saveable
+                and let AD keep full parameters alive fwd→bwd, silently
+                reverting to 'dear' memory behavior. (A model that re-casts
+                params internally still creates such an alias — pass
+                gather_dtype matching the model's compute dtype so that
+                cast is the identity.)"""
+                full = [
+                    _named(C.all_gather(cast_shard(s), axis_name))
+                    for s in bufs
+                ]
+                return F.unpack_all(full, plan, wrap=_named,
+                                    cast=gather_dtype is None)
+
+            def shard_loss(bufs, mstate, b, extra):
+                return canonical_loss(_named_unpack(bufs), mstate, b, extra)
+
+            # Save activations but NOT the gathered buckets: backward
+            # re-gathers each bucket right where its grads are needed.
+            # ``save_anything_except_these_names`` alone cannot force that:
+            # it lets AD save the named value's unnamed PRODUCER (the gather
+            # or a view of it) instead — every eqn that isn't a `name` is
+            # saveable under it, so nothing is ever recomputed. Deny the
+            # gather and all cheap view/cast prims too; then the only
+            # saveable values are genuine compute outputs (activations), and
+            # the cheapest path back to the weights in backward is
+            # re-gathering the shard (which jax.checkpoint wraps in an
+            # optimization barrier — prevent_cse — so XLA cannot fold the
+            # two gathers back into one and silently restore 'dear'-mode
+            # param liveness).
+            unsaveable = frozenset({
+                "all_gather", "reshape", "dynamic_slice",
+                "convert_element_type", "transpose", "squeeze",
+                "broadcast_in_dim", "concatenate", "pad",
+            })
+
+            def _fsdp_policy(prim, *_, **params):
+                if prim.name == "name":
+                    return params["name"] != "dear_gathered"
+                return prim.name not in unsaveable
+
+            diff_fn = jax.checkpoint(shard_loss, policy=_fsdp_policy)
+            w0 = tuple(state.buffers)
+        else:
+            diff_fn = canonical_loss
+            w0 = params
+
+        vg = jax.value_and_grad(diff_fn, has_aux=True)
         if accum_steps == 1:
             (loss, (new_model_state, aux)), grads = vg(
-                params, state.model_state, batch, extra_args
+                w0, state.model_state, batch, extra_args
             )
         else:
             # Microbatch scan: grads SUM across microbatches (divided once at
@@ -378,13 +477,13 @@ def build_train_step(
                     (jax.random.fold_in(extra_args[0], i),)
                     if extra_args else ()
                 )
-                (loss_i, (ms_i, aux_i)), g_i = vg(params, ms, b_i, extra)
+                (loss_i, (ms_i, aux_i)), g_i = vg(w0, ms, b_i, extra)
                 gacc = jax.tree.map(jnp.add, gacc, g_i)
                 return (ms_i, gacc), (loss_i, aux_i)
 
             (new_model_state, gsum), (mb_losses, mb_auxs) = lax.scan(
                 mb_body,
-                (state.model_state, jax.tree.map(jnp.zeros_like, params)),
+                (state.model_state, jax.tree.map(jnp.zeros_like, w0)),
                 (mb_batch, jnp.arange(accum_steps)),
             )
             grads = jax.tree.map(lambda g: g / accum_steps, gsum)
@@ -409,12 +508,19 @@ def build_train_step(
         else:
             new_model_state = state.model_state
 
-        grad_bufs = F.pack_all(grads, plan, dtype=comm_dtype)
+        # fsdp: grads ARE the per-bucket shards already (AD transposed the
+        # gathers into reduce-scatters); others: pack the param-tree grads.
+        grad_bufs = (
+            None if mode == "fsdp"
+            else F.pack_all(grads, plan, dtype=comm_dtype)
+        )
 
         new_buffers, new_opt, new_comp = [], [], []
         for g, b in enumerate(plan.buckets):
-            gbuf = grad_bufs[g]
-            if sharded:
+            gbuf = None if mode == "fsdp" else grad_bufs[g]
+            if mode == "fsdp":
+                grad = grads[g].astype(state.buffers[g].dtype) / mean_world
+            elif sharded:
                 if "reducescatter" in excl:  # ablation: local slice, no comm
                     gshard = lax.dynamic_slice_in_dim(
                         gbuf, idx * b.shard_size, b.shard_size

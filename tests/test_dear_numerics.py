@@ -83,7 +83,7 @@ def problem():
     return params, batches, ref_params, ref_losses
 
 
-@pytest.mark.parametrize("mode", ["dear", "allreduce", "rsag", "rb"])
+@pytest.mark.parametrize("mode", ["dear", "allreduce", "rsag", "rb", "fsdp"])
 def test_schedule_matches_baseline(mesh, world, problem, mode):
     params, batches, ref_params, ref_losses = problem
     ts = build_train_step(
