@@ -136,7 +136,8 @@ def main(argv=None) -> runner.BenchResult:
     def sync():
         # One device->host scalar fetch drains the in-order pipeline (see
         # bench.py's tunnel note).
-        float(holder["metrics"]["loss"])
+        if holder["metrics"] is not None:  # warmup may be zero steps
+            float(holder["metrics"]["loss"])
 
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)

@@ -34,12 +34,14 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None) -> runner.BenchResult:
-    args = build_parser().parse_args(argv)
-    runner.apply_platform_env()
-    mesh = backend.init()
-    world = backend.dp_size(mesh)
+def setup_cnn(args, mesh):
+    """Model + fake data + loss for a CNN benchmark on ``mesh``.
 
+    Returns ``(loss_fn, params, model_state, batch, sharding, image_size,
+    global_bs)``; shared by the throughput CLI below and the scaling sweep
+    (benchmarks/scaling.py), which calls it once per sub-mesh size.
+    """
+    world = mesh.shape[DP_AXIS]
     dtype = jnp.bfloat16 if args.fp16 else jnp.float32
     model = models.get_model(args.model, dtype=dtype)
     image_size = 299 if args.model.lower() == "inceptionv4" else 224
@@ -81,6 +83,20 @@ def main(argv=None) -> runner.BenchResult:
             )
             return data.softmax_xent(logits, b["label"])
 
+    return (loss_fn, params, model_state, batch, sharding, image_size,
+            global_bs)
+
+
+def main(argv=None) -> runner.BenchResult:
+    args = build_parser().parse_args(argv)
+    runner.apply_platform_env()
+    mesh = backend.init()
+    world = backend.dp_size(mesh)
+
+    (loss_fn, params, model_state, batch, sharding, image_size,
+     global_bs) = setup_cnn(args, mesh)
+    has_bn = model_state is not None
+
     cfg = runner.config_from_args(args)
     ts, stepper = runner.build_stepper(
         cfg, loss_fn, params, mesh, model_state=model_state,
@@ -114,7 +130,8 @@ def main(argv=None) -> runner.BenchResult:
     def sync():
         # One device->host scalar fetch drains the in-order pipeline; cheaper
         # and tunnel-safe vs block_until_ready on every buffer (see bench.py).
-        float(holder["metrics"]["loss"])
+        if holder["metrics"] is not None:  # warmup may be zero steps
+            float(holder["metrics"]["loss"])
 
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)

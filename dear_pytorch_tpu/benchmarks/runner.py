@@ -85,15 +85,17 @@ def run_timed(
     num_iters: int = 5,
     unit: str = "img",
     sync: Optional[Callable[[], None]] = None,
+    world: Optional[int] = None,
 ) -> BenchResult:
     """Run the warmup + timed-iteration protocol around ``step_fn``.
 
     ``step_fn`` performs one training step (async dispatch is fine);
     ``sync`` blocks until all dispatched work finished (defaults to
-    `jax.effects_barrier`-free no-op — pass one!).
+    `jax.effects_barrier`-free no-op — pass one!). ``world`` overrides the
+    device count in the report (the scaling sweep runs on sub-meshes).
     """
     dev = device_name()
-    world = backend.device_count()
+    world = backend.device_count() if world is None else world
 
     log("Running warmup...")
     for _ in range(num_warmup_batches):

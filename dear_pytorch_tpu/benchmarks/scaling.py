@@ -1,0 +1,135 @@
+"""Scaling-efficiency sweep: one CLI run, one throughput point per world.
+
+BASELINE.md's third headline target is scaling efficiency across chip
+counts (the reference measures it by re-running `benchmarks.py` per
+``nworkers`` over mpirun hostfiles, configs/cluster{8..64}). SPMD makes the
+sweep a loop over SUB-MESHES in a single process: world k trains on the
+first k devices of the slice, and efficiency is the per-device throughput
+retention relative to the smallest world (weak scaling — the per-device
+batch is fixed, the reference's protocol).
+
+Example (emulated):
+  JAX_PLATFORMS=cpu DEAR_NUM_CPU_DEVICES=8 python -m \
+      dear_pytorch_tpu.benchmarks.scaling --model resnet50 --worlds 1,2,4,8
+
+Prints one ``Total img/sec ...`` line per world (the driver's scrape
+format) plus a final ``Scaling efficiency`` summary and an optional
+``--json`` dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import numpy as np
+
+from dear_pytorch_tpu.benchmarks import imagenet, runner
+from dear_pytorch_tpu.comm import backend
+from dear_pytorch_tpu.comm.backend import DP_AXIS
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU scaling-efficiency sweep",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--model", type=str, default="resnet50")
+    p.add_argument("--worlds", type=str, default=None,
+                   help="comma list of device counts (default: powers of "
+                        "two up to the full slice)")
+    p.add_argument("--json", type=str, default=None,
+                   help="write {world: img_per_sec_per_device} plus "
+                        "efficiencies to this file")
+    runner.add_common_args(p)
+    return p
+
+
+def _parse_worlds(spec, ndev: int) -> list[int]:
+    if spec:
+        worlds = sorted({int(w) for w in spec.split(",") if w.strip()})
+    else:
+        worlds, k = [], 1
+        while k <= ndev:
+            worlds.append(k)
+            k *= 2
+    bad = [w for w in worlds if w < 1 or w > ndev]
+    if bad:
+        raise SystemExit(f"--worlds {bad} out of range (1..{ndev} devices)")
+    return worlds
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    runner.apply_platform_env()
+    # accepted-but-inactive options (config_from_args convention): the sweep
+    # measures the fixed-batch protocol only
+    import warnings
+
+    if args.pipeline != "none":
+        warnings.warn("--pipeline is ignored by the scaling sweep "
+                      "(fixed-batch protocol)")
+    if args.mfu or args.profile_dir:
+        warnings.warn("--mfu/--profile-dir are ignored by the scaling sweep")
+    backend.init()
+    devices = jax.devices()
+    worlds = _parse_worlds(args.worlds, len(devices))
+    cfg = runner.config_from_args(args)
+
+    per_dev: dict[int, float] = {}
+    for k in worlds:
+        mesh = jax.sharding.Mesh(
+            np.asarray(devices[:k]).reshape(k), (DP_AXIS,)
+        )
+        (loss_fn, params, model_state, batch, _sharding, _isz,
+         global_bs) = imagenet.setup_cnn(args, mesh)
+        ts, stepper = runner.build_stepper(
+            cfg, loss_fn, params, mesh, model_state=model_state,
+            mgwfbp=args.mgwfbp,
+        )
+        state = (ts.init(params, model_state) if model_state is not None
+                 else ts.init(params))
+        runner.log(f"--- world {k}: global batch {global_bs}, "
+                   f"{ts.plan.num_buckets} bucket(s) ---")
+        holder = {"state": state, "metrics": None}
+
+        def step_fn():
+            holder["state"], holder["metrics"] = stepper.step(
+                holder["state"], batch
+            )
+
+        res = runner.run_timed(
+            step_fn,
+            batch_size=args.batch_size,
+            num_warmup_batches=args.num_warmup_batches,
+            num_batches_per_iter=args.num_batches_per_iter,
+            num_iters=args.num_iters,
+            unit="img",
+            sync=lambda: (holder["metrics"] is not None
+                          and float(holder["metrics"]["loss"])),
+            world=k,
+        )
+        per_dev[k] = res.per_device_mean
+
+    base_world = worlds[0]
+    eff = {k: per_dev[k] / per_dev[base_world] for k in worlds}
+    runner.log("")
+    runner.log(f"Weak scaling vs {base_world} device(s) "
+               f"[{args.model}, bs {args.batch_size}/device, {args.mode}]:")
+    for k in worlds:
+        runner.log(f"  {k:4d} device(s): {per_dev[k]:9.1f} img/s/device  "
+                   f"efficiency {100 * eff[k]:5.1f}%")
+    runner.log(f"Scaling efficiency ({base_world}->{worlds[-1]} devices): "
+               f"{100 * eff[worlds[-1]]:.1f}%")
+    out = {"per_device_img_sec": per_dev, "efficiency": eff,
+           "model": args.model, "mode": args.mode,
+           "batch_size_per_device": args.batch_size}
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""The scaling-efficiency sweep CLI (benchmarks/scaling.py)."""
+
+import json
+
+
+def test_scaling_sweep_runs(mesh, capsys, tmp_path):
+    from dear_pytorch_tpu.benchmarks import scaling
+
+    out_json = tmp_path / "scaling.json"
+    out = scaling.main([
+        "--model", "mnistnet", "--batch-size", "4",
+        "--worlds", "1,2,4",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1",
+        "--json", str(out_json),
+    ])
+    assert sorted(out["per_device_img_sec"]) == [1, 2, 4]
+    assert out["efficiency"][1] == 1.0
+    assert all(v > 0 for v in out["per_device_img_sec"].values())
+    captured = capsys.readouterr().out
+    # per-world scrape lines (the driver's format) + the summary line
+    assert "Total img/sec on 1 CPU(s)" in captured
+    assert "Total img/sec on 4 CPU(s)" in captured
+    assert "Scaling efficiency (1->4 devices):" in captured
+    assert json.loads(out_json.read_text())["model"] == "mnistnet"
+
+
+def test_scaling_rejects_bad_worlds(mesh):
+    import pytest
+
+    from dear_pytorch_tpu.benchmarks import scaling
+
+    with pytest.raises(SystemExit, match="out of range"):
+        scaling.main([
+            "--model", "mnistnet", "--worlds", "64",
+        ])
