@@ -41,24 +41,58 @@ def _ckpt_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:010d}")
 
 
+_async_ckptr = None
+
+
+def _get_async_checkpointer():
+    """One process-wide AsyncCheckpointer (it owns the writer threads; Orbax
+    requires saves to be serialized through a single instance)."""
+    global _async_ckptr
+    if _async_ckptr is None:
+        import orbax.checkpoint as ocp
+
+        _async_ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    return _async_ckptr
+
+
 def save_checkpoint(
-    directory: str, state: D.DearState, plan: F.FusionPlan
+    directory: str, state: D.DearState, plan: F.FusionPlan,
+    *, asynchronous: bool = False,
 ) -> str:
-    """Write a checkpoint for the state's current step; returns its path."""
+    """Write a checkpoint for the state's current step; returns its path.
+
+    ``asynchronous=True`` returns as soon as the on-device arrays are
+    snapshotted; serialization to disk proceeds on Orbax's writer threads
+    while training continues (the step dir appears atomically when the write
+    commits). Call `wait_for_checkpoints` before reading the files or
+    exiting the process.
+    """
     import orbax.checkpoint as ocp
 
     step = int(jax.device_get(state.step))
     path = _ckpt_dir(directory, step)
-    ckptr = ocp.PyTreeCheckpointer()
     # Hand Orbax the live (possibly sharded) arrays: each process writes its
     # addressable shards. A jax.device_get here would fail on non-addressable
     # shards in multi-host runs and replicate everything through host RAM.
-    ckptr.save(os.path.abspath(path), state)
+    if asynchronous:
+        _get_async_checkpointer().save(os.path.abspath(path), state)
+    else:
+        ocp.PyTreeCheckpointer().save(os.path.abspath(path), state)
     if jax.process_index() == 0:  # one writer for the sidecar on shared fs
+        # written eagerly even for async saves: restore only ever reaches a
+        # sidecar through a COMMITTED step dir (latest_step scans dirs), so
+        # a crash mid-write leaves an orphan sidecar, never a broken restore
         meta = {"plan": plan_fingerprint(plan), "step": step}
         with open(os.path.join(directory, f"meta_{step:010d}.json"), "w") as f:
             json.dump(meta, f)
     return path
+
+
+def wait_for_checkpoints() -> None:
+    """Block until every `save_checkpoint(asynchronous=True)` has committed.
+    No-op when none are in flight."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
 
 
 def latest_step(directory: str) -> Optional[int]:

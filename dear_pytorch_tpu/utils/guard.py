@@ -62,9 +62,11 @@ class GuardedTrainer:
         max_recoveries: int = 3,
         max_keep: int = 3,
         on_rollback: Optional[Callable[[int, int], None]] = None,
+        async_checkpoints: bool = False,
     ):
         self.ts = ts
         self.directory = directory
+        self.async_checkpoints = async_checkpoints
         self.check_every = max(int(check_every), 1)
         self.checkpoint_every = max(int(checkpoint_every), 1)
         self.max_recoveries = max_recoveries
@@ -88,11 +90,27 @@ class GuardedTrainer:
         return self._template
 
     def _save(self, state) -> None:
-        ckpt.save_checkpoint(self.directory, state, self.ts.plan)
+        try:
+            ckpt.save_checkpoint(self.directory, state, self.ts.plan,
+                                 asynchronous=self.async_checkpoints)
+        except Exception as exc:
+            if not self.async_checkpoints:
+                raise
+            # Orbax surfaces a PREVIOUS async write's deferred failure at
+            # the next save call. The training state in hand is healthy —
+            # losing one checkpoint must not kill the run this class exists
+            # to keep alive. Log, skip this save, try again next interval.
+            logger.error("guard: async checkpoint save failed: %s", exc)
+            return
         self._last_good_step = int(jax.device_get(state.step))
-        self._prune()
+        # async: the save's own atomic-write temp dir is legitimately alive
+        # right now — pruning it would corrupt the in-flight write
+        self._prune(
+            skip_tmp_step=(self._last_good_step
+                           if self.async_checkpoints else None)
+        )
 
-    def _prune(self) -> None:
+    def _prune(self, skip_tmp_step: Optional[int] = None) -> None:
         """Keep the newest ``max_keep`` checkpoints (the guard only ever
         restores the latest; unbounded retention would eventually fill the
         filesystem and crash the very trainer meant to survive faults)."""
@@ -116,6 +134,9 @@ class GuardedTrainer:
         # retention policy exists to protect
         for name in names:
             if name.startswith("step_") and ".orbax-checkpoint-tmp" in name:
+                if (skip_tmp_step is not None
+                        and name.startswith(f"step_{skip_tmp_step:010d}.")):
+                    continue  # in-flight async write, not a crash leftover
                 shutil.rmtree(
                     os.path.join(self.directory, name), ignore_errors=True
                 )
@@ -130,8 +151,37 @@ class GuardedTrainer:
                 )
             except OSError:
                 pass
+        # orphan sidecars: meta written eagerly for a save that never
+        # committed (async failure / crash mid-write). Restores never read
+        # them (they go through committed dirs), but a crash-restart loop
+        # would accumulate them unboundedly.
+        committed = set(steps)
+        for name in names:
+            if not (name.startswith("meta_") and name.endswith(".json")):
+                continue
+            digits = name[len("meta_"):-len(".json")]
+            if not digits.isdigit():
+                continue
+            s = int(digits)
+            if s not in committed and s != skip_tmp_step:
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass
 
     def _restore(self, cause: Optional[BaseException] = None):
+        # an async save may still be in flight: its step dir only appears
+        # on commit, so wait — rolling back to the older checkpoint while a
+        # newer healthy one is mid-write would lose good progress. A FAILED
+        # in-flight write must not kill the rollback itself: fall back to
+        # the newest committed checkpoint.
+        try:
+            ckpt.wait_for_checkpoints()
+        except Exception as exc:
+            logger.error(
+                "guard: in-flight async checkpoint failed (%s); restoring "
+                "the newest committed checkpoint instead", exc,
+            )
         step = ckpt.latest_step(self.directory)
         if step is None:
             raise DivergenceError(
@@ -226,3 +276,23 @@ class GuardedTrainer:
             # incident, not a continuation of an old one
             self.recoveries = 0
         return new_state, metrics
+
+    def finalize(self) -> None:
+        """Wait for in-flight async checkpoint writes and surface their
+        errors. Call when training ends (or use the trainer as a context
+        manager) — otherwise a failed LAST async save is silently dropped
+        and resume finds an older step than `_last_good_step` claims."""
+        ckpt.wait_for_checkpoints()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.finalize()
+        else:
+            # already failing: don't let a deferred write error mask it
+            try:
+                self.finalize()
+            except Exception:
+                logger.exception("guard: finalize failed during unwind")

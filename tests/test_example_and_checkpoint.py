@@ -77,6 +77,40 @@ def test_checkpoint_roundtrip_and_plan_guard(mesh, tmp_path):
         ckpt.restore_checkpoint(d, ts2, template=ts2.init(params))
 
 
+def test_async_checkpoint_roundtrip(mesh, tmp_path):
+    """save_checkpoint(asynchronous=True) returns before the write commits;
+    after wait_for_checkpoints the checkpoint restores exactly, and the
+    state mutating AFTER the async save must not corrupt what was saved
+    (Orbax snapshots the arrays up front; donate=False here, but the
+    snapshot guarantee is what this pins)."""
+    params = _mlp_params(jax.random.PRNGKey(0))
+    batches = [_data(jax.random.PRNGKey(200 + i)) for i in range(3)]
+    opt = fused_sgd(lr=0.1, momentum=0.9)
+    ts = build_train_step(_loss_fn, params, mesh=mesh, optimizer=opt,
+                          threshold_mb=0.0008, donate=False)
+    state = ts.init(params)
+    state, _ = ts.step(state, batches[0])
+    saved_buf0 = np.asarray(jax.device_get(state.buffers[0]))
+
+    d = str(tmp_path / "async_ckpts")
+    ckpt.save_checkpoint(d, state, ts.plan, asynchronous=True)
+    # keep training while the write is in flight
+    for b in batches[1:]:
+        state, _ = ts.step(state, b)
+    ckpt.wait_for_checkpoints()
+
+    assert ckpt.latest_step(d) == 1
+    restored = ckpt.restore_checkpoint(d, ts, template=ts.init(params))
+    assert int(jax.device_get(restored.step)) == 1
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored.buffers[0])), saved_buf0
+    )
+
+
+def test_wait_for_checkpoints_noop():
+    ckpt.wait_for_checkpoints()  # nothing in flight: must not raise
+
+
 def test_broadcast_helpers_single_process():
     import dear_pytorch_tpu as dear
 

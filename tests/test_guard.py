@@ -55,6 +55,84 @@ def test_rollback_on_nan_and_recovery(mesh, tmp_path):
     assert int(jax.device_get(state.step)) > 4
 
 
+def test_rollback_with_async_checkpoints(mesh, tmp_path):
+    """async_checkpoints=True: saves overlap training, the in-flight write's
+    temp dir survives pruning, and a rollback waits for the commit so it
+    restores the NEWEST checkpoint."""
+    params, ts, tr = _trainer(mesh, tmp_path, async_checkpoints=True)
+    batches = [_data(jax.random.PRNGKey(300 + i)) for i in range(12)]
+    state = ts.init(params)
+    rollbacks = []
+    tr.on_rollback = lambda n, at: rollbacks.append((n, at))
+
+    for i, b in enumerate(batches):
+        if i == 9:  # after the step-8 checkpoint (saved asynchronously)
+            state, m = tr.step(state, _poison(b))
+            assert m.get("rolled_back"), m
+            continue
+        state, m = tr.step(state, b)
+        assert np.isfinite(float(m["loss"]))
+
+    # restored from step 8 (the async save committed before restore), not 4
+    assert rollbacks == [(1, 8)]
+    assert int(jax.device_get(state.step)) > 8
+
+
+def test_rollback_survives_failed_inflight_async_write(mesh, tmp_path,
+                                                       monkeypatch):
+    """A failed in-flight async write must not kill the rollback: the guard
+    falls back to the newest COMMITTED checkpoint."""
+    from dear_pytorch_tpu.utils import checkpoint as ckpt_mod
+
+    params, ts, tr = _trainer(mesh, tmp_path, async_checkpoints=True)
+    batches = [_data(jax.random.PRNGKey(400 + i)) for i in range(6)]
+    state = ts.init(params)
+    for b in batches[:5]:
+        state, _ = tr.step(state, b)  # step-4 checkpoint committed
+    tr.finalize()
+
+    def boom():
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(ckpt_mod, "wait_for_checkpoints", boom)
+    state, m = tr.step(state, _poison(batches[5]))
+    assert m.get("rolled_back"), m
+    assert int(jax.device_get(state.step)) == 4
+
+
+def test_finalize_and_context_manager(mesh, tmp_path):
+    params, ts, tr = _trainer(mesh, tmp_path, async_checkpoints=True)
+    batches = [_data(jax.random.PRNGKey(500 + i)) for i in range(4)]
+    state = ts.init(params)
+    with tr:
+        for b in batches:
+            state, _ = tr.step(state, b)
+    # the final async save committed before the with-block exited
+    from dear_pytorch_tpu.utils import checkpoint as ckpt_mod
+
+    assert ckpt_mod.latest_step(str(tmp_path / "g")) == 4
+
+
+def test_prune_removes_orphan_meta_sidecars(mesh, tmp_path):
+    """meta_*.json written for a save that never committed (async failure /
+    crash) must be cleaned up by the retention pass."""
+    import os
+
+    params, ts, tr = _trainer(mesh, tmp_path)
+    d = str(tmp_path / "g")
+    os.makedirs(d, exist_ok=True)
+    orphan = os.path.join(d, "meta_0000000099.json")
+    with open(orphan, "w") as f:
+        f.write("{}")
+    batches = [_data(jax.random.PRNGKey(600 + i)) for i in range(4)]
+    state = ts.init(params)
+    for b in batches:
+        state, _ = tr.step(state, b)  # step-4 checkpoint triggers _prune
+    assert not os.path.exists(orphan)
+    # the committed checkpoint's sidecar survives
+    assert os.path.exists(os.path.join(d, "meta_0000000004.json"))
+
+
 def test_divergence_before_first_checkpoint_raises(mesh, tmp_path):
     params, ts, tr = _trainer(mesh, tmp_path, checkpoint_every=1000)
     state = ts.init(params)
