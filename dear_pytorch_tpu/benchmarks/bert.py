@@ -89,7 +89,7 @@ def main(argv=None) -> runner.BenchResult:
         vocab_size=cfg.vocab_size,
     )
     sharding = jax.sharding.NamedSharding(mesh, jax.P(DP_AXIS))
-    batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    batch = runner.stage_global(batch, sharding)  # multi-host safe
 
     params = model.init(
         {"params": jax.random.PRNGKey(0)}, batch["input_ids"], train=False

@@ -57,7 +57,7 @@ def setup_cnn(args, mesh):
             dtype=dtype,
         )
     sharding = jax.sharding.NamedSharding(mesh, jax.P(DP_AXIS))
-    batch = jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    batch = runner.stage_global(batch, sharding)  # multi-host safe
 
     variables = model.init(
         {"params": jax.random.PRNGKey(0)}, batch["image"], train=False

@@ -216,6 +216,28 @@ def add_common_args(parser) -> None:
                              "compile)")
 
 
+def stage_global(tree, sharding):
+    """Stage host-replicated arrays onto a (possibly multi-host) sharding.
+
+    Single-process: plain `jax.device_put`. Multi-process: `device_put`
+    onto a sharding with non-addressable devices raises, so each process
+    materializes ONLY its addressable shards from the host copy
+    (`make_array_from_callback`) — every host is assumed to hold the same
+    full array (the synthetic-data protocol; a real loader would hand each
+    host its slice instead).
+    """
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+    def put(x):  # pragma: no cover - multi-host only
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        )
+
+    return jax.tree.map(put, tree)
+
+
 def make_batch_source(args, spec, sharding, template_batch):
     """``(next_batch, close)`` for the timed loop, honoring ``--pipeline``.
 
@@ -223,7 +245,8 @@ def make_batch_source(args, spec, sharding, template_batch):
     (the reference's fixed-fake-data measurement protocol). 'native'/'numpy'
     stream fresh host batches from `runtime.Pipeline` — produced by C++
     ring-buffer threads (or the numpy fallback) while the previous step
-    runs — and stage each onto the mesh with ``jax.device_put``.
+    runs — and stage each onto the mesh via `stage_global` (multi-host
+    safe: each process materializes only its addressable shards).
     """
     if args.pipeline == "none":
         return (lambda: template_batch), (lambda: None)
@@ -249,10 +272,11 @@ def make_batch_source(args, spec, sharding, template_batch):
 
     def next_batch():
         host = pl.next()
-        return {
-            k: jax.device_put(v.astype(tmpl_dtypes[k], copy=False), sharding)
-            for k, v in host.items()
-        }
+        return stage_global(
+            {k: v.astype(tmpl_dtypes[k], copy=False)
+             for k, v in host.items()},
+            sharding,
+        )
 
     return next_batch, pl.close
 

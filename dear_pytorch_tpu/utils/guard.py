@@ -89,7 +89,10 @@ class GuardedTrainer:
             self._template = self.ts.init(self._params_template)
         return self._template
 
-    def _save(self, state) -> None:
+    def _save(self, state) -> bool:
+        """True when the save was (at least) enqueued; False on a swallowed
+        async failure — the caller must NOT treat that as persisted
+        progress."""
         try:
             ckpt.save_checkpoint(self.directory, state, self.ts.plan,
                                  asynchronous=self.async_checkpoints)
@@ -99,9 +102,13 @@ class GuardedTrainer:
             # Orbax surfaces a PREVIOUS async write's deferred failure at
             # the next save call. The training state in hand is healthy —
             # losing one checkpoint must not kill the run this class exists
-            # to keep alive. Log, skip this save, try again next interval.
+            # to keep alive. Log, skip this save, try again next interval —
+            # but still run retention: a failure streak would otherwise
+            # accumulate failed-write tmp dirs and orphan sidecars without
+            # bound.
             logger.error("guard: async checkpoint save failed: %s", exc)
-            return
+            self._prune(skip_tmp_step=None)
+            return False
         self._last_good_step = int(jax.device_get(state.step))
         # async: the save's own atomic-write temp dir is legitimately alive
         # right now — pruning it would corrupt the in-flight write
@@ -109,6 +116,7 @@ class GuardedTrainer:
             skip_tmp_step=(self._last_good_step
                            if self.async_checkpoints else None)
         )
+        return True
 
     def _prune(self, skip_tmp_step: Optional[int] = None) -> None:
         """Keep the newest ``max_keep`` checkpoints (the guard only ever
@@ -270,10 +278,12 @@ class GuardedTrainer:
                 self.on_rollback(self.recoveries, at_step)
             return restored, {"loss": float("nan"), "rolled_back": True}
 
-        if is_ckpt:
-            self._save(new_state)
+        if is_ckpt and self._save(new_state):
             # persisted healthy progress: a future rollback is a NEW
-            # incident, not a continuation of an old one
+            # incident, not a continuation of an old one. A FAILED async
+            # save must not reset the counter — nothing was persisted, and
+            # resetting would let a diverge/rollback loop spin forever past
+            # max_recoveries.
             self.recoveries = 0
         return new_state, metrics
 

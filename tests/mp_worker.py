@@ -81,6 +81,20 @@ def main() -> None:
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0], losses
 
+    # explicit cross-process staging (the CLIs' path): each process
+    # materializes only its addressable shards of the host-global batch
+    from dear_pytorch_tpu.benchmarks import runner
+
+    sharding = jax.sharding.NamedSharding(mesh, jax.P(backend.DP_AXIS))
+    staged = runner.stage_global(
+        {"x": np.asarray(batch[0]), "y": np.asarray(batch[1])}, sharding
+    )
+    assert staged["x"].shape == batch[0].shape  # global logical shape
+    local = sum(s.data.shape[0] for s in staged["x"].addressable_shards)
+    assert local == batch[0].shape[0] // n  # only this host's rows live here
+    state, m = ts.step(state, (staged["x"], staged["y"]))
+    assert np.isfinite(float(m["loss"]))
+
     # every process computed the identical loss sequence (the collectives
     # actually coupled them)
     from jax.experimental import multihost_utils

@@ -100,6 +100,30 @@ def test_rollback_survives_failed_inflight_async_write(mesh, tmp_path,
     assert int(jax.device_get(state.step)) == 4
 
 
+def test_failed_async_save_does_not_reset_recoveries(mesh, tmp_path,
+                                                     monkeypatch):
+    """A swallowed async save failure must not count as persisted progress:
+    the recoveries counter keeps accumulating so max_recoveries still
+    trips."""
+    from dear_pytorch_tpu.utils import checkpoint as ckpt_mod
+
+    params, ts, tr = _trainer(mesh, tmp_path, async_checkpoints=True)
+    state = ts.init(params)
+    batches = [_data(jax.random.PRNGKey(700 + i)) for i in range(5)]
+    for b in batches:
+        state, _ = tr.step(state, b)  # commits the step-4 checkpoint
+    tr.finalize()
+    tr.recoveries = 2
+
+    def boom(*a, **kw):
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", boom)
+    for i in range(3):
+        state, _ = tr.step(state, _data(jax.random.PRNGKey(800 + i)))
+    assert tr.recoveries == 2  # failed saves reset nothing
+
+
 def test_finalize_and_context_manager(mesh, tmp_path):
     params, ts, tr = _trainer(mesh, tmp_path, async_checkpoints=True)
     batches = [_data(jax.random.PRNGKey(500 + i)) for i in range(4)]
