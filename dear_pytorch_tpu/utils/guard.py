@@ -90,9 +90,10 @@ class GuardedTrainer:
         return self._template
 
     def _save(self, state) -> bool:
-        """True when the save was (at least) enqueued; False on a swallowed
-        async failure — the caller must NOT treat that as persisted
-        progress."""
+        """True when the save committed (or was enqueued after a clean
+        handoff); False on a swallowed async failure — the caller must NOT
+        treat that as persisted progress."""
+        step = int(jax.device_get(state.step))
         try:
             ckpt.save_checkpoint(self.directory, state, self.ts.plan,
                                  asynchronous=self.async_checkpoints)
@@ -105,11 +106,13 @@ class GuardedTrainer:
             # to keep alive. Log, skip this save, try again next interval —
             # but still run retention: a failure streak would otherwise
             # accumulate failed-write tmp dirs and orphan sidecars without
-            # bound.
+            # bound. THIS call's write may have been enqueued before the
+            # exception (e.g. a sidecar failure after AsyncCheckpointer
+            # created its tmp dir), so its tmp dir must survive the prune.
             logger.error("guard: async checkpoint save failed: %s", exc)
-            self._prune(skip_tmp_step=None)
+            self._prune(skip_tmp_step=step)
             return False
-        self._last_good_step = int(jax.device_get(state.step))
+        self._last_good_step = step
         # async: the save's own atomic-write temp dir is legitimately alive
         # right now — pruning it would corrupt the in-flight write
         self._prune(

@@ -219,6 +219,36 @@ def test_optax_adamw_on_shards(mesh, world, problem):
     )
 
 
+def test_optax_lr_schedule_on_shards(mesh, problem):
+    """optax schedules (stateful count) work on sharded buffers: the 0-d
+    count leaf is replicated by _opt_bucket_specs, per-element state shards
+    with its bucket — parity vs full-tree optax on one device."""
+    import optax
+
+    params, batches, _, _ = problem
+    tx = optax.sgd(optax.exponential_decay(0.1, 2, 0.5))
+    ts = build_train_step(
+        _loss_fn, params, optimizer=from_optax(tx), mesh=mesh, mode="dear",
+        threshold_mb=0.0008, donate=False,
+    )
+    state = ts.init(params)
+    for b in batches:
+        state, _ = ts.step(state, b)
+
+    opt_state = tx.init(params)
+    p = params
+    for b in batches:
+        g = jax.grad(_loss_fn)(p, b)
+        upd, opt_state = tx.update(g, opt_state, p)
+        p = optax.apply_updates(p, upd)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        ts.gather_params(state), p,
+    )
+
+
 def test_comm_dtype_bf16(mesh, world, problem):
     params, batches, _, _ = problem
     ts = build_train_step(

@@ -25,6 +25,31 @@ def test_scaling_sweep_runs(mesh, capsys, tmp_path):
     assert json.loads(out_json.read_text())["model"] == "mnistnet"
 
 
+def test_collectives_microbench_cli(mesh, capsys, tmp_path):
+    from dear_pytorch_tpu.benchmarks import collectives as cb
+
+    out_json = tmp_path / "coll.json"
+    out = cb.main([
+        "--collectives", "all_reduce,reduce_scatter",
+        "--sizes-log2", "8:11:2", "--repeats", "2", "--warmup", "1",
+        "--json", str(out_json),
+    ])
+    assert set(out["collectives"]) == {"all_reduce", "reduce_scatter"}
+    ar = out["collectives"]["all_reduce"]
+    assert ar["alpha_s"] >= 0 and len(ar["rows"]) == 2
+    assert all(r["bw_gbs"] > 0 for r in ar["rows"])
+    captured = capsys.readouterr().out
+    assert "[all_reduce]" in captured and "busbw GB/s" in captured
+    assert json.loads(out_json.read_text())["world"] == 8
+
+    import pytest
+
+    with pytest.raises(SystemExit, match="unknown collective"):
+        cb.main(["--collectives", "bogus"])
+    with pytest.raises(SystemExit, match="sizes-log2"):
+        cb.main(["--sizes-log2", "abc"])
+
+
 def test_scaling_rejects_bad_worlds(mesh):
     import pytest
 
