@@ -133,6 +133,7 @@ def main(argv=None) -> runner.BenchResult:
         if holder["metrics"] is not None:  # warmup may be zero steps
             float(holder["metrics"]["loss"])
 
+    metrics_log = runner.metrics_from_args(args)
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     try:
@@ -144,10 +145,13 @@ def main(argv=None) -> runner.BenchResult:
             num_iters=args.num_iters,
             unit="img",
             sync=sync,
+            metrics=metrics_log,
         )
     finally:
         if args.profile_dir:
             jax.profiler.stop_trace()
+        if metrics_log is not None:
+            metrics_log.close()
         close()
     if args.mfu:
         # the autotuner may have re-bucketed: use its CURRENT step

@@ -86,6 +86,7 @@ def run_timed(
     unit: str = "img",
     sync: Optional[Callable[[], None]] = None,
     world: Optional[int] = None,
+    metrics=None,
 ) -> BenchResult:
     """Run the warmup + timed-iteration protocol around ``step_fn``.
 
@@ -93,6 +94,8 @@ def run_timed(
     ``sync`` blocks until all dispatched work finished (defaults to
     `jax.effects_barrier`-free no-op — pass one!). ``world`` overrides the
     device count in the report (the scaling sweep runs on sub-meshes).
+    ``metrics`` (a `utils.MetricsLogger`) receives one record per timed
+    iteration plus a final summary record.
     """
     dev = device_name()
     world = backend.device_count() if world is None else world
@@ -116,6 +119,11 @@ def run_timed(
         log(f"Iter #{x}: {thr:.1f} {unit}/sec per {dev}")
         per_iter.append(thr)
         iter_times.append(dt / num_batches_per_iter)
+        if metrics is not None:
+            metrics.log(
+                iter=x, **{f"{unit}_per_sec_per_device": thr},
+                step_time_s=dt / num_batches_per_iter,
+            )
 
     res = BenchResult(
         unit=unit,
@@ -132,6 +140,13 @@ def run_timed(
         f"{res.per_device_mean:.1f} +-{res.per_device_conf:.1f}")
     log(f"Total {unit}/sec on {res.world} {dev}(s): "
         f"{res.total_mean:.1f} +-{res.total_conf:.1f}")
+    if metrics is not None:
+        metrics.log(
+            summary=True, world=res.world, unit=unit,
+            per_device_mean=res.per_device_mean,
+            per_device_conf=res.per_device_conf,
+            iter_time_mean=res.iter_time_mean,
+        )
     return res
 
 
@@ -208,12 +223,26 @@ def add_common_args(parser) -> None:
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="write a jax.profiler trace of the timed "
                              "region here")
+    parser.add_argument("--metrics-file", type=str, default=None,
+                        help="append per-iteration + summary records as "
+                             "JSONL here (utils.MetricsLogger; replaces "
+                             "the reference's log-scrape observability)")
     parser.add_argument("--mfu", action="store_true", default=False,
                         help="report model FLOPs utilization from XLA cost "
                              "analysis (the reference's nvprof FLOPs "
                              "accounting, horovod/prof.sh + "
                              "extract_profilings.py; costs one extra AOT "
                              "compile)")
+
+
+def metrics_from_args(args):
+    """`utils.MetricsLogger` for ``--metrics-file`` (None when unset); the
+    single construction point shared by the CLIs."""
+    if not getattr(args, "metrics_file", None):
+        return None
+    from dear_pytorch_tpu.utils import MetricsLogger
+
+    return MetricsLogger(args.metrics_file)
 
 
 def stage_global(tree, sharding):

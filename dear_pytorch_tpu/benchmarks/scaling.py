@@ -60,23 +60,8 @@ def _parse_worlds(spec, ndev: int) -> list[int]:
     return worlds
 
 
-def main(argv=None) -> dict:
-    args = build_parser().parse_args(argv)
-    runner.apply_platform_env()
-    # accepted-but-inactive options (config_from_args convention): the sweep
-    # measures the fixed-batch protocol only
-    import warnings
-
-    if args.pipeline != "none":
-        warnings.warn("--pipeline is ignored by the scaling sweep "
-                      "(fixed-batch protocol)")
-    if args.mfu or args.profile_dir:
-        warnings.warn("--mfu/--profile-dir are ignored by the scaling sweep")
-    backend.init()
-    devices = jax.devices()
-    worlds = _parse_worlds(args.worlds, len(devices))
-    cfg = runner.config_from_args(args)
-
+def _sweep(args, cfg, devices, worlds, metrics_log) -> dict:
+    """{world: img/s/device} for each sub-mesh size."""
     per_dev: dict[int, float] = {}
     for k in worlds:
         mesh = jax.sharding.Mesh(
@@ -99,6 +84,8 @@ def main(argv=None) -> dict:
                 holder["state"], batch
             )
 
+        if metrics_log is not None:
+            metrics_log.log(event="world_start", world=k)
         res = runner.run_timed(
             step_fn,
             batch_size=args.batch_size,
@@ -109,8 +96,35 @@ def main(argv=None) -> dict:
             sync=lambda: (holder["metrics"] is not None
                           and float(holder["metrics"]["loss"])),
             world=k,
+            metrics=metrics_log,
         )
         per_dev[k] = res.per_device_mean
+    return per_dev
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    runner.apply_platform_env()
+    # accepted-but-inactive options (config_from_args convention): the sweep
+    # measures the fixed-batch protocol only
+    import warnings
+
+    if args.pipeline != "none":
+        warnings.warn("--pipeline is ignored by the scaling sweep "
+                      "(fixed-batch protocol)")
+    if args.mfu or args.profile_dir:
+        warnings.warn("--mfu/--profile-dir are ignored by the scaling sweep")
+    backend.init()
+    devices = jax.devices()
+    worlds = _parse_worlds(args.worlds, len(devices))
+    cfg = runner.config_from_args(args)
+
+    metrics_log = runner.metrics_from_args(args)
+    try:
+        per_dev = _sweep(args, cfg, devices, worlds, metrics_log)
+    finally:
+        if metrics_log is not None:
+            metrics_log.close()
 
     base_world = worlds[0]
     eff = {k: per_dev[k] / per_dev[base_world] for k in worlds}

@@ -6,6 +6,10 @@ from dear_pytorch_tpu.utils.guard import (  # noqa: F401
     DivergenceError,
     GuardedTrainer,
 )
+from dear_pytorch_tpu.utils.metrics import (  # noqa: F401
+    MetricsLogger,
+    read_metrics,
+)
 from dear_pytorch_tpu.utils.perf_model import (  # noqa: F401
     allgather_perf_model,
     fit_alpha_beta,

@@ -1,0 +1,101 @@
+"""Structured training-metrics logging (JSONL) — the observability layer the
+reference reduces to rank-0 ``print`` + log-scraping regexes (SURVEY.md §5:
+``log()`` helpers, dear/imagenet_benchmark.py:139-142; results recovered by
+``extract_log`` pattern-matching, benchmarks.py:119-128).
+
+One record per call, one JSON object per line, flushed eagerly so a crashed
+run keeps everything logged up to the failure. Rank-0-only by default (the
+in-step metrics are already cross-replica reduced). Values are coerced to
+host scalars lazily — pass device arrays freely, but note each write then
+costs a device sync; under async dispatch prefer logging every N steps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import IO, Optional
+
+import jax
+import numpy as np
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics writer.
+
+    >>> ml = MetricsLogger("/tmp/run/metrics.jsonl")
+    >>> ml.log(step=10, loss=0.3, img_per_sec=1890.0)
+    >>> ml.close()
+
+    Each record carries ``step`` (if given), a wall-clock ``time`` (seconds
+    since logger creation), and every keyword as a JSON scalar.
+    """
+
+    def __init__(self, path: str, *, all_ranks: bool = False,
+                 append: bool = False):
+        self._active = all_ranks or jax.process_index() == 0
+        self._f: Optional[IO[str]] = None
+        self.path = path
+        if self._active:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(path, "a" if append else "w")
+        self._t0 = time.time()
+
+    @staticmethod
+    def _json_safe(v):
+        # NaN/Inf are not standard JSON (json.dumps would emit bare NaN
+        # tokens that strict parsers reject); stringify them, recursively
+        if isinstance(v, float) and not np.isfinite(v):
+            return repr(v)
+        if isinstance(v, list):
+            return [MetricsLogger._json_safe(x) for x in v]
+        return v
+
+    @staticmethod
+    def _scalar(v):
+        if isinstance(v, (str, bool)) or v is None:
+            return v
+        arr = np.asarray(jax.device_get(v))
+        if arr.size == 1:
+            return MetricsLogger._json_safe(arr.reshape(()).item())
+        return MetricsLogger._json_safe(arr.tolist())
+
+    def log(self, step: Optional[int] = None, **values) -> None:
+        if not self._active:
+            return
+        rec = {"time": round(time.time() - self._t0, 6)}
+        if step is not None:
+            rec["step"] = int(step)
+        for k, v in values.items():
+            rec[k] = self._scalar(v)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_metrics(path: str) -> list[dict]:
+    """Parse a JSONL metrics file back into records (skips torn last lines
+    from a crash mid-write)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

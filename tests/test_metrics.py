@@ -1,0 +1,64 @@
+"""JSONL metrics logging (utils/metrics.py) and its CLI integration."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from dear_pytorch_tpu.utils import MetricsLogger, read_metrics
+
+
+def test_logger_roundtrip(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    with MetricsLogger(p) as ml:
+        ml.log(step=1, loss=jnp.float32(0.5), acc=0.9, tag="warmup")
+        ml.log(step=2, loss=np.float64(0.25), vec=np.arange(3.0))
+    recs = read_metrics(p)
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[0]["loss"] == 0.5 and recs[0]["tag"] == "warmup"
+    assert recs[1]["vec"] == [0.0, 1.0, 2.0]
+    assert all("time" in r for r in recs)
+
+
+def test_logger_nonfinite_and_append(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    with MetricsLogger(p) as ml:
+        ml.log(step=1, loss=float("nan"))
+    with MetricsLogger(p, append=True) as ml:
+        ml.log(step=2, loss=1.0)
+    recs = read_metrics(p)
+    assert len(recs) == 2  # nan did not break JSON parsing
+    assert recs[0]["loss"] == "nan"
+
+
+def test_logger_nonfinite_in_arrays_stays_strict_json(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    with MetricsLogger(p) as ml:
+        ml.log(hist=np.array([1.0, float("nan"), float("inf")]))
+    line = open(p).read().strip()
+    json.loads(line)  # strict: no bare NaN/Infinity tokens
+    assert '"nan"' in line and '"inf"' in line
+
+
+def test_read_skips_torn_tail(tmp_path):
+    p = tmp_path / "m.jsonl"
+    p.write_text('{"time": 0.1, "step": 1, "loss": 2.0}\n{"time": 0.2, "st')
+    recs = read_metrics(str(p))
+    assert len(recs) == 1 and recs[0]["loss"] == 2.0
+
+
+def test_cli_metrics_file(mesh, tmp_path):
+    from dear_pytorch_tpu.benchmarks import imagenet
+
+    p = str(tmp_path / "cli.jsonl")
+    imagenet.main([
+        "--model", "mnistnet", "--batch-size", "4",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "2", "--metrics-file", p,
+    ])
+    recs = read_metrics(p)
+    iters = [r for r in recs if "iter" in r]
+    summaries = [r for r in recs if r.get("summary")]
+    assert len(iters) == 2
+    assert all(r["img_per_sec_per_device"] > 0 for r in iters)
+    assert len(summaries) == 1 and summaries[0]["world"] == 8
