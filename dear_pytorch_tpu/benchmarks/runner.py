@@ -50,18 +50,10 @@ class BenchResult:
 def apply_platform_env() -> None:
     """Honor JAX_PLATFORMS / DEAR_NUM_CPU_DEVICES before backend init.
 
-    Some PJRT plugin environments initialize their (possibly remote) client
-    even when the env var asks for CPU; `jax.config.update` before first
-    device contact is the reliable switch. The batch driver sets these for
-    emulated cells."""
-    import os
-
-    plats = os.environ.get("JAX_PLATFORMS")
-    if plats:
-        jax.config.update("jax_platforms", plats)
-    n = os.environ.get("DEAR_NUM_CPU_DEVICES")
-    if n:
-        jax.config.update("jax_num_cpu_devices", int(n))
+    Delegates to `backend._apply_platform_env` (which `backend.init` also
+    runs itself, so every entry point is covered); kept as the CLI-facing
+    name."""
+    backend._apply_platform_env()
 
 
 def log(s: str, nl: bool = True) -> None:

@@ -114,6 +114,28 @@ def _initialize_kwargs() -> dict:
     return kwargs
 
 
+def _apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS / DEAR_NUM_CPU_DEVICES via `jax.config` before
+    first device contact.
+
+    Env-only platform selection is unreliable in environments whose
+    sitecustomize imports jax at interpreter startup (the var is read too
+    late) — and in this session's container, falling through to a wedged
+    tunneled-accelerator plugin HANGS in device init. The config update is
+    the authoritative switch; a no-op once a backend is live.
+    """
+    plats = os.environ.get("JAX_PLATFORMS")
+    n = os.environ.get("DEAR_NUM_CPU_DEVICES")
+    ndev = _env_int("DEAR_NUM_CPU_DEVICES") if n else None  # loud on junk
+    try:
+        if plats:
+            jax.config.update("jax_platforms", plats)
+        if ndev:
+            jax.config.update("jax_num_cpu_devices", ndev)
+    except Exception as exc:  # backend already initialized: keep it
+        logger.debug("platform env not applied: %s", exc)
+
+
 def init(
     axis_names: Sequence[str] = (DP_AXIS,),
     mesh_shape: Optional[Sequence[int]] = None,
@@ -138,6 +160,7 @@ def init(
     with _lock:
         if _initialized and _global_mesh is not None:
             return _global_mesh
+        _apply_platform_env()
         # Join the cluster BEFORE any call that touches the XLA backend
         # (jax.devices/process_count would lock in a single-process world).
         if _multiprocess_env_configured():

@@ -16,10 +16,11 @@ from dear_pytorch_tpu.utils import checkpoint as ckpt
 from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
 
 
-def _load_example():
+def _load_example(filename: str = "mnist.py"):
     root = os.path.join(os.path.dirname(__file__), "..", "examples",
-                        "mnist.py")
-    spec = importlib.util.spec_from_file_location("mnist_example", root)
+                        filename)
+    name = filename.removesuffix(".py") + "_example"
+    spec = importlib.util.spec_from_file_location(name, root)
     m = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(m)
     return m
@@ -75,6 +76,31 @@ def test_checkpoint_roundtrip_and_plan_guard(mesh, tmp_path):
                            threshold_mb=None, donate=False)
     with pytest.raises(ValueError, match="plan"):
         ckpt.restore_checkpoint(d, ts2, template=ts2.init(params))
+
+
+def test_production_example_runs_and_resumes(mesh, tmp_path):
+    """examples/production.py: fsdp + guarded async checkpoints + metrics +
+    pipeline end-to-end, then resume-from-latest continues the step count."""
+    m = _load_example("production.py")
+
+    wd = str(tmp_path / "run")
+    m.main(["--steps", "12", "--checkpoint-every", "5", "--log-every", "3",
+            "--workdir", wd])
+    from dear_pytorch_tpu.utils import checkpoint as ckpt_mod
+    from dear_pytorch_tpu.utils import read_metrics
+
+    assert ckpt_mod.latest_step(os.path.join(wd, "ckpts")) == 10
+    n_recs = len(read_metrics(os.path.join(wd, "metrics.jsonl")))
+    assert n_recs >= 3
+
+    m.main(["--steps", "18", "--checkpoint-every", "5", "--log-every", "3",
+            "--workdir", wd])  # resumes from step 10
+    assert ckpt_mod.latest_step(os.path.join(wd, "ckpts")) == 15
+    recs = read_metrics(os.path.join(wd, "metrics.jsonl"))
+    assert len(recs) > n_recs
+    # replayed steps (11-12) must not leave duplicate step records behind
+    steps = [r["step"] for r in recs if "step" in r]
+    assert len(steps) == len(set(steps)), steps
 
 
 def test_async_checkpoint_roundtrip(mesh, tmp_path):
@@ -194,10 +220,6 @@ def test_compressed_multi_axis_rejected():
 def test_parallelism_example_smoke(axis):
     """examples/parallelism.py runs and improves for the model-sharding
     axes (dp/sp are covered end-to-end elsewhere)."""
-    root = os.path.join(os.path.dirname(__file__), "..", "examples",
-                        "parallelism.py")
-    spec = importlib.util.spec_from_file_location("parallelism_example", root)
-    m = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(m)
+    m = _load_example("parallelism.py")
     final = m.main(["--axis", axis, "--steps", "3"])
     assert np.isfinite(final)
