@@ -35,9 +35,11 @@ from dear_pytorch_tpu.comm.backend import (  # noqa: F401
     local_size,
     device_count,
     barrier,
+    barriar,  # the reference's spelling (comm_core.cpp:15), drop-in parity
     global_mesh,
     set_global_mesh,
 )
+from dear_pytorch_tpu.config import DearConfig  # noqa: F401
 from dear_pytorch_tpu.comm.communicator import Communicator  # noqa: F401
 from dear_pytorch_tpu.comm import collectives  # noqa: F401
 from dear_pytorch_tpu.comm.collectives import allreduce  # noqa: F401

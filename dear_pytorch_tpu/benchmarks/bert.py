@@ -49,6 +49,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> runner.BenchResult:
     args = build_parser().parse_args(argv)
     runner.apply_platform_env()
+    scan_steps = runner.validate_scan_steps(args)  # before any resources
     mesh = backend.init()
     world = backend.dp_size(mesh)
 
@@ -126,12 +127,10 @@ def main(argv=None) -> runner.BenchResult:
                         vocab=cfg.vocab_size)
     next_batch, close = runner.make_batch_source(args, spec, sharding, batch)
 
-    holder = {"state": state, "metrics": None}
-
-    def step_fn():
-        holder["state"], holder["metrics"] = stepper.step(
-            holder["state"], next_batch()
-        )
+    holder = {"state": state, "metrics": None, "batch": batch}
+    step_fn, timed_kwargs = runner.make_step_source(
+        args, scan_steps, ts, stepper, holder, next_batch
+    )
 
     def sync():
         # One device->host scalar fetch drains the in-order pipeline (see
@@ -145,13 +144,10 @@ def main(argv=None) -> runner.BenchResult:
     try:
         result = runner.run_timed(
             step_fn,
-            batch_size=args.batch_size,
-            num_warmup_batches=args.num_warmup_batches,
-            num_batches_per_iter=args.num_batches_per_iter,
-            num_iters=args.num_iters,
             unit="sen",
             sync=sync,
             metrics=metrics_log,
+            **timed_kwargs,
         )
     finally:
         if args.profile_dir:

@@ -79,6 +79,7 @@ def run_timed(
     sync: Optional[Callable[[], None]] = None,
     world: Optional[int] = None,
     metrics=None,
+    steps_per_call: int = 1,
 ) -> BenchResult:
     """Run the warmup + timed-iteration protocol around ``step_fn``.
 
@@ -87,10 +88,14 @@ def run_timed(
     `jax.effects_barrier`-free no-op — pass one!). ``world`` overrides the
     device count in the report (the scaling sweep runs on sub-meshes).
     ``metrics`` (a `utils.MetricsLogger`) receives one record per timed
-    iteration plus a final summary record.
+    iteration plus a final summary record. ``steps_per_call`` says how many
+    REAL train steps one ``step_fn()`` call performs (the scanned
+    protocol) so reported step times stay per-step; ``batch_size`` must
+    then be the items per CALL.
     """
     dev = device_name()
     world = backend.device_count() if world is None else world
+    steps_per_call = max(int(steps_per_call), 1)
 
     log("Running warmup...")
     for _ in range(num_warmup_batches):
@@ -110,11 +115,12 @@ def run_timed(
         thr = batch_size * num_batches_per_iter / dt
         log(f"Iter #{x}: {thr:.1f} {unit}/sec per {dev}")
         per_iter.append(thr)
-        iter_times.append(dt / num_batches_per_iter)
+        # per REAL train step, independent of the scanned-dispatch shape
+        iter_times.append(dt / (num_batches_per_iter * steps_per_call))
         if metrics is not None:
             metrics.log(
                 iter=x, **{f"{unit}_per_sec_per_device": thr},
-                step_time_s=dt / num_batches_per_iter,
+                step_time_s=dt / (num_batches_per_iter * steps_per_call),
             )
 
     res = BenchResult(
@@ -210,6 +216,12 @@ def add_common_args(parser) -> None:
                         help="gradient accumulation: split each per-device "
                              "batch into this many scanned microbatches; "
                              "collectives and the update run once per step")
+    parser.add_argument("--scan-steps", type=int, default=1,
+                        help="compile k train steps as ONE lax.scan program "
+                             "per dispatch (TrainStep.multi_step): amortizes "
+                             "host/tunnel dispatch latency and exposes "
+                             "cross-step overlap to the scheduler; requires "
+                             "--pipeline none and no --autotune")
     parser.add_argument("--base-lr", type=float, default=0.01)
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--profile-dir", type=str, default=None,
@@ -304,7 +316,8 @@ def make_batch_source(args, spec, sharding, template_batch):
 
 def log_mfu(ts, state, batch, result: BenchResult) -> Optional[float]:
     """Log achieved FLOP/s + MFU for the compiled train step (enable with
-    ``--mfu``). Uses the step's mean iteration time from ``result``."""
+    ``--mfu``). ``result.iter_time_mean`` is per REAL step under every
+    protocol (run_timed's steps_per_call accounting)."""
     from dear_pytorch_tpu.utils import perf_model
 
     try:
@@ -385,6 +398,62 @@ def config_from_args(args, *, fp16_comm: bool = True):
         partition_mb=args.partition,
         accum_steps=args.accum_steps,
     )
+
+
+def validate_scan_steps(args) -> int:
+    """Resolve --scan-steps; call IMMEDIATELY after parse_args so rejected
+    combinations fail before any pipeline/tuner resources are created."""
+    k = int(getattr(args, "scan_steps", 1) or 1)
+    if k <= 1:
+        return 1
+    if args.pipeline != "none":
+        raise SystemExit("--scan-steps re-feeds one constant batch inside "
+                         "the scanned program; incompatible with --pipeline")
+    if args.autotune:
+        raise SystemExit("--scan-steps and --autotune are incompatible "
+                         "(the tuner re-buckets between steps)")
+    return k
+
+
+def _ceil_div_keep_zero(n: int, k: int) -> int:
+    return -(-n // k) if n > 0 else 0
+
+
+def make_step_source(args, scan_steps: int, ts, stepper, holder,
+                     next_batch):
+    """(step_fn, run_timed protocol kwargs) honoring ``--scan-steps``.
+
+    Scanned mode compiles ``scan_steps`` steps as ONE lax.scan program
+    (`TrainStep.multi_step`) on the constant batch in ``holder['batch']``;
+    warmup/iteration counts convert to dispatch calls by ceiling division
+    (a zero warmup stays zero — cold-start measurements are a thing).
+    """
+    if scan_steps > 1:
+        log(f"Scanned protocol: {scan_steps} steps per dispatch")
+        runner_fn = ts.multi_step(scan_steps)
+
+        def step_fn():
+            holder["state"], holder["metrics"] = runner_fn(
+                holder["state"], holder["batch"]
+            )
+    else:
+        def step_fn():
+            holder["state"], holder["metrics"] = stepper.step(
+                holder["state"], next_batch()
+            )
+
+    kwargs = dict(
+        batch_size=args.batch_size * scan_steps,
+        num_warmup_batches=_ceil_div_keep_zero(
+            args.num_warmup_batches, scan_steps
+        ),
+        num_batches_per_iter=max(
+            _ceil_div_keep_zero(args.num_batches_per_iter, scan_steps), 1
+        ),
+        num_iters=args.num_iters,
+        steps_per_call=scan_steps,
+    )
+    return step_fn, kwargs
 
 
 def build_stepper(cfg, loss_fn, params, mesh, *, model_state=None,

@@ -114,6 +114,8 @@ def main(argv=None) -> dict:
                       "(fixed-batch protocol)")
     if args.mfu or args.profile_dir:
         warnings.warn("--mfu/--profile-dir are ignored by the scaling sweep")
+    if getattr(args, "scan_steps", 1) > 1:
+        warnings.warn("--scan-steps is ignored by the scaling sweep")
     backend.init()
     devices = jax.devices()
     worlds = _parse_worlds(args.worlds, len(devices))

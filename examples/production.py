@@ -109,15 +109,20 @@ def main(argv=None) -> float:
     last_loss = float("nan")
     with guard, MetricsLogger(metrics_path, append=start > 0) as ml:
         try:
-            for i in range(start, args.steps):
+            for _ in range(start, args.steps):
                 state, m = guard.step(state, pipe.next())
                 if m.get("rolled_back"):
-                    ml.log(step=i, event="rollback")
+                    # no step= label: the restored step was already logged;
+                    # replayed steps after a rollback re-log their numbers
+                    # (latest record wins for a consumer)
+                    ml.log(event="rollback",
+                           restored_step=int(jax.device_get(state.step)))
                     continue
-                if (i + 1) % args.log_every == 0:
+                cur = int(jax.device_get(state.step))  # truth, not loop idx
+                if cur % args.log_every == 0:
                     last_loss = float(m["loss"])
-                    ml.log(step=i + 1, loss=last_loss)
-                    print(f"step {i + 1}: loss {last_loss:.4f}")
+                    ml.log(step=cur, loss=last_loss)
+                    print(f"step {cur}: loss {last_loss:.4f}")
         finally:
             pipe.close()
     print(f"done at step {int(jax.device_get(state.step))}, "

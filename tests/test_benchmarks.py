@@ -29,6 +29,33 @@ def test_imagenet_cli_output_contract(mesh, capsys):
     assert res.total_mean == pytest.approx(8 * res.per_device_mean)
 
 
+def test_imagenet_scanned_protocol(mesh, capsys):
+    """--scan-steps k: one lax.scan program per dispatch; reported
+    throughput stays in the same ballpark as per-step dispatch and the
+    scrape line shape is unchanged."""
+    base = imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4"] + TINY
+    )
+    scanned = imagenet_bench.main(
+        ["--model", "mnistnet", "--batch-size", "4", "--scan-steps", "2",
+         "--num-warmup-batches", "2", "--num-batches-per-iter", "4",
+         "--num-iters", "2"]
+    )
+    out = capsys.readouterr().out
+    assert "Scanned protocol: 2 steps per dispatch" in out
+    assert scanned.per_device_mean > 0.3 * base.per_device_mean
+    with pytest.raises(SystemExit, match="pipeline"):
+        imagenet_bench.main(
+            ["--model", "mnistnet", "--batch-size", "4", "--scan-steps",
+             "2", "--pipeline", "numpy"] + TINY
+        )
+    with pytest.raises(SystemExit, match="autotune"):
+        imagenet_bench.main(
+            ["--model", "mnistnet", "--batch-size", "4", "--scan-steps",
+             "2", "--autotune", "bo"] + TINY
+        )
+
+
 def test_imagenet_modes_and_ablations(mesh):
     # baseline schedule + exclude-parts ablation parse & run
     imagenet_bench.main(
