@@ -212,14 +212,20 @@ def _flash_fwd(q, k, v, kv_mask, scale, causal):
     return o, (q, k, v, kv_mask, o, lse)
 
 
-def _flash_bwd(scale, causal, res, do):
-    q, k, v, kv_mask, o, lse = res
+def flash_pair_fwd(q, k, v, kv_mask, scale, causal):
+    """(o, lse) for one (q-block, k-block) pair over folded ``[BH, S, D]``
+    operands — ring attention's per-step forward building block."""
+    return _flash_fwd_impl(q, k, v, kv_mask, scale, causal)
+
+
+def flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal):
+    """dQ for one (q-block, k-block) pair given GLOBAL ``lse``/``delta``
+    (folded ``[BH, S, D]`` operands). This is the flash backward's dq leg;
+    exposed separately so ring attention can run it per ring step."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _pick_block(sq), _pick_block(sk)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, seq_k=sk),
         grid=(bh, sq // bq),
@@ -237,7 +243,14 @@ def _flash_bwd(scale, causal, res, do):
         interpret=_interpret(),
     )(q, k, v, kv_mask, do, lse, delta)
 
-    dk, dv = pl.pallas_call(
+
+def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal):
+    """dK/dV for one (q-block, k-block) pair given GLOBAL ``lse``/``delta``
+    (see `flash_pair_dq`)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, seq_q=sq),
         grid=(bh, sk // bk),
@@ -260,6 +273,13 @@ def _flash_bwd(scale, causal, res, do):
         ],
         interpret=_interpret(),
     )(q, k, v, kv_mask, do, lse, delta)
+
+
+def _flash_bwd(scale, causal, res, do):
+    q, k, v, kv_mask, o, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dq = flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal)
+    dk, dv = flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal)
     return dq, dk, dv, None
 
 

@@ -26,6 +26,7 @@ from jax import lax
 from dear_pytorch_tpu.comm.backend import DP_AXIS, SP_AXIS
 from dear_pytorch_tpu.parallel.ring_attention import (
     make_ring_attention_impl,
+    make_ring_flash_attention_impl,
 )
 
 
@@ -132,11 +133,14 @@ def make_sp_bert_loss_fn(model, *, sp_axis: str = SP_AXIS,
     return loss_fn
 
 
-def sp_bert_model(config, sp_axis: str = SP_AXIS):
+def sp_bert_model(config, sp_axis: str = SP_AXIS, *, flash: bool = False):
     """A `BertForPreTraining` whose attention runs as a ring over
-    ``sp_axis``."""
+    ``sp_axis``. ``flash=True`` uses the Pallas flash kernels per ring
+    block (`make_ring_flash_attention_impl`): O(S_loc·D) attention memory,
+    MXU-tiled blocks; falls back to the dense-block ring while
+    attention-prob dropout is active."""
     from dear_pytorch_tpu.models.bert import BertForPreTraining
 
-    return BertForPreTraining(
-        config, attention_impl=make_ring_attention_impl(sp_axis)
-    )
+    impl = (make_ring_flash_attention_impl(sp_axis) if flash
+            else make_ring_attention_impl(sp_axis))
+    return BertForPreTraining(config, attention_impl=impl)

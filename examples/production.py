@@ -109,16 +109,18 @@ def main(argv=None) -> float:
     last_loss = float("nan")
     with guard, MetricsLogger(metrics_path, append=start > 0) as ml:
         try:
-            for _ in range(start, args.steps):
+            # host-side step mirror: fetching state.step every iteration
+            # would sync host and device per step, killing the async
+            # pipeline; it only diverges on rollback, where we re-sync
+            cur = start
+            while cur < args.steps:
                 state, m = guard.step(state, pipe.next())
                 if m.get("rolled_back"):
-                    # no step= label: the restored step was already logged;
-                    # replayed steps after a rollback re-log their numbers
-                    # (latest record wins for a consumer)
-                    ml.log(event="rollback",
-                           restored_step=int(jax.device_get(state.step)))
+                    cur = int(jax.device_get(state.step))
+                    # replayed steps re-log their numbers (latest wins)
+                    ml.log(event="rollback", restored_step=cur)
                     continue
-                cur = int(jax.device_get(state.step))  # truth, not loop idx
+                cur += 1
                 if cur % args.log_every == 0:
                     last_loss = float(m["loss"])
                     ml.log(step=cur, loss=last_loss)

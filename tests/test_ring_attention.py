@@ -11,6 +11,7 @@ from dear_pytorch_tpu.comm.backend import DP_AXIS
 from dear_pytorch_tpu.parallel.ring_attention import (
     full_attention,
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
 )
 
@@ -74,6 +75,85 @@ def test_ulysses_matches_full(mesh, causal):
         return out[None]
 
     got = _run_sharded(fn, q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_attention_matches_full(mesh, causal):
+    """The Pallas-per-block ring (LSE combine across blocks) is exact."""
+    q, k, v = _qkv(jax.random.PRNGKey(7))
+    want = full_attention(q, k, v, causal=causal)
+
+    def fn(qb, kb, vb):
+        out = ring_flash_attention(qb[0], kb[0], vb[0], DP_AXIS,
+                                   causal=causal)
+        return out[None]
+
+    got = _run_sharded(fn, q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_attention_gradients(mesh, causal):
+    """The ring-level custom VJP (second ring of flash backward kernels
+    under the global LSE) equals the dense gradients for q, k, AND v."""
+    q, k, v = _qkv(jax.random.PRNGKey(8))
+
+    def ref_loss(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+
+    world = mesh.shape[DP_AXIS]
+
+    def ring_loss(q, k, v):
+        qs, ks, vs = (_shard_seq(x, world) for x in (q, k, v))
+
+        def fn(qb, kb, vb):
+            out = ring_flash_attention(qb[0], kb[0], vb[0], DP_AXIS,
+                                       causal=causal)
+            return jnp.sum(out ** 2)[None]
+
+        mapped = jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(jax.P(DP_AXIS),) * 3,
+            out_specs=jax.P(DP_AXIS),
+            check_vma=False,
+        )
+        return jnp.sum(mapped(qs, ks, vs))
+
+    got = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ring_flash_attention_padding_mask(mesh):
+    """Key-padding masks rotate with K/V and match the dense twin."""
+    q, k, v = _qkv(jax.random.PRNGKey(9))
+    kv_mask = jnp.ones((B, S), jnp.bool_).at[:, S - 10:].set(False)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+    s = jnp.where(kv_mask[:, None, None, :], s, -jnp.inf)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+
+    world = mesh.shape[DP_AXIS]
+
+    def fn(qb, kb, vb, mb):
+        out = ring_flash_attention(qb[0], kb[0], vb[0], DP_AXIS,
+                                   kv_mask=mb[0])
+        return out[None]
+
+    qs, ks, vs = (_shard_seq(x, world) for x in (q, k, v))
+    ms = kv_mask.reshape(B, world, S // world).transpose(1, 0, 2)
+    mapped = jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.P(DP_AXIS),) * 4,
+        out_specs=jax.P(DP_AXIS),
+        check_vma=False,
+    ))
+    got = _unshard_seq(mapped(qs, ks, vs, ms))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
 

@@ -70,7 +70,8 @@ def _dense_baseline(params, batch, steps, lr=0.05, momentum=0.9):
     return losses
 
 
-def test_sp_bert_training_matches_dense(mesh2d):
+@pytest.mark.parametrize("flash", [False, True])
+def test_sp_bert_training_matches_dense(mesh2d, flash):
     batch = _batch()
     dense_model = BertForPreTraining(CFG)
     params = dense_model.init(
@@ -79,7 +80,7 @@ def test_sp_bert_training_matches_dense(mesh2d):
 
     ref_losses = _dense_baseline(params, batch, steps=4)
 
-    sp_model = SP.sp_bert_model(CFG)
+    sp_model = SP.sp_bert_model(CFG, flash=flash)
     loss_fn = SP.make_sp_bert_loss_fn(sp_model, train=False)
 
     ts = build_train_step(
