@@ -177,7 +177,7 @@ def _flash(q, k, v, kv_mask, scale, causal):
     return o
 
 
-def _flash_fwd_impl(q, k, v, kv_mask, scale, causal):
+def _flash_fwd_impl(q, k, v, kv_mask, scale, causal, out_dtype=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _pick_block(sq), _pick_block(sk)
@@ -199,7 +199,7 @@ def _flash_fwd_impl(q, k, v, kv_mask, scale, causal):
             pl.BlockSpec((1, bq), lambda i, j: (i, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, sq), jnp.float32),
         ],
         interpret=_interpret(),
@@ -212,13 +212,17 @@ def _flash_fwd(q, k, v, kv_mask, scale, causal):
     return o, (q, k, v, kv_mask, o, lse)
 
 
-def flash_pair_fwd(q, k, v, kv_mask, scale, causal):
+def flash_pair_fwd(q, k, v, kv_mask, scale, causal, out_dtype=None):
     """(o, lse) for one (q-block, k-block) pair over folded ``[BH, S, D]``
-    operands — ring attention's per-step forward building block."""
-    return _flash_fwd_impl(q, k, v, kv_mask, scale, causal)
+    operands — ring attention's per-step forward building block.
+    ``out_dtype`` (default: q's dtype) lets the ring keep the per-block
+    contributions in fp32 for its cross-block accumulation."""
+    return _flash_fwd_impl(q, k, v, kv_mask, scale, causal,
+                           out_dtype=out_dtype)
 
 
-def flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal):
+def flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal,
+                  out_dtype=None):
     """dQ for one (q-block, k-block) pair given GLOBAL ``lse``/``delta``
     (folded ``[BH, S, D]`` operands). This is the flash backward's dq leg;
     exposed separately so ring attention can run it per ring step."""
@@ -239,12 +243,13 @@ def flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal):
             pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # delta
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
         interpret=_interpret(),
     )(q, k, v, kv_mask, do, lse, delta)
 
 
-def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal):
+def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal,
+                   out_dtype=None):
     """dK/dV for one (q-block, k-block) pair given GLOBAL ``lse``/``delta``
     (see `flash_pair_dq`)."""
     bh, sq, d = q.shape
@@ -268,8 +273,8 @@ def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal):
             pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or v.dtype),
         ],
         interpret=_interpret(),
     )(q, k, v, kv_mask, do, lse, delta)

@@ -213,20 +213,21 @@ def _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal):
     heads = bh // mask.shape[0]  # mask stays [B, S]; repeat locally per call
     perm = _ring_perm(world)
 
-    def full_b(args):
-        q_, kb, vb, mb = args
-        return flash_pair_fwd(q_, kb, vb, jnp.repeat(mb, heads, axis=0),
-                              scale, False)
-
-    def causal_b(args):
-        q_, kb, vb, mb = args
-        return flash_pair_fwd(q_, kb, vb, jnp.repeat(mb, heads, axis=0),
-                              scale, True)
+    def make_branch(causal_pair):
+        def branch(args):
+            q_, kb, vb, mb = args
+            # fp32 block contributions: the cross-block accumulation below
+            # must not round through the input dtype per step
+            return flash_pair_fwd(q_, kb, vb, jnp.repeat(mb, heads, axis=0),
+                                  scale, causal_pair, out_dtype=jnp.float32)
+        return branch
 
     def skip_b(args):
         q_ = args[0]
-        return (jnp.zeros_like(q_),
+        return (jnp.zeros(q_.shape, jnp.float32),
                 jnp.full((bh, sq), _NEG_BIG, jnp.float32))
+
+    full_b, causal_b = make_branch(False), make_branch(True)
 
     def step(carry, s):
         kb, vb, mb, m, den, num = carry
@@ -239,7 +240,7 @@ def _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal):
         w = jnp.exp(lse_b - m_new)
         alpha = jnp.exp(m - m_new)
         den = den * alpha + w
-        num = num * alpha[..., None] + o_b.astype(jnp.float32) * w[..., None]
+        num = num * alpha[..., None] + o_b * w[..., None]
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
         mb = lax.ppermute(mb, axis_name, perm)
@@ -275,25 +276,24 @@ def _ring_flash_bwd(axis_name, scale, causal, res, do):
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
 
-    def full_b(args):
-        q_, kb, vb, mb = args
-        mbh = jnp.repeat(mb, heads, axis=0)
-        return (flash_pair_dq(q_, kb, vb, mbh, do, lse, delta, scale,
-                              False),
-                *flash_pair_dkv(q_, kb, vb, mbh, do, lse, delta, scale,
-                                False))
-
-    def causal_b(args):
-        q_, kb, vb, mb = args
-        mbh = jnp.repeat(mb, heads, axis=0)
-        return (flash_pair_dq(q_, kb, vb, mbh, do, lse, delta, scale,
-                              True),
-                *flash_pair_dkv(q_, kb, vb, mbh, do, lse, delta, scale,
-                                True))
+    def make_branch(causal_pair):
+        def branch(args):
+            q_, kb, vb, mb = args
+            mbh = jnp.repeat(mb, heads, axis=0)
+            # fp32 contributions into the fp32 accumulators (see fwd pass)
+            return (flash_pair_dq(q_, kb, vb, mbh, do, lse, delta, scale,
+                                  causal_pair, out_dtype=jnp.float32),
+                    *flash_pair_dkv(q_, kb, vb, mbh, do, lse, delta, scale,
+                                    causal_pair, out_dtype=jnp.float32))
+        return branch
 
     def skip_b(args):
         q_, kb, vb, _ = args
-        return jnp.zeros_like(q_), jnp.zeros_like(kb), jnp.zeros_like(vb)
+        return (jnp.zeros(q_.shape, jnp.float32),
+                jnp.zeros(kb.shape, jnp.float32),
+                jnp.zeros(vb.shape, jnp.float32))
+
+    full_b, causal_b = make_branch(False), make_branch(True)
 
     def step(carry, s):
         kb, vb, mb, dkb, dvb, dq = carry
@@ -301,9 +301,9 @@ def _ring_flash_bwd(axis_name, scale, causal, res, do):
         br = _pair_branch(owner, idx, causal)
         dq_c, dk_c, dv_c = lax.switch(br, [full_b, causal_b, skip_b],
                                       (q, kb, vb, mb))
-        dq = dq + dq_c.astype(dq.dtype)
-        dkb = dkb + dk_c.astype(dkb.dtype)
-        dvb = dvb + dv_c.astype(dvb.dtype)
+        dq = dq + dq_c
+        dkb = dkb + dk_c
+        dvb = dvb + dv_c
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
         mb = lax.ppermute(mb, axis_name, perm)
@@ -366,14 +366,16 @@ def make_ring_flash_attention_impl(axis_name: str, causal: bool = False):
     active (the tiled kernel does not express it — semantics never silently
     change)."""
 
+    fallback = make_ring_attention_impl(axis_name, causal)
+
     def impl(q, k, v, mask, dropout_rng=None, dropout_rate=0.0, dtype=None):
+        if dropout_rng is not None and dropout_rate > 0.0:
+            return fallback(q, k, v, mask, dropout_rng=dropout_rng,
+                            dropout_rate=dropout_rate, dtype=dtype)
         kv_mask = None
         if mask is not None:
+            # model masks are ADDITIVE [B,1,1,S]; ring wants key validity
             kv_mask = mask.reshape(mask.shape[0], mask.shape[-1]) > -1.0
-        if dropout_rng is not None and dropout_rate > 0.0:
-            return ring_attention(q, k, v, axis_name, causal=causal,
-                                  kv_mask=kv_mask, dropout_rng=dropout_rng,
-                                  dropout_rate=dropout_rate)
         return ring_flash_attention(q, k, v, axis_name, causal=causal,
                                     kv_mask=kv_mask)
 

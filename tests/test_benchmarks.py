@@ -43,7 +43,16 @@ def test_imagenet_scanned_protocol(mesh, capsys):
     )
     out = capsys.readouterr().out
     assert "Scanned protocol: 2 steps per dispatch" in out
-    assert scanned.per_device_mean > 0.3 * base.per_device_mean
+    # accounting invariant: throughput x per-REAL-step time = per-device
+    # batch items, under BOTH protocols. Means of reciprocal quantities are
+    # Jensen-biased upward under timing variance, so the tolerance is
+    # generous — this checks the scan_steps bookkeeping (a factor-2 error
+    # would blow straight through it), not machine speed.
+    for res in (base, scanned):
+        assert res.per_device_mean * res.iter_time_mean == pytest.approx(
+            4.0, rel=0.35
+        )
+    assert scanned.per_device_mean > 0
     with pytest.raises(SystemExit, match="pipeline"):
         imagenet_bench.main(
             ["--model", "mnistnet", "--batch-size", "4", "--scan-steps",
