@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from dear_pytorch_tpu import models
 from dear_pytorch_tpu.benchmarks import runner
 from dear_pytorch_tpu.comm import backend
-from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.comm.backend import DP_AXIS, SP_AXIS
 from dear_pytorch_tpu.models import data
 
 
@@ -41,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use the Pallas flash-attention kernel "
                         "(ops/flash_attention.py); falls back to dense "
                         "attention wherever attention dropout is active")
+    p.add_argument("--sp-degree", type=int, default=1,
+                   help="sequence-parallel degree: dp x sp mesh with the "
+                        "sequence dim sharded over 'sp' and ring attention "
+                        "(or ring-flash with --flash-attention) inside the "
+                        "model; DeAR gradients reduce over both axes")
     runner.add_common_args(p)
     p.set_defaults(batch_size=8, base_lr=2e-5, momentum=0.0)
     return p
@@ -50,87 +55,149 @@ def main(argv=None) -> runner.BenchResult:
     args = build_parser().parse_args(argv)
     runner.apply_platform_env()
     scan_steps = runner.validate_scan_steps(args)  # before any resources
-    mesh = backend.init()
-    world = backend.dp_size(mesh)
+    sp = max(int(args.sp_degree), 1)
+    if sp > 1:
+        backend.init()  # bootstrap (multi-host) without fixing the axes:
+        # init() is idempotent and another mesh may already be installed
+        import numpy as np
+
+        devices = jax.devices()
+        ndev = len(devices)
+        if ndev % sp:
+            raise SystemExit(f"--sp-degree {sp} does not divide the "
+                             f"{ndev}-device world")
+        if args.sentence_len % sp:
+            raise SystemExit(f"--sentence-len {args.sentence_len} must "
+                             f"divide by --sp-degree {sp}")
+        if args.pipeline != "none":
+            raise SystemExit("--pipeline streaming is dp-only; use "
+                             "--pipeline none with --sp-degree")
+        mesh = jax.sharding.Mesh(
+            np.asarray(devices).reshape(ndev // sp, sp),
+            (DP_AXIS, SP_AXIS),
+        )
+    else:
+        mesh = backend.init()
+    world = backend.dp_size(mesh)  # data-parallel degree (sentences)
 
     dtype = jnp.bfloat16 if args.fp16 else jnp.float32
     model = models.get_model(args.model, dtype=dtype)
     attention_impl = None
-    if args.flash_attention:
+    if args.flash_attention and sp == 1:
         from dear_pytorch_tpu.ops import make_flash_attention_impl
 
         attention_impl = make_flash_attention_impl()
-    if args.num_hidden_layers is not None or attention_impl is not None:
+    cfg_over = model.config
+    if args.num_hidden_layers is not None or args.flash_attention:
         import dataclasses
 
-        cfg_over = model.config
         if args.num_hidden_layers is not None:
             cfg_over = dataclasses.replace(
                 cfg_over, num_hidden_layers=args.num_hidden_layers
             )
-        if attention_impl is not None and cfg_over.attention_probs_dropout_prob:
-            # the impl falls back to dense attention wherever attention
-            # dropout is active — benchmarking the kernel requires
-            # disabling it, and silently measuring the fallback would be
-            # worse than changing the config
+        if args.flash_attention and cfg_over.attention_probs_dropout_prob:
+            # the flash impls fall back to dense/ring attention wherever
+            # attention dropout is active — benchmarking the kernel
+            # requires disabling it, and silently measuring the fallback
+            # would be worse than changing the config
             runner.log("flash-attention: attention_probs_dropout_prob "
                        f"{cfg_over.attention_probs_dropout_prob} -> 0.0 "
                        "(kernel has no prob-dropout path)")
             cfg_over = dataclasses.replace(
                 cfg_over, attention_probs_dropout_prob=0.0
             )
+    if sp == 1 and (cfg_over is not model.config
+                    or attention_impl is not None):
         model = models.BertForPreTraining(
             cfg_over, attention_impl=attention_impl
         )
-    cfg = model.config
+    cfg = cfg_over  # == model.config whenever the model was (re)built
 
     global_bs = args.batch_size * world
     batch = data.synthetic_bert_batch(
         jax.random.PRNGKey(0), global_bs, seq_len=args.sentence_len,
         vocab_size=cfg.vocab_size,
     )
-    sharding = jax.sharding.NamedSharding(mesh, jax.P(DP_AXIS))
-    batch = runner.stage_global(batch, sharding)  # multi-host safe
 
-    params = model.init(
-        {"params": jax.random.PRNGKey(0)}, batch["input_ids"], train=False
-    )["params"]
+    extra_build = {}
+    if sp > 1:
+        from dear_pytorch_tpu.parallel import sp as SP
 
-    def loss_fn(p, b, rng):
-        logits, nsp = model.apply(
-            {"params": p}, b["input_ids"], b["token_type_ids"],
-            b["attention_mask"], train=True, rngs={"dropout": rng},
+        sp_model = SP.sp_bert_model(cfg, flash=args.flash_attention)
+        # stage per-leaf: [B, S] leaves shard (dp, sp); [B] leaves (dp,)
+        shardings = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(mesh, s),
+            SP.bert_sp_batch_specs(batch),
         )
-        return models.bert_pretraining_loss(
-            logits.astype(jnp.float32), nsp.astype(jnp.float32),
-            b["masked_lm_labels"], b["next_sentence_labels"],
+        batch = jax.tree.map(
+            lambda x, sh: runner.stage_global(x, sh), batch, shardings
         )
+        # init with the dense twin (identical params; the ring model only
+        # traces inside shard_map where 'sp' is bound)
+        params = models.BertForPreTraining(cfg).init(
+            {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
+            train=False,
+        )["params"]
+        loss_fn = SP.make_sp_bert_loss_fn(sp_model, train=True)
+        extra_build = dict(
+            axis_name=(DP_AXIS, SP_AXIS),
+            mean_axes=(DP_AXIS,),
+            batch_spec_fn=SP.bert_sp_batch_specs,
+        )
+    else:
+        sharding = jax.sharding.NamedSharding(mesh, jax.P(DP_AXIS))
+        batch = runner.stage_global(batch, sharding)  # multi-host safe
+
+        params = model.init(
+            {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
+            train=False,
+        )["params"]
+
+        def loss_fn(p, b, rng):
+            logits, nsp = model.apply(
+                {"params": p}, b["input_ids"], b["token_type_ids"],
+                b["attention_mask"], train=True, rngs={"dropout": rng},
+            )
+            return models.bert_pretraining_loss(
+                logits.astype(jnp.float32), nsp.astype(jnp.float32),
+                b["masked_lm_labels"], b["next_sentence_labels"],
+            )
 
     dear_cfg = runner.config_from_args(args)
     ts, stepper = runner.build_stepper(
-        dear_cfg, loss_fn, params, mesh, mgwfbp=args.mgwfbp,
+        dear_cfg, loss_fn, params, mesh, mgwfbp=args.mgwfbp, **extra_build,
     )
     state = ts.init(params)
 
     name = {"bert": "BERT Large", "bert_large": "BERT Large",
             "bert_base": "BERT Base"}[args.model.lower()]
     runner.log(f"{name} Pretraining, Sentence len: {args.sentence_len}")
-    runner.log(f"Batch size: {args.batch_size} (per device), "
+    runner.log(f"Batch size: {args.batch_size} (per dp rank), "
                f"{global_bs} global")
-    runner.log(f"Number of {runner.device_name()}s: {world}")
+    runner.log(f"Number of {runner.device_name()}s: "
+               f"{backend.device_count()}"
+               + (f" (dp {world} x sp {sp})" if sp > 1 else ""))
     runner.log(f"Schedule: {args.mode}; "
                f"fusion: {ts.plan.num_buckets} bucket(s)")
 
-    from dear_pytorch_tpu.runtime import pipeline as RP
+    if sp > 1:
+        # --pipeline none enforced above: the constant-batch source
+        next_batch, close = runner.make_batch_source(args, None, None, batch)
+    else:
+        from dear_pytorch_tpu.runtime import pipeline as RP
 
-    spec = RP.bert_spec(global_bs, args.sentence_len,
-                        vocab=cfg.vocab_size)
-    next_batch, close = runner.make_batch_source(args, spec, sharding, batch)
+        spec = RP.bert_spec(global_bs, args.sentence_len,
+                            vocab=cfg.vocab_size)
+        next_batch, close = runner.make_batch_source(
+            args, spec, sharding, batch
+        )
 
     holder = {"state": state, "metrics": None, "batch": batch}
     step_fn, timed_kwargs = runner.make_step_source(
         args, scan_steps, ts, stepper, holder, next_batch
     )
+    # sentences per CHIP per step: with sp, each sentence spans sp chips
+    timed_kwargs["batch_size"] = timed_kwargs["batch_size"] / sp
 
     def sync():
         # One device->host scalar fetch drains the in-order pipeline (see

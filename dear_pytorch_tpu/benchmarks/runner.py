@@ -457,18 +457,19 @@ def make_step_source(args, scan_steps: int, ts, stepper, holder,
 
 
 def build_stepper(cfg, loss_fn, params, mesh, *, model_state=None,
-                  mgwfbp=False):
+                  mgwfbp=False, **extra):
     """(train_step, stepper) from a `DearConfig` — the single construction
     path shared by the CNN and BERT CLIs. ``stepper.step(state, batch)`` is
     what the timed loop calls (the AutoTuner when tuning, the TrainStep
-    otherwise)."""
+    otherwise). ``extra`` forwards to `build_train_step` (multi-axis
+    options: axis_name/mean_axes/batch_spec_fn for the sp path)."""
     from dear_pytorch_tpu.parallel import dear as D
 
     if mgwfbp and cfg.autotune:
         raise SystemExit("--mgwfbp and --autotune are mutually exclusive: "
                          "both own the fusion plan")
     kwargs = dict(cfg.build_kwargs(), mesh=mesh,
-                  model_state_template=model_state)
+                  model_state_template=model_state, **extra)
     if cfg.autotune:
         from dear_pytorch_tpu.tuning import AutoTuner
 

@@ -125,6 +125,29 @@ def test_imagenet_streaming_pipeline(mesh, pl):
     assert res.total_mean > base.total_mean / 5, (res, base)
 
 
+@pytest.mark.parametrize("flash", [False, True])
+def test_bert_sequence_parallel_cli(mesh, capsys, flash):
+    """--sp-degree k: dp x sp mesh, ring(-flash) attention inside the
+    model, sentences/sec accounted per CHIP (a sentence spans sp chips)."""
+    argv = ["--model", "bert_base", "--num-hidden-layers", "1",
+            "--sentence-len", "32", "--batch-size", "2",
+            "--sp-degree", "4"] + TINY
+    if flash:
+        argv.append("--flash-attention")
+    res = bert_bench.main(argv)
+    out = capsys.readouterr().out
+    assert "(dp 2 x sp 4)" in out
+    assert re.search(r"Total sen/sec on 8 \w+\(s\): ", out), out
+    # 4 sentences/step globally: total throughput = 4 / step_time
+    assert res.total_mean * res.iter_time_mean == pytest.approx(4.0,
+                                                               rel=0.35)
+    with pytest.raises(SystemExit, match="divide"):
+        bert_bench.main(["--model", "bert_base", "--sp-degree", "3"] + TINY)
+    with pytest.raises(SystemExit, match="sentence-len"):
+        bert_bench.main(["--model", "bert_base", "--sentence-len", "30",
+                         "--sp-degree", "4"] + TINY)
+
+
 def test_bert_streaming_pipeline(mesh):
     res = bert_bench.main(
         ["--model", "bert_base", "--num-hidden-layers", "1",
