@@ -114,30 +114,180 @@ def pipeline_apply(
     return outputs
 
 
+def one_f_one_b(
+    stage_fn: Callable,
+    my_params,
+    x: jax.Array,
+    mb_loss_fn: Callable,
+    batch,
+    *,
+    n_stages: int,
+    axis_name: str = PP_AXIS,
+):
+    """1F1B pipeline: forward AND hand-orchestrated backward in one
+    synchronous tick loop, activation residency O(L) instead of GPipe's
+    O(M).
+
+    Per tick, device i (stage i) runs at most one microbatch forward
+    (``mb_f = t - i``) and one backward (``mb_b = t - 2(L-1) + i``);
+    activations flow forward and cotangents backward via `ppermute`. A
+    stage saves only its INPUT per in-flight microbatch — a ring buffer of
+    ``2(L-1)+1`` slots, independent of M — and the backward slot recomputes
+    the stage through `jax.vjp` (recompute-style 1F1B; ~1 extra stage
+    forward per microbatch, the standard memory/FLOPs trade). The last
+    stage computes ``d(mb_loss)/dy`` the same tick its forward finishes, so
+    its backward starts immediately (the 1F1B property).
+
+    ``mb_loss_fn(y_m, batch_m) -> scalar`` must decompose the loss per
+    microbatch (mean-of-microbatch-losses semantics — the overall loss is
+    their mean); ``batch_m`` is the caller's batch pytree with every leaf
+    pre-sliced to this microbatch (the framework owns the split, so a
+    caller cannot desynchronize its own reshape from ``n_microbatches``).
+    Returns ``(loss, dparams)`` for THIS device's stage; nothing else of
+    the backward escapes the loop.
+    """
+    idx = lax.axis_index(axis_name)
+    M = x.shape[0]
+    L = n_stages
+    n_ticks = 2 * (L - 1) + M
+    nbuf = 2 * (L - 1) + 1  # max in-flight inputs per stage (+1 slack)
+
+    def _split(l):
+        if l.shape[0] % M:
+            raise ValueError(
+                f"batch leaf leading axis {l.shape[0]} must divide by "
+                f"n_microbatches ({M})"
+            )
+        return l.reshape((M, l.shape[0] // M) + l.shape[1:])
+
+    batch_mb = jax.tree.map(_split, batch)
+
+    out_shape = jax.eval_shape(stage_fn, my_params, x[0])
+    act_dtype = out_shape.dtype
+    act_shape = tuple(out_shape.shape)
+    if act_shape != tuple(x.shape[1:]):
+        raise ValueError(
+            "pipeline stages must map activations to the same shape "
+            f"(stage out {act_shape} vs in {tuple(x.shape[1:])})"
+        )
+
+    def fwd_one(inp):
+        return stage_fn(my_params, inp)
+
+    def bwd_one(saved_in, cot):
+        _, vjp_fn = jax.vjp(stage_fn, my_params, saved_in)
+        dparams, dx = vjp_fn(cot)
+        return dparams, dx
+
+    dparams0 = jax.tree.map(lambda l: jnp.zeros_like(l), my_params)
+
+    def body(t, carry):
+        act_in, cot_in, ring, dparams, loss = carry
+        # ---- forward slot -------------------------------------------------
+        mb_f = t - idx
+        f_active = (mb_f >= 0) & (mb_f < M)
+        inp = jnp.where(idx == 0,
+                        x[jnp.clip(mb_f, 0, M - 1)].astype(act_dtype),
+                        act_in)
+        ring = lax.dynamic_update_index_in_dim(
+            ring, jnp.where(f_active, inp, ring[jnp.clip(mb_f, 0, M - 1) % nbuf]),
+            jnp.clip(mb_f, 0, M - 1) % nbuf, axis=0,
+        )
+        y = fwd_one(inp)
+        y = jnp.where(f_active, y, jnp.zeros_like(y))
+        # last stage: this microbatch's loss + output cotangent, same tick.
+        # lax.cond so the (possibly expensive) loss head runs ONLY there —
+        # every other stage's slot would be dead compute.
+        is_last = idx == L - 1
+
+        def mb_loss(y_):
+            b_m = jax.tree.map(
+                lambda l: l[jnp.clip(mb_f, 0, M - 1)], batch_mb
+            )
+            return mb_loss_fn(y_, b_m)
+
+        def loss_branch(y_):
+            l, g = jax.value_and_grad(mb_loss)(y_)
+            return l.astype(jnp.float32), g
+
+        mb_l, dy = lax.cond(
+            is_last,
+            loss_branch,
+            lambda y_: (jnp.zeros((), jnp.float32), jnp.zeros_like(y_)),
+            y,
+        )
+        take_loss = f_active & is_last
+        loss = loss + jnp.where(take_loss, mb_l, 0.0)
+        # ---- backward slot ------------------------------------------------
+        mb_b = t - 2 * (L - 1) + idx
+        b_active = (mb_b >= 0) & (mb_b < M)
+        # at the last stage the bwd microbatch IS the fwd one (same tick):
+        # its cotangent is dy computed above; other stages take the rotated
+        # cotangent register
+        cot = jnp.where(is_last, dy.astype(act_dtype), cot_in)
+        saved = ring[jnp.clip(mb_b, 0, M - 1) % nbuf]
+        dp, dx = bwd_one(saved, cot)
+        dparams = jax.tree.map(
+            lambda a, g: a + jnp.where(b_active, g, jnp.zeros_like(g)),
+            dparams, dp,
+        )
+        dx = jnp.where(b_active, dx, jnp.zeros_like(dx))
+        # ---- rotate registers --------------------------------------------
+        fwd_perm = [(i, (i + 1) % L) for i in range(L)]
+        bwd_perm = [(i, (i - 1) % L) for i in range(L)]
+        act_in = lax.ppermute(y, axis_name, fwd_perm)
+        cot_in = lax.ppermute(dx, axis_name, bwd_perm)
+        return act_in, cot_in, ring, dparams, loss
+
+    act0 = jnp.zeros(act_shape, act_dtype)
+    ring0 = jnp.zeros((nbuf,) + act_shape, act_dtype)
+    carry = (act0, act0, ring0, dparams0, jnp.zeros((), jnp.float32))
+    _, _, _, dparams, loss = lax.fori_loop(0, n_ticks, body, carry)
+    # loss lives on the last stage only; grads are mean-of-microbatches
+    loss = lax.psum(loss, axis_name) / M
+    dparams = jax.tree.map(lambda g: g / M, dparams)
+    return loss, dparams
+
+
 def make_pp_train_step(
     stage_fn: Callable,
     stage_params_list,
     *,
     mesh: jax.sharding.Mesh,
-    loss_fn: Callable,
+    loss_fn: Optional[Callable] = None,
     n_microbatches: int,
     lr: float = 0.01,
     momentum: float = 0.9,
     axis_name: str = PP_AXIS,
     donate: bool = True,
+    schedule: str = "gpipe",
+    mb_loss_fn: Optional[Callable] = None,
 ) -> PpTrainStep:
     """Jitted pipeline-parallel train step.
 
     ``stage_fn(stage_params, x) -> y`` — one stage's forward (all stages
-    share an architecture). ``loss_fn(final_outputs, batch) -> scalar``
-    consumes the depiped outputs ``[M, mb, ...]`` plus the original batch.
-    ``stage_params_list``: per-stage parameter pytrees (length = pp size).
+    share an architecture). ``stage_params_list``: per-stage parameter
+    pytrees (length = pp size).
+
+    ``schedule='gpipe'``: autodiff through the forward pipeline;
+    ``loss_fn(final_outputs, batch) -> scalar`` consumes the depiped
+    outputs ``[M, mb, ...]``. ``schedule='1f1b'``: hand-orchestrated
+    interleaved backward (`one_f_one_b`) with O(L) activation residency;
+    requires ``mb_loss_fn(y_m, batch_m) -> scalar`` (per-microbatch loss
+    on the pre-sliced batch pytree; the training loss is their mean).
     """
     n_stages = mesh.shape[axis_name]
     if len(stage_params_list) != n_stages:
         raise ValueError(
             f"{len(stage_params_list)} stages for pp={n_stages} mesh axis"
         )
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be 'gpipe' or '1f1b', got "
+                         f"{schedule!r}")
+    if schedule == "1f1b" and mb_loss_fn is None:
+        raise ValueError("schedule='1f1b' needs mb_loss_fn (per-microbatch)")
+    if schedule == "gpipe" and loss_fn is None:
+        raise ValueError("schedule='gpipe' needs loss_fn")
     # specs only need shapes — don't materialize a stacked copy here
     stacked_shape = jax.eval_shape(stack_stage_params, stage_params_list)
     pspec = jax.tree.map(lambda _: jax.P(axis_name), stacked_shape)
@@ -163,35 +313,57 @@ def make_pp_train_step(
         )
         return jax.tree.map(jax.device_put, state, state_shardings)
 
-    def device_loss(stacked_block, batch):
-        # this device's stage params: strip the (length-1) stage dim of the
-        # sharded block
-        my_params = jax.tree.map(lambda l: l[0], stacked_block)
+    def _microbatches(batch):
         x = batch[0]
         M = n_microbatches
         if x.shape[0] % M:
             raise ValueError(
                 f"batch ({x.shape[0]}) must divide by n_microbatches ({M})"
             )
-        xm = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        return x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+    def device_loss(stacked_block, batch):
+        # this device's stage params: strip the (length-1) stage dim of the
+        # sharded block
+        my_params = jax.tree.map(lambda l: l[0], stacked_block)
+        xm = _microbatches(batch)
         outs = pipeline_apply(
             stage_fn, my_params, xm, n_stages=n_stages, axis_name=axis_name
         )
-        flat = outs.reshape((x.shape[0],) + outs.shape[2:])
+        flat = outs.reshape((outs.shape[0] * outs.shape[1],) + outs.shape[2:])
         return loss_fn(flat, batch)
 
+    def device_1f1b(stacked_block, batch):
+        my_params = jax.tree.map(lambda l: l[0], stacked_block)
+        loss, dparams = one_f_one_b(
+            stage_fn, my_params, _microbatches(batch), mb_loss_fn, batch,
+            n_stages=n_stages, axis_name=axis_name,
+        )
+        # re-add the (length-1) stage dim so grads shard like the params
+        return loss, jax.tree.map(lambda l: l[None], dparams)
+
     def _step(state: PpState, batch):
-        def total_loss(params):
+        if schedule == "1f1b":
             mapped = jax.shard_map(
-                device_loss,
+                device_1f1b,
                 mesh=mesh,
                 in_specs=(pspec, jax.P()),
-                out_specs=jax.P(),
+                out_specs=(jax.P(), pspec),
                 check_vma=False,
             )
-            return mapped(params, batch)
+            loss, grads = mapped(state.params, batch)
+        else:
+            def total_loss(params):
+                mapped = jax.shard_map(
+                    device_loss,
+                    mesh=mesh,
+                    in_specs=(pspec, jax.P()),
+                    out_specs=jax.P(),
+                    check_vma=False,
+                )
+                return mapped(params, batch)
 
-        loss, grads = jax.value_and_grad(total_loss)(state.params)
+            loss, grads = jax.value_and_grad(total_loss)(state.params)
         new_p, new_m = sgd_momentum_tree_update(
             state.params, state.momentum, grads, lr=lr, momentum=momentum
         )

@@ -117,6 +117,101 @@ def test_pipeline_training_matches_sequential_trajectory():
     assert got[-1] < got[0]
 
 
+def _mb_loss_fn(y_m, batch_m):
+    _, ym = batch_m  # the framework pre-slices every batch leaf
+    return jnp.mean((y_m - ym) ** 2)
+
+
+def test_1f1b_matches_sequential_loss_and_grads():
+    """The hand-orchestrated 1F1B backward (O(L) activation residency)
+    produces the same loss and gradients as autodiff'd GPipe/sequential."""
+    stages, batch = _problem()
+    want_loss = _sequential_loss(stages, batch)
+    want_grads = jax.grad(lambda s: _sequential_loss(s, batch))(stages)
+
+    lr = 0.1
+    ts = PP.make_pp_train_step(
+        _stage_fn, stages, mesh=_mesh(), schedule="1f1b",
+        mb_loss_fn=_mb_loss_fn, n_microbatches=MB, lr=lr, momentum=0.0,
+        donate=False,
+    )
+    state = ts.init(stages)
+    st2, m = ts.step(state, batch)
+    np.testing.assert_allclose(float(m["loss"]), float(want_loss),
+                               rtol=1e-5)
+    for i in range(N_STAGES):
+        got_delta = (
+            np.asarray(st2.params["w"][i]) - np.asarray(stages[i]["w"])
+        )
+        want_delta = -lr * np.asarray(want_grads[i]["w"])
+        np.testing.assert_allclose(got_delta, want_delta, rtol=1e-4,
+                                   atol=1e-6)
+        got_db = (
+            np.asarray(st2.params["b"][i]) - np.asarray(stages[i]["b"])
+        )
+        np.testing.assert_allclose(got_db, -lr * np.asarray(
+            want_grads[i]["b"]), rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_training_trajectory_matches_gpipe():
+    stages, batch = _problem()
+    lr, mom, steps = 0.05, 0.9, 4
+    common = dict(mesh=_mesh(), n_microbatches=MB, lr=lr, momentum=mom,
+                  donate=False)
+    ts_g = PP.make_pp_train_step(_stage_fn, stages, loss_fn=_loss_fn,
+                                 **common)
+    ts_i = PP.make_pp_train_step(_stage_fn, stages, schedule="1f1b",
+                                 mb_loss_fn=_mb_loss_fn, **common)
+    sg, si = ts_g.init(stages), ts_i.init(stages)
+    for _ in range(steps):
+        sg, mg = ts_g.step(sg, batch)
+        si, mi = ts_i.step(si, batch)
+        np.testing.assert_allclose(float(mi["loss"]), float(mg["loss"]),
+                                   rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        si.params, sg.params,
+    )
+
+
+def test_1f1b_deep_pipeline_many_microbatches():
+    """M > L (the regime 1F1B exists for: residency stays O(L) while M
+    grows): gradients still match the sequential reference."""
+    stages, batch = _problem()
+    M = 8  # batch 8 -> microbatch size 1, M twice the stage count
+    want_grads = jax.grad(lambda s: _sequential_loss(s, batch))(stages)
+    lr = 0.1
+    ts = PP.make_pp_train_step(
+        _stage_fn, stages, mesh=_mesh(), schedule="1f1b",
+        mb_loss_fn=_mb_loss_fn, n_microbatches=M, lr=lr, momentum=0.0,
+        donate=False,
+    )
+    st2, m = ts.step(ts.init(stages), batch)
+    np.testing.assert_allclose(
+        float(m["loss"]), float(_sequential_loss(stages, batch)), rtol=1e-5
+    )
+    for i in range(N_STAGES):
+        got = np.asarray(st2.params["w"][i]) - np.asarray(stages[i]["w"])
+        np.testing.assert_allclose(got, -lr * np.asarray(
+            want_grads[i]["w"]), rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_option_validation():
+    stages, _ = _problem()
+    with pytest.raises(ValueError, match="mb_loss_fn"):
+        PP.make_pp_train_step(_stage_fn, stages, mesh=_mesh(),
+                              schedule="1f1b", n_microbatches=MB)
+    with pytest.raises(ValueError, match="loss_fn"):
+        PP.make_pp_train_step(_stage_fn, stages, mesh=_mesh(),
+                              n_microbatches=MB)
+    with pytest.raises(ValueError, match="schedule"):
+        PP.make_pp_train_step(_stage_fn, stages, mesh=_mesh(),
+                              schedule="zb", loss_fn=_loss_fn,
+                              n_microbatches=MB)
+
+
 def test_pipeline_rejects_bad_shapes():
     stages, batch = _problem()
     with pytest.raises(ValueError, match="stages"):
