@@ -262,6 +262,7 @@ def make_pp_train_step(
     donate: bool = True,
     schedule: str = "gpipe",
     mb_loss_fn: Optional[Callable] = None,
+    dp_axis: Optional[str] = None,
 ) -> PpTrainStep:
     """Jitted pipeline-parallel train step.
 
@@ -275,6 +276,12 @@ def make_pp_train_step(
     interleaved backward (`one_f_one_b`) with O(L) activation residency;
     requires ``mb_loss_fn(y_m, batch_m) -> scalar`` (per-microbatch loss
     on the pre-sliced batch pytree; the training loss is their mean).
+
+    ``dp_axis``: compose with data parallelism on a (dp, pp) mesh — the
+    batch's leading dim shards over ``dp_axis`` (each dp row runs its own
+    pipeline over its replica of the stage weights; per-device batch/
+    microbatch sizes are the PER-REPLICA ones), losses and stage gradients
+    average across dp rows.
     """
     n_stages = mesh.shape[axis_name]
     if len(stage_params_list) != n_stages:
@@ -322,6 +329,8 @@ def make_pp_train_step(
             )
         return x.reshape((M, x.shape[0] // M) + x.shape[1:])
 
+    batch_spec = jax.P(dp_axis) if dp_axis else jax.P()
+
     def device_loss(stacked_block, batch):
         # this device's stage params: strip the (length-1) stage dim of the
         # sharded block
@@ -331,7 +340,10 @@ def make_pp_train_step(
             stage_fn, my_params, xm, n_stages=n_stages, axis_name=axis_name
         )
         flat = outs.reshape((outs.shape[0] * outs.shape[1],) + outs.shape[2:])
-        return loss_fn(flat, batch)
+        loss = loss_fn(flat, batch)
+        # dp rows saw different batch shards: the training loss (and, via
+        # AD of this pmean, the stage gradients) average across them
+        return lax.pmean(loss, dp_axis) if dp_axis else loss
 
     def device_1f1b(stacked_block, batch):
         my_params = jax.tree.map(lambda l: l[0], stacked_block)
@@ -339,6 +351,11 @@ def make_pp_train_step(
             stage_fn, my_params, _microbatches(batch), mb_loss_fn, batch,
             n_stages=n_stages, axis_name=axis_name,
         )
+        if dp_axis:  # manual backward: average the replicas explicitly
+            loss = lax.pmean(loss, dp_axis)
+            dparams = jax.tree.map(
+                lambda g: lax.pmean(g, dp_axis), dparams
+            )
         # re-add the (length-1) stage dim so grads shard like the params
         return loss, jax.tree.map(lambda l: l[None], dparams)
 
@@ -347,7 +364,7 @@ def make_pp_train_step(
             mapped = jax.shard_map(
                 device_1f1b,
                 mesh=mesh,
-                in_specs=(pspec, jax.P()),
+                in_specs=(pspec, batch_spec),
                 out_specs=(jax.P(), pspec),
                 check_vma=False,
             )
@@ -357,7 +374,7 @@ def make_pp_train_step(
                 mapped = jax.shard_map(
                     device_loss,
                     mesh=mesh,
-                    in_specs=(pspec, jax.P()),
+                    in_specs=(pspec, batch_spec),
                     out_specs=jax.P(),
                     check_vma=False,
                 )

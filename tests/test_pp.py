@@ -198,6 +198,33 @@ def test_1f1b_deep_pipeline_many_microbatches():
             want_grads[i]["w"]), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_dp_pp_composition_matches_sequential(schedule):
+    """(dp=2, pp=4) mesh: each dp row pipelines its batch shard; losses and
+    stage grads average across rows — equal to sequential full-batch."""
+    stages, batch = _problem()
+    want_loss = _sequential_loss(stages, batch)
+    want_grads = jax.grad(lambda s: _sequential_loss(s, batch))(stages)
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = jax.sharding.Mesh(devs, ("dp", PP.PP_AXIS))
+    lr = 0.1
+    kw = dict(mesh=mesh, n_microbatches=MB, lr=lr, momentum=0.0,
+              donate=False, dp_axis="dp")
+    if schedule == "1f1b":
+        kw.update(schedule="1f1b", mb_loss_fn=_mb_loss_fn)
+    else:
+        kw.update(loss_fn=_loss_fn)
+    ts = PP.make_pp_train_step(_stage_fn, stages, **kw)
+    st2, m = ts.step(ts.init(stages), batch)
+    np.testing.assert_allclose(float(m["loss"]), float(want_loss),
+                               rtol=1e-5)
+    for i in range(N_STAGES):
+        got = np.asarray(st2.params["w"][i]) - np.asarray(stages[i]["w"])
+        np.testing.assert_allclose(got, -lr * np.asarray(
+            want_grads[i]["w"]), rtol=1e-4, atol=1e-6)
+
+
 def test_1f1b_option_validation():
     stages, _ = _problem()
     with pytest.raises(ValueError, match="mb_loss_fn"):
