@@ -13,9 +13,10 @@ stages. Autodiff runs backward through the loop (the transpose of
 `ppermute` is the reverse rotation), so the backward pipeline falls out of
 the forward program — no hand-written 1F1B schedule, XLA owns the overlap.
 
-Composes with dp: put 'pp' innermost in the mesh and shard the batch over
-'dp' as usual; gradients for stage weights stay per-stage (no reduction
-over 'pp'), reduce over 'dp' automatically via the partitioner.
+Composes with dp EXPLICITLY: pass ``dp_axis='dp'`` on a (dp, pp) mesh —
+each dp row pipelines its own batch shard over its stage-weight replica,
+and losses/stage-gradients average across rows. (Without ``dp_axis`` the
+batch is treated as replicated and every row does the full work.)
 """
 
 from __future__ import annotations
@@ -295,6 +296,15 @@ def make_pp_train_step(
         raise ValueError("schedule='1f1b' needs mb_loss_fn (per-microbatch)")
     if schedule == "gpipe" and loss_fn is None:
         raise ValueError("schedule='gpipe' needs loss_fn")
+    if dp_axis is not None:
+        if dp_axis == axis_name:
+            raise ValueError(
+                f"dp_axis must differ from the pipeline axis {axis_name!r}"
+            )
+        if dp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"dp_axis {dp_axis!r} not in mesh axes {mesh.axis_names}"
+            )
     # specs only need shapes — don't materialize a stacked copy here
     stacked_shape = jax.eval_shape(stack_stage_params, stage_params_list)
     pspec = jax.tree.map(lambda _: jax.P(axis_name), stacked_shape)

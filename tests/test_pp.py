@@ -237,6 +237,14 @@ def test_1f1b_option_validation():
         PP.make_pp_train_step(_stage_fn, stages, mesh=_mesh(),
                               schedule="zb", loss_fn=_loss_fn,
                               n_microbatches=MB)
+    with pytest.raises(ValueError, match="dp_axis"):
+        PP.make_pp_train_step(_stage_fn, stages, mesh=_mesh(),
+                              loss_fn=_loss_fn, n_microbatches=MB,
+                              dp_axis=PP.PP_AXIS)
+    with pytest.raises(ValueError, match="mesh axes"):
+        PP.make_pp_train_step(_stage_fn, stages, mesh=_mesh(),
+                              loss_fn=_loss_fn, n_microbatches=MB,
+                              dp_axis="nope")
 
 
 def test_pipeline_rejects_bad_shapes():
