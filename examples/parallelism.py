@@ -2,7 +2,9 @@
 
 The reference is data-parallel only; this framework adds the model-sharding
 axes, each the XLA-native way. This example trains the same tiny BERT (or a
-stage-MLP for pp, a routed MLP for ep) under the axis you pick:
+stage-MLP for pp — 'pp-1f1b' runs the same pipeline under the interleaved
+1F1B schedule with O(depth) activation residency — a routed MLP for ep)
+under the axis you pick:
 
   dp   DeAR decoupled RS+AG over a 1-D mesh (ZeRO-1 sharded masters)
   sp   dp x sp: sequence sharded over 'sp', ring attention in the model
@@ -27,7 +29,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main(argv=None) -> float:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--axis", choices=["dp", "sp", "tp", "pp", "ep"],
+    ap.add_argument("--axis",
+                    choices=["dp", "sp", "tp", "pp", "pp-1f1b", "ep"],
                     default="dp")
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--emulate", type=int, default=8,
@@ -153,7 +156,7 @@ def main(argv=None) -> float:
             state, m = ts.step(state, batch)
             losses.append(float(m["loss"]))
 
-    elif args.axis == "pp":
+    elif args.axis in ("pp", "pp-1f1b"):
         mesh = jax.sharding.Mesh(
             np.asarray(jax.devices()).reshape(n), (PP.PP_AXIS,)
         )
@@ -166,10 +169,15 @@ def main(argv=None) -> float:
         ]
         x = jax.random.normal(jax.random.fold_in(key, 100), (8, width))
         y = jax.random.normal(jax.random.fold_in(key, 101), (8, width))
+        if args.axis == "pp-1f1b":
+            # interleaved schedule: O(depth) activation residency
+            sched = dict(schedule="1f1b",
+                         mb_loss_fn=lambda o, bm: jnp.mean((o - bm[1]) ** 2))
+        else:
+            sched = dict(loss_fn=lambda o, b: jnp.mean((o - b[1]) ** 2))
         ts = make_pp_train_step(
             lambda p, t: jnp.tanh(t @ p["w"] + p["b"]), stages, mesh=mesh,
-            loss_fn=lambda o, b: jnp.mean((o - b[1]) ** 2),
-            n_microbatches=2, lr=0.05,
+            n_microbatches=2, lr=0.05, **sched,
         )
         state = ts.init(stages)
         for _ in range(args.steps):
@@ -199,7 +207,7 @@ def main(argv=None) -> float:
 
     print(f"[{args.axis}] losses: " + " ".join(f"{v:.4f}" for v in losses))
     assert all(np.isfinite(losses))
-    return losses[-1]
+    return losses
 
 
 if __name__ == "__main__":

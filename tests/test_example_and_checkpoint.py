@@ -216,10 +216,11 @@ def test_compressed_multi_axis_rejected():
         )
 
 
-@pytest.mark.parametrize("axis", ["tp", "pp", "ep"])
+@pytest.mark.parametrize("axis", ["tp", "pp", "pp-1f1b", "ep"])
 def test_parallelism_example_smoke(axis):
     """examples/parallelism.py runs and improves for the model-sharding
     axes (dp/sp are covered end-to-end elsewhere)."""
     m = _load_example("parallelism.py")
-    final = m.main(["--axis", axis, "--steps", "3"])
-    assert np.isfinite(final)
+    losses = m.main(["--axis", axis, "--steps", "4"])
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0]  # actually trains, not just runs
