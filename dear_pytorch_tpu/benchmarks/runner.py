@@ -391,8 +391,14 @@ def config_from_args(args, *, fp16_comm: bool = True):
         comm_dtype=(jnp.bfloat16
                     if (args.fp16 and fp16_comm and args.mode != "fsdp")
                     else None),
+        # dear mode too: halves the all-gather bytes and matches the fsdp
+        # schedule's precision. bf16-compute kernels see identical inputs
+        # (their own cast becomes the identity); the rare fp32-dtype
+        # submodule (e.g. the BERT NSP head) sees bf16-rounded params — the
+        # same values fsdp mode feeds it
         gather_dtype=(jnp.bfloat16
-                      if (args.fp16 and fp16_comm and args.mode == "fsdp")
+                      if (args.fp16 and fp16_comm
+                          and args.mode in ("dear", "fsdp"))
                       else None),
         rng_seed=42,
         partition_mb=args.partition,

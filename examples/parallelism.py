@@ -27,7 +27,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main(argv=None) -> float:
+def main(argv=None) -> list:
     ap = argparse.ArgumentParser()
     ap.add_argument("--axis",
                     choices=["dp", "sp", "tp", "pp", "pp-1f1b", "ep"],
