@@ -247,6 +247,9 @@ def test_1f1b_uses_less_activation_memory_than_gpipe():
     for M, factor in ((4, 0.7), (32, 0.25)):
         g, i = temp_bytes("gpipe", M), temp_bytes("1f1b", M)
         assert i < factor * g, (M, i, g)
+
+
+def test_1f1b_option_validation():
     stages, _ = _problem()
     with pytest.raises(ValueError, match="mb_loss_fn"):
         PP.make_pp_train_step(_stage_fn, stages, mesh=_mesh(),
