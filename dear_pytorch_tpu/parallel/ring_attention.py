@@ -141,14 +141,18 @@ def ring_attention(
 
 
 def full_attention(q, k, v, *, causal: bool = False,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   kv_mask: Optional[jax.Array] = None) -> jax.Array:
     """Single-device reference attention (same math, no ring) — used by
-    tests and as the Ulysses per-head-group kernel."""
+    tests and as the Ulysses per-head-group kernel. ``kv_mask``: key
+    validity ``[B, S_k]`` (True = attend)."""
     D = q.shape[-1]
     scale = D ** -0.5 if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, _NEG_BIG)
     if causal:
         Sq, Sk = s.shape[-2], s.shape[-1]
         mask = jnp.arange(Sk)[None, :] <= jnp.arange(Sq)[:, None]
@@ -156,6 +160,15 @@ def full_attention(q, k, v, *, causal: bool = False,
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def _additive_to_kv_mask(mask):
+    """Model masks are ADDITIVE ``[B, 1, 1, S]`` (0 = attend, big negative =
+    masked); the sequence-parallel impls want boolean key validity
+    ``[B, S]``."""
+    if mask is None:
+        return None
+    return mask.reshape(mask.shape[0], mask.shape[-1]) > -1.0
 
 
 def make_ring_attention_impl(axis_name: str, causal: bool = False):
@@ -167,11 +180,7 @@ def make_ring_attention_impl(axis_name: str, causal: bool = False):
     blockwise inside the ring (see `ring_attention`)."""
 
     def impl(q, k, v, mask, dropout_rng=None, dropout_rate=0.0, dtype=None):
-        kv_mask = None
-        if mask is not None:
-            # model masks are ADDITIVE [B,1,1,S] (0 = attend, big negative =
-            # masked); ring wants boolean key validity [B, S]
-            kv_mask = mask.reshape(mask.shape[0], mask.shape[-1]) > -1.0
+        kv_mask = _additive_to_kv_mask(mask)
         return ring_attention(q, k, v, axis_name, causal=causal,
                               kv_mask=kv_mask, dropout_rng=dropout_rng,
                               dropout_rate=dropout_rate)
@@ -372,12 +381,35 @@ def make_ring_flash_attention_impl(axis_name: str, causal: bool = False):
         if dropout_rng is not None and dropout_rate > 0.0:
             return fallback(q, k, v, mask, dropout_rng=dropout_rng,
                             dropout_rate=dropout_rate, dtype=dtype)
-        kv_mask = None
-        if mask is not None:
-            # model masks are ADDITIVE [B,1,1,S]; ring wants key validity
-            kv_mask = mask.reshape(mask.shape[0], mask.shape[-1]) > -1.0
         return ring_flash_attention(q, k, v, axis_name, causal=causal,
-                                    kv_mask=kv_mask)
+                                    kv_mask=_additive_to_kv_mask(mask))
+
+    return impl
+
+
+def make_ulysses_attention_impl(axis_name: str, causal: bool = False):
+    """Model-zoo ``attention_impl`` backed by `ulysses_attention` (two
+    all-to-alls instead of a P-step ring; needs heads % P == 0). The local
+    key-padding mask is all-gathered over the axis once (tiny [B, S]
+    bools) so the per-head-group full attention sees global validity.
+    Falls back to the dense-block ring while attention-prob dropout is
+    active (same policy as the flash impl)."""
+    fallback = make_ring_attention_impl(axis_name, causal)
+
+    def impl(q, k, v, mask, dropout_rng=None, dropout_rate=0.0, dtype=None):
+        if dropout_rng is not None and dropout_rate > 0.0:
+            return fallback(q, k, v, mask, dropout_rng=dropout_rng,
+                            dropout_rate=dropout_rate, dtype=dtype)
+        attn_kwargs = {}
+        kvm_local = _additive_to_kv_mask(mask)
+        if kvm_local is not None:
+            attn_kwargs["kv_mask"] = lax.all_gather(
+                kvm_local, axis_name, axis=1, tiled=True
+            )
+        return ulysses_attention(
+            q, k, v, axis_name, causal=causal,
+            attn_fn=partial(full_attention, causal=causal, **attn_kwargs),
+        )
 
     return impl
 
@@ -404,18 +436,16 @@ def ulysses_attention(
         raise ValueError(f"heads ({H}) must divide by axis size ({world})")
 
     def seq_to_heads(x):
-        # [B, S_loc, H, D] -> [B, S_loc, P, H/P, D] -> a2a over P (gathering
-        # sequence, scattering heads) -> [B, S_glob, H/P, D]
-        x = x.reshape(B, S, world, H // world, D)
-        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                           tiled=False)
-        return x.reshape(B, S * world, H // world, D)
+        # [B, S_loc, H, D] -> [B, S_glob, H/P, D]: tiled all-to-all splits
+        # the head axis into P contiguous groups and concatenates the
+        # sequence blocks in axis order (no reshapes; the tiled transpose is
+        # the reverse all-to-all, which keeps AD well-defined)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
 
     def heads_to_seq(x):
-        x = x.reshape(B, world, S, H // world, D)
-        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                           tiled=False)
-        return x.reshape(B, S, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     attn = attn_fn or partial(full_attention, causal=causal, scale=scale)

@@ -27,6 +27,7 @@ from dear_pytorch_tpu.comm.backend import DP_AXIS, SP_AXIS
 from dear_pytorch_tpu.parallel.ring_attention import (
     make_ring_attention_impl,
     make_ring_flash_attention_impl,
+    make_ulysses_attention_impl,
 )
 
 
@@ -133,14 +134,38 @@ def make_sp_bert_loss_fn(model, *, sp_axis: str = SP_AXIS,
     return loss_fn
 
 
-def sp_bert_model(config, sp_axis: str = SP_AXIS, *, flash: bool = False):
-    """A `BertForPreTraining` whose attention runs as a ring over
-    ``sp_axis``. ``flash=True`` uses the Pallas flash kernels per ring
-    block (`make_ring_flash_attention_impl`): O(S_loc·D) attention memory,
-    MXU-tiled blocks; falls back to the dense-block ring while
+_SP_ATTENTION_IMPLS = {
+    "ring": make_ring_attention_impl,
+    "ring_flash": make_ring_flash_attention_impl,
+    "ulysses": make_ulysses_attention_impl,
+}
+
+
+def sp_bert_model(config, sp_axis: str = SP_AXIS, *, flash: bool = False,
+                  attention: Optional[str] = None):
+    """A `BertForPreTraining` whose attention is sequence-parallel over
+    ``sp_axis``. ``attention`` selects the scheme:
+
+      'ring'        dense-block ring (default; supports attention dropout)
+      'ring_flash'  Pallas flash kernels per ring block — O(S_loc·D)
+                    attention memory, MXU-tiled (``flash=True`` shorthand)
+      'ulysses'     two all-to-alls, full attention per head group
+                    (heads % sp == 0)
+
+    The flash/ulysses impls fall back to the dense-block ring while
     attention-prob dropout is active."""
     from dear_pytorch_tpu.models.bert import BertForPreTraining
 
-    impl = (make_ring_flash_attention_impl(sp_axis) if flash
-            else make_ring_attention_impl(sp_axis))
+    if attention is None:
+        attention = "ring_flash" if flash else "ring"
+    elif flash and attention != "ring_flash":
+        raise ValueError(
+            f"flash=True conflicts with attention={attention!r}; pass one"
+        )
+    if attention not in _SP_ATTENTION_IMPLS:
+        raise ValueError(
+            f"attention must be one of {sorted(_SP_ATTENTION_IMPLS)}, "
+            f"got {attention!r}"
+        )
+    impl = _SP_ATTENTION_IMPLS[attention](sp_axis)
     return BertForPreTraining(config, attention_impl=impl)

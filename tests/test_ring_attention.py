@@ -158,6 +158,38 @@ def test_ring_flash_attention_padding_mask(mesh):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ulysses_impl_padding_mask(mesh):
+    """The ulysses impl all-gathers the LOCAL key-padding mask to global
+    validity; fwd must match dense masked attention."""
+    from dear_pytorch_tpu.parallel.ring_attention import (
+        make_ulysses_attention_impl,
+    )
+
+    q, k, v = _qkv(jax.random.PRNGKey(11))
+    kv_mask = jnp.ones((B, S), jnp.bool_).at[:, S - 12:].set(False)
+    want = full_attention(q, k, v, kv_mask=kv_mask)
+
+    world = mesh.shape[DP_AXIS]
+    impl = make_ulysses_attention_impl(DP_AXIS)
+    # additive model-mask shard [B, 1, 1, S_loc] (0 = attend, -1e9 = masked)
+    add = jnp.where(kv_mask, 0.0, -1e9)[:, None, None, :]
+    adds = add.reshape(B, 1, 1, world, S // world).transpose(3, 0, 1, 2, 4)
+
+    def fn(qb, kb, vb, mb):
+        return impl(qb[0], kb[0], vb[0], mb[0])[None]
+
+    qs, ks, vs = (_shard_seq(x, world) for x in (q, k, v))
+    mapped = jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.P(DP_AXIS),) * 4,
+        out_specs=jax.P(DP_AXIS),
+        check_vma=False,
+    ))
+    got = _unshard_seq(mapped(qs, ks, vs, adds))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ring_attention_gradients(mesh):
     """d(loss)/dq through the ring (ppermute/fori_loop transpose) equals the
     full-attention gradient."""

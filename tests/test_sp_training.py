@@ -70,8 +70,8 @@ def _dense_baseline(params, batch, steps, lr=0.05, momentum=0.9):
     return losses
 
 
-@pytest.mark.parametrize("flash", [False, True])
-def test_sp_bert_training_matches_dense(mesh2d, flash):
+@pytest.mark.parametrize("attention", ["ring", "ring_flash", "ulysses"])
+def test_sp_bert_training_matches_dense(mesh2d, attention):
     batch = _batch()
     dense_model = BertForPreTraining(CFG)
     params = dense_model.init(
@@ -80,7 +80,7 @@ def test_sp_bert_training_matches_dense(mesh2d, flash):
 
     ref_losses = _dense_baseline(params, batch, steps=4)
 
-    sp_model = SP.sp_bert_model(CFG, flash=flash)
+    sp_model = SP.sp_bert_model(CFG, attention=attention)
     loss_fn = SP.make_sp_bert_loss_fn(sp_model, train=False)
 
     ts = build_train_step(
