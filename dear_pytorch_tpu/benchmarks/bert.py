@@ -46,6 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "sequence dim sharded over 'sp' and ring attention "
                         "(or ring-flash with --flash-attention) inside the "
                         "model; DeAR gradients reduce over both axes")
+    p.add_argument("--sp-attention", type=str, default=None,
+                   choices=["ring", "ring_flash", "ulysses"],
+                   help="sequence-parallel attention scheme (default: "
+                        "ring, or ring_flash with --flash-attention)")
     runner.add_common_args(p)
     p.set_defaults(batch_size=8, base_lr=2e-5, momentum=0.0)
     return p
@@ -56,6 +60,12 @@ def main(argv=None) -> runner.BenchResult:
     runner.apply_platform_env()
     scan_steps = runner.validate_scan_steps(args)  # before any resources
     sp = max(int(args.sp_degree), 1)
+    if args.sp_attention and sp == 1:
+        raise SystemExit("--sp-attention requires --sp-degree > 1")
+    if (args.flash_attention and args.sp_attention
+            and args.sp_attention != "ring_flash"):
+        raise SystemExit("--flash-attention conflicts with "
+                         f"--sp-attention {args.sp_attention}; pass one")
     if sp > 1:
         backend.init()  # bootstrap (multi-host) without fixing the axes:
         # init() is idempotent and another mesh may already be installed
@@ -88,21 +98,23 @@ def main(argv=None) -> runner.BenchResult:
 
         attention_impl = make_flash_attention_impl()
     cfg_over = model.config
-    if args.num_hidden_layers is not None or args.flash_attention:
+    # impls with no attention-prob-dropout path: dropout>0 would silently
+    # measure their dense/ring FALLBACK instead of the requested kernel
+    kernel_attn = (args.flash_attention
+                   or args.sp_attention in ("ring_flash", "ulysses"))
+    if args.num_hidden_layers is not None or kernel_attn:
         import dataclasses
 
         if args.num_hidden_layers is not None:
             cfg_over = dataclasses.replace(
                 cfg_over, num_hidden_layers=args.num_hidden_layers
             )
-        if args.flash_attention and cfg_over.attention_probs_dropout_prob:
-            # the flash impls fall back to dense/ring attention wherever
-            # attention dropout is active — benchmarking the kernel
-            # requires disabling it, and silently measuring the fallback
-            # would be worse than changing the config
-            runner.log("flash-attention: attention_probs_dropout_prob "
+        if kernel_attn and cfg_over.attention_probs_dropout_prob:
+            # benchmarking the kernel requires disabling it, and silently
+            # measuring the fallback would be worse than changing the config
+            runner.log("kernel attention: attention_probs_dropout_prob "
                        f"{cfg_over.attention_probs_dropout_prob} -> 0.0 "
-                       "(kernel has no prob-dropout path)")
+                       "(no prob-dropout path in the requested impl)")
             cfg_over = dataclasses.replace(
                 cfg_over, attention_probs_dropout_prob=0.0
             )
@@ -123,7 +135,8 @@ def main(argv=None) -> runner.BenchResult:
     if sp > 1:
         from dear_pytorch_tpu.parallel import sp as SP
 
-        sp_model = SP.sp_bert_model(cfg, flash=args.flash_attention)
+        sp_model = SP.sp_bert_model(cfg, flash=args.flash_attention,
+                                    attention=args.sp_attention)
         # stage per-leaf: [B, S] leaves shard (dp, sp); [B] leaves (dp,)
         shardings = jax.tree.map(
             lambda s: jax.sharding.NamedSharding(mesh, s),

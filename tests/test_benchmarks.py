@@ -146,6 +146,13 @@ def test_bert_sequence_parallel_cli(mesh, capsys, flash):
     with pytest.raises(SystemExit, match="sentence-len"):
         bert_bench.main(["--model", "bert_base", "--sentence-len", "30",
                          "--sp-degree", "4"] + TINY)
+    with pytest.raises(SystemExit, match="sp-degree"):
+        bert_bench.main(["--model", "bert_base",
+                         "--sp-attention", "ulysses"] + TINY)
+    with pytest.raises(SystemExit, match="conflicts"):
+        bert_bench.main(["--model", "bert_base", "--sp-degree", "4",
+                         "--flash-attention",
+                         "--sp-attention", "ulysses"] + TINY)
 
 
 def test_bert_streaming_pipeline(mesh):
