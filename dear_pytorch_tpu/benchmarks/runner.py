@@ -224,6 +224,10 @@ def add_common_args(parser) -> None:
                              "--pipeline none and no --autotune")
     parser.add_argument("--base-lr", type=float, default=0.01)
     parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--clip-norm", type=float, default=None,
+                        help="clip gradients to this global L2 norm "
+                             "(exact under sharding: shard square-norms "
+                             "psum across the mesh)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="write a jax.profiler trace of the timed "
                              "region here")
@@ -387,6 +391,7 @@ def config_from_args(args, *, fp16_comm: bool = True):
         ),
         lr=args.base_lr,
         momentum=args.momentum,
+        clip_norm=args.clip_norm,
         # fsdp communicates both legs in gather_dtype (RS = gather transpose)
         comm_dtype=(jnp.bfloat16
                     if (args.fp16 and fp16_comm and args.mode != "fsdp")

@@ -59,6 +59,7 @@ class DearConfig:
     momentum: float = 0.9
     weight_decay: float = 0.0
     nesterov: bool = False
+    clip_norm: Optional[float] = None       # global-L2 gradient clipping
 
     # precision
     comm_dtype: Any = None                  # e.g. jnp.bfloat16
@@ -99,7 +100,7 @@ class DearConfig:
     @staticmethod
     def _parse(name: str, raw: str):
         raw = raw.strip()
-        if name in ("threshold_mb",):
+        if name in ("threshold_mb", "clip_norm"):
             return None if raw.lower() in ("none", "") else float(raw)
         if name in ("nearby_layers", "bo_trials", "bo_interval"):
             return None if raw.lower() in ("none", "") else int(raw)
@@ -162,6 +163,7 @@ class DearConfig:
             donate=self.donate,
             partition_mb=self.partition_mb,
             accum_steps=self.accum_steps,
+            clip_norm=self.clip_norm,
         )
 
     def describe(self) -> str:

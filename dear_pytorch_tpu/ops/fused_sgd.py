@@ -94,7 +94,8 @@ def from_optax(tx) -> ShardOptimizer:
     state and updates must depend on each element independently, so running
     on a shard equals running on the full tensor. Cross-parameter transforms
     (e.g. clip_by_global_norm) would silently compute shard-local norms —
-    use schedule mode 'allreduce' with full parameters for those.
+    for global-norm clipping use ``build_train_step(clip_norm=...)``, which
+    psums the shard square-norms for the exact global value.
     """
 
     def init(param):

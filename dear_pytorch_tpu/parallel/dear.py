@@ -183,6 +183,7 @@ def build_train_step(
     partition_mb: float = 4.0,
     accum_steps: int = 1,
     gather_dtype=None,
+    clip_norm: Optional[float] = None,
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -260,6 +261,14 @@ def build_train_step(
         Updates still read the f32 masters. In 'fsdp' mode this also sets
         the reduce-scatter dtype (the RS is the gather's AD transpose), so
         ``comm_dtype`` must be None there.
+      clip_norm: clip gradients to this GLOBAL L2 norm before the update.
+        Exact under sharding: shard-local square-norms psum across the
+        axes, so the scale equals the full-tree norm clip a single device
+        would compute — the cross-parameter reduction `from_optax`
+        explicitly cannot express on shards. Applied to the reduced
+        (averaged) gradient; the per-step norm ships in
+        ``metrics['grad_norm']``. Not supported with compression (the
+        sparse payloads are already a lossy transform of the gradient).
       mean_axes: the axes over which per-device losses are independent
         equal-weight samples (gradients are AVERAGED over these; summed over
         the rest). Defaults to all of ``axis_name``. For dp×sp pass
@@ -334,6 +343,14 @@ def build_train_step(
     if int(accum_steps) != accum_steps or accum_steps < 1:
         raise ValueError(f"accum_steps must be a positive int, got {accum_steps}")
     accum_steps = int(accum_steps)
+    if clip_norm is not None:
+        if compressed:
+            raise ValueError(
+                "clip_norm with compression is unsupported: the sparse "
+                "payloads are already a lossy gradient transform"
+            )
+        if clip_norm <= 0:
+            raise ValueError(f"clip_norm must be positive, got {clip_norm}")
     if momentum_correction and comp.name not in Z.SPARSE:
         raise ValueError(
             "momentum_correction requires a sparse (top-k-family) "
@@ -515,7 +532,7 @@ def build_train_step(
             else F.pack_all(grads, plan, dtype=comm_dtype)
         )
 
-        new_buffers, new_opt, new_comp = [], [], []
+        bucket_grads, new_comp = [], []
         for g, b in enumerate(plan.buckets):
             gbuf = None if mode == "fsdp" else grad_bufs[g]
             if mode == "fsdp":
@@ -619,11 +636,33 @@ def build_train_step(
                 grad = C.broadcast(reduced, 0, axis_name).astype(
                     state.buffers[g].dtype
                 ) / mean_world
-            new_p, new_o = optimizer.update(grad, state.opt_state[g], state.buffers[g])
-            new_buffers.append(new_p)
-            new_opt.append(new_o)
+            bucket_grads.append(grad)
 
         metrics = {"loss": lax.pmean(loss, axis_name)}
+        if clip_norm is not None:
+            sumsq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in bucket_grads
+            )
+            if sharded:
+                # each device holds a DISTINCT shard: psum completes the
+                # global square-norm. (Replicated modes hold identical full
+                # gradients — their local sum already IS the global one.)
+                sumsq = lax.psum(sumsq, axis_name)
+            gnorm = jnp.sqrt(sumsq)
+            scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
+            bucket_grads = [
+                g * scale.astype(g.dtype) for g in bucket_grads
+            ]
+            metrics["grad_norm"] = gnorm
+
+        new_buffers, new_opt = [], []
+        for g, grad in enumerate(bucket_grads):
+            new_p, new_o = optimizer.update(
+                grad, state.opt_state[g], state.buffers[g]
+            )
+            new_buffers.append(new_p)
+            new_opt.append(new_o)
         if aux is not None:
             metrics["aux"] = lax.pmean(aux, axis_name)
         next_state = DearState(

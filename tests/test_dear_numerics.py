@@ -219,6 +219,56 @@ def test_optax_adamw_on_shards(mesh, world, problem):
     )
 
 
+@pytest.mark.parametrize("mode", ["dear", "fsdp", "allreduce"])
+def test_clip_norm_matches_optax_global_clip(mesh, problem, mode):
+    """clip_norm on (sharded) buckets == optax clip_by_global_norm on the
+    full tree: shard-local square-norms psum to the exact global norm."""
+    import optax
+
+    params, batches, _, _ = problem
+    clip = 0.05  # small enough to be active every step
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, mode=mode, threshold_mb=0.0008,
+        optimizer=fused_sgd(lr=0.1, momentum=0.9), clip_norm=clip,
+        donate=False,
+    )
+    state = ts.init(params)
+    norms = []
+    for b in batches:
+        state, m = ts.step(state, b)
+        norms.append(float(m["grad_norm"]))
+    assert all(n > clip for n in norms), norms  # the clip was active
+
+    tx = optax.chain(
+        optax.clip_by_global_norm(clip),
+        optax.trace(decay=0.9),  # torch-style momentum (trace), lr applied
+        optax.scale(-0.1),
+    )
+    opt_state = tx.init(params)
+    p = params
+    for b in batches:
+        g = jax.grad(_loss_fn)(p, b)
+        upd, opt_state = tx.update(g, opt_state, p)
+        p = optax.apply_updates(p, upd)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        ts.gather_params(state), p,
+    )
+
+
+def test_clip_norm_validation(mesh, problem):
+    params, _, _, _ = problem
+    with pytest.raises(ValueError, match="positive"):
+        build_train_step(_loss_fn, params, mesh=mesh, clip_norm=0.0)
+    with pytest.raises(ValueError, match="compression"):
+        build_train_step(
+            _loss_fn, params, mesh=mesh, mode="allreduce",
+            compressor="eftopk", density=0.1, clip_norm=1.0,
+        )
+
+
 def test_optax_lr_schedule_on_shards(mesh, problem):
     """optax schedules (stateful count) work on sharded buffers: the 0-d
     count leaf is replicated by _opt_bucket_specs, per-element state shards
