@@ -81,6 +81,7 @@ def main(argv=None) -> float:
     ts = build_train_step(
         loss_fn, params, mesh=mesh, mode=args.mode,
         threshold_mb=0.05, accum_steps=args.accum_steps,
+        clip_norm=5.0,  # global-norm clipping, exact on shards
         optimizer=fused_sgd(lr=0.05, momentum=0.9), donate=False,
     )
 
@@ -123,7 +124,8 @@ def main(argv=None) -> float:
                 cur += 1
                 if cur % args.log_every == 0:
                     last_loss = float(m["loss"])
-                    ml.log(step=cur, loss=last_loss)
+                    ml.log(step=cur, loss=last_loss,
+                           grad_norm=m["grad_norm"])
                     print(f"step {cur}: loss {last_loss:.4f}")
         finally:
             pipe.close()

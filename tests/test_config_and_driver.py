@@ -37,11 +37,15 @@ def test_config_from_env(monkeypatch):
     monkeypatch.setenv("DEAR_GTOPK", "true")
     monkeypatch.setenv("DEAR_COMM_DTYPE", "bf16")
     monkeypatch.setenv("DEAR_EXCLUDE_PARTS", "")
+    monkeypatch.setenv("DEAR_CLIP_NORM", "1.5")
+    monkeypatch.setenv("DEAR_GATHER_DTYPE", "bf16")
     cfg = DearConfig.from_env()
     assert cfg.mode == "allreduce"
     assert cfg.threshold_mb is None
     assert cfg.compressor == "eftopk" and cfg.density == 0.05 and cfg.gtopk
     assert cfg.comm_dtype is jnp.bfloat16
+    assert cfg.clip_norm == 1.5
+    assert cfg.gather_dtype is jnp.bfloat16
     # overrides beat env
     cfg2 = DearConfig.from_env(mode="dear", compressor=None, gtopk=False)
     assert cfg2.mode == "dear"
