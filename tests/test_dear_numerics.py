@@ -258,6 +258,33 @@ def test_clip_norm_matches_optax_global_clip(mesh, problem, mode):
     )
 
 
+def test_accum_clip_gather_dtype_compose(mesh, problem):
+    """The three newest builder options stack: microbatch accumulation,
+    global-norm clipping of the accumulated gradient, bf16 gathers — and
+    still match the same configuration without accumulation."""
+    params, batches, _, _ = problem
+    common = dict(
+        mesh=mesh, mode="dear", threshold_mb=0.0008, clip_norm=0.05,
+        gather_dtype=jnp.bfloat16,
+        optimizer=fused_sgd(lr=0.1, momentum=0.9), donate=False,
+    )
+    ts1 = build_train_step(_loss_fn, params, **common)
+    ts4 = build_train_step(_loss_fn, params, accum_steps=4, **common)
+    s1, s4 = ts1.init(params), ts4.init(params)
+    for b in batches[:3]:
+        s1, m1 = ts1.step(s1, b)
+        s4, m4 = ts4.step(s4, b)
+        assert float(m4["grad_norm"]) == pytest.approx(
+            float(m1["grad_norm"]), rel=1e-2
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=1e-4
+        ),
+        s1.buffers, s4.buffers,
+    )
+
+
 def test_clip_norm_validation(mesh, problem):
     params, _, _, _ = problem
     with pytest.raises(ValueError, match="positive"):
