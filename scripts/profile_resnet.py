@@ -38,6 +38,8 @@ def timed(fn, *args, warmup=5, iters=20, fetch=None):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--model", type=str, default="resnet50",
+                    help="CNN from the zoo (mnistnet = fast CPU drive)")
     ap.add_argument("--trace-dir", type=str, default=None)
     args = ap.parse_args()
 
@@ -55,22 +57,39 @@ def main():
     print(f"device: {dev.device_kind}  peak bf16: "
           f"{perf_model.device_peak_flops(dev) / 1e12:.0f} TFLOP/s")
 
-    model = models.get_model("resnet50", dtype=jnp.bfloat16)
-    batch = data.synthetic_image_batch(
-        jax.random.PRNGKey(0), args.batch, dtype=jnp.bfloat16
-    )
+    if models.is_bert(args.model):
+        raise SystemExit(f"--model {args.model}: CNN names only "
+                         f"({models.cnn_names()}); this script feeds image "
+                         "batches")
+    model = models.get_model(args.model, dtype=jnp.bfloat16)
+    if args.model.lower() == "mnistnet":
+        batch = data.synthetic_mnist_batch(jax.random.PRNGKey(0), args.batch)
+    else:
+        batch = data.synthetic_image_batch(
+            jax.random.PRNGKey(0), args.batch, dtype=jnp.bfloat16
+        )
     variables = model.init(
         {"params": jax.random.PRNGKey(0)}, batch["image"], train=False
     )
     params = variables["params"]
-    model_state = {"batch_stats": variables["batch_stats"]}
+    has_bn = "batch_stats" in variables
+    model_state = (
+        {"batch_stats": variables["batch_stats"]} if has_bn else None
+    )
 
-    def loss_fn(p, mstate, b):
-        logits, new_state = model.apply(
-            {"params": p, **mstate}, b["image"], train=True,
-            mutable=["batch_stats"],
-        )
-        return data.softmax_xent(logits, b["label"]), new_state
+    if has_bn:
+        def loss_fn(p, mstate, b):
+            logits, new_state = model.apply(
+                {"params": p, **mstate}, b["image"], train=True,
+                mutable=["batch_stats"],
+            )
+            return data.softmax_xent(logits, b["label"]), new_state
+    else:
+        def loss_fn(p, b):
+            # deterministic (no dropout): this script measures schedules,
+            # not regularization
+            logits = model.apply({"params": p}, b["image"], train=False)
+            return data.softmax_xent(logits, b["label"])
 
     # ---- forward only ------------------------------------------------------
     fwd = jax.jit(lambda v, x: model.apply(v, x, train=False))
@@ -79,23 +98,29 @@ def main():
           f"({args.batch / t_fwd:8.1f} img/s)")
 
     # ---- forward + backward (no comm, no optimizer) ------------------------
-    grad_fn = jax.jit(
-        jax.grad(
-            lambda p, ms, b: loss_fn(p, ms, b)[0], argnums=0
+    if has_bn:
+        grad_fn = jax.jit(
+            jax.grad(lambda p, ms, b: loss_fn(p, ms, b)[0], argnums=0)
         )
-    )
-    t_bwd = timed(grad_fn, params, model_state, batch)
+        t_bwd = timed(grad_fn, params, model_state, batch)
+    else:
+        grad_fn = jax.jit(jax.grad(loss_fn, argnums=0))
+        t_bwd = timed(grad_fn, params, batch)
     print(f"fwd+bwd (grads only)  : {t_bwd * 1e3:7.2f} ms "
           f"({args.batch / t_bwd:8.1f} img/s)")
+
+    # one configuration for EVERY build below — the A/B and trace runs must
+    # measure the same step the mode loop does
+    step_kwargs = dict(
+        mesh=mesh, threshold_mb=25.0,
+        optimizer=fused_sgd(lr=0.01, momentum=0.9),
+        comm_dtype=jnp.bfloat16, model_state_template=model_state,
+    )
 
     # ---- full steps per mode ----------------------------------------------
     results = {}
     for mode in ("dear", "allreduce"):
-        ts = D.build_train_step(
-            loss_fn, params, mesh=mesh, mode=mode, threshold_mb=25.0,
-            optimizer=fused_sgd(lr=0.01, momentum=0.9),
-            comm_dtype=jnp.bfloat16, model_state_template=model_state,
-        )
+        ts = D.build_train_step(loss_fn, params, mode=mode, **step_kwargs)
         state = ts.init(params, model_state)
         compiled = ts.lower(state, batch).compile()
         cost = {}
@@ -137,12 +162,30 @@ def main():
         print("  !! host dispatch rate ~= step rate: the TUNNEL/dispatch "
               "path, not the device, likely bounds throughput")
 
+    # ---- scanned-protocol A/B: k steps per dispatch ------------------------
+    # Isolates per-dispatch (tunnel RPC) cost: if per-step time collapses as
+    # k grows, dispatch was the bottleneck; if flat, the device binds.
+    # donate=True like the mode loop: donate=False would add a state-sized
+    # copy per dispatch that amortizes with k exactly like RPC latency,
+    # faking a dispatch-bound signature
+    ts = D.build_train_step(loss_fn, params, mode="dear", **step_kwargs)
+    print("\nscanned protocol (one compiled k-step program per dispatch):")
+    for kk in (1, 4, 10):
+        runner_fn = ts.multi_step(kk)
+        st = ts.init(params, model_state)
+        holder2 = {"s": st, "m": None}
+
+        def stepk():
+            holder2["s"], holder2["m"] = runner_fn(holder2["s"], batch)
+            return holder2["m"]["loss"]
+
+        tk = timed(stepk, warmup=3, iters=max(10 // kk, 3),
+                   fetch=lambda x: float(x))
+        print(f"  k={kk:3d}: {tk / kk * 1e3:7.2f} ms/step "
+              f"({args.batch * kk / tk:8.1f} img/s)")
+
     if args.trace_dir:
-        ts = D.build_train_step(
-            loss_fn, params, mesh=mesh, mode="dear", threshold_mb=25.0,
-            optimizer=fused_sgd(lr=0.01, momentum=0.9),
-            comm_dtype=jnp.bfloat16, model_state_template=model_state,
-        )
+        ts = D.build_train_step(loss_fn, params, mode="dear", **step_kwargs)
         state = ts.init(params, model_state)
         for _ in range(3):
             state, m = ts.step(state, batch)
