@@ -40,9 +40,65 @@ import numpy as np
 # Round-1 pin: ResNet-50 bs=64 bf16 train step, TPU v5 lite (1 chip),
 # ~33.5 ms/step.
 BASELINE_IMG_SEC = 1910.0
-# BERT pin: first driver-captured measurement (this round); vs_baseline is
-# tracked against it from the next round on.
+# BERT pin: pinned automatically to the FIRST successful driver capture
+# found in BENCH_r*.json history (pin-on-first-capture — no manual edit
+# needed when the first on-chip BERT number lands). None until then.
 BASELINE_BERT_SEN_SEC = None
+
+PRIMARY_METRIC = "resnet50_bs64_train_img_sec_per_chip"
+
+
+def _bert_baseline():
+    """First captured bert_base sen/s from BENCH_r*.json history, else the
+    pin. The driver stores each round as {"n", "cmd", "rc", "tail",
+    "parsed"} where "parsed" is our contract line (extra_metrics carries the
+    BERT entry) — pin-on-first-capture without manual edits."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(p))
+        if m:
+            rounds.append((int(m.group(1)), p))
+    for _, path in sorted(rounds):
+        try:
+            with open(path) as f:
+                record = json.load(f)
+            parsed = record.get("parsed") if isinstance(record, dict) else None
+            if not isinstance(parsed, dict):
+                continue
+            candidates = [parsed] + list(parsed.get("extra_metrics") or [])
+            for m in candidates:
+                if (
+                    isinstance(m, dict)
+                    and m.get("metric") == "bert_base_sen_sec_per_chip"
+                    and isinstance(m.get("value"), (int, float))
+                    and m["value"] > 0
+                ):
+                    return float(m["value"])
+        except Exception:
+            continue
+    return BASELINE_BERT_SEN_SEC
+
+
+# The driver contract is ONE JSON line on stdout; the watchdog thread and the
+# main thread may both reach their print under a race (phase completes right
+# at the timeout), so all emission goes through this gate.
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def _emit(out: dict) -> bool:
+    """Print the contract JSON line exactly once, process-wide."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return False
+        _EMITTED = True
+        print(json.dumps(out), flush=True)
+        return True
 
 SMOKE = bool(os.environ.get("DEAR_BENCH_SMOKE"))  # tiny shapes, CPU-safe
 
@@ -203,8 +259,9 @@ def bench_bert(mesh, variant: str = "bert_base"):
     }
     if hbm:
         out["peak_hbm_gb"] = round(hbm / 2**30, 3)
-    if not large and BASELINE_BERT_SEN_SEC:
-        out["vs_baseline"] = round(value / BASELINE_BERT_SEN_SEC, 3)
+    baseline = None if large else _bert_baseline()
+    if baseline:
+        out["vs_baseline"] = round(value / baseline, 3)
     return out
 
 
@@ -242,16 +299,24 @@ class _Watchdog:
                 "down?); aborting\n"
             )
             sys.stderr.flush()
+            err = {
+                "metric": metric,
+                "error": f"watchdog: {phase} wedged after {self.secs:.0f}s",
+            }
             if self.primary is not None:
                 out = dict(self.primary)
-                # keep every secondary metric that already completed
-                out["extra_metrics"] = list(self.extras) + [{
-                    "metric": metric,
-                    "error": f"watchdog: {phase} wedged after "
-                             f"{self.secs:.0f}s",
-                }]
-                print(json.dumps(out), flush=True)
+                # keep every secondary metric that already completed; if the
+                # phase finished right at the timeout its result is already
+                # in extras — don't also report it as wedged
+                done = list(self.extras)
+                if not any(m.get("metric") == metric for m in done):
+                    done.append(err)
+                out["extra_metrics"] = done
+                _emit(out)
                 os._exit(0)
+            # no primary yet: still honor the one-JSON-line contract so a
+            # red round leaves machine-readable evidence, then exit red
+            _emit(dict(err, metric=PRIMARY_METRIC))
             os._exit(3)
 
         self._timer = threading.Timer(self.secs, fire)
@@ -274,8 +339,20 @@ def main() -> None:
     # backend's device init whenever the tunnel is down).
     runner.apply_platform_env()
     dog = _Watchdog()
-    dog.arm("resnet", "resnet50_bs64_train_img_sec_per_chip")
-    mesh = backend.init()
+    dog.arm("resnet", PRIMARY_METRIC)
+    try:
+        mesh = backend.init()
+    except Exception as exc:
+        # a down backend must still yield the contract JSON line (plus a
+        # documented nonzero rc), not a raw traceback
+        dog.disarm()
+        _emit({
+            "metric": PRIMARY_METRIC,
+            "error": f"backend unavailable: "
+                     f"{type(exc).__name__}: {exc}"[:300],
+        })
+        sys.stderr.write(f"bench.py: backend init failed: {exc}\n")
+        return 2
     resnet = bench_resnet(mesh)
     dog.primary = resnet
     dog.arm("bert", "bert_base_sen_sec_per_chip")
@@ -302,7 +379,7 @@ def main() -> None:
     dog.disarm()
     out = dict(resnet)
     out["extra_metrics"] = extras
-    print(json.dumps(out))
+    _emit(out)
 
 
 if __name__ == "__main__":

@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import warnings
 from typing import IO, Optional
 
 import jax
@@ -86,16 +87,26 @@ class MetricsLogger:
 
 
 def read_metrics(path: str) -> list[dict]:
-    """Parse a JSONL metrics file back into records (skips torn last lines
-    from a crash mid-write)."""
+    """Parse a JSONL metrics file back into records.
+
+    A torn FINAL line (crash mid-write) is expected and dropped silently;
+    an undecodable line in the middle of the file means real corruption, so
+    it is reported with its line number rather than vanishing.
+    """
     out = []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if i == len(lines) - 1:
+                continue  # torn last line from a crash: tolerated
+            warnings.warn(
+                f"{path}:{i + 1}: skipping undecodable metrics line ({e})",
+                stacklevel=2,
+            )
     return out

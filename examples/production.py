@@ -37,9 +37,12 @@ def _truncate_metrics(path: str, start: int) -> None:
     from dear_pytorch_tpu.utils import read_metrics
 
     kept = [r for r in read_metrics(path) if r.get("step", 0) <= start]
-    with open(path, "w") as f:
+    # atomic rewrite: a crash mid-truncation must not lose the history
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         for r in kept:
             f.write(json.dumps(r) + "\n")
+    os.replace(tmp, path)
 
 
 def main(argv=None) -> float:
