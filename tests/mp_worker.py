@@ -35,6 +35,12 @@ def main() -> None:
     assert jax.process_count() == n, (jax.process_count(), n)
     assert backend.size() == n and backend.rank() == pid
     assert mesh.shape[backend.DP_AXIS] == jax.device_count()
+    # TPU-pod shape: several addressable devices per process when the
+    # launcher exports DEAR_NUM_CPU_DEVICES (emulating chips-per-host)
+    want_local = int(os.environ.get("DEAR_NUM_CPU_DEVICES") or 1)
+    assert jax.local_device_count() == want_local, (
+        jax.local_device_count(), want_local,
+    )
 
     backend.barrier()  # multi-process sync_global_devices branch
 
@@ -44,6 +50,19 @@ def main() -> None:
     out = dear.broadcast_parameters(params)
     np.testing.assert_allclose(np.asarray(out["w"]), 0.0)
     np.testing.assert_allclose(np.asarray(out["b"]), 1.0)
+
+    # start-state contract for the optimizer too (reference
+    # dear_dopt.py:428-544): host-side state with mixed float/int leaves,
+    # perturbed per rank, must come back as rank 0's everywhere
+    opt_state = {
+        "momentum": {"w": np.full((3, 2), float(pid)),
+                     "b": np.full((2,), float(pid))},
+        "step": np.asarray(pid, np.int32),
+    }
+    synced = dear.broadcast_optimizer_state(opt_state)
+    np.testing.assert_allclose(np.asarray(synced["momentum"]["w"]), 0.0)
+    np.testing.assert_allclose(np.asarray(synced["momentum"]["b"]), 0.0)
+    assert int(synced["step"]) == 0
 
     # host-level allreduce helper (metrics aggregation across processes)
     got = C.allreduce(np.array([1.0 + pid]), average=True)

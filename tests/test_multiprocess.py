@@ -1,9 +1,24 @@
-"""Launch a real 2-process CPU cluster (jax.distributed over localhost) and
-run tests/mp_worker.py in every rank — the CI-able replacement for the
+"""Launch real multi-process CPU clusters (jax.distributed over localhost)
+and run tests/mp_worker.py in every rank — the CI-able replacement for the
 reference's mpirun-only multi-node checks (common/comm_core/tests/
 test_comm.py, runnable only on a GPU cluster). Covers the multi-process
-branches of init/barrier/broadcast_parameters/allreduce and a cross-process
-dear train step."""
+branches of init/barrier/broadcast_parameters/broadcast_optimizer_state/
+allreduce and a cross-process dear train step.
+
+Worlds covered (process_count x local_device_count):
+  - 2 x 1: the minimal real cluster, launched directly.
+  - 4 x 1: >2 processes (ring topologies stop being pairwise), launched
+    through launch/cpu_cluster.sh so the launcher contract itself is
+    exercised (reference equivalent: the 16-host launch surface,
+    pytorch-ddp/launch_torch.sh:24-25).
+  - 2 x 2: multiple ADDRESSABLE devices per process — the TPU-pod shape
+    (one process per host, several chips each); collectives cross both the
+    intra-process and inter-process boundary in one mesh.
+
+Hang safety: pytest-timeout is not installed (its mark would be inert), so
+every subprocess wait carries an explicit deadline and kills the whole
+process group on expiry — a wedged child cannot wedge the suite.
+"""
 
 import os
 import socket
@@ -12,7 +27,7 @@ import sys
 
 import pytest
 
-NPROCS = 2
+DEADLINE = 240  # seconds per cluster run
 
 
 def _free_port() -> int:
@@ -21,20 +36,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.timeout(300)
-def test_two_process_cluster():
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = os.path.join(repo, "tests", "mp_worker.py")
+def _base_env(repo: str) -> dict:
+    env = dict(os.environ)
+    env.pop("DEAR_DISABLE_DISTRIBUTED", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_direct(repo: str, worker: str, nprocs: int, local_devices: int):
+    """Spawn one subprocess per rank with the launcher env contract."""
     port = _free_port()
     procs = []
-    for pid in range(NPROCS):
-        env = dict(os.environ)
-        env.pop("DEAR_DISABLE_DISTRIBUTED", None)
+    for pid in range(nprocs):
+        env = _base_env(repo)
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-        env["JAX_NUM_PROCESSES"] = str(NPROCS)
+        env["JAX_NUM_PROCESSES"] = str(nprocs)
         env["JAX_PROCESS_ID"] = str(pid)
-        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        if local_devices > 1:
+            env["DEAR_NUM_CPU_DEVICES"] = str(local_devices)
         procs.append(
             subprocess.Popen(
                 [sys.executable, worker], env=env,
@@ -44,7 +64,7 @@ def test_two_process_cluster():
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=DEADLINE)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -52,4 +72,44 @@ def test_two_process_cluster():
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {pid} failed:\n{out[-3000:]}"
-        assert f"MP_WORKER_OK rank={pid}/{NPROCS}" in out, out[-3000:]
+        assert f"MP_WORKER_OK rank={pid}/{nprocs}" in out, out[-3000:]
+
+
+def _run_via_launcher(repo: str, worker: str, nprocs: int):
+    """Run the same worker through launch/cpu_cluster.sh (ranks share one
+    output stream), so the launcher's env contract is itself under test."""
+    script = os.path.join(repo, "launch", "cpu_cluster.sh")
+    assert os.access(script, os.X_OK), f"{script} must be executable"
+    try:
+        proc = subprocess.run(
+            [script, str(nprocs), "--", sys.executable, worker],
+            env=_base_env(repo), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=DEADLINE,
+        )
+    except subprocess.TimeoutExpired as e:
+        raise AssertionError(
+            f"cpu_cluster.sh wedged past {DEADLINE}s:\n"
+            f"{(e.stdout or b'')[-3000:]}"
+        ) from e
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    for pid in range(nprocs):
+        assert f"MP_WORKER_OK rank={pid}/{nprocs}" in proc.stdout, (
+            proc.stdout[-3000:]
+        )
+
+
+@pytest.mark.parametrize(
+    "nprocs,local_devices,via_launcher",
+    [
+        pytest.param(2, 1, False, id="2procs"),
+        pytest.param(4, 1, True, id="4procs-cpu_cluster.sh"),
+        pytest.param(2, 2, False, id="2procs-x-2localdev"),
+    ],
+)
+def test_process_cluster(nprocs, local_devices, via_launcher):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "mp_worker.py")
+    if via_launcher:
+        _run_via_launcher(repo, worker, nprocs)
+    else:
+        _run_direct(repo, worker, nprocs, local_devices)
