@@ -102,6 +102,17 @@ def _emit(out: dict) -> bool:
 
 SMOKE = bool(os.environ.get("DEAR_BENCH_SMOKE"))  # tiny shapes, CPU-safe
 
+
+def _gather_dtype():
+    """Master shards are cast to bf16 BEFORE the per-bucket all-gather by
+    default: the model computes in bf16 anyway (its per-layer cast becomes
+    the identity), and the gather leg's bytes halve — on an 8+ chip mesh
+    that is half the AG traffic on ICI, at world=1 half the HBM read.
+    A/B with DEAR_BENCH_GATHER_DTYPE=f32 (keeps the round-2-and-earlier
+    f32 gather)."""
+    v = os.environ.get("DEAR_BENCH_GATHER_DTYPE", "bf16").strip().lower()
+    return None if v in ("f32", "none", "") else jnp.bfloat16
+
 WARMUP_BATCHES = 2 if SMOKE else 10
 NUM_ITERS = 2 if SMOKE else 5
 NUM_BATCHES_PER_ITER = 2 if SMOKE else 10
@@ -180,6 +191,7 @@ def bench_resnet(mesh):
         threshold_mb=25.0,
         optimizer=fused_sgd(lr=0.01, momentum=0.9),
         comm_dtype=jnp.bfloat16,
+        gather_dtype=_gather_dtype(),
         model_state_template=model_state,
     )
     state = ts.init(params, model_state)
@@ -245,6 +257,7 @@ def bench_bert(mesh, variant: str = "bert_base"):
         threshold_mb=25.0,
         optimizer=fused_sgd(lr=2e-5, momentum=0.0),
         comm_dtype=jnp.bfloat16,
+        gather_dtype=_gather_dtype(),
         rng_seed=42,
     )
     state = ts.init(params)

@@ -110,17 +110,21 @@ def main():
           f"({args.batch / t_bwd:8.1f} img/s)")
 
     # one configuration for EVERY build below — the A/B and trace runs must
-    # measure the same step the mode loop does
+    # measure the same step the mode loop does. gather_dtype mirrors
+    # bench.py's default (bf16 pre-gather cast) but only applies to the
+    # sharded schedule; the allreduce baseline rejects it.
     step_kwargs = dict(
         mesh=mesh, threshold_mb=25.0,
         optimizer=fused_sgd(lr=0.01, momentum=0.9),
         comm_dtype=jnp.bfloat16, model_state_template=model_state,
     )
+    dear_kwargs = dict(step_kwargs, gather_dtype=jnp.bfloat16)
 
     # ---- full steps per mode ----------------------------------------------
     results = {}
     for mode in ("dear", "allreduce"):
-        ts = D.build_train_step(loss_fn, params, mode=mode, **step_kwargs)
+        kw = dear_kwargs if mode == "dear" else step_kwargs
+        ts = D.build_train_step(loss_fn, params, mode=mode, **kw)
         state = ts.init(params, model_state)
         compiled = ts.lower(state, batch).compile()
         cost = {}
@@ -168,7 +172,7 @@ def main():
     # donate=True like the mode loop: donate=False would add a state-sized
     # copy per dispatch that amortizes with k exactly like RPC latency,
     # faking a dispatch-bound signature
-    ts = D.build_train_step(loss_fn, params, mode="dear", **step_kwargs)
+    ts = D.build_train_step(loss_fn, params, mode="dear", **dear_kwargs)
     print("\nscanned protocol (one compiled k-step program per dispatch):")
     for kk in (1, 4, 10):
         runner_fn = ts.multi_step(kk)
@@ -185,7 +189,7 @@ def main():
               f"({args.batch * kk / tk:8.1f} img/s)")
 
     if args.trace_dir:
-        ts = D.build_train_step(loss_fn, params, mode="dear", **step_kwargs)
+        ts = D.build_train_step(loss_fn, params, mode="dear", **dear_kwargs)
         state = ts.init(params, model_state)
         for _ in range(3):
             state, m = ts.step(state, batch)
