@@ -224,6 +224,13 @@ def add_common_args(parser) -> None:
                              "--pipeline none and no --autotune")
     parser.add_argument("--base-lr", type=float, default=0.01)
     parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--optimizer", type=str, default="sgd",
+                        choices=["sgd", "adamw"],
+                        help="fused shard-safe optimizer (torch semantics; "
+                             "adamw = real-world BERT pretraining, beyond "
+                             "the reference's SGD-only fused path); betas/"
+                             "eps/weight decay via DEAR_ADAM_BETAS, "
+                             "DEAR_ADAM_EPS, DEAR_WEIGHT_DECAY")
     parser.add_argument("--clip-norm", type=float, default=None,
                         help="clip gradients to this global L2 norm "
                              "(exact under sharding: shard square-norms "
@@ -389,6 +396,7 @@ def config_from_args(args, *, fp16_comm: bool = True):
         momentum_correction=(
             args.momentum_correction if use_compression else 0.0
         ),
+        optimizer_name=getattr(args, "optimizer", "sgd"),
         lr=args.base_lr,
         momentum=args.momentum,
         clip_norm=args.clip_norm,

@@ -134,6 +134,21 @@ def _apply_platform_env() -> None:
             jax.config.update("jax_num_cpu_devices", ndev)
     except Exception as exc:  # backend already initialized: keep it
         logger.debug("platform env not applied: %s", exc)
+    # Persistent compilation cache: the session TPU's first compile costs
+    # 20-40 s per program and its tunnel stays up for short windows, so
+    # recompiling bench/profile programs on every process wastes most of a
+    # window. Default on (/tmp is per-container); disable with
+    # DEAR_COMPILATION_CACHE_DIR=off, redirect by setting a path.
+    cache = os.environ.get("DEAR_COMPILATION_CACHE_DIR",
+                           "/tmp/dear_jax_cache").strip()
+    if cache and cache.lower() not in ("0", "off", "no", "false"):
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5
+            )
+        except Exception as exc:
+            logger.debug("compilation cache not applied: %s", exc)
 
 
 def init(

@@ -55,10 +55,13 @@ class DearConfig:
     momentum_correction: float = 0.0        # DGC mc coefficient (sparse only)
 
     # optimizer
+    optimizer_name: str = "sgd"             # sgd | adamw (fused, shard-safe)
     lr: float = 0.01
     momentum: float = 0.9
     weight_decay: float = 0.0
     nesterov: bool = False
+    adam_betas: tuple = (0.9, 0.999)        # torch.optim.AdamW defaults
+    adam_eps: float = 1e-8
     clip_norm: Optional[float] = None       # global-L2 gradient clipping
 
     # precision
@@ -115,8 +118,12 @@ class DearConfig:
                 )
             return v
         if name in ("lr", "momentum", "weight_decay", "density",
-                    "cycle_time_s", "partition_mb", "momentum_correction"):
+                    "cycle_time_s", "partition_mb", "momentum_correction",
+                    "adam_eps"):
             return float(raw)
+        if name == "adam_betas":
+            b1, b2 = raw.split(",")
+            return (float(b1), float(b2))
         if name in ("gtopk", "nesterov", "donate", "compute_bf16"):
             return raw.lower() in ("1", "true", "yes")
         if name in ("comm_dtype", "gather_dtype"):
@@ -135,8 +142,18 @@ class DearConfig:
     # -- consumption ---------------------------------------------------------
 
     def optimizer(self):
-        from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+        from dear_pytorch_tpu.ops.fused_sgd import fused_adamw, fused_sgd
 
+        if self.optimizer_name == "adamw":
+            return fused_adamw(
+                lr=self.lr, betas=self.adam_betas, eps=self.adam_eps,
+                weight_decay=self.weight_decay,
+            )
+        if self.optimizer_name != "sgd":
+            raise ValueError(
+                f"optimizer_name must be 'sgd' or 'adamw', "
+                f"got {self.optimizer_name!r}"
+            )
         # with momentum correction the LOCAL pre-sparsification velocity
         # carries the momentum; the reference's step likewise bypasses its
         # SGD momentum buffer (wfbp/dopt.py:934-942)

@@ -74,6 +74,60 @@ def fused_sgd(
     return ShardOptimizer(init, update)
 
 
+def fused_adamw(
+    lr: float,
+    betas: tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+) -> ShardOptimizer:
+    """torch.optim.AdamW semantics on flat buffers — the optimizer real BERT
+    pretraining uses, beyond the reference's SGD-only fused path
+    (dear/dear_dopt.py:310-336; its bert_benchmark trains with SGD lr=2e-5,
+    dear/bert_benchmark.py:122). Elementwise, so it runs unchanged on ZeRO
+    shards (exp_avg/exp_avg_sq shard with the params — ZeRO-1's main win,
+    since Adam state is 2x the params).
+
+    p   *= 1 - lr * wd                        (decoupled decay)
+    m    = b1 * m + (1 - b1) * g
+    v    = b2 * v + (1 - b2) * g^2
+    p   -= lr * (m / (1 - b1^t)) / (sqrt(v / (1 - b2^t)) + eps)
+
+    Exactness is pinned against torch.optim.AdamW in
+    tests/test_dear_numerics.py.
+    """
+    b1, b2 = betas
+    if not (0.0 <= b1 < 1.0 and 0.0 <= b2 < 1.0):
+        raise ValueError(f"betas must be in [0, 1), got {betas}")
+
+    def init(param: jax.Array):
+        return (
+            jnp.zeros_like(param),           # exp_avg
+            jnp.zeros_like(param),           # exp_avg_sq
+            jnp.zeros((), jnp.int32),        # step count
+        )
+
+    def update(grad, state, param):
+        m, v, t = state
+        t = t + 1
+        grad = grad.astype(param.dtype)
+        if weight_decay:
+            param = param * (1.0 - lr * weight_decay)
+        # torch updates exp_avg via lerp: m + (1-b1)(g - m) — keep that
+        # form so parity with torch.optim.AdamW is rounding-tight
+        m = m + (1.0 - b1) * (grad - m)
+        v = b2 * v + (1.0 - b2) * jnp.square(grad)
+        # torch's evaluation order exactly (so parity is rounding-tight):
+        # denom = sqrt(v) / sqrt(1 - b2^t) + eps;  p -= (lr / (1 - b1^t)) * m / denom
+        tf = t.astype(param.dtype)
+        bc1 = 1.0 - jnp.asarray(b1, param.dtype) ** tf
+        bc2_sqrt = jnp.sqrt(1.0 - jnp.asarray(b2, param.dtype) ** tf)
+        denom = jnp.sqrt(v) / bc2_sqrt + eps
+        new_param = param - (lr / bc1) * m / denom
+        return new_param, (m, v, t)
+
+    return ShardOptimizer(init, update)
+
+
 def sgd_momentum_tree_update(params, momentum_tree, grads, *, lr: float,
                              momentum: float):
     """(new_params, new_momentum) for pytree-shaped SGD+momentum — the
