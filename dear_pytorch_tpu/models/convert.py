@@ -1,0 +1,144 @@
+"""Torch → JAX checkpoint conversion for BERT — the migration path for
+reference users.
+
+The reference trains HuggingFace ``BertForPreTraining`` (torch) from local
+JSON configs (reference dear/bert_benchmark.py:63-86); anyone switching
+from that stack holds torch state_dicts. `convert_bert_from_torch` maps one
+onto this framework's flax `BertForPreTraining` parameter tree so training
+resumes here, layer for layer:
+
+  - torch ``nn.Linear`` stores ``weight[out, in]``; flax kernels are
+    ``[in, out]`` (and attention projections are DenseGeneral kernels
+    ``[H, heads, head_dim]`` / ``[heads, head_dim, H]``) — transposed and
+    reshaped accordingly.
+  - The MLM decoder is tied to the word embedding in both stacks; only the
+    embedding and the decoder bias are materialized.
+  - The vocab is padded to a multiple of 8 (reference
+    dear/bert_benchmark.py:72-78). Padded embedding rows are zero and the
+    padded decoder-bias entries are -1e9, so the padded ids contribute
+    ~exp(-1e9)=0 to every softmax denominator and the converted model's
+    MLM distribution over REAL tokens equals the torch model's.
+
+Numerical parity of the full forward is pinned in tests/test_convert.py
+against ``transformers`` built from a local config (no network): our gelu
+is the tanh approximation (original BERT's), i.e. HF ``hidden_act:
+"gelu_new"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from dear_pytorch_tpu.models.bert import BertConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like -> float32 numpy (host)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def config_from_hf(hf_config: Any) -> BertConfig:
+    """Our `BertConfig` from a HF BertConfig object or plain dict (the
+    reference's bert_config.json schema)."""
+    get = (
+        hf_config.get if isinstance(hf_config, Mapping)
+        else lambda k, d=None: getattr(hf_config, k, d)
+    )
+    return BertConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        intermediate_size=get("intermediate_size"),
+        max_position_embeddings=get("max_position_embeddings"),
+        type_vocab_size=get("type_vocab_size", 2),
+        hidden_dropout_prob=get("hidden_dropout_prob", 0.1),
+        attention_probs_dropout_prob=get(
+            "attention_probs_dropout_prob", 0.1
+        ),
+        layer_norm_eps=get("layer_norm_eps", 1e-12),
+        initializer_range=get("initializer_range", 0.02),
+    )
+
+
+def convert_bert_from_torch(state_dict: Mapping[str, Any],
+                            cfg: BertConfig) -> dict:
+    """HF ``BertForPreTraining.state_dict()`` -> flax params for
+    `models.bert.BertForPreTraining(cfg)`.
+
+    Accepts torch tensors or arrays. Raises KeyError with the missing HF
+    name if the state_dict is not a BertForPreTraining checkpoint.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    H, nh = cfg.hidden_size, cfg.num_attention_heads
+    d = H // nh
+    Vp = cfg.padded_vocab_size
+
+    def linear(prefix, kernel_shape=None):
+        """torch Linear -> {'kernel','bias'} with optional reshape."""
+        w = sd[prefix + ".weight"].T  # [in, out]
+        b = sd[prefix + ".bias"]
+        if kernel_shape is not None:
+            w = w.reshape(kernel_shape)
+        return {"kernel": w, "bias": b}
+
+    def layernorm(prefix):
+        return {"scale": sd[prefix + ".weight"], "bias": sd[prefix + ".bias"]}
+
+    def embed(name, pad_to=None):
+        e = sd[name]
+        if pad_to is not None and e.shape[0] < pad_to:
+            e = np.concatenate(
+                [e, np.zeros((pad_to - e.shape[0], e.shape[1]), e.dtype)]
+            )
+        return {"embedding": e}
+
+    params = {
+        "word_embeddings": embed(
+            "bert.embeddings.word_embeddings.weight", pad_to=Vp
+        ),
+        "position_embeddings": embed(
+            "bert.embeddings.position_embeddings.weight"
+        ),
+        "token_type_embeddings": embed(
+            "bert.embeddings.token_type_embeddings.weight"
+        ),
+        "embeddings_ln": layernorm("bert.embeddings.LayerNorm"),
+        "mlm_transform": linear("cls.predictions.transform.dense"),
+        "mlm_ln": layernorm("cls.predictions.transform.LayerNorm"),
+        "pooler": linear("bert.pooler.dense"),
+        "nsp_classifier": linear("cls.seq_relationship"),
+    }
+    # decoder bias (decoder weight is tied to the word embedding in both
+    # stacks); padded entries get -1e9 so padded ids vanish from softmax
+    mlm_bias = sd["cls.predictions.bias"]
+    if mlm_bias.shape[0] < Vp:
+        mlm_bias = np.concatenate([
+            mlm_bias,
+            np.full((Vp - mlm_bias.shape[0],), -1e9, mlm_bias.dtype),
+        ])
+    params["mlm_bias"] = mlm_bias
+
+    for i in range(cfg.num_hidden_layers):
+        hf = f"bert.encoder.layer.{i}"
+        attn = {
+            "query": linear(f"{hf}.attention.self.query", (H, nh, d)),
+            "key": linear(f"{hf}.attention.self.key", (H, nh, d)),
+            "value": linear(f"{hf}.attention.self.value", (H, nh, d)),
+            # out-projection consumes (heads, head_dim): kernel [nh, d, H]
+            "output": linear(f"{hf}.attention.output.dense", (nh, d, H)),
+        }
+        for name in ("query", "key", "value"):
+            attn[name]["bias"] = attn[name]["bias"].reshape(nh, d)
+        params[f"layer_{i}"] = {
+            "attention": attn,
+            "attention_ln": layernorm(f"{hf}.attention.output.LayerNorm"),
+            "intermediate": linear(f"{hf}.intermediate.dense"),
+            "output": linear(f"{hf}.output.dense"),
+            "output_ln": layernorm(f"{hf}.output.LayerNorm"),
+        }
+    return params
