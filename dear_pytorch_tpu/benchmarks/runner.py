@@ -225,9 +225,11 @@ def add_common_args(parser) -> None:
     parser.add_argument("--base-lr", type=float, default=0.01)
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--optimizer", type=str, default="sgd",
-                        choices=["sgd", "adamw"],
-                        help="fused shard-safe optimizer (torch semantics; "
-                             "adamw = real-world BERT pretraining, beyond "
+                        choices=["sgd", "adamw", "lamb"],
+                        help="fused shard-safe optimizer (adamw = torch "
+                             "semantics, real-world BERT pretraining; lamb "
+                             "= large-batch BERT with exact per-parameter "
+                             "trust ratios on ZeRO shards — both beyond "
                              "the reference's SGD-only fused path); betas/"
                              "eps/weight decay via DEAR_ADAM_BETAS, "
                              "DEAR_ADAM_EPS, DEAR_WEIGHT_DECAY")

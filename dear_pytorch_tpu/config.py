@@ -55,7 +55,7 @@ class DearConfig:
     momentum_correction: float = 0.0        # DGC mc coefficient (sparse only)
 
     # optimizer
-    optimizer_name: str = "sgd"             # sgd | adamw (fused, shard-safe)
+    optimizer_name: str = "sgd"     # sgd | adamw | lamb (fused, shard-safe)
     lr: float = 0.01
     momentum: float = 0.9
     weight_decay: float = 0.0
@@ -142,16 +142,25 @@ class DearConfig:
     # -- consumption ---------------------------------------------------------
 
     def optimizer(self):
-        from dear_pytorch_tpu.ops.fused_sgd import fused_adamw, fused_sgd
+        from dear_pytorch_tpu.ops.fused_sgd import (
+            fused_adamw,
+            fused_lamb,
+            fused_sgd,
+        )
 
         if self.optimizer_name == "adamw":
             return fused_adamw(
                 lr=self.lr, betas=self.adam_betas, eps=self.adam_eps,
                 weight_decay=self.weight_decay,
             )
+        if self.optimizer_name == "lamb":
+            return fused_lamb(
+                lr=self.lr, betas=self.adam_betas, eps=self.adam_eps,
+                weight_decay=self.weight_decay,
+            )
         if self.optimizer_name != "sgd":
             raise ValueError(
-                f"optimizer_name must be 'sgd' or 'adamw', "
+                f"optimizer_name must be 'sgd', 'adamw' or 'lamb', "
                 f"got {self.optimizer_name!r}"
             )
         # with momentum correction the LOCAL pre-sparsification velocity

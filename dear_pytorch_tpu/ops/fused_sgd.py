@@ -33,6 +33,21 @@ class ShardOptimizer(NamedTuple):
     update: Callable[[jax.Array, Any, jax.Array], tuple[jax.Array, Any]]
 
 
+class LayerwiseShardOptimizer(NamedTuple):
+    """Optimizer needing per-PARAMETER reductions (LAMB trust ratios) on
+    flat buffers. ``update(grad, state, param, seg_ids, num_segments,
+    psum)``: ``seg_ids`` maps each element of this device's buffer (shard)
+    to its bucket-local parameter index (`FusionPlan.segment_ids`), with
+    padding in the trailing dummy segment ``num_segments - 1``; ``psum``
+    completes shard-local segment sums across the mesh (identity when the
+    buffer is replicated). This is how a cross-element statistic stays
+    EXACT under ZeRO sharding — the limitation `from_optax` documents for
+    elementwise-only transforms does not apply here."""
+
+    init: Callable[[jax.Array], Any]
+    update: Callable[..., tuple[jax.Array, Any]]
+
+
 def fused_sgd(
     lr: float,
     momentum: float = 0.0,
@@ -126,6 +141,67 @@ def fused_adamw(
         return new_param, (m, v, t)
 
     return ShardOptimizer(init, update)
+
+
+def fused_lamb(
+    lr: float,
+    betas: tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+) -> LayerwiseShardOptimizer:
+    """LAMB (You et al. 2020, the BERT large-batch optimizer) on flat shard
+    buffers with EXACT per-parameter trust ratios.
+
+    The hard part under ZeRO sharding is that the trust ratio
+    ``||w_layer|| / ||update_layer||`` is a cross-element reduction over a
+    parameter that may span shard boundaries; elementwise adapters
+    (`from_optax`) cannot express it. Here segment-sums over the fusion
+    plan's per-element parameter ids, completed by a psum across the mesh,
+    recover the exact full-parameter norms on every shard:
+
+      m    = b1 m + (1-b1) g;   v = b2 v + (1-b2) g^2
+      u    = m/(1-b1^t) / (sqrt(v/(1-b2^t)) + eps) + wd * w
+      r    = ||w||_seg / ||u||_seg          (1 where either norm is 0)
+      w   -= lr * r[seg] * u
+
+    Bias correction follows the paper's Adam base; padding elements live in
+    a dummy trailing segment and never move (w=0, g=0 -> u=0).
+    """
+    b1, b2 = betas
+
+    def init(param: jax.Array):
+        return (
+            jnp.zeros_like(param),
+            jnp.zeros_like(param),
+            jnp.zeros((), jnp.int32),
+        )
+
+    def update(grad, state, param, seg_ids, num_segments, psum):
+        m, v, t = state
+        t = t + 1
+        grad = grad.astype(param.dtype)
+        m = b1 * m + (1.0 - b1) * grad
+        v = b2 * v + (1.0 - b2) * jnp.square(grad)
+        tf = t.astype(param.dtype)
+        m_hat = m / (1.0 - jnp.asarray(b1, param.dtype) ** tf)
+        v_hat = v / (1.0 - jnp.asarray(b2, param.dtype) ** tf)
+        u = m_hat / (jnp.sqrt(v_hat) + eps)
+        if weight_decay:
+            u = u + weight_decay * param
+        w_sq = psum(jax.ops.segment_sum(
+            jnp.square(param), seg_ids, num_segments
+        ))
+        u_sq = psum(jax.ops.segment_sum(
+            jnp.square(u), seg_ids, num_segments
+        ))
+        w_norm, u_norm = jnp.sqrt(w_sq), jnp.sqrt(u_sq)
+        trust = jnp.where(
+            (w_norm > 0.0) & (u_norm > 0.0), w_norm / jnp.maximum(u_norm, 1e-12), 1.0
+        )
+        new_param = param - lr * trust[seg_ids] * u
+        return new_param, (m, v, t)
+
+    return LayerwiseShardOptimizer(init, update)
 
 
 def sgd_momentum_tree_update(params, momentum_tree, grads, *, lr: float,

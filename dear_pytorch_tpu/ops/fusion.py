@@ -103,6 +103,19 @@ class FusionPlan:
                 return b.index
         raise KeyError(leaf_id)
 
+    def segment_ids(self, bucket: int) -> np.ndarray:
+        """int32[padded_size] mapping each flat-buffer element to its
+        bucket-local parameter index (padding maps to a trailing dummy
+        segment, id == len(leaf_ids)). Static metadata — layerwise
+        optimizers (LAMB trust ratios) use it to compute exact per-parameter
+        norms on shards via segment-sum + psum, even when a parameter spans
+        shard boundaries."""
+        b = self.buckets[bucket]
+        out = np.full((b.padded_size,), len(b.leaf_ids), np.int32)
+        for local, (leaf_id, off) in enumerate(zip(b.leaf_ids, b.offsets)):
+            out[off:off + self.leaves[leaf_id].size] = local
+        return out
+
     def describe(self) -> str:
         lines = [
             f"FusionPlan: {len(self.leaves)} tensors, "

@@ -85,7 +85,11 @@ from dear_pytorch_tpu.comm import collectives as C
 from dear_pytorch_tpu.comm.backend import DP_AXIS
 from dear_pytorch_tpu.ops import compression as Z
 from dear_pytorch_tpu.ops import fusion as F
-from dear_pytorch_tpu.ops.fused_sgd import ShardOptimizer, fused_sgd
+from dear_pytorch_tpu.ops.fused_sgd import (
+    LayerwiseShardOptimizer,
+    ShardOptimizer,
+    fused_sgd,
+)
 
 MODES = ("dear", "allreduce", "rsag", "rb", "bytescheduler", "fsdp")
 #: Ablation switches (reference `exclude_parts`, dear/dear_dopt.py:75-76,
@@ -656,11 +660,32 @@ def build_train_step(
             ]
             metrics["grad_norm"] = gnorm
 
+        layerwise = isinstance(optimizer, LayerwiseShardOptimizer)
         new_buffers, new_opt = [], []
         for g, grad in enumerate(bucket_grads):
-            new_p, new_o = optimizer.update(
-                grad, state.opt_state[g], state.buffers[g]
-            )
+            if layerwise:
+                # per-parameter segment metadata for exact cross-shard
+                # reductions (LAMB trust ratios): this device's slice of the
+                # bucket's element->parameter map, plus the psum completing
+                # shard-local segment sums (identity when replicated)
+                b = plan.buckets[g]
+                seg_full = jnp.asarray(plan.segment_ids(g))
+                if sharded:
+                    seg = lax.dynamic_slice_in_dim(
+                        seg_full, idx * b.shard_size, b.shard_size
+                    )
+                    psum = lambda x: lax.psum(x, axis_name)  # noqa: E731
+                else:
+                    seg = seg_full
+                    psum = lambda x: x  # noqa: E731
+                new_p, new_o = optimizer.update(
+                    grad, state.opt_state[g], state.buffers[g],
+                    seg, len(b.leaf_ids) + 1, psum,
+                )
+            else:
+                new_p, new_o = optimizer.update(
+                    grad, state.opt_state[g], state.buffers[g]
+                )
             new_buffers.append(new_p)
             new_opt.append(new_o)
         if aux is not None:

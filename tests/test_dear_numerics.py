@@ -677,3 +677,67 @@ def test_adamw_dear_schedule_matches_single_device(mesh, world):
         ),
         ts.gather_params(state), cur,
     )
+
+
+def test_lamb_sharded_trust_ratios_exact(mesh, world):
+    """fused_lamb on the dear schedule: per-parameter trust ratios must be
+    EXACT even though every parameter spans shard boundaries (world devices
+    each own 1/world of each bucket). Pinned against a per-leaf
+    single-device LAMB written directly from the paper."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_lamb
+
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-6, 0.05
+    params = _mlp_params(jax.random.PRNGKey(5))
+    batches = [_data(jax.random.PRNGKey(300 + i)) for i in range(4)]
+
+    # single-device reference: leaf-shaped state, python floats for norms
+    cur = jax.tree.map(lambda x: np.asarray(x, np.float64), params)
+    m_tree = jax.tree.map(np.zeros_like, cur)
+    v_tree = jax.tree.map(np.zeros_like, cur)
+    ref_losses = []
+    for t, b in enumerate(batches, start=1):
+        loss, grads = jax.value_and_grad(_loss_fn)(
+            jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), cur), b
+        )
+        ref_losses.append(float(loss))
+        grads = jax.tree.map(lambda g: np.asarray(g, np.float64), grads)
+
+        def upd(p, g, m, v):
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mh = m / (1 - b1 ** t)
+            vh = v / (1 - b2 ** t)
+            u = mh / (np.sqrt(vh) + eps) + wd * p
+            wn, un = np.linalg.norm(p), np.linalg.norm(u)
+            trust = wn / max(un, 1e-12) if (wn > 0 and un > 0) else 1.0
+            return p - lr * trust * u, m, v
+
+        flat_p, treedef = jax.tree_util.tree_flatten(cur)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_m = jax.tree_util.tree_leaves(m_tree)
+        flat_v = jax.tree_util.tree_leaves(v_tree)
+        out = [upd(p, g, m, v) for p, g, m, v
+               in zip(flat_p, flat_g, flat_m, flat_v)]
+        cur = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+        m_tree = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        v_tree = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
+
+    ts = build_train_step(
+        _loss_fn, params,
+        optimizer=fused_lamb(lr=lr, betas=(b1, b2), eps=eps,
+                             weight_decay=wd),
+        mesh=mesh, mode="dear", threshold_mb=0.0008, donate=False,
+    )
+    assert ts.plan.num_buckets >= 2
+    state = ts.init(params)
+    losses = []
+    for b in batches:
+        state, mtr = ts.step(state, b)
+        losses.append(float(mtr["loss"]))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        ts.gather_params(state), cur,
+    )
