@@ -65,6 +65,59 @@ def config_from_hf(hf_config: Any) -> BertConfig:
     )
 
 
+def convert_resnet_from_torch(state_dict: Mapping[str, Any],
+                              stage_sizes: tuple[int, ...] = (3, 4, 6, 3),
+                              ) -> tuple[dict, dict]:
+    """torchvision ResNet ``state_dict()`` -> ``(params, batch_stats)`` for
+    `models.resnet.ResNet` (the reference's headline CNN is torchvision
+    resnet50, reference dear/imagenet_benchmark.py:88-95;
+    benchmarks.py:21-28).
+
+    Layout mapping: torch conv weights are ``[out, in, kh, kw]`` (NCHW);
+    flax NHWC kernels are ``[kh, kw, in, out]``. BatchNorm
+    ``weight/bias/running_mean/running_var`` map to
+    ``scale/bias`` + ``batch_stats.mean/var``. The flax model's explicit
+    torch-aligned padding makes the forward numerically identical.
+    ``stage_sizes`` selects the variant ((2,2,2,2) = resnet18, default
+    resnet50); bottleneck-vs-basic is inferred from the checkpoint keys.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def conv(name):
+        return {"kernel": sd[name + ".weight"].transpose(2, 3, 1, 0)}
+
+    def bn(name):
+        return (
+            {"scale": sd[name + ".weight"], "bias": sd[name + ".bias"]},
+            {"mean": sd[name + ".running_mean"],
+             "var": sd[name + ".running_var"]},
+        )
+
+    params: dict = {"stem_conv": conv("conv1")}
+    stats: dict = {}
+    p, s = bn("bn1")
+    params["stem_bn"], stats["stem_bn"] = p, s
+
+    n_convs = 3 if "layer1.0.conv3.weight" in sd else 2
+    for i, n_blocks in enumerate(stage_sizes):
+        for j in range(n_blocks):
+            hf = f"layer{i + 1}.{j}"
+            ours = f"stage{i + 1}_block{j + 1}"
+            blk_p: dict = {}
+            blk_s: dict = {}
+            for c in range(1, n_convs + 1):
+                blk_p[f"conv{c}"] = conv(f"{hf}.conv{c}")
+                bp, bs = bn(f"{hf}.bn{c}")
+                blk_p[f"bn{c}"], blk_s[f"bn{c}"] = bp, bs
+            if f"{hf}.downsample.0.weight" in sd:
+                blk_p["downsample_conv"] = conv(f"{hf}.downsample.0")
+                bp, bs = bn(f"{hf}.downsample.1")
+                blk_p["downsample_bn"], blk_s["downsample_bn"] = bp, bs
+            params[ours], stats[ours] = blk_p, blk_s
+    params["fc"] = {"kernel": sd["fc.weight"].T, "bias": sd["fc.bias"]}
+    return params, stats
+
+
 def convert_bert_from_torch(state_dict: Mapping[str, Any],
                             cfg: BertConfig) -> dict:
     """HF ``BertForPreTraining.state_dict()`` -> flax params for

@@ -41,6 +41,7 @@ class BottleneckBlock(nn.Module):
         y = self.act(y)
         y = self.conv(
             self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=((1, 1), (1, 1)),  # torch-aligned (SAME differs at stride 2)
             use_bias=False, name="conv2",
         )(y)
         y = self.norm(name="bn2")(y)
@@ -70,11 +71,13 @@ class BasicBlock(nn.Module):
         residual = x
         y = self.conv(
             self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=((1, 1), (1, 1)),  # torch-aligned (SAME differs at stride 2)
             use_bias=False, name="conv1",
         )(x)
         y = self.norm(name="bn1")(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3), use_bias=False, name="conv2")(y)
+        y = self.conv(self.filters, (3, 3), padding=((1, 1), (1, 1)),
+                      use_bias=False, name="conv2")(y)
         y = self.norm(name="bn2", scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = self.conv(
@@ -100,8 +103,9 @@ class ResNet(nn.Module):
             epsilon=1e-5, dtype=self.dtype,
         )
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
-                 name="stem_conv")(x)
+        x = conv(self.width, (7, 7), strides=(2, 2),
+                 padding=((3, 3), (3, 3)),  # torch-aligned stem
+                 use_bias=False, name="stem_conv")(x)
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

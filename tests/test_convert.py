@@ -111,3 +111,97 @@ def test_converted_params_train(mesh):
         losses.append(float(m["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+class _TorchBottleneck(torch.nn.Module):
+    """Minimal torch bottleneck with torchvision's exact attribute naming
+    (conv1/bn1/conv2/bn2/conv3/bn3/downsample.0/.1) — the checkpoint-format
+    contract the converter maps from."""
+
+    def __init__(self, inplanes, planes, stride=1):
+        super().__init__()
+        nn = torch.nn
+        self.conv1 = nn.Conv2d(inplanes, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride=stride, padding=1,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(planes * 4)
+        self.relu = nn.ReLU()
+        self.downsample = None
+        if stride != 1 or inplanes != planes * 4:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(inplanes, planes * 4, 1, stride=stride, bias=False),
+                nn.BatchNorm2d(planes * 4),
+            )
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(idn + y)
+
+
+class _TorchResNet(torch.nn.Module):
+    """Tiny torchvision-shaped ResNet (names: conv1/bn1/layerN.M/fc)."""
+
+    def __init__(self, stage_sizes=(1, 1), width=8, num_classes=4):
+        super().__init__()
+        nn = torch.nn
+        self.conv1 = nn.Conv2d(3, width, 7, stride=2, padding=3, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2d(3, stride=2, padding=1)
+        inplanes = width
+        for i, n in enumerate(stage_sizes):
+            blocks = []
+            for j in range(n):
+                stride = 2 if i > 0 and j == 0 else 1
+                blocks.append(_TorchBottleneck(inplanes, width * 2**i,
+                                               stride))
+                inplanes = width * 2**i * 4
+            setattr(self, f"layer{i + 1}", nn.Sequential(*blocks))
+        self.fc = nn.Linear(inplanes, num_classes)
+        self.stage_sizes = stage_sizes
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        for i in range(len(self.stage_sizes)):
+            x = getattr(self, f"layer{i + 1}")(x)
+        x = x.mean(dim=(2, 3))
+        return self.fc(x)
+
+
+def test_resnet_forward_parity():
+    from dear_pytorch_tpu.models.convert import convert_resnet_from_torch
+    from dear_pytorch_tpu.models.resnet import BottleneckBlock, ResNet
+
+    torch.manual_seed(0)
+    tmodel = _TorchResNet()
+    # randomize BN affine + running stats so identity mappings can't hide
+    with torch.no_grad():
+        for m in tmodel.modules():
+            if isinstance(m, torch.nn.BatchNorm2d):
+                m.weight.uniform_(0.5, 1.5)
+                m.bias.uniform_(-0.3, 0.3)
+                m.running_mean.uniform_(-0.2, 0.2)
+                m.running_var.uniform_(0.6, 1.4)
+    tmodel.eval()
+
+    params, stats = convert_resnet_from_torch(
+        tmodel.state_dict(), stage_sizes=(1, 1)
+    )
+    jmodel = ResNet(stage_sizes=(1, 1), width=8, num_classes=4,
+                    block=BottleneckBlock)
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 33, 33).astype(np.float32)  # odd size: padding edge
+    with torch.no_grad():
+        ref = tmodel(torch.tensor(x)).numpy()
+    got = jmodel.apply(
+        {"params": params, "batch_stats": stats},
+        jnp.asarray(x.transpose(0, 2, 3, 1)), train=False,
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
