@@ -1,0 +1,150 @@
+"""Synthetic GPT causal-LM pre-training benchmark — a model family beyond
+the reference zoo (its benchmarks stop at CNNs + BERT,
+dear/bert_benchmark.py), measured with the same harness/output format so
+the sweep driver's scraper works unchanged.
+
+Example:
+  python -m dear_pytorch_tpu.benchmarks.gpt \
+      --model gpt2 --batch-size 8 --sequence-len 1024 --fp16 \
+      --flash-attention
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dear_pytorch_tpu import models
+from dear_pytorch_tpu.benchmarks import runner
+from dear_pytorch_tpu.comm import backend
+from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.models import data
+from dear_pytorch_tpu.models.gpt import flash_causal_attention_impl
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU Synthetic GPT Benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    p.add_argument("--model", type=str, default="gpt2",
+                   help=f"one of {models.gpt_names()}")
+    p.add_argument("--sequence-len", type=int, default=1024)
+    p.add_argument("--num-hidden-layers", type=int, default=None,
+                   help="override depth (scaling studies / smoke tests)")
+    p.add_argument("--flash-attention", action="store_true", default=False,
+                   help="causal Pallas flash kernel instead of the dense "
+                        "triangle-masked attention")
+    runner.add_common_args(p)
+    p.set_defaults(batch_size=8, base_lr=1e-4, momentum=0.0)
+    return p
+
+
+def main(argv=None) -> runner.BenchResult:
+    args = build_parser().parse_args(argv)
+    runner.apply_platform_env()
+    scan_steps = runner.validate_scan_steps(args)
+    mesh = backend.init()
+    world = backend.dp_size(mesh)
+
+    dtype = jnp.bfloat16 if args.fp16 else jnp.float32
+    model = models.get_model(args.model, dtype=dtype)
+    cfg = model.config
+    if args.num_hidden_layers is not None:
+        cfg = dataclasses.replace(
+            cfg, num_hidden_layers=args.num_hidden_layers
+        )
+    if args.sequence_len > cfg.max_position_embeddings:
+        raise SystemExit(f"--sequence-len {args.sequence_len} exceeds "
+                         f"max_position_embeddings "
+                         f"{cfg.max_position_embeddings}")
+    attention_impl = None
+    if args.flash_attention:
+        if cfg.attention_probs_dropout_prob:
+            runner.log("flash attention: attention_probs_dropout_prob "
+                       f"{cfg.attention_probs_dropout_prob} -> 0.0 "
+                       "(no prob-dropout path in the kernel)")
+            cfg = dataclasses.replace(
+                cfg, attention_probs_dropout_prob=0.0
+            )
+        attention_impl = flash_causal_attention_impl()
+    if cfg is not model.config or attention_impl is not None:
+        model = models.GptLmHeadModel(cfg, attention_impl=attention_impl)
+
+    global_bs = args.batch_size * world
+    batch = data.synthetic_gpt_batch(
+        jax.random.PRNGKey(0), global_bs, seq_len=args.sequence_len,
+        vocab_size=cfg.vocab_size,
+    )
+    sharding = jax.sharding.NamedSharding(mesh, jax.P(DP_AXIS))
+    batch = runner.stage_global(batch, sharding)
+
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, batch["input_ids"], train=False
+    )["params"]
+
+    def loss_fn(p, b, rng):
+        logits = model.apply(
+            {"params": p}, b["input_ids"], train=True,
+            rngs={"dropout": rng},
+        )
+        return models.gpt_lm_loss(logits, b["input_ids"],
+                                  vocab_size=cfg.vocab_size)
+
+    dear_cfg = runner.config_from_args(args)
+    ts, stepper = runner.build_stepper(
+        dear_cfg, loss_fn, params, mesh, mgwfbp=args.mgwfbp,
+    )
+    state = ts.init(params)
+
+    runner.log(f"{args.model} causal-LM pretraining, "
+               f"sequence len: {args.sequence_len}")
+    runner.log(f"Batch size: {args.batch_size} (per dp rank), "
+               f"{global_bs} global "
+               f"({global_bs * args.sequence_len} tokens/step)")
+    runner.log(f"Number of {runner.device_name()}s: "
+               f"{backend.device_count()}")
+    runner.log(f"Schedule: {args.mode}; "
+               f"fusion: {ts.plan.num_buckets} bucket(s)")
+
+    if args.pipeline != "none":
+        raise SystemExit("--pipeline streaming is not wired for the GPT "
+                         "bench yet; use --pipeline none")
+    next_batch, close = runner.make_batch_source(args, None, None, batch)
+
+    holder = {"state": state, "metrics": None, "batch": batch}
+    step_fn, timed_kwargs = runner.make_step_source(
+        args, scan_steps, ts, stepper, holder, next_batch
+    )
+
+    def sync():
+        if holder["metrics"] is not None:
+            float(holder["metrics"]["loss"])
+
+    metrics_log = runner.metrics_from_args(args)
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+    try:
+        result = runner.run_timed(
+            step_fn, unit="sen", sync=sync, metrics=metrics_log,
+            **timed_kwargs,
+        )
+    finally:
+        if args.profile_dir:
+            jax.profiler.stop_trace()
+        if metrics_log is not None:
+            metrics_log.close()
+        close()
+    runner.log(f"Tokens/sec on {result.world} {runner.device_name()}(s): "
+               f"{result.total_mean * args.sequence_len:.0f}")
+    if args.mfu:
+        runner.log_mfu(getattr(stepper, "ts", ts), holder["state"], batch,
+                       result)
+    return result
+
+
+if __name__ == "__main__":
+    main()

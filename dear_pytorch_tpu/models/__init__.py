@@ -22,6 +22,14 @@ from dear_pytorch_tpu.models.bert import (  # noqa: F401
     BertForPreTraining,
     bert_pretraining_loss,
 )
+from dear_pytorch_tpu.models.gpt import (  # noqa: F401
+    GPT2_LARGE,
+    GPT2_MEDIUM,
+    GPT2_SMALL,
+    GptConfig,
+    GptLmHeadModel,
+    gpt_lm_loss,
+)
 from dear_pytorch_tpu.models.densenet import (  # noqa: F401
     DenseNet121,
     DenseNet169,
@@ -60,6 +68,13 @@ _BERT_REGISTRY: dict[str, Any] = {
     "bert_large": BERT_LARGE,
 }
 
+# Beyond the reference zoo: decoder-only causal LMs (models/gpt.py).
+_GPT_REGISTRY: dict[str, Any] = {
+    "gpt2": GPT2_SMALL,
+    "gpt2_medium": GPT2_MEDIUM,
+    "gpt2_large": GPT2_LARGE,
+}
+
 
 def cnn_names() -> list[str]:
     return sorted(_CNN_REGISTRY)
@@ -67,6 +82,10 @@ def cnn_names() -> list[str]:
 
 def bert_names() -> list[str]:
     return sorted(_BERT_REGISTRY)
+
+
+def gpt_names() -> list[str]:
+    return sorted(_GPT_REGISTRY)
 
 
 def get_model(name: str, *, dtype=jnp.float32, **kwargs):
@@ -79,17 +98,23 @@ def get_model(name: str, *, dtype=jnp.float32, **kwargs):
     key = name.lower()
     if key in _CNN_REGISTRY:
         return _CNN_REGISTRY[key](dtype=dtype, **kwargs)
-    if key in _BERT_REGISTRY:
-        cfg = _BERT_REGISTRY[key]
+    if key in _BERT_REGISTRY or key in _GPT_REGISTRY:
+        cfg = _BERT_REGISTRY.get(key) or _GPT_REGISTRY[key]
         if dtype is not jnp.float32:
             import dataclasses
 
             cfg = dataclasses.replace(cfg, dtype=dtype)
-        return BertForPreTraining(cfg, **kwargs)
+        cls = BertForPreTraining if key in _BERT_REGISTRY else GptLmHeadModel
+        return cls(cfg, **kwargs)
     raise KeyError(
-        f"unknown model {name!r}; CNNs: {cnn_names()}, BERT: {bert_names()}"
+        f"unknown model {name!r}; CNNs: {cnn_names()}, BERT: {bert_names()}, "
+        f"GPT: {gpt_names()}"
     )
 
 
 def is_bert(name: str) -> bool:
     return name.lower() in _BERT_REGISTRY
+
+
+def is_gpt(name: str) -> bool:
+    return name.lower() in _GPT_REGISTRY

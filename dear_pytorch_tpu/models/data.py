@@ -53,6 +53,15 @@ def synthetic_bert_batch(rng: jax.Array, batch_size: int, seq_len: int = 64,
     }
 
 
+def synthetic_gpt_batch(rng: jax.Array, batch_size: int, seq_len: int = 1024,
+                        vocab_size: int = 50257):
+    """Random causal-LM batch (same fixed-fake-data protocol as the other
+    generators): token ids only — the LM loss derives next-token targets by
+    shifting."""
+    input_ids = jax.random.randint(rng, (batch_size, seq_len), 0, vocab_size)
+    return {"input_ids": input_ids}
+
+
 def synthetic_mnist_batch(rng: jax.Array, batch_size: int):
     k1, k2 = jax.random.split(rng)
     images = jax.random.normal(k1, (batch_size, 28, 28, 1))

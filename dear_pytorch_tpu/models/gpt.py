@@ -1,0 +1,177 @@
+"""Decoder-only causal LM (GPT-2 family) — a model family BEYOND the
+reference (its zoo stops at torchvision CNNs + BERT, reference
+dear/imagenet_benchmark.py:88-95, dear/bert_benchmark.py:63-86), added
+because autoregressive pretraining is the dominant large-scale workload the
+decoupled schedule should also serve.
+
+TPU-first choices mirror models/bert.py: compute-dtype threading (bf16 on
+the MXU), static shapes, attention as batched einsums, the LM head tied to
+the token embedding, vocab padded to a multiple of 8, and an
+``attention_impl`` hook so the Pallas causal flash kernel
+(`ops.flash_attention`) or the sequence-parallel engines can replace the
+core attention without forking the model. Pre-LN residual blocks (GPT-2),
+gelu(tanh) MLPs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GptConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    embd_dropout_prob: float = 0.1
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: Any = jnp.float32
+
+    @property
+    def padded_vocab_size(self) -> int:
+        return ((self.vocab_size + 7) // 8) * 8
+
+
+GPT2_SMALL = GptConfig()
+GPT2_MEDIUM = GptConfig(hidden_size=1024, num_hidden_layers=24,
+                        num_attention_heads=16, intermediate_size=4096)
+GPT2_LARGE = GptConfig(hidden_size=1280, num_hidden_layers=36,
+                       num_attention_heads=20, intermediate_size=5120)
+
+
+def causal_dot_product_attention(q, k, v, mask, *, dropout_rng=None,
+                                 dropout_rate=0.0, dtype=jnp.float32):
+    """Dense causal attention core (same calling convention as
+    models.bert.dot_product_attention; ``mask`` is the additive key-padding
+    mask [B,1,1,S] or None — the causal triangle is applied here)."""
+    depth = q.shape[-1]
+    S = q.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(dtype)
+    tri = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(tri[None, None], scores,
+                       jnp.asarray(-1e9, scores.dtype))
+    if mask is not None:
+        scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    if dropout_rng is not None and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_causal_attention_impl():
+    """Causal attention via the Pallas flash kernel (attention dropout is
+    not supported inside the kernel — use for inference/benchmarks or
+    dropout-free training)."""
+    from dear_pytorch_tpu.ops.flash_attention import flash_attention
+
+    def impl(q, k, v, mask, *, dropout_rng=None, dropout_rate=0.0,
+             dtype=jnp.float32):
+        if dropout_rng is not None and dropout_rate > 0.0:
+            raise ValueError(
+                "flash attention kernel has no attention-dropout path; "
+                "set attention_probs_dropout_prob=0"
+            )
+        del mask  # full sequences in the causal LM path
+        return flash_attention(q, k, v, causal=True)
+
+    return impl
+
+
+class GptBlock(nn.Module):
+    config: GptConfig
+    attention_impl: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.config
+        h, nh = cfg.hidden_size, cfg.num_attention_heads
+        d = h // nh
+        init = nn.initializers.normal(cfg.initializer_range)
+
+        y = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_1")(x)
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (nh, d), dtype=cfg.dtype, kernel_init=init, name=name)
+        q, k, v = dense("query")(y), dense("key")(y), dense("value")(y)
+        dropout_rng = None
+        if train and cfg.attention_probs_dropout_prob > 0.0:
+            dropout_rng = self.make_rng("dropout")
+        impl = self.attention_impl or causal_dot_product_attention
+        ctx = impl(q, k, v, None, dropout_rng=dropout_rng,
+                   dropout_rate=(cfg.attention_probs_dropout_prob
+                                 if train else 0.0),
+                   dtype=cfg.dtype)
+        attn = nn.DenseGeneral(h, axis=(-2, -1), dtype=cfg.dtype,
+                               kernel_init=init, name="output")(ctx)
+        attn = nn.Dropout(cfg.hidden_dropout_prob,
+                          deterministic=not train)(attn)
+        x = x + attn
+
+        y = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_2")(x)
+        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     kernel_init=init, name="mlp_in")(y)
+        y = nn.gelu(y, approximate=True)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, kernel_init=init,
+                     name="mlp_out")(y)
+        y = nn.Dropout(cfg.hidden_dropout_prob, deterministic=not train)(y)
+        return x + y
+
+
+class GptLmHeadModel(nn.Module):
+    """Token + position embeddings, pre-LN blocks, final LN, tied LM head.
+
+    ``__call__(input_ids, train=...)`` -> next-token logits
+    ``[B, S, padded_vocab]``.
+    """
+
+    config: GptConfig
+    attention_impl: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = True, position_offset=0):
+        cfg = self.config
+        B, S = input_ids.shape
+        init = nn.initializers.normal(cfg.initializer_range)
+        wte = nn.Embed(cfg.padded_vocab_size, cfg.hidden_size,
+                       embedding_init=init, dtype=cfg.dtype, name="wte")
+        x = wte(input_ids)
+        pos = position_offset + jnp.arange(S)[None, :]
+        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                         embedding_init=init, dtype=cfg.dtype,
+                         name="wpe")(pos)
+        x = nn.Dropout(cfg.embd_dropout_prob, deterministic=not train)(x)
+        for i in range(cfg.num_hidden_layers):
+            x = GptBlock(cfg, attention_impl=self.attention_impl,
+                         name=f"h_{i}")(x, train)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_f")(x)
+        return wte.attend(x).astype(jnp.float32)
+
+
+def gpt_lm_loss(logits, input_ids, *, vocab_size: Optional[int] = None):
+    """Next-token cross-entropy: logits[:, t] predict input_ids[:, t+1].
+    Padded vocab ids (>= ``vocab_size``) are excluded from the softmax
+    support by masking their logits, so the loss matches an unpadded
+    model's."""
+    logits = logits[:, :-1]
+    targets = input_ids[:, 1:]
+    if vocab_size is not None and vocab_size < logits.shape[-1]:
+        pad = jnp.arange(logits.shape[-1]) >= vocab_size
+        logits = jnp.where(pad[None, None], -1e9, logits)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,118 @@
+"""GPT (decoder-only causal LM) family — beyond the reference zoo.
+
+Pins: the causality property (future tokens cannot influence past logits),
+dense vs Pallas-flash causal equivalence, training under the dear schedule,
+the padded-vocab loss contract, and the benchmark CLI's scrape-able output.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.models import GptConfig, GptLmHeadModel, gpt_lm_loss
+from dear_pytorch_tpu.models.gpt import flash_causal_attention_impl
+
+TINY = GptConfig(
+    vocab_size=61,  # odd: exercises padding to 64
+    hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+    intermediate_size=64, max_position_embeddings=64,
+    embd_dropout_prob=0.0, hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0,
+)
+
+
+def _params(cfg=TINY, seq=16):
+    model = GptLmHeadModel(cfg)
+    ids = jnp.zeros((1, seq), jnp.int32)
+    return model, model.init({"params": jax.random.PRNGKey(0)}, ids,
+                             train=False)["params"]
+
+
+def test_causality():
+    """Changing token t+1.. must not change logits at positions <= t."""
+    model, params = _params()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 61, (2, 16))
+    t = 7
+    ids2 = ids.copy()
+    ids2[:, t + 1:] = rng.randint(0, 61, (2, 16 - t - 1))
+    a = model.apply({"params": params}, jnp.asarray(ids), train=False)
+    b = model.apply({"params": params}, jnp.asarray(ids2), train=False)
+    np.testing.assert_allclose(
+        np.asarray(a[:, : t + 1]), np.asarray(b[:, : t + 1]),
+        rtol=1e-5, atol=1e-6,
+    )
+    # and they DO differ after t (the model is not degenerate)
+    assert not np.allclose(np.asarray(a[:, t + 1:]), np.asarray(b[:, t + 1:]))
+
+
+def test_flash_causal_matches_dense():
+    model, params = _params()
+    fmodel = GptLmHeadModel(TINY,
+                            attention_impl=flash_causal_attention_impl())
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 61, (2, 16)))
+    dense = model.apply({"params": params}, ids, train=False)
+    flash = fmodel.apply({"params": params}, ids, train=False)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_padded_vocab_is_dead_in_loss():
+    """Loss must equal the unpadded-softmax value: padded ids are masked
+    out of the support."""
+    model, params = _params()
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 61, (2, 16)))
+    logits = model.apply({"params": params}, ids, train=False)
+    assert logits.shape[-1] == 64  # padded
+    loss = gpt_lm_loss(logits, ids, vocab_size=61)
+    # reference value: softmax over the REAL vocab only
+    ref = gpt_lm_loss(logits[..., :61], ids)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)
+
+
+def test_trains_under_dear(mesh):
+    from dear_pytorch_tpu.models import data
+    from dear_pytorch_tpu.ops.fused_sgd import fused_adamw
+    from dear_pytorch_tpu.parallel import build_train_step
+
+    model, params = _params()
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["input_ids"], train=False)
+        return gpt_lm_loss(logits, b["input_ids"], vocab_size=61)
+
+    batch = data.synthetic_gpt_batch(
+        jax.random.PRNGKey(3), 8, seq_len=16, vocab_size=61
+    )
+    ts = build_train_step(
+        loss_fn, params, mesh=mesh, mode="dear", threshold_mb=0.01,
+        optimizer=fused_adamw(lr=1e-3), donate=False,
+    )
+    assert ts.plan.num_buckets >= 2
+    state = ts.init(params)
+    losses = []
+    for _ in range(5):
+        state, m = ts.step(state, batch)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_gpt_cli_output_contract(mesh, capsys):
+    from dear_pytorch_tpu.benchmarks import gpt as gpt_bench
+
+    res = gpt_bench.main([
+        "--model", "gpt2", "--batch-size", "2", "--sequence-len", "32",
+        "--num-hidden-layers", "2", "--num-warmup-batches", "1",
+        "--num-batches-per-iter", "2", "--num-iters", "2",
+    ])
+    out = capsys.readouterr().out
+    m = re.search(r"Total sen/sec on (\d+) \w+\(s\): ([\d.]+) \+-([\d.]+)",
+                  out)
+    assert m, out
+    assert int(m.group(1)) == 8
+    assert abs(float(m.group(2)) - res.total_mean) < 0.1
+    assert re.search(r"Tokens/sec on 8 \w+\(s\): \d+", out), out
