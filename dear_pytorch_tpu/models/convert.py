@@ -118,6 +118,87 @@ def convert_resnet_from_torch(state_dict: Mapping[str, Any],
     return params, stats
 
 
+def gpt_config_from_hf(hf_config: Any) -> "GptConfig":
+    """Our `GptConfig` from a HF GPT2Config object or dict."""
+    from dear_pytorch_tpu.models.gpt import GptConfig
+
+    get = (
+        hf_config.get if isinstance(hf_config, Mapping)
+        else lambda k, d=None: getattr(hf_config, k, d)
+    )
+    h = get("n_embd")
+    return GptConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=h,
+        num_hidden_layers=get("n_layer"),
+        num_attention_heads=get("n_head"),
+        intermediate_size=get("n_inner") or 4 * h,
+        max_position_embeddings=get("n_positions"),
+        embd_dropout_prob=get("embd_pdrop", 0.1),
+        hidden_dropout_prob=get("resid_pdrop", 0.1),
+        attention_probs_dropout_prob=get("attn_pdrop", 0.1),
+        layer_norm_eps=get("layer_norm_epsilon", 1e-5),
+        initializer_range=get("initializer_range", 0.02),
+    )
+
+
+def convert_gpt2_from_torch(state_dict: Mapping[str, Any],
+                            cfg: "GptConfig") -> dict:
+    """HF ``GPT2LMHeadModel.state_dict()`` -> flax params for
+    `models.gpt.GptLmHeadModel(cfg)`.
+
+    HF GPT-2 stores linear layers as ``Conv1D`` with weights already in
+    ``[in, out]`` layout (no transpose, unlike BERT), and fuses q/k/v into
+    one ``c_attn`` of width 3H — split here into per-head DenseGeneral
+    kernels. The LM head is tied to ``wte`` in both stacks. Vocab padding
+    follows the BERT converter (zero embedding rows; the LM loss masks
+    padded ids out of the softmax).
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    H, nh = cfg.hidden_size, cfg.num_attention_heads
+    d = H // nh
+    Vp = cfg.padded_vocab_size
+
+    wte = sd["transformer.wte.weight"]
+    if wte.shape[0] < Vp:
+        wte = np.concatenate(
+            [wte, np.zeros((Vp - wte.shape[0], H), wte.dtype)]
+        )
+    params: dict = {
+        "wte": {"embedding": wte},
+        "wpe": {"embedding": sd["transformer.wpe.weight"]},
+        "ln_f": {"scale": sd["transformer.ln_f.weight"],
+                 "bias": sd["transformer.ln_f.bias"]},
+    }
+    for i in range(cfg.num_hidden_layers):
+        hf = f"transformer.h.{i}"
+        w_qkv = sd[f"{hf}.attn.c_attn.weight"]       # [H, 3H], Conv1D layout
+        b_qkv = sd[f"{hf}.attn.c_attn.bias"]         # [3H]
+        wq, wk, wv = np.split(w_qkv, 3, axis=1)
+        bq, bk, bv = np.split(b_qkv, 3)
+        blk = {
+            "ln_1": {"scale": sd[f"{hf}.ln_1.weight"],
+                     "bias": sd[f"{hf}.ln_1.bias"]},
+            "query": {"kernel": wq.reshape(H, nh, d),
+                      "bias": bq.reshape(nh, d)},
+            "key": {"kernel": wk.reshape(H, nh, d),
+                    "bias": bk.reshape(nh, d)},
+            "value": {"kernel": wv.reshape(H, nh, d),
+                      "bias": bv.reshape(nh, d)},
+            "output": {"kernel": sd[f"{hf}.attn.c_proj.weight"]
+                       .reshape(nh, d, H),
+                       "bias": sd[f"{hf}.attn.c_proj.bias"]},
+            "ln_2": {"scale": sd[f"{hf}.ln_2.weight"],
+                     "bias": sd[f"{hf}.ln_2.bias"]},
+            "mlp_in": {"kernel": sd[f"{hf}.mlp.c_fc.weight"],
+                       "bias": sd[f"{hf}.mlp.c_fc.bias"]},
+            "mlp_out": {"kernel": sd[f"{hf}.mlp.c_proj.weight"],
+                        "bias": sd[f"{hf}.mlp.c_proj.bias"]},
+        }
+        params[f"h_{i}"] = blk
+    return params
+
+
 def convert_bert_from_torch(state_dict: Mapping[str, Any],
                             cfg: BertConfig) -> dict:
     """HF ``BertForPreTraining.state_dict()`` -> flax params for

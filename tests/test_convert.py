@@ -205,3 +205,35 @@ def test_resnet_forward_parity():
         jnp.asarray(x.transpose(0, 2, 3, 1)), train=False,
     )
     np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_forward_parity():
+    """HF GPT2LMHeadModel from a local config vs our GptLmHeadModel under
+    converted params: logits over the real vocab must match."""
+    from dear_pytorch_tpu.models.convert import (
+        convert_gpt2_from_torch,
+        gpt_config_from_hf,
+    )
+    from dear_pytorch_tpu.models.gpt import GptLmHeadModel
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=61, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    tmodel = transformers.GPT2LMHeadModel(hf_cfg)
+    tmodel.eval()
+
+    cfg = gpt_config_from_hf(hf_cfg)
+    assert cfg.padded_vocab_size == 64
+    params = convert_gpt2_from_torch(tmodel.state_dict(), cfg)
+
+    ids = np.random.RandomState(3).randint(0, 61, (2, 16))
+    with torch.no_grad():
+        ref = tmodel(torch.tensor(ids)).logits.numpy()
+    got = GptLmHeadModel(cfg).apply(
+        {"params": params}, jnp.asarray(ids), train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[..., :61], ref, rtol=2e-4, atol=2e-4
+    )
