@@ -75,6 +75,7 @@ _RESULT_RE = re.compile(
 )
 
 BERT_MODELS = ("bert", "bert_base", "bert_large")
+GPT_MODELS = ("gpt2", "gpt2_medium", "gpt2_large")
 
 
 def extract_log(logfile: str) -> Optional[tuple[float, float]]:
@@ -91,11 +92,12 @@ def extract_log(logfile: str) -> Optional[tuple[float, float]]:
 
 
 def cell_cmd(model: str, bs: int, method: str, extra: list[str]) -> list[str]:
-    mod = (
-        "dear_pytorch_tpu.benchmarks.bert"
-        if model in BERT_MODELS
-        else "dear_pytorch_tpu.benchmarks.imagenet"
-    )
+    if model in BERT_MODELS:
+        mod = "dear_pytorch_tpu.benchmarks.bert"
+    elif model in GPT_MODELS:
+        mod = "dear_pytorch_tpu.benchmarks.gpt"
+    else:
+        mod = "dear_pytorch_tpu.benchmarks.imagenet"
     return [
         sys.executable, "-m", mod, "--model", model,
         "--batch-size", str(bs), *METHOD_ARGS[method], *extra,
