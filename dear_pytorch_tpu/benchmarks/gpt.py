@@ -38,6 +38,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flash-attention", action="store_true", default=False,
                    help="causal Pallas flash kernel instead of the dense "
                         "triangle-masked attention")
+    p.add_argument("--sp-degree", type=int, default=1,
+                   help="sequence-parallel degree: dp x sp mesh, causal "
+                        "ring attention (or ring-flash with "
+                        "--flash-attention) over global positions — "
+                        "long-context autoregressive pretraining")
+    p.add_argument("--sp-attention", type=str, default=None,
+                   choices=["ring", "ring_flash", "ulysses"],
+                   help="sequence-parallel attention scheme (default: ring, "
+                        "or ring_flash with --flash-attention)")
     runner.add_common_args(p)
     p.set_defaults(batch_size=8, base_lr=1e-4, momentum=0.0)
     return p
@@ -47,7 +56,36 @@ def main(argv=None) -> runner.BenchResult:
     args = build_parser().parse_args(argv)
     runner.apply_platform_env()
     scan_steps = runner.validate_scan_steps(args)
-    mesh = backend.init()
+    sp = max(int(args.sp_degree), 1)
+    if args.sp_attention and sp == 1:
+        raise SystemExit("--sp-attention requires --sp-degree > 1")
+    if (args.flash_attention and args.sp_attention
+            and args.sp_attention != "ring_flash"):
+        raise SystemExit("--flash-attention conflicts with "
+                         f"--sp-attention {args.sp_attention}; pass one")
+    if sp > 1:
+        backend.init()
+        import numpy as np
+
+        from dear_pytorch_tpu.comm.backend import SP_AXIS
+
+        devices = jax.devices()
+        ndev = len(devices)
+        if ndev % sp:
+            raise SystemExit(f"--sp-degree {sp} does not divide the "
+                             f"{ndev}-device world")
+        if args.sequence_len % sp:
+            raise SystemExit(f"--sequence-len {args.sequence_len} must "
+                             f"divide by --sp-degree {sp}")
+        if args.pipeline != "none":
+            raise SystemExit("--pipeline streaming is dp-only; use "
+                             "--pipeline none with --sp-degree")
+        mesh = jax.sharding.Mesh(
+            np.asarray(devices).reshape(ndev // sp, sp),
+            (DP_AXIS, SP_AXIS),
+        )
+    else:
+        mesh = backend.init()
     world = backend.dp_size(mesh)
 
     dtype = jnp.bfloat16 if args.fp16 else jnp.float32
@@ -62,16 +100,16 @@ def main(argv=None) -> runner.BenchResult:
                          f"max_position_embeddings "
                          f"{cfg.max_position_embeddings}")
     attention_impl = None
-    if args.flash_attention:
-        if cfg.attention_probs_dropout_prob:
-            runner.log("flash attention: attention_probs_dropout_prob "
-                       f"{cfg.attention_probs_dropout_prob} -> 0.0 "
-                       "(no prob-dropout path in the kernel)")
-            cfg = dataclasses.replace(
-                cfg, attention_probs_dropout_prob=0.0
-            )
+    kernel_attn = (args.flash_attention
+                   or args.sp_attention in ("ring_flash", "ulysses"))
+    if kernel_attn and cfg.attention_probs_dropout_prob:
+        runner.log("kernel attention: attention_probs_dropout_prob "
+                   f"{cfg.attention_probs_dropout_prob} -> 0.0 "
+                   "(no prob-dropout path in the requested impl)")
+        cfg = dataclasses.replace(cfg, attention_probs_dropout_prob=0.0)
+    if args.flash_attention and sp == 1:
         attention_impl = flash_causal_attention_impl()
-    if cfg is not model.config or attention_impl is not None:
+    if sp == 1 and (cfg is not model.config or attention_impl is not None):
         model = models.GptLmHeadModel(cfg, attention_impl=attention_impl)
 
     global_bs = args.batch_size * world
@@ -79,24 +117,53 @@ def main(argv=None) -> runner.BenchResult:
         jax.random.PRNGKey(0), global_bs, seq_len=args.sequence_len,
         vocab_size=cfg.vocab_size,
     )
-    sharding = jax.sharding.NamedSharding(mesh, jax.P(DP_AXIS))
-    batch = runner.stage_global(batch, sharding)
 
-    params = model.init(
-        {"params": jax.random.PRNGKey(0)}, batch["input_ids"], train=False
-    )["params"]
+    extra_build = {}
+    if sp > 1:
+        from dear_pytorch_tpu.comm.backend import SP_AXIS
+        from dear_pytorch_tpu.parallel import sp as SP
 
-    def loss_fn(p, b, rng):
-        logits = model.apply(
-            {"params": p}, b["input_ids"], train=True,
-            rngs={"dropout": rng},
+        sp_model = SP.sp_gpt_model(cfg, flash=args.flash_attention,
+                                   attention=args.sp_attention)
+        shardings = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(mesh, s),
+            SP.bert_sp_batch_specs(batch),
         )
-        return models.gpt_lm_loss(logits, b["input_ids"],
-                                  vocab_size=cfg.vocab_size)
+        batch = jax.tree.map(
+            lambda x, sh: runner.stage_global(x, sh), batch, shardings
+        )
+        params = models.GptLmHeadModel(cfg).init(
+            {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
+            train=False,
+        )["params"]
+        loss_fn = SP.make_sp_gpt_loss_fn(
+            sp_model, vocab_size=cfg.vocab_size, train=True
+        )
+        extra_build = dict(
+            axis_name=(DP_AXIS, SP_AXIS),
+            mean_axes=(DP_AXIS,),
+            batch_spec_fn=SP.bert_sp_batch_specs,
+        )
+    else:
+        sharding = jax.sharding.NamedSharding(mesh, jax.P(DP_AXIS))
+        batch = runner.stage_global(batch, sharding)
+
+        params = model.init(
+            {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
+            train=False,
+        )["params"]
+
+        def loss_fn(p, b, rng):
+            logits = model.apply(
+                {"params": p}, b["input_ids"], train=True,
+                rngs={"dropout": rng},
+            )
+            return models.gpt_lm_loss(logits, b["input_ids"],
+                                      vocab_size=cfg.vocab_size)
 
     dear_cfg = runner.config_from_args(args)
     ts, stepper = runner.build_stepper(
-        dear_cfg, loss_fn, params, mesh, mgwfbp=args.mgwfbp,
+        dear_cfg, loss_fn, params, mesh, mgwfbp=args.mgwfbp, **extra_build,
     )
     state = ts.init(params)
 
@@ -106,7 +173,8 @@ def main(argv=None) -> runner.BenchResult:
                f"{global_bs} global "
                f"({global_bs * args.sequence_len} tokens/step)")
     runner.log(f"Number of {runner.device_name()}s: "
-               f"{backend.device_count()}")
+               f"{backend.device_count()}"
+               + (f" (dp {world} x sp {sp})" if sp > 1 else ""))
     runner.log(f"Schedule: {args.mode}; "
                f"fusion: {ts.plan.num_buckets} bucket(s)")
 
@@ -119,6 +187,8 @@ def main(argv=None) -> runner.BenchResult:
     step_fn, timed_kwargs = runner.make_step_source(
         args, scan_steps, ts, stepper, holder, next_batch
     )
+    # sequences per CHIP per step: with sp, each sequence spans sp chips
+    timed_kwargs["batch_size"] = timed_kwargs["batch_size"] / sp
 
     def sync():
         if holder["metrics"] is not None:

@@ -134,11 +134,105 @@ def make_sp_bert_loss_fn(model, *, sp_axis: str = SP_AXIS,
     return loss_fn
 
 
+def sp_gpt_loss(logits, input_ids, axis_name: str = SP_AXIS,
+                vocab_size: Optional[int] = None):
+    """Next-token cross-entropy under sequence sharding.
+
+    The shift crosses shard boundaries: the LAST position of shard i
+    predicts the FIRST token of shard i+1, so each shard ppermutes its
+    first token to its left neighbor. The global last position has no
+    target and is masked out on the final shard.
+
+    Gradient accounting mirrors `sp_bert_loss` (the train step SUMS partial
+    gradients over sp with ``mean_axes=('dp',)``): every token's NLL enters
+    the grad path on exactly one device — the one holding its logit — and
+    normalization is by the GLOBAL target count (psum'd, gradient-stopped).
+    The returned VALUE is the true replicated loss on every rank.
+    """
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, Vp = logits.shape
+    # shard i receives shard (i+1)'s first token (wraps; the wrapped value
+    # lands on the last shard's masked-out final position)
+    nxt = lax.ppermute(
+        input_ids[:, 0], axis_name,
+        [((i + 1) % world, i) for i in range(world)],
+    )
+    targets = jnp.concatenate([input_ids[:, 1:], nxt[:, None]], axis=1)
+    col_ok = jnp.arange(S)[None, :] < S - 1
+    valid = jnp.where(idx == world - 1, col_ok,
+                      jnp.ones_like(col_ok))          # [1, S] broadcasts
+    valid = jnp.broadcast_to(valid, (B, S))
+    if vocab_size is not None and vocab_size < Vp:
+        pad = jnp.arange(Vp) >= vocab_size
+        logits = jnp.where(pad[None, None], -1e9, logits)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    local_num = jnp.sum(nll * valid)
+    den = jax.lax.stop_gradient(lax.psum(jnp.sum(valid), axis_name))
+    den = jnp.maximum(den, 1)
+    loss_grad_path = local_num / den
+    true_loss = lax.psum(jax.lax.stop_gradient(local_num), axis_name) / den
+    return loss_grad_path + jax.lax.stop_gradient(
+        true_loss - loss_grad_path
+    )
+
+
+def make_sp_gpt_loss_fn(model, *, vocab_size: Optional[int] = None,
+                        sp_axis: str = SP_AXIS, train: bool = True):
+    """``loss_fn(params, batch[, rng])`` for `build_train_step` on a dp×sp
+    mesh: causal ring attention over ``sp_axis``, offset positions,
+    cross-shard next-token targets. The model must have been built with
+    `sp_gpt_model`."""
+
+    def loss_fn(params, batch, rng=None):
+        ids = batch["input_ids"]
+        offset = sp_position_offset(ids.shape[1], sp_axis)
+        rngs = {"dropout": rng} if rng is not None else None
+        logits = model.apply(
+            {"params": params}, ids, train=train, rngs=rngs,
+            position_offset=offset,
+        )
+        return sp_gpt_loss(logits.astype(jnp.float32), ids, sp_axis,
+                           vocab_size=vocab_size)
+
+    return loss_fn
+
+
 _SP_ATTENTION_IMPLS = {
     "ring": make_ring_attention_impl,
     "ring_flash": make_ring_flash_attention_impl,
     "ulysses": make_ulysses_attention_impl,
 }
+
+
+def sp_gpt_model(config, sp_axis: str = SP_AXIS, *, flash: bool = False,
+                 attention: Optional[str] = None):
+    """A `GptLmHeadModel` whose CAUSAL attention is sequence-parallel over
+    ``sp_axis`` — long-context autoregressive pretraining. Same scheme
+    choices and fallback policy as `sp_bert_model`; causality is enforced
+    over GLOBAL positions inside the ring (earlier blocks attend fully, the
+    aligned diagonal block causally, later blocks are skipped — the
+    ring-flash path prunes skipped pairs instead of masking them)."""
+    from dear_pytorch_tpu.models.gpt import GptLmHeadModel
+
+    impl = _resolve_sp_attention(flash, attention)(sp_axis, causal=True)
+    return GptLmHeadModel(config, attention_impl=impl)
+
+
+def _resolve_sp_attention(flash: bool, attention: Optional[str]):
+    if attention is None:
+        attention = "ring_flash" if flash else "ring"
+    elif flash and attention != "ring_flash":
+        raise ValueError(
+            f"flash=True conflicts with attention={attention!r}; pass one"
+        )
+    if attention not in _SP_ATTENTION_IMPLS:
+        raise ValueError(
+            f"attention must be one of {sorted(_SP_ATTENTION_IMPLS)}, "
+            f"got {attention!r}"
+        )
+    return _SP_ATTENTION_IMPLS[attention]
 
 
 def sp_bert_model(config, sp_axis: str = SP_AXIS, *, flash: bool = False,
@@ -156,16 +250,5 @@ def sp_bert_model(config, sp_axis: str = SP_AXIS, *, flash: bool = False,
     attention-prob dropout is active."""
     from dear_pytorch_tpu.models.bert import BertForPreTraining
 
-    if attention is None:
-        attention = "ring_flash" if flash else "ring"
-    elif flash and attention != "ring_flash":
-        raise ValueError(
-            f"flash=True conflicts with attention={attention!r}; pass one"
-        )
-    if attention not in _SP_ATTENTION_IMPLS:
-        raise ValueError(
-            f"attention must be one of {sorted(_SP_ATTENTION_IMPLS)}, "
-            f"got {attention!r}"
-        )
-    impl = _SP_ATTENTION_IMPLS[attention](sp_axis)
+    impl = _resolve_sp_attention(flash, attention)(sp_axis)
     return BertForPreTraining(config, attention_impl=impl)
