@@ -67,25 +67,7 @@ def main(argv=None) -> runner.BenchResult:
         raise SystemExit("--flash-attention conflicts with "
                          f"--sp-attention {args.sp_attention}; pass one")
     if sp > 1:
-        backend.init()  # bootstrap (multi-host) without fixing the axes:
-        # init() is idempotent and another mesh may already be installed
-        import numpy as np
-
-        devices = jax.devices()
-        ndev = len(devices)
-        if ndev % sp:
-            raise SystemExit(f"--sp-degree {sp} does not divide the "
-                             f"{ndev}-device world")
-        if args.sentence_len % sp:
-            raise SystemExit(f"--sentence-len {args.sentence_len} must "
-                             f"divide by --sp-degree {sp}")
-        if args.pipeline != "none":
-            raise SystemExit("--pipeline streaming is dp-only; use "
-                             "--pipeline none with --sp-degree")
-        mesh = jax.sharding.Mesh(
-            np.asarray(devices).reshape(ndev // sp, sp),
-            (DP_AXIS, SP_AXIS),
-        )
+        mesh = runner.build_sp_mesh(sp, args.sentence_len, args.pipeline)
     else:
         mesh = backend.init()
     world = backend.dp_size(mesh)  # data-parallel degree (sentences)

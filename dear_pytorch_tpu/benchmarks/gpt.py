@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from dear_pytorch_tpu import models
 from dear_pytorch_tpu.benchmarks import runner
 from dear_pytorch_tpu.comm import backend
-from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.comm.backend import DP_AXIS, SP_AXIS
 from dear_pytorch_tpu.models import data
 from dear_pytorch_tpu.models.gpt import flash_causal_attention_impl
 
@@ -56,6 +56,9 @@ def main(argv=None) -> runner.BenchResult:
     args = build_parser().parse_args(argv)
     runner.apply_platform_env()
     scan_steps = runner.validate_scan_steps(args)
+    if args.pipeline != "none":
+        raise SystemExit("--pipeline streaming is not wired for the GPT "
+                         "bench yet; use --pipeline none")
     sp = max(int(args.sp_degree), 1)
     if args.sp_attention and sp == 1:
         raise SystemExit("--sp-attention requires --sp-degree > 1")
@@ -64,26 +67,7 @@ def main(argv=None) -> runner.BenchResult:
         raise SystemExit("--flash-attention conflicts with "
                          f"--sp-attention {args.sp_attention}; pass one")
     if sp > 1:
-        backend.init()
-        import numpy as np
-
-        from dear_pytorch_tpu.comm.backend import SP_AXIS
-
-        devices = jax.devices()
-        ndev = len(devices)
-        if ndev % sp:
-            raise SystemExit(f"--sp-degree {sp} does not divide the "
-                             f"{ndev}-device world")
-        if args.sequence_len % sp:
-            raise SystemExit(f"--sequence-len {args.sequence_len} must "
-                             f"divide by --sp-degree {sp}")
-        if args.pipeline != "none":
-            raise SystemExit("--pipeline streaming is dp-only; use "
-                             "--pipeline none with --sp-degree")
-        mesh = jax.sharding.Mesh(
-            np.asarray(devices).reshape(ndev // sp, sp),
-            (DP_AXIS, SP_AXIS),
-        )
+        mesh = runner.build_sp_mesh(sp, args.sequence_len, args.pipeline)
     else:
         mesh = backend.init()
     world = backend.dp_size(mesh)
@@ -120,7 +104,6 @@ def main(argv=None) -> runner.BenchResult:
 
     extra_build = {}
     if sp > 1:
-        from dear_pytorch_tpu.comm.backend import SP_AXIS
         from dear_pytorch_tpu.parallel import sp as SP
 
         sp_model = SP.sp_gpt_model(cfg, flash=args.flash_attention,
@@ -178,9 +161,6 @@ def main(argv=None) -> runner.BenchResult:
     runner.log(f"Schedule: {args.mode}; "
                f"fusion: {ts.plan.num_buckets} bucket(s)")
 
-    if args.pipeline != "none":
-        raise SystemExit("--pipeline streaming is not wired for the GPT "
-                         "bench yet; use --pipeline none")
     next_batch, close = runner.make_batch_source(args, None, None, batch)
 
     holder = {"state": state, "metrics": None, "batch": batch}

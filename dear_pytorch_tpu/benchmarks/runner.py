@@ -252,6 +252,32 @@ def add_common_args(parser) -> None:
                              "compile)")
 
 
+def build_sp_mesh(sp: int, seq_len: int, pipeline: str):
+    """dp x sp mesh for a sequence-parallel CLI run, with the shared
+    validation both BERT and GPT benches need. `backend.init()` runs first
+    for the (multi-host) bootstrap without fixing the axes — it is
+    idempotent and another mesh may already be installed."""
+    import numpy as np
+
+    from dear_pytorch_tpu.comm.backend import DP_AXIS, SP_AXIS
+
+    backend.init()
+    devices = jax.devices()
+    ndev = len(devices)
+    if ndev % sp:
+        raise SystemExit(f"--sp-degree {sp} does not divide the "
+                         f"{ndev}-device world")
+    if seq_len % sp:
+        raise SystemExit(f"sequence length {seq_len} must divide by "
+                         f"--sp-degree {sp}")
+    if pipeline != "none":
+        raise SystemExit("--pipeline streaming is dp-only; use "
+                         "--pipeline none with --sp-degree")
+    return jax.sharding.Mesh(
+        np.asarray(devices).reshape(ndev // sp, sp), (DP_AXIS, SP_AXIS)
+    )
+
+
 def metrics_from_args(args):
     """`utils.MetricsLogger` for ``--metrics-file`` (None when unset); the
     single construction point shared by the CLIs."""

@@ -130,9 +130,6 @@ def test_sp_cls_pool_picks_global_first_token(mesh2d):
 # Causal (GPT) sequence parallelism
 # ---------------------------------------------------------------------------
 
-GPT_CFG = None  # built lazily (module import order)
-
-
 def _gpt_cfg():
     from dear_pytorch_tpu.models.gpt import GptConfig
 
