@@ -92,10 +92,22 @@ def repack_state(
     new_opt = _repack_bucket_states(
         list(state.opt_state), old_ts.plan, new_ts.plan
     )
-    # install repacked values with the fresh state's shardings
-    new_opt = jax.tree.map(
-        lambda v, ref: jax.device_put(v, ref.sharding), new_opt,
-        fresh.opt_state,
+    # install repacked values with the fresh state's shardings — matched by
+    # LEAF ORDER, not structure: a checkpoint-restored state's containers
+    # may be dict-form images of the live tuples (utils.checkpoint.
+    # elastic_restore), while the leaf order is identical
+    fresh_flat, fresh_def = jax.tree_util.tree_flatten(fresh.opt_state)
+    new_flat = jax.tree_util.tree_leaves(new_opt)
+    if len(new_flat) != len(fresh_flat):
+        raise ValueError(
+            f"optimizer state leaf count changed across plans: "
+            f"{len(new_flat)} vs {len(fresh_flat)} — was the step rebuilt "
+            "with a different optimizer?"
+        )
+    new_opt = jax.tree_util.tree_unflatten(
+        fresh_def,
+        [jax.device_put(v, ref.sharding)
+         for v, ref in zip(new_flat, fresh_flat)],
     )
     step = jax.device_put(state.step, fresh.step.sharding)
     return D.DearState(fresh.buffers, new_opt, step, fresh.model_state,

@@ -37,6 +37,46 @@ def plan_fingerprint(plan: F.FusionPlan) -> str:
     ).hexdigest()[:16]
 
 
+def plan_desc(plan: F.FusionPlan) -> dict:
+    """JSON-serializable description from which the plan's buffer layout
+    can be REBUILT (not just checked) — the sidecar payload that makes
+    `elastic_restore` possible on a different world size."""
+    return {
+        "world": plan.world,
+        "leaves": [
+            {"name": s.name, "layer": s.layer, "shape": list(s.shape),
+             "dtype": str(s.dtype)}
+            for s in plan.leaves
+        ],
+        "groups": [list(b.leaf_ids) for b in plan.buckets],
+    }
+
+
+def plan_from_desc(desc: dict, treedef) -> F.FusionPlan:
+    """Rebuild a `FusionPlan` from `plan_desc` output. ``treedef`` comes
+    from a live plan over the SAME model (the pytree structure is not
+    serializable; leaf order is the flatten order both plans share)."""
+    import jax.numpy as jnp
+
+    specs = tuple(
+        F.LeafSpec(
+            name=d["name"], layer=d["layer"], shape=tuple(d["shape"]),
+            dtype=jnp.dtype(d["dtype"]),
+            size=int(max(1, _prod(d["shape"]))),
+        )
+        for d in desc["leaves"]
+    )
+    return F._build_plan(specs, [list(g) for g in desc["groups"]],
+                         desc["world"], treedef)
+
+
+def _prod(shape) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
 def _ckpt_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:010d}")
 
@@ -82,7 +122,8 @@ def save_checkpoint(
         # written eagerly even for async saves: restore only ever reaches a
         # sidecar through a COMMITTED step dir (latest_step scans dirs), so
         # a crash mid-write leaves an orphan sidecar, never a broken restore
-        meta = {"plan": plan_fingerprint(plan), "step": step}
+        meta = {"plan": plan_fingerprint(plan), "step": step,
+                "plan_desc": plan_desc(plan)}
         with open(os.path.join(directory, f"meta_{step:010d}.json"), "w") as f:
             json.dump(meta, f)
     return path
@@ -150,3 +191,95 @@ def restore_checkpoint(
         item=template,
         restore_args=restore_args,
     )
+
+
+class _PlanShim:
+    """The one attribute `repack_state` reads from its train steps."""
+
+    def __init__(self, plan):
+        self.plan = plan
+
+
+def elastic_restore(
+    directory: str,
+    ts: D.TrainStep,
+    *,
+    step: Optional[int] = None,
+) -> D.DearState:
+    """Restore a checkpoint written under a DIFFERENT world size or fusion
+    plan into ``ts`` — elastic recovery: a world=8 run resumes on 4 chips
+    (or vice versa, or after re-bucketing) with parameters, elementwise
+    optimizer state, and the step counter carried over exactly.
+
+    The sidecar's ``plan_desc`` rebuilds the original plan's buffer layout;
+    the checkpoint is read to host and re-packed/re-sharded through
+    `tuning.autotune.repack_state` (compressor residuals reset, scalar
+    optimizer leaves carried per that function's contract). Numerics: the
+    global batch math is world-independent, so training continues with the
+    same loss trajectory it would have had without the resize.
+
+    Single-controller path: the full state passes through host RAM of each
+    process (fine for recovery; the fast same-plan path is
+    `restore_checkpoint`). Use that one when the plan fingerprints match.
+    """
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from dear_pytorch_tpu.tuning.autotune import repack_state
+
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    with open(os.path.join(directory, f"meta_{step:010d}.json")) as f:
+        meta = json.load(f)
+    if "plan_desc" not in meta:
+        raise ValueError(
+            f"checkpoint step {step} predates plan_desc sidecars; elastic "
+            "restore needs the original layout description"
+        )
+    old_plan = plan_from_desc(meta["plan_desc"], ts.plan.treedef)
+    if [s.name for s in old_plan.leaves] != [s.name for s in ts.plan.leaves]:
+        raise ValueError(
+            "checkpoint parameters do not match the live model "
+            "(leaf names differ) — elastic restore resizes worlds, it does "
+            "not migrate architectures"
+        )
+
+    # Restore to HOST numpy explicitly: a structureless restore would use
+    # the SAVED shardings, which reference devices that no longer exist
+    # after a genuine downsize (orbax warns exactly about this).
+    ckptr = ocp.PyTreeCheckpointer()
+    path = os.path.abspath(_ckpt_dir(directory, step))
+    item_md = ckptr.metadata(path).item_metadata
+    item_tree = item_md.tree if hasattr(item_md, "tree") else item_md
+    restore_args = jax.tree.map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item_tree
+    )
+    raw = ckptr.restore(path, restore_args=restore_args)
+    # NamedTuples come back as field-name dicts from a structureless
+    # restore; tolerate either form
+    get = raw.get if isinstance(raw, dict) else \
+        (lambda k, d=None: getattr(raw, k, d))
+
+    def host(x):
+        return jax.tree.map(np.asarray, x)
+
+    state = D.DearState(
+        buffers=tuple(host(list(get("buffers")))),
+        opt_state=tuple(
+            host(s) for s in _as_sequence(get("opt_state"))
+        ),
+        step=np.asarray(get("step")),
+        model_state=host(get("model_state", ())) or (),
+        comp_state=(),
+    )
+    return repack_state(state, _PlanShim(old_plan), ts)
+
+
+def _as_sequence(tree):
+    """Per-bucket entries of a restored tuple field (dict with stringified
+    indices, or an actual sequence)."""
+    if isinstance(tree, dict):
+        return [tree[k] for k in sorted(tree, key=lambda s: int(s))]
+    return list(tree)

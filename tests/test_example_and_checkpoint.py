@@ -224,3 +224,47 @@ def test_parallelism_example_smoke(axis):
     losses = m.main(["--axis", axis, "--steps", "4"])
     assert all(np.isfinite(v) for v in losses)
     assert losses[-1] < losses[0]  # actually trains, not just runs
+
+
+def test_elastic_restore_world_resize(mesh, tmp_path):
+    """Elastic recovery: a world=8 run's checkpoint resumes on a 4-device
+    mesh (different padding, different shard sizes, different bucketing)
+    and the continued loss trajectory matches the run that never resized —
+    the global batch math is world-independent, so an exact restore of
+    params + momentum must reproduce it."""
+    params = _mlp_params(jax.random.PRNGKey(11))
+    batches = [_data(jax.random.PRNGKey(700 + i)) for i in range(6)]
+    opt = lambda: fused_sgd(lr=0.05, momentum=0.9)  # noqa: E731
+
+    ts8 = build_train_step(_loss_fn, params, mesh=mesh, optimizer=opt(),
+                          threshold_mb=0.0008, donate=False)
+    state = ts8.init(params)
+    for b in batches[:3]:
+        state, _ = ts8.step(state, b)
+    ckpt.save_checkpoint(str(tmp_path), state, ts8.plan)
+
+    # the unresized continuation (ground truth)
+    ref_losses = []
+    for b in batches[3:]:
+        state, m = ts8.step(state, b)
+        ref_losses.append(float(m["loss"]))
+
+    # resume on HALF the devices with a different fusion threshold
+    mesh4 = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:4]).reshape(4), ("dp",)
+    )
+    ts4 = build_train_step(_loss_fn, params, mesh=mesh4, optimizer=opt(),
+                          threshold_mb=0.002, donate=False)
+    assert ckpt.plan_fingerprint(ts4.plan) != ckpt.plan_fingerprint(ts8.plan)
+    restored = ckpt.elastic_restore(str(tmp_path), ts4)
+    assert int(restored.step) == 3
+    losses = []
+    for b in batches[3:]:
+        restored, m = ts4.step(restored, b)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+
+    # sanity: the strict path still refuses the mismatched plan
+    with pytest.raises(ValueError, match="plan"):
+        ckpt.restore_checkpoint(str(tmp_path), ts4,
+                                template=ts4.init(params))
