@@ -91,9 +91,17 @@ def main(argv=None) -> float:
     ckpt_dir = os.path.join(args.workdir, "ckpts")
     start = 0
     if ckpt.latest_step(ckpt_dir) is not None:  # resume-from-latest
-        state = ckpt.restore_checkpoint(
-            ckpt_dir, ts, template=ts.init(params)
-        )
+        try:
+            state = ckpt.restore_checkpoint(
+                ckpt_dir, ts, template=ts.init(params)
+            )
+        except ValueError:
+            # layout changed since the checkpoint (different world size
+            # after losing/gaining chips, or re-bucketed fusion): take the
+            # elastic path, which re-packs through host RAM
+            state = ckpt.elastic_restore(ckpt_dir, ts)
+            print("elastic resume: checkpoint layout differed "
+                  "(world resize or re-bucketing)")
         start = int(jax.device_get(state.step))
         print(f"resumed from checkpoint step {start}")
     else:
