@@ -667,17 +667,26 @@ def build_train_step(
                 # per-parameter segment metadata for exact cross-shard
                 # reductions (LAMB trust ratios): this device's slice of the
                 # bucket's element->parameter map, plus the psum completing
-                # shard-local segment sums (identity when replicated)
+                # shard-local segment sums (identity when replicated).
+                # Computed from the TINY per-bucket offsets array via
+                # searchsorted — materializing FusionPlan.segment_ids here
+                # would bake an int32[padded_size] constant (~1/4 of the
+                # parameter bytes) into the program on every device.
                 b = plan.buckets[g]
-                seg_full = jnp.asarray(plan.segment_ids(g))
+                starts = jnp.asarray(b.offsets, jnp.int32)
                 if sharded:
-                    seg = lax.dynamic_slice_in_dim(
-                        seg_full, idx * b.shard_size, b.shard_size
+                    pos = idx * b.shard_size + jnp.arange(
+                        b.shard_size, dtype=jnp.int32
                     )
                     psum = lambda x: lax.psum(x, axis_name)  # noqa: E731
                 else:
-                    seg = seg_full
+                    pos = jnp.arange(b.padded_size, dtype=jnp.int32)
                     psum = lambda x: x  # noqa: E731
+                seg = (
+                    jnp.searchsorted(starts, pos, side="right")
+                    .astype(jnp.int32) - 1
+                )
+                seg = jnp.where(pos < b.size, seg, len(b.leaf_ids))
                 new_p, new_o = optimizer.update(
                     grad, state.opt_state[g], state.buffers[g],
                     seg, len(b.leaf_ids) + 1, psum,

@@ -266,7 +266,7 @@ def elastic_restore(
         return jax.tree.map(np.asarray, x)
 
     state = D.DearState(
-        buffers=tuple(host(list(get("buffers")))),
+        buffers=tuple(host(b) for b in _as_sequence(get("buffers"))),
         opt_state=tuple(
             host(s) for s in _as_sequence(get("opt_state"))
         ),

@@ -63,10 +63,15 @@ _DTYPE_BYTES = {
 }
 
 # `%x.1 = bf16[64,112,112,64]{3,2,1,0} convolution(...)` — also matches
-# tuple-free scalar shapes like `f32[]`.
+# scalar shapes like `f32[]`.
 _OP_RE = re.compile(
-    r"=\s+(?:\([^)]*\)\s+)?(\w+)\[([\d,]*)\][^ ]*\s+([\w-]+)\("
+    r"=\s+(\w+)\[([\d,]*)\][^ ]*\s+([\w-]+)\("
 )
+# tuple-shaped outputs: `%x = (bf16[..]{..}, bf16[..]{..}) all-reduce(...)`
+# (XLA's all-reduce combiner and while-loops produce these; missing them
+# would zero out exactly the collective bytes this tool exists to count)
+_TUPLE_OP_RE = re.compile(r"=\s+\(([^)]*)\)\s+([\w-]+)\(")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 
 
 def shape_bytes(dtype: str, dims: str) -> int:
@@ -101,15 +106,23 @@ def hlo_histogram(hlo_text: str) -> dict:
         if in_fusion_body:
             continue
         m = _OP_RE.search(line)
-        if not m:
-            continue
-        dtype, dims, op = m.groups()
+        if m:
+            dtype, dims, op = m.groups()
+            nbytes = shape_bytes(dtype, dims)
+        else:
+            mt = _TUPLE_OP_RE.search(line)
+            if not mt:
+                continue
+            shapes, op = mt.groups()
+            nbytes = sum(
+                shape_bytes(d, dims) for d, dims in _SHAPE_RE.findall(shapes)
+            )
         cat = (
             MOVE_OPS.get(op) or COLL_OPS.get(op) or COMPUTE_OPS.get(op)
             or f"other:{op}"
         )
         hist[cat][0] += 1
-        hist[cat][1] += shape_bytes(dtype, dims)
+        hist[cat][1] += nbytes
     return dict(hist)
 
 

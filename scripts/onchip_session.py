@@ -107,8 +107,11 @@ def main() -> int:
             os.path.join(outdir, "bench_raw.txt"), T,
             env_extra={"DEAR_BENCH_WATCHDOG_SECS": str(int(T * 0.9))},
         )
-        # extract the contract JSON line for easy reading
-        for line in reversed(r["tail"].splitlines()):
+        # extract the contract JSON line from the FULL artifact (the
+        # summary tail is truncated and the line easily exceeds it)
+        with open(os.path.join(outdir, "bench_raw.txt")) as f:
+            bench_out = f.read()
+        for line in reversed(bench_out.splitlines()):
             if line.startswith("{") and '"metric"' in line:
                 with open(os.path.join(outdir, "bench.json"), "w") as f:
                     f.write(line + "\n")

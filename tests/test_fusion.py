@@ -115,3 +115,26 @@ def test_scalar_and_empty_edge_cases(rng):
 
     with pytest.raises(ValueError):
         fusion.make_plan(params, world=0)
+
+
+def test_segment_ids_searchsorted_equivalence():
+    """The train step derives per-element parameter ids via searchsorted
+    over bucket offsets (no O(params) constant); it must agree with the
+    explicit FusionPlan.segment_ids map everywhere, padding included."""
+    import jax.numpy as jnp
+
+    from dear_pytorch_tpu.ops import fusion as F
+
+    params = {
+        "a": {"kernel": jnp.zeros((5, 3)), "bias": jnp.zeros((3,))},
+        "b": {"kernel": jnp.zeros((3, 7))},
+    }
+    plan = F.make_plan(params, world=8, nearby_layers=2)
+    for b in plan.buckets:
+        ref = plan.segment_ids(b.index)
+        starts = jnp.asarray(b.offsets, jnp.int32)
+        pos = jnp.arange(b.padded_size, dtype=jnp.int32)
+        seg = jnp.searchsorted(starts, pos, side="right").astype(jnp.int32) - 1
+        seg = jnp.where(pos < b.size, seg, len(b.leaf_ids))
+        np.testing.assert_array_equal(np.asarray(seg), ref)
+        assert b.pad > 0 or b.padded_size == b.size

@@ -77,25 +77,31 @@ def _run_direct(repo: str, worker: str, nprocs: int, local_devices: int):
 
 def _run_via_launcher(repo: str, worker: str, nprocs: int):
     """Run the same worker through launch/cpu_cluster.sh (ranks share one
-    output stream), so the launcher's env contract is itself under test."""
+    output stream), so the launcher's env contract is itself under test.
+    The launcher runs in its own session so a deadline kill takes the whole
+    process GROUP — killing only the shell would leave the rank processes
+    holding the coordinator port."""
+    import signal
+
     script = os.path.join(repo, "launch", "cpu_cluster.sh")
     assert os.access(script, os.X_OK), f"{script} must be executable"
+    proc = subprocess.Popen(
+        [script, str(nprocs), "--", sys.executable, worker],
+        env=_base_env(repo), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            [script, str(nprocs), "--", sys.executable, worker],
-            env=_base_env(repo), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True, timeout=DEADLINE,
-        )
+        out, _ = proc.communicate(timeout=DEADLINE)
     except subprocess.TimeoutExpired as e:
+        os.killpg(proc.pid, signal.SIGKILL)
+        out, _ = proc.communicate()
         raise AssertionError(
             f"cpu_cluster.sh wedged past {DEADLINE}s:\n"
-            f"{(e.stdout or b'')[-3000:]}"
+            f"{(e.stdout or out or '')[-3000:]}"
         ) from e
-    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert proc.returncode == 0, out[-3000:]
     for pid in range(nprocs):
-        assert f"MP_WORKER_OK rank={pid}/{nprocs}" in proc.stdout, (
-            proc.stdout[-3000:]
-        )
+        assert f"MP_WORKER_OK rank={pid}/{nprocs}" in out, out[-3000:]
 
 
 @pytest.mark.parametrize(
