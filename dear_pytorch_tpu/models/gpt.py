@@ -21,6 +21,7 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,7 +96,7 @@ class GptBlock(nn.Module):
     attention_impl: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, decode: bool = False):
         cfg = self.config
         h, nh = cfg.hidden_size, cfg.num_attention_heads
         d = h // nh
@@ -109,11 +110,14 @@ class GptBlock(nn.Module):
         dropout_rng = None
         if train and cfg.attention_probs_dropout_prob > 0.0:
             dropout_rng = self.make_rng("dropout")
-        impl = self.attention_impl or causal_dot_product_attention
-        ctx = impl(q, k, v, None, dropout_rng=dropout_rng,
-                   dropout_rate=(cfg.attention_probs_dropout_prob
-                                 if train else 0.0),
-                   dtype=cfg.dtype)
+        if decode:
+            ctx = self._decode_attend(q, k, v)
+        else:
+            impl = self.attention_impl or causal_dot_product_attention
+            ctx = impl(q, k, v, None, dropout_rng=dropout_rng,
+                       dropout_rate=(cfg.attention_probs_dropout_prob
+                                     if train else 0.0),
+                       dtype=cfg.dtype)
         attn = nn.DenseGeneral(h, axis=(-2, -1), dtype=cfg.dtype,
                                kernel_init=init, name="output")(ctx)
         attn = nn.Dropout(cfg.hidden_dropout_prob,
@@ -130,6 +134,53 @@ class GptBlock(nn.Module):
         y = nn.Dropout(cfg.hidden_dropout_prob, deterministic=not train)(y)
         return x + y
 
+    def _decode_attend(self, q, k, v):
+        """Single-token attention against a KV cache (autoregressive
+        decoding). The cache lives in the flax 'cache' collection
+        (``B x max_position x heads x head_dim`` per block plus a write
+        index); each call writes this step's K/V at the index and attends q
+        over the valid prefix. Shapes are static — max cache length is the
+        config's position budget."""
+        cfg = self.config
+        B, S, nh, d = q.shape
+        if S != 1:
+            raise ValueError(
+                f"decode mode feeds one token at a time, got S={S}"
+            )
+        L = cfg.max_position_embeddings
+        # flax's standard decode-cache pattern: during model.init the
+        # variables are being CREATED (has_variable is False) and the call
+        # must not execute a cache write — otherwise the returned cache
+        # starts at idx=1 with a phantom entry in slot 0, and every later
+        # key is double-counted one slot over
+        initialized = self.has_variable("cache", "k")
+        ck = self.variable("cache", "k",
+                           lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
+        cv = self.variable("cache", "v",
+                           lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
+        ci = self.variable("cache", "idx",
+                           lambda: jnp.zeros((), jnp.int32))
+        if not initialized:
+            return jnp.zeros_like(q)
+        i = ci.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, i, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, i, 0, 0))
+        ci.value = i + 1
+        # additive mask over cache slots: positions > i are invalid
+        valid = jnp.arange(L) <= i
+        mask = jnp.where(valid, 0.0, -1e9).astype(cfg.dtype)[
+            None, None, None, :
+        ]
+        # plain masked attention: causality is carried by the validity
+        # mask (a [1, L] causal triangle would mask everything but slot 0)
+        from dear_pytorch_tpu.models.bert import dot_product_attention
+
+        return dot_product_attention(
+            q, ck.value, cv.value, mask, dtype=cfg.dtype
+        )
+
 
 class GptLmHeadModel(nn.Module):
     """Token + position embeddings, pre-LN blocks, final LN, tied LM head.
@@ -142,7 +193,12 @@ class GptLmHeadModel(nn.Module):
     attention_impl: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True, position_offset=0):
+    def __call__(self, input_ids, train: bool = True, position_offset=0,
+                 decode: bool = False):
+        """``decode=True``: autoregressive mode — ``input_ids`` is one
+        token per sequence ``[B, 1]``, attention reads/writes the 'cache'
+        collection (apply with ``mutable=['cache']``), and
+        ``position_offset`` is the token's global position."""
         cfg = self.config
         B, S = input_ids.shape
         init = nn.initializers.normal(cfg.initializer_range)
@@ -156,10 +212,84 @@ class GptLmHeadModel(nn.Module):
         x = nn.Dropout(cfg.embd_dropout_prob, deterministic=not train)(x)
         for i in range(cfg.num_hidden_layers):
             x = GptBlock(cfg, attention_impl=self.attention_impl,
-                         name=f"h_{i}")(x, train)
+                         name=f"h_{i}")(x, train, decode=decode)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_f")(x)
         return wte.attend(x).astype(jnp.float32)
+
+
+def generate(
+    model: GptLmHeadModel,
+    params,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive decoding with a KV cache, as one jittable program.
+
+    The prompt prefills the cache one token per scan tick (same decode path
+    as sampling — one code path, exactly consistent with training-time
+    logits, pinned by tests/test_gpt.py), then ``max_new_tokens`` tokens
+    are sampled greedily (``temperature=0``) or from the
+    temperature-scaled categorical. Returns ``[B, prompt + new]`` token
+    ids. Padded vocab ids are masked out of the sampling support.
+    """
+    cfg = model.config
+    B, P = prompt_ids.shape
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    total = P + max_new_tokens
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt + new tokens ({total}) exceeds the cache budget "
+            f"(max_position_embeddings={cfg.max_position_embeddings})"
+        )
+
+    cache = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((B, 1), prompt_ids.dtype), train=False, decode=True,
+    )["cache"]
+    pad_mask = jnp.where(
+        jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size, 0.0, -1e9
+    )
+    # right-padded token buffer; scan index t reads (prompt) or writes
+    # (sampled) position t
+    tokens0 = jnp.concatenate(
+        [prompt_ids, jnp.zeros((B, max_new_tokens), prompt_ids.dtype)],
+        axis=1,
+    )
+
+    def tick(carry, t):
+        tokens, cache, key = carry
+        tok = lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache}, tok, train=False,
+            decode=True, position_offset=t, mutable=["cache"],
+        )
+        logits = logits[:, 0] + pad_mask[None, :]
+        if temperature > 0.0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(tokens.dtype)
+        # during prefill (t + 1 < P) the next token is the prompt's, not
+        # the model's; afterwards write the sample at t + 1
+        write_at = jnp.minimum(t + 1, total - 1)
+        keep = lax.dynamic_slice_in_dim(tokens, write_at, 1, axis=1)[:, 0]
+        chosen = jnp.where(t + 1 < P, keep, nxt)
+        tokens = lax.dynamic_update_slice_in_dim(
+            tokens, chosen[:, None], write_at, axis=1
+        )
+        return (tokens, vars_out["cache"], key), None
+
+    (tokens, _, _), _ = lax.scan(
+        tick, (tokens0, cache, rng), jnp.arange(total - 1)
+    )
+    return tokens
 
 
 def gpt_lm_loss(logits, input_ids, *, vocab_size: Optional[int] = None):
