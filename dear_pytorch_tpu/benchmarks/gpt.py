@@ -67,7 +67,8 @@ def main(argv=None) -> runner.BenchResult:
         raise SystemExit("--flash-attention conflicts with "
                          f"--sp-attention {args.sp_attention}; pass one")
     if sp > 1:
-        mesh = runner.build_sp_mesh(sp, args.sequence_len, args.pipeline)
+        mesh = runner.build_sp_mesh(sp, args.sequence_len, args.pipeline,
+                                    seq_flag="--sequence-len")
     else:
         mesh = backend.init()
     world = backend.dp_size(mesh)

@@ -252,7 +252,8 @@ def add_common_args(parser) -> None:
                              "compile)")
 
 
-def build_sp_mesh(sp: int, seq_len: int, pipeline: str):
+def build_sp_mesh(sp: int, seq_len: int, pipeline: str,
+                  seq_flag: str = "--sentence-len"):
     """dp x sp mesh for a sequence-parallel CLI run, with the shared
     validation both BERT and GPT benches need. `backend.init()` runs first
     for the (multi-host) bootstrap without fixing the axes — it is
@@ -268,7 +269,7 @@ def build_sp_mesh(sp: int, seq_len: int, pipeline: str):
         raise SystemExit(f"--sp-degree {sp} does not divide the "
                          f"{ndev}-device world")
     if seq_len % sp:
-        raise SystemExit(f"sequence length {seq_len} must divide by "
+        raise SystemExit(f"{seq_flag} {seq_len} must divide by "
                          f"--sp-degree {sp}")
     if pipeline != "none":
         raise SystemExit("--pipeline streaming is dp-only; use "
