@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dear_pytorch_tpu.models.bert import dot_product_attention
+
 
 @dataclasses.dataclass(frozen=True)
 class GptConfig:
@@ -175,8 +177,6 @@ class GptBlock(nn.Module):
         ]
         # plain masked attention: causality is carried by the validity
         # mask (a [1, L] causal triangle would mask everything but slot 0)
-        from dear_pytorch_tpu.models.bert import dot_product_attention
-
         return dot_product_attention(
             q, ck.value, cv.value, mask, dtype=cfg.dtype
         )
@@ -248,10 +248,18 @@ def generate(
             f"(max_position_embeddings={cfg.max_position_embeddings})"
         )
 
-    cache = model.init(
-        {"params": jax.random.PRNGKey(0)},
-        jnp.zeros((B, 1), prompt_ids.dtype), train=False, decode=True,
-    )["cache"]
+    # cache template from shapes only — a real model.init here would
+    # materialize (and discard) a full random parameter tree per call
+    cache = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(
+            lambda: model.init(
+                {"params": jax.random.PRNGKey(0)},
+                jnp.zeros((B, 1), prompt_ids.dtype), train=False,
+                decode=True,
+            )["cache"]
+        ),
+    )
     pad_mask = jnp.where(
         jnp.arange(cfg.padded_vocab_size) < cfg.vocab_size, 0.0, -1e9
     )
@@ -277,8 +285,9 @@ def generate(
             nxt = jnp.argmax(logits, axis=-1)
         nxt = nxt.astype(tokens.dtype)
         # during prefill (t + 1 < P) the next token is the prompt's, not
-        # the model's; afterwards write the sample at t + 1
-        write_at = jnp.minimum(t + 1, total - 1)
+        # the model's; afterwards write the sample at t + 1 (t runs to
+        # total - 2, so the write never leaves the buffer)
+        write_at = t + 1
         keep = lax.dynamic_slice_in_dim(tokens, write_at, 1, axis=1)[:, 0]
         chosen = jnp.where(t + 1 < P, keep, nxt)
         tokens = lax.dynamic_update_slice_in_dim(

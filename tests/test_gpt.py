@@ -171,7 +171,5 @@ def test_generate_temperature_sampling_runs():
                    temperature=0.8, rng=jax.random.PRNGKey(1))
     assert out.shape == (1, 9)
     assert int(jnp.max(out)) < 61
-    import pytest as _pytest
-
-    with _pytest.raises(ValueError, match="rng"):
+    with pytest.raises(ValueError, match="rng"):
         generate(model, params, prompt, max_new_tokens=2, temperature=0.5)
