@@ -218,6 +218,22 @@ class GptLmHeadModel(nn.Module):
         return wte.attend(x).astype(jnp.float32)
 
 
+def _top_p_filter(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest set of tokens whose cumulative
+    probability reaches ``top_p`` (the most-probable token always stays);
+    everything else is masked to -inf."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # cutoff = lowest logit still inside the nucleus: first index where the
+    # cumulative mass (EXCLUSIVE of the current token) is already >= top_p
+    inside = (cum - probs) < top_p
+    cutoff = jnp.min(
+        jnp.where(inside, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= cutoff, logits, -jnp.inf)
+
+
 def generate(
     model: GptLmHeadModel,
     params,
@@ -225,6 +241,7 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Autoregressive decoding with a KV cache, as one jittable program.
@@ -233,13 +250,16 @@ def generate(
     as sampling — one code path, exactly consistent with training-time
     logits, pinned by tests/test_gpt.py), then ``max_new_tokens`` tokens
     are sampled greedily (``temperature=0``) or from the
-    temperature-scaled categorical. Returns ``[B, prompt + new]`` token
-    ids. Padded vocab ids are masked out of the sampling support.
+    temperature-scaled categorical, optionally nucleus-filtered
+    (``top_p < 1``). Returns ``[B, prompt + new]`` token ids. Padded vocab
+    ids are masked out of the sampling support.
     """
     cfg = model.config
     B, P = prompt_ids.shape
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     total = P + max_new_tokens
     if total > cfg.max_position_embeddings:
@@ -280,7 +300,10 @@ def generate(
         logits = logits[:, 0] + pad_mask[None, :]
         if temperature > 0.0:
             key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            logits = logits / temperature
+            if top_p < 1.0:
+                logits = _top_p_filter(logits, top_p)
+            nxt = jax.random.categorical(sub, logits, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         nxt = nxt.astype(tokens.dtype)

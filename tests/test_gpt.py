@@ -173,3 +173,34 @@ def test_generate_temperature_sampling_runs():
     assert int(jnp.max(out)) < 61
     with pytest.raises(ValueError, match="rng"):
         generate(model, params, prompt, max_new_tokens=2, temperature=0.5)
+
+
+def test_top_p_filter_properties():
+    """Nucleus filter: the most-probable token always survives; with a
+    tiny top_p only it survives; with top_p=1 nothing is filtered; sampled
+    ids stay inside the filtered support."""
+    from dear_pytorch_tpu.models.gpt import _top_p_filter, generate
+
+    logits = jnp.asarray([[2.0, 1.0, 0.5, -1.0], [0.0, 3.0, 2.9, -2.0]])
+    tight = _top_p_filter(logits, 1e-6)
+    # only the argmax survives a near-zero nucleus
+    np.testing.assert_array_equal(
+        np.asarray(jnp.isfinite(tight)),
+        np.asarray(jax.nn.one_hot(jnp.argmax(logits, -1), 4) > 0),
+    )
+    full = _top_p_filter(logits, 1.0)
+    np.testing.assert_array_equal(np.asarray(jnp.isfinite(full)),
+                                  np.ones((2, 4), bool))
+    # mid nucleus keeps a prefix of the sorted tokens (monotone support)
+    mid = _top_p_filter(logits, 0.7)
+    kept = np.asarray(jnp.isfinite(mid))
+    assert kept[0].sum() >= 1 and kept[1].sum() >= 1
+    assert kept[0, 0] and kept[1, 1]  # argmax rows kept
+
+    model, params = _params()
+    prompt = jnp.asarray(np.random.RandomState(8).randint(0, 61, (1, 4)))
+    out = generate(model, params, prompt, max_new_tokens=4,
+                   temperature=0.9, top_p=0.9, rng=jax.random.PRNGKey(2))
+    assert out.shape == (1, 8) and int(jnp.max(out)) < 61
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, max_new_tokens=2, top_p=0.0)
