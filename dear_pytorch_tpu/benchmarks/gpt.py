@@ -56,9 +56,6 @@ def main(argv=None) -> runner.BenchResult:
     args = build_parser().parse_args(argv)
     runner.apply_platform_env()
     scan_steps = runner.validate_scan_steps(args)
-    if args.pipeline != "none":
-        raise SystemExit("--pipeline streaming is not wired for the GPT "
-                         "bench yet; use --pipeline none")
     sp = max(int(args.sp_degree), 1)
     if args.sp_attention and sp == 1:
         raise SystemExit("--sp-attention requires --sp-degree > 1")
@@ -162,7 +159,17 @@ def main(argv=None) -> runner.BenchResult:
     runner.log(f"Schedule: {args.mode}; "
                f"fusion: {ts.plan.num_buckets} bucket(s)")
 
-    next_batch, close = runner.make_batch_source(args, None, None, batch)
+    if sp > 1:
+        # --pipeline none enforced by build_sp_mesh: constant-batch source
+        next_batch, close = runner.make_batch_source(args, None, None, batch)
+    else:
+        from dear_pytorch_tpu.runtime import pipeline as RP
+
+        spec = RP.gpt_spec(global_bs, args.sequence_len,
+                           vocab=cfg.vocab_size)
+        next_batch, close = runner.make_batch_source(
+            args, spec, sharding, batch
+        )
 
     holder = {"state": state, "metrics": None, "batch": batch}
     step_fn, timed_kwargs = runner.make_step_source(
