@@ -44,9 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--flash-attention) over global positions — "
                         "long-context autoregressive pretraining")
     p.add_argument("--sp-attention", type=str, default=None,
-                   choices=["ring", "ring_flash", "ulysses"],
+                   choices=["ring", "ring_flash", "ulysses", "zigzag"],
                    help="sequence-parallel attention scheme (default: ring, "
-                        "or ring_flash with --flash-attention)")
+                        "or ring_flash with --flash-attention; zigzag = "
+                        "load-balanced causal ring flash over a striped "
+                        "shard layout)")
     runner.add_common_args(p)
     p.set_defaults(batch_size=8, base_lr=1e-4, momentum=0.0)
     return p
@@ -83,7 +85,8 @@ def main(argv=None) -> runner.BenchResult:
                          f"{cfg.max_position_embeddings}")
     attention_impl = None
     kernel_attn = (args.flash_attention
-                   or args.sp_attention in ("ring_flash", "ulysses"))
+                   or args.sp_attention in ("ring_flash", "ulysses",
+                                            "zigzag"))
     if kernel_attn and cfg.attention_probs_dropout_prob:
         runner.log("kernel attention: attention_probs_dropout_prob "
                    f"{cfg.attention_probs_dropout_prob} -> 0.0 "
@@ -106,6 +109,14 @@ def main(argv=None) -> runner.BenchResult:
 
         sp_model = SP.sp_gpt_model(cfg, flash=args.flash_attention,
                                    attention=args.sp_attention)
+        zigzag = args.sp_attention == "zigzag"
+        if zigzag:
+            from dear_pytorch_tpu.parallel.ring_attention import (
+                zigzag_permutation,
+            )
+
+            perm = zigzag_permutation(args.sequence_len, sp)
+            batch = {"input_ids": batch["input_ids"][:, perm]}
         shardings = jax.tree.map(
             lambda s: jax.sharding.NamedSharding(mesh, s),
             SP.bert_sp_batch_specs(batch),
@@ -118,7 +129,7 @@ def main(argv=None) -> runner.BenchResult:
             train=False,
         )["params"]
         loss_fn = SP.make_sp_gpt_loss_fn(
-            sp_model, vocab_size=cfg.vocab_size, train=True
+            sp_model, vocab_size=cfg.vocab_size, train=True, zigzag=zigzag
         )
         extra_build = dict(
             axis_name=(DP_AXIS, SP_AXIS),

@@ -387,6 +387,246 @@ def make_ring_flash_attention_impl(axis_name: str, causal: bool = False):
     return impl
 
 
+# ---------------------------------------------------------------------------
+# Zigzag (striped) causal ring flash — load-balanced long-context causal
+# ---------------------------------------------------------------------------
+#
+# With the SEQUENTIAL shard layout, causal ring flash is load-imbalanced:
+# device i computes i+1 block pairs while the ring's wall-clock is the max.
+# The zigzag layout (Striped Attention family; zhuzilin's zigzag variant)
+# gives each device TWO half-size chunks from opposite ends of the
+# sequence: device i owns chunks (i, 2P-1-i), local order [early, late].
+# Then EVERY pair reduces to existing kernels at ~half a pair's cost:
+#
+#   owner == idx : local order is globally monotone -> plain CAUSAL pair
+#   owner <  idx : k's early chunk is before ALL local q (full attend);
+#                  k's late chunk is after all local q (skip) -> half-k pair
+#   owner >  idx : local early q precedes all of k (skip); local late q is
+#                  after ALL of k (full attend)               -> half-q pair
+#
+# so the per-device work is ~P half-pairs regardless of idx — balanced.
+
+
+def _zz_branches_fwd(scale, c, heads):
+    """Forward branches (same output shapes) for lax.switch."""
+
+    def aligned(args):
+        q, kb, vb, mb = args
+        return flash_pair_fwd(q, kb, vb, jnp.repeat(mb, heads, axis=0),
+                              scale, True, out_dtype=jnp.float32)
+
+    def earlier(args):
+        q, kb, vb, mb = args
+        mh = jnp.repeat(mb[:, :c], heads, axis=0)
+        return flash_pair_fwd(q, kb[:, :c], vb[:, :c], mh, scale, False,
+                              out_dtype=jnp.float32)
+
+    def later(args):
+        q, kb, vb, mb = args
+        bh, sq, d = q.shape
+        mh = jnp.repeat(mb, heads, axis=0)
+        o_h, lse_h = flash_pair_fwd(q[:, c:], kb, vb, mh, scale, False,
+                                    out_dtype=jnp.float32)
+        o = jnp.concatenate(
+            [jnp.zeros((bh, c, d), jnp.float32), o_h], axis=1
+        )
+        lse = jnp.concatenate(
+            [jnp.full((bh, c), _NEG_BIG, jnp.float32), lse_h], axis=1
+        )
+        return o, lse
+
+    return [aligned, earlier, later]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _zigzag_ring_flash(q, k, v, mask, axis_name, scale):
+    out, _ = _zigzag_fwd_pass(q, k, v, mask, axis_name, scale)
+    return out
+
+
+def _zz_branch_index(owner, idx):
+    return jnp.where(owner == idx, 0, jnp.where(owner < idx, 1, 2))
+
+
+def _zigzag_fwd_pass(q, k, v, mask, axis_name, scale):
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bh, sq, d = q.shape
+    c = sq // 2
+    heads = bh // mask.shape[0]
+    perm = _ring_perm(world)
+    branches = _zz_branches_fwd(scale, c, heads)
+
+    def step(carry, s):
+        kb, vb, mb, m, den, num = carry
+        owner = (idx - s) % world
+        o_b, lse_b = lax.switch(_zz_branch_index(owner, idx), branches,
+                                (q, kb, vb, mb))
+        lse_b = jnp.maximum(lse_b, _NEG_BIG)
+        m_new = jnp.maximum(m, lse_b)
+        w = jnp.exp(lse_b - m_new)
+        alpha = jnp.exp(m - m_new)
+        den = den * alpha + w
+        num = num * alpha[..., None] + o_b * w[..., None]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        mb = lax.ppermute(mb, axis_name, perm)
+        return (kb, vb, mb, m_new, den, num), None
+
+    m0 = jnp.full((bh, sq), _NEG_BIG, jnp.float32)
+    den0 = jnp.zeros((bh, sq), jnp.float32)
+    num0 = jnp.zeros((bh, sq, d), jnp.float32)
+    (_, _, _, m, den, num), _ = lax.scan(
+        step, (k, v, mask, m0, den0, num0), jnp.arange(world)
+    )
+    out = (num / jnp.maximum(den, 1e-30)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(den, 1e-30))
+    return out, lse
+
+
+def _zigzag_fwd(q, k, v, mask, axis_name, scale):
+    out, lse = _zigzag_fwd_pass(q, k, v, mask, axis_name, scale)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _zigzag_bwd(axis_name, scale, res, do):
+    q, k, v, mask, out, lse = res
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bh, sq, d = q.shape
+    c = sq // 2
+    heads = bh // mask.shape[0]
+    perm = _ring_perm(world)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+
+    def aligned(args):
+        q_, kb, vb, mb = args
+        mh = jnp.repeat(mb, heads, axis=0)
+        return (flash_pair_dq(q_, kb, vb, mh, do, lse, delta, scale, True,
+                              out_dtype=jnp.float32),
+                *flash_pair_dkv(q_, kb, vb, mh, do, lse, delta, scale,
+                                True, out_dtype=jnp.float32))
+
+    def earlier(args):
+        q_, kb, vb, mb = args
+        mh = jnp.repeat(mb[:, :c], heads, axis=0)
+        kh, vh = kb[:, :c], vb[:, :c]
+        dq_c = flash_pair_dq(q_, kh, vh, mh, do, lse, delta, scale, False,
+                             out_dtype=jnp.float32)
+        dkh, dvh = flash_pair_dkv(q_, kh, vh, mh, do, lse, delta, scale,
+                                  False, out_dtype=jnp.float32)
+        z = jnp.zeros((bh, sq - c, d), jnp.float32)
+        return (dq_c, jnp.concatenate([dkh, z], axis=1),
+                jnp.concatenate([dvh, z], axis=1))
+
+    def later(args):
+        q_, kb, vb, mb = args
+        mh = jnp.repeat(mb, heads, axis=0)
+        qh, doh = q_[:, c:], do[:, c:]
+        lseh, deltah = lse[:, c:], delta[:, c:]
+        dq_h = flash_pair_dq(qh, kb, vb, mh, doh, lseh, deltah, scale,
+                             False, out_dtype=jnp.float32)
+        dk_c, dv_c = flash_pair_dkv(qh, kb, vb, mh, doh, lseh, deltah,
+                                    scale, False, out_dtype=jnp.float32)
+        dq_c = jnp.concatenate(
+            [jnp.zeros((bh, c, d), jnp.float32), dq_h], axis=1
+        )
+        return dq_c, dk_c, dv_c
+
+    branches = [aligned, earlier, later]
+
+    def step(carry, s):
+        kb, vb, mb, dkb, dvb, dq = carry
+        owner = (idx - s) % world
+        dq_c, dk_c, dv_c = lax.switch(_zz_branch_index(owner, idx),
+                                      branches, (q, kb, vb, mb))
+        dq = dq + dq_c
+        dkb = dkb + dk_c
+        dvb = dvb + dv_c
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        mb = lax.ppermute(mb, axis_name, perm)
+        dkb = lax.ppermute(dkb, axis_name, perm)
+        dvb = lax.ppermute(dvb, axis_name, perm)
+        return (kb, vb, mb, dkb, dvb, dq), None
+
+    (_, _, _, dk, dv, dq), _ = lax.scan(
+        step,
+        (k, v, mask, jnp.zeros(k.shape, jnp.float32),
+         jnp.zeros(v.shape, jnp.float32), jnp.zeros(q.shape, jnp.float32)),
+        jnp.arange(world),
+    )
+    import numpy as _np
+
+    dmask = _np.zeros(mask.shape, jax.dtypes.float0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dmask
+
+
+_zigzag_ring_flash.defvjp(_zigzag_fwd, _zigzag_bwd)
+
+
+def zigzag_positions(seq_local: int, axis_name: str):
+    """Global token positions of this device's zigzag shard: local order is
+    [chunk idx, chunk 2P-1-idx], chunk size = seq_local // 2."""
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    c = seq_local // 2
+    ar = jnp.arange(c)
+    return jnp.concatenate([idx * c + ar, (2 * world - 1 - idx) * c + ar])
+
+
+def zigzag_permutation(seq_len: int, world: int):
+    """numpy index array p with ``x_zigzag = x[:, p]``: position j of the
+    zigzag-layout sequence (devices' shards concatenated in mesh order)
+    holds global token p[j]. Use it to pre-permute host batches; it is an
+    involution composed with nothing — invert with argsort."""
+    import numpy as np
+
+    if seq_len % (2 * world):
+        raise ValueError(
+            f"seq_len {seq_len} must divide by 2*world ({2 * world})"
+        )
+    c = seq_len // (2 * world)
+    out = []
+    for d in range(world):
+        out.append(np.arange(d * c, (d + 1) * c))
+        out.append(np.arange((2 * world - 1 - d) * c,
+                             (2 * world - d) * c))
+    return np.concatenate(out)
+
+
+def zigzag_ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Load-balanced CAUSAL ring flash attention over zigzag-layout shards
+    (see the section comment; inputs must already be in zigzag order —
+    `zigzag_permutation` / `zigzag_positions`). Exact; differentiable
+    (ring-level custom VJP); every device does ~P half-pairs of kernel
+    work instead of idx+1 full pairs."""
+    B, S, H, D = q.shape
+    if S % 2:
+        raise ValueError(f"zigzag needs an even local length, got {S}")
+    scale = D ** -0.5 if scale is None else scale
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    kvm = (
+        jnp.ones((B, S), jnp.int32) if kv_mask is None
+        else kv_mask.astype(jnp.int32)
+    )
+    o = _zigzag_ring_flash(fold(q), fold(k), fold(v), kvm, axis_name, scale)
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
 def make_ulysses_attention_impl(axis_name: str, causal: bool = False):
     """Model-zoo ``attention_impl`` backed by `ulysses_attention` (two
     all-to-alls instead of a P-step ring; needs heads % P == 0). The local

@@ -28,6 +28,8 @@ from dear_pytorch_tpu.parallel.ring_attention import (
     make_ring_attention_impl,
     make_ring_flash_attention_impl,
     make_ulysses_attention_impl,
+    zigzag_positions,
+    zigzag_ring_flash_attention,
 )
 
 
@@ -135,7 +137,7 @@ def make_sp_bert_loss_fn(model, *, sp_axis: str = SP_AXIS,
 
 
 def sp_gpt_loss(logits, input_ids, axis_name: str = SP_AXIS,
-                vocab_size: Optional[int] = None):
+                vocab_size: Optional[int] = None, zigzag: bool = False):
     """Next-token cross-entropy under sequence sharding.
 
     The shift crosses shard boundaries: the LAST position of shard i
@@ -152,17 +154,20 @@ def sp_gpt_loss(logits, input_ids, axis_name: str = SP_AXIS,
     world = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, S, Vp = logits.shape
-    # shard i receives shard (i+1)'s first token (wraps; the wrapped value
-    # lands on the last shard's masked-out final position)
-    nxt = lax.ppermute(
-        input_ids[:, 0], axis_name,
-        [((i + 1) % world, i) for i in range(world)],
-    )
-    targets = jnp.concatenate([input_ids[:, 1:], nxt[:, None]], axis=1)
-    col_ok = jnp.arange(S)[None, :] < S - 1
-    valid = jnp.where(idx == world - 1, col_ok,
-                      jnp.ones_like(col_ok))          # [1, S] broadcasts
-    valid = jnp.broadcast_to(valid, (B, S))
+    if zigzag:
+        targets, valid = _zigzag_gpt_targets(input_ids, axis_name)
+    else:
+        # shard i receives shard (i+1)'s first token (wraps; the wrapped
+        # value lands on the last shard's masked-out final position)
+        nxt = lax.ppermute(
+            input_ids[:, 0], axis_name,
+            [((i + 1) % world, i) for i in range(world)],
+        )
+        targets = jnp.concatenate([input_ids[:, 1:], nxt[:, None]], axis=1)
+        col_ok = jnp.arange(S)[None, :] < S - 1
+        valid = jnp.where(idx == world - 1, col_ok,
+                          jnp.ones_like(col_ok))      # [1, S] broadcasts
+        valid = jnp.broadcast_to(valid, (B, S))
     if vocab_size is not None and vocab_size < Vp:
         pad = jnp.arange(Vp) >= vocab_size
         logits = jnp.where(pad[None, None], -1e9, logits)
@@ -178,31 +183,93 @@ def sp_gpt_loss(logits, input_ids, axis_name: str = SP_AXIS,
     )
 
 
+def _zigzag_gpt_targets(ids, axis_name: str):
+    """(targets, valid) for the next-token loss under the ZIGZAG layout:
+    each device holds chunks (idx, 2W-1-idx); within-chunk targets shift by
+    one, each chunk's boundary target is the NEXT chunk's first token
+    (all-gathered — 2 tiny tokens per device), and the global last position
+    (chunk 2W-1's end, on device 0) is masked."""
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S = ids.shape
+    c = S // 2
+    firsts = jnp.stack([ids[:, 0], ids[:, c]], axis=-1)      # [B, 2]
+    gathered = lax.all_gather(firsts, axis_name)             # [W, B, 2]
+
+    def first_of_chunk(ch):
+        ch = jnp.minimum(ch, 2 * world - 1)  # clamp the past-the-end lookup
+        dev = jnp.where(ch < world, ch, 2 * world - 1 - ch)
+        slot = jnp.where(ch < world, 0, 1)
+        return gathered[dev, :, slot]                        # [B]
+
+    next_a = first_of_chunk(idx + 1)
+    next_b = first_of_chunk(2 * world - idx)
+    targets = jnp.concatenate(
+        [ids[:, 1:c], next_a[:, None], ids[:, c + 1:], next_b[:, None]],
+        axis=1,
+    )
+    # only the very last global position (device 0's chunk 2W-1 end) has
+    # no target
+    last_col = jnp.arange(S)[None, :] == S - 1
+    valid = jnp.broadcast_to(~(last_col & (idx == 0)), (B, S))
+    return targets, valid
+
+
 def make_sp_gpt_loss_fn(model, *, vocab_size: Optional[int] = None,
-                        sp_axis: str = SP_AXIS, train: bool = True):
+                        sp_axis: str = SP_AXIS, train: bool = True,
+                        zigzag: bool = False):
     """``loss_fn(params, batch[, rng])`` for `build_train_step` on a dp×sp
     mesh: causal ring attention over ``sp_axis``, offset positions,
     cross-shard next-token targets. The model must have been built with
-    `sp_gpt_model`."""
+    `sp_gpt_model` (pass ``zigzag=True`` iff it uses the zigzag attention —
+    positions and targets then follow the zigzag layout)."""
 
     def loss_fn(params, batch, rng=None):
         ids = batch["input_ids"]
-        offset = sp_position_offset(ids.shape[1], sp_axis)
+        S = ids.shape[1]
+        if zigzag:
+            # position_offset enters the model as offset + arange(S); an
+            # offset VECTOR recovers arbitrary per-token global positions
+            offset = (zigzag_positions(S, sp_axis) - jnp.arange(S))[None, :]
+        else:
+            offset = sp_position_offset(S, sp_axis)
         rngs = {"dropout": rng} if rng is not None else None
         logits = model.apply(
             {"params": params}, ids, train=train, rngs=rngs,
             position_offset=offset,
         )
         return sp_gpt_loss(logits.astype(jnp.float32), ids, sp_axis,
-                           vocab_size=vocab_size)
+                           vocab_size=vocab_size, zigzag=zigzag)
 
     return loss_fn
+
+
+def make_zigzag_attention_impl(axis_name: str, causal: bool = True):
+    """Model-zoo ``attention_impl`` backed by the load-balanced zigzag
+    causal ring flash. CAUSAL ONLY (the layout exists to balance causal
+    skipping) and no attention-prob dropout — there is no correct fallback:
+    the dense ring's causal mask assumes the SEQUENTIAL layout, so falling
+    back under the zigzag layout would silently compute wrong attention."""
+    if not causal:
+        raise ValueError("zigzag attention is causal-only")
+
+    def impl(q, k, v, mask, dropout_rng=None, dropout_rate=0.0, dtype=None):
+        if dropout_rng is not None and dropout_rate > 0.0:
+            raise ValueError(
+                "zigzag attention has no attention-dropout path; set "
+                "attention_probs_dropout_prob=0"
+            )
+        del mask  # full sequences in the causal LM path
+        return zigzag_ring_flash_attention(q, k, v, axis_name)
+
+    return impl
 
 
 _SP_ATTENTION_IMPLS = {
     "ring": make_ring_attention_impl,
     "ring_flash": make_ring_flash_attention_impl,
     "ulysses": make_ulysses_attention_impl,
+    "zigzag": make_zigzag_attention_impl,
 }
 
 
@@ -213,7 +280,12 @@ def sp_gpt_model(config, sp_axis: str = SP_AXIS, *, flash: bool = False,
     choices and fallback policy as `sp_bert_model`; causality is enforced
     over GLOBAL positions inside the ring (earlier blocks attend fully, the
     aligned diagonal block causally, later blocks are skipped — the
-    ring-flash path prunes skipped pairs instead of masking them)."""
+    ring-flash path prunes skipped pairs instead of masking them).
+    ``attention='zigzag'`` adds the LOAD-BALANCED variant: shards hold two
+    half-chunks from opposite sequence ends, so skipping saves the same
+    work on every device — batches must be pre-permuted with
+    `ring_attention.zigzag_permutation` and the loss built with
+    ``make_sp_gpt_loss_fn(..., zigzag=True)``."""
     from dear_pytorch_tpu.models.gpt import GptLmHeadModel
 
     impl = _resolve_sp_attention(flash, attention)(sp_axis, causal=True)
@@ -250,5 +322,11 @@ def sp_bert_model(config, sp_axis: str = SP_AXIS, *, flash: bool = False,
     attention-prob dropout is active."""
     from dear_pytorch_tpu.models.bert import BertForPreTraining
 
+    if attention == "zigzag":
+        raise ValueError(
+            "zigzag attention is causal-only (the layout balances causal "
+            "skipping); BERT attention is bidirectional — use "
+            "ring/ring_flash/ulysses"
+        )
     impl = _resolve_sp_attention(flash, attention)(sp_axis)
     return BertForPreTraining(config, attention_impl=impl)

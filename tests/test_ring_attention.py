@@ -283,3 +283,85 @@ def test_ring_attention_dropout_matches_blockwise_reference(mesh):
                      np.asarray(v))
     assert keep.mean() < 0.95  # dropout actually dropped something
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag (striped) causal ring flash
+# ---------------------------------------------------------------------------
+
+
+def _zigzag_perm(world):
+    from dear_pytorch_tpu.parallel.ring_attention import zigzag_permutation
+
+    return zigzag_permutation(S, world)
+
+
+def test_zigzag_matches_full_causal(mesh):
+    """Zigzag-layout causal ring flash == full causal attention, after
+    undoing the layout permutation."""
+    from dear_pytorch_tpu.parallel.ring_attention import (
+        zigzag_ring_flash_attention,
+    )
+
+    world = mesh.shape[DP_AXIS]
+    perm = _zigzag_perm(world)
+    q, k, v = _qkv(jax.random.PRNGKey(11))
+    want = full_attention(q, k, v, causal=True)
+
+    def fn(qb, kb, vb):
+        out = zigzag_ring_flash_attention(qb[0], kb[0], vb[0], DP_AXIS)
+        return out[None]
+
+    got_z = _run_sharded(
+        fn, q[:, perm], k[:, perm], v[:, perm], mesh
+    )
+    inv = np.argsort(perm)
+    np.testing.assert_allclose(
+        np.asarray(got_z)[:, inv], np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_zigzag_gradients_match_full_causal(mesh):
+    """The zigzag ring-level VJP must reproduce full causal attention's
+    gradients (after the layout permutation)."""
+    from dear_pytorch_tpu.parallel.ring_attention import (
+        zigzag_ring_flash_attention,
+    )
+
+    world = mesh.shape[DP_AXIS]
+    perm = _zigzag_perm(world)
+    inv = np.argsort(perm)
+    q, k, v = _qkv(jax.random.PRNGKey(12))
+    w = jax.random.normal(jax.random.PRNGKey(13), (B, S, H, D))
+
+    def ref_loss(q_, k_, v_):
+        return jnp.sum(full_attention(q_, k_, v_, causal=True) * w)
+
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+
+    wz = w[:, perm]
+
+    def fn(qb, kb, vb, wb):
+        out = zigzag_ring_flash_attention(qb[0], kb[0], vb[0], DP_AXIS)
+        return jnp.sum(out * wb[0])[None]
+
+    def zz_loss(qz, kz, vz):
+        mapped = jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(jax.P(DP_AXIS),) * 4,
+            out_specs=jax.P(DP_AXIS),
+            check_vma=False,
+        )
+        parts = mapped(
+            _shard_seq(qz, world), _shard_seq(kz, world),
+            _shard_seq(vz, world), _shard_seq(wz, world),
+        )
+        return jnp.sum(parts)
+
+    got = jax.grad(zz_loss, argnums=(0, 1, 2))(
+        q[:, perm], k[:, perm], v[:, perm]
+    )
+    for g, ref in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g)[:, inv], np.asarray(ref), rtol=5e-5, atol=5e-5
+        )

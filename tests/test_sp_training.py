@@ -205,3 +205,51 @@ def test_sp_gpt_training_matches_dense(mesh2d, attention):
         state, m = ts.step(state, batch)
         losses.append(float(m["loss"]))
     np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-5)
+
+
+def test_sp_gpt_zigzag_training_matches_dense(mesh2d):
+    """The load-balanced zigzag layout: pre-permuted batches, per-token
+    position offsets, cross-CHUNK next-token targets — all of it must
+    still reproduce dense single-device GPT training step for step."""
+    from dear_pytorch_tpu.models import data
+    from dear_pytorch_tpu.models.gpt import GptLmHeadModel
+    from dear_pytorch_tpu.parallel import sp as SP
+    from dear_pytorch_tpu.parallel.ring_attention import zigzag_permutation
+
+    cfg = _gpt_cfg()
+    batch = data.synthetic_gpt_batch(
+        jax.random.PRNGKey(21), B, seq_len=S, vocab_size=cfg.vocab_size
+    )
+    dense = GptLmHeadModel(cfg)
+    params = dense.init(
+        {"params": jax.random.PRNGKey(0)}, batch["input_ids"], train=False
+    )["params"]
+    ref_losses = _gpt_dense_losses(cfg, params, batch["input_ids"], steps=3)
+
+    sp_world = mesh2d.shape["sp"]
+    perm = zigzag_permutation(S, sp_world)
+    zbatch = {"input_ids": batch["input_ids"][:, perm]}
+
+    model = SP.sp_gpt_model(cfg, attention="zigzag")
+    ts = build_train_step(
+        SP.make_sp_gpt_loss_fn(model, vocab_size=cfg.vocab_size,
+                               train=False, zigzag=True),
+        params,
+        mesh=mesh2d,
+        axis_name=("dp", "sp"),
+        mean_axes=("dp",),
+        batch_spec_fn=SP.bert_sp_batch_specs,
+        threshold_mb=0.01,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        donate=False,
+    )
+    state = ts.init(params)
+    losses = []
+    for _ in range(3):
+        state, m = ts.step(state, zbatch)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-5)
+
+    # zigzag is causal-only and refuses silent fallbacks
+    with pytest.raises(ValueError, match="causal-only"):
+        SP.sp_bert_model(CFG, attention="zigzag")
