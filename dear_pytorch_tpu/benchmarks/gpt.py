@@ -65,6 +65,11 @@ def main(argv=None) -> runner.BenchResult:
             and args.sp_attention != "ring_flash"):
         raise SystemExit("--flash-attention conflicts with "
                          f"--sp-attention {args.sp_attention}; pass one")
+    if args.sp_attention == "zigzag" and args.sequence_len % (2 * sp):
+        raise SystemExit(
+            f"--sp-attention zigzag needs --sequence-len divisible by "
+            f"2*sp-degree ({2 * sp}), got {args.sequence_len}"
+        )
     if sp > 1:
         mesh = runner.build_sp_mesh(sp, args.sequence_len, args.pipeline,
                                     seq_flag="--sequence-len")

@@ -200,49 +200,26 @@ def _pair_branch(owner, idx, causal):
                      jnp.where(owner < idx, jnp.int32(0), jnp.int32(2)))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _ring_flash(q, k, v, mask, axis_name, scale, causal):
-    out, _ = _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal)
-    return out
+# Shared ring-of-flash-kernels scaffold. A "variant" is just a branch set
+# for lax.switch plus the (owner, idx) -> branch index map; the sequential
+# and zigzag layouts share EVERYTHING else (the online-softmax LSE combine,
+# _NEG_BIG clamps, the co-rotating dK/dV ppermute schedule, the fp32
+# accumulation) so a numerics fix can never apply to one and miss the other.
 
 
-def _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal):
-    """Ring of flash-forward kernels over folded ``[BH, S, D]`` shards.
-
-    Per step, this device attends its Q block against the K/V block
-    currently resident (rotating via ppermute) using the Pallas kernel —
-    the [S_loc, S_loc] score tile never hits HBM — and folds the block's
-    normalized output into a running LSE combine:
+def _ring_fwd_scan(q, k, v, mask, axis_name, branch_index_fn, branches):
+    """Forward ring: fold per-step (o, lse) block contributions into
         out = Σ_b o_b · exp(lse_b − m*) / Σ_b exp(lse_b − m*)
-    Returns (out, global_lse).
-    """
+    Returns (out, global_lse)."""
     world = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     bh, sq, d = q.shape
-    heads = bh // mask.shape[0]  # mask stays [B, S]; repeat locally per call
     perm = _ring_perm(world)
-
-    def make_branch(causal_pair):
-        def branch(args):
-            q_, kb, vb, mb = args
-            # fp32 block contributions: the cross-block accumulation below
-            # must not round through the input dtype per step
-            return flash_pair_fwd(q_, kb, vb, jnp.repeat(mb, heads, axis=0),
-                                  scale, causal_pair, out_dtype=jnp.float32)
-        return branch
-
-    def skip_b(args):
-        q_ = args[0]
-        return (jnp.zeros(q_.shape, jnp.float32),
-                jnp.full((bh, sq), _NEG_BIG, jnp.float32))
-
-    full_b, causal_b = make_branch(False), make_branch(True)
 
     def step(carry, s):
         kb, vb, mb, m, den, num = carry
         owner = (idx - s) % world
-        br = _pair_branch(owner, idx, causal)
-        o_b, lse_b = lax.switch(br, [full_b, causal_b, skip_b],
+        o_b, lse_b = lax.switch(branch_index_fn(owner, idx), branches,
                                 (q, kb, vb, mb))
         lse_b = jnp.maximum(lse_b, _NEG_BIG)     # fully-masked rows finite
         m_new = jnp.maximum(m, lse_b)
@@ -266,6 +243,83 @@ def _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal):
     return out, lse
 
 
+def _ring_bwd_scan(q, k, v, mask, axis_name, branch_index_fn, branches):
+    """Backward ring: per-step (dq, dk, dv) block contributions; dK/dV
+    accumulators rotate WITH their K/V blocks and arrive home after
+    ``world`` steps. Returns fp32 (dq, dk, dv)."""
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(world)
+
+    def step(carry, s):
+        kb, vb, mb, dkb, dvb, dq = carry
+        owner = (idx - s) % world
+        dq_c, dk_c, dv_c = lax.switch(branch_index_fn(owner, idx),
+                                      branches, (q, kb, vb, mb))
+        dq = dq + dq_c
+        dkb = dkb + dk_c
+        dvb = dvb + dv_c
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        mb = lax.ppermute(mb, axis_name, perm)
+        dkb = lax.ppermute(dkb, axis_name, perm)
+        dvb = lax.ppermute(dvb, axis_name, perm)
+        return (kb, vb, mb, dkb, dvb, dq), None
+
+    (_, _, _, dk, dv, dq), _ = lax.scan(
+        step,
+        (k, v, mask, jnp.zeros(k.shape, jnp.float32),
+         jnp.zeros(v.shape, jnp.float32), jnp.zeros(q.shape, jnp.float32)),
+        jnp.arange(world),
+    )
+    return dq, dk, dv
+
+
+def _float0_mask(mask):
+    import numpy as _np
+
+    return _np.zeros(mask.shape, jax.dtypes.float0)  # int mask: no tangent
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _ring_flash(q, k, v, mask, axis_name, scale, causal):
+    out, _ = _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal)
+    return out
+
+
+def _seq_fwd_branches(q, mask, scale, heads):
+    """Sequential-layout branch set: full / aligned-causal / skip."""
+    bh, sq, _ = q.shape
+
+    def make_branch(causal_pair):
+        def branch(args):
+            q_, kb, vb, mb = args
+            # fp32 block contributions: the cross-block accumulation must
+            # not round through the input dtype per step
+            return flash_pair_fwd(q_, kb, vb, jnp.repeat(mb, heads, axis=0),
+                                  scale, causal_pair, out_dtype=jnp.float32)
+        return branch
+
+    def skip_b(args):
+        q_ = args[0]
+        return (jnp.zeros(q_.shape, jnp.float32),
+                jnp.full((bh, sq), _NEG_BIG, jnp.float32))
+
+    return [make_branch(False), make_branch(True), skip_b]
+
+
+def _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal):
+    """Ring of flash-forward kernels over folded ``[BH, S, D]`` shards
+    (sequential layout): the per-pair score tile never hits HBM; causal
+    masking skips future-block pairs entirely."""
+    heads = q.shape[0] // mask.shape[0]  # mask stays [B, S]
+    return _ring_fwd_scan(
+        q, k, v, mask, axis_name,
+        lambda owner, idx: _pair_branch(owner, idx, causal),
+        _seq_fwd_branches(q, mask, scale, heads),
+    )
+
+
 def _ring_flash_fwd(q, k, v, mask, axis_name, scale, causal):
     out, lse = _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal)
     return out, (q, k, v, mask, out, lse)
@@ -274,13 +328,9 @@ def _ring_flash_fwd(q, k, v, mask, axis_name, scale, causal):
 def _ring_flash_bwd(axis_name, scale, causal, res, do):
     """Blockwise flash backward around the ring: with the GLOBAL lse and
     delta = rowsum(do·out), each (q, k-block) pair's dq/dk/dv are exactly
-    the single-device flash backward kernels; dK/dV accumulators rotate
-    WITH their K/V blocks and arrive home after ``world`` steps."""
+    the single-device flash backward kernels."""
     q, k, v, mask, out, lse = res
-    world = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
     heads = q.shape[0] // mask.shape[0]
-    perm = _ring_perm(world)
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
@@ -289,7 +339,6 @@ def _ring_flash_bwd(axis_name, scale, causal, res, do):
         def branch(args):
             q_, kb, vb, mb = args
             mbh = jnp.repeat(mb, heads, axis=0)
-            # fp32 contributions into the fp32 accumulators (see fwd pass)
             return (flash_pair_dq(q_, kb, vb, mbh, do, lse, delta, scale,
                                   causal_pair, out_dtype=jnp.float32),
                     *flash_pair_dkv(q_, kb, vb, mbh, do, lse, delta, scale,
@@ -302,34 +351,13 @@ def _ring_flash_bwd(axis_name, scale, causal, res, do):
                 jnp.zeros(kb.shape, jnp.float32),
                 jnp.zeros(vb.shape, jnp.float32))
 
-    full_b, causal_b = make_branch(False), make_branch(True)
-
-    def step(carry, s):
-        kb, vb, mb, dkb, dvb, dq = carry
-        owner = (idx - s) % world
-        br = _pair_branch(owner, idx, causal)
-        dq_c, dk_c, dv_c = lax.switch(br, [full_b, causal_b, skip_b],
-                                      (q, kb, vb, mb))
-        dq = dq + dq_c
-        dkb = dkb + dk_c
-        dvb = dvb + dv_c
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        mb = lax.ppermute(mb, axis_name, perm)
-        dkb = lax.ppermute(dkb, axis_name, perm)
-        dvb = lax.ppermute(dvb, axis_name, perm)
-        return (kb, vb, mb, dkb, dvb, dq), None
-
-    dk0 = jnp.zeros(k.shape, jnp.float32)
-    dv0 = jnp.zeros(v.shape, jnp.float32)
-    dq0 = jnp.zeros(q.shape, jnp.float32)
-    (_, _, _, dk, dv, dq), _ = lax.scan(
-        step, (k, v, mask, dk0, dv0, dq0), jnp.arange(world)
+    dq, dk, dv = _ring_bwd_scan(
+        q, k, v, mask, axis_name,
+        lambda owner, idx: _pair_branch(owner, idx, causal),
+        [make_branch(False), make_branch(True), skip_b],
     )
-    import numpy as _np
-
-    dmask = _np.zeros(mask.shape, jax.dtypes.float0)  # int mask: no tangent
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dmask
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            _float0_mask(mask))
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -449,39 +477,12 @@ def _zz_branch_index(owner, idx):
 
 
 def _zigzag_fwd_pass(q, k, v, mask, axis_name, scale):
-    world = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    bh, sq, d = q.shape
-    c = sq // 2
-    heads = bh // mask.shape[0]
-    perm = _ring_perm(world)
-    branches = _zz_branches_fwd(scale, c, heads)
-
-    def step(carry, s):
-        kb, vb, mb, m, den, num = carry
-        owner = (idx - s) % world
-        o_b, lse_b = lax.switch(_zz_branch_index(owner, idx), branches,
-                                (q, kb, vb, mb))
-        lse_b = jnp.maximum(lse_b, _NEG_BIG)
-        m_new = jnp.maximum(m, lse_b)
-        w = jnp.exp(lse_b - m_new)
-        alpha = jnp.exp(m - m_new)
-        den = den * alpha + w
-        num = num * alpha[..., None] + o_b * w[..., None]
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        mb = lax.ppermute(mb, axis_name, perm)
-        return (kb, vb, mb, m_new, den, num), None
-
-    m0 = jnp.full((bh, sq), _NEG_BIG, jnp.float32)
-    den0 = jnp.zeros((bh, sq), jnp.float32)
-    num0 = jnp.zeros((bh, sq, d), jnp.float32)
-    (_, _, _, m, den, num), _ = lax.scan(
-        step, (k, v, mask, m0, den0, num0), jnp.arange(world)
+    c = q.shape[1] // 2
+    heads = q.shape[0] // mask.shape[0]
+    return _ring_fwd_scan(
+        q, k, v, mask, axis_name, _zz_branch_index,
+        _zz_branches_fwd(scale, c, heads),
     )
-    out = (num / jnp.maximum(den, 1e-30)[..., None]).astype(q.dtype)
-    lse = m + jnp.log(jnp.maximum(den, 1e-30))
-    return out, lse
 
 
 def _zigzag_fwd(q, k, v, mask, axis_name, scale):
@@ -491,12 +492,9 @@ def _zigzag_fwd(q, k, v, mask, axis_name, scale):
 
 def _zigzag_bwd(axis_name, scale, res, do):
     q, k, v, mask, out, lse = res
-    world = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
     bh, sq, d = q.shape
     c = sq // 2
     heads = bh // mask.shape[0]
-    perm = _ring_perm(world)
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
@@ -535,33 +533,12 @@ def _zigzag_bwd(axis_name, scale, res, do):
         )
         return dq_c, dk_c, dv_c
 
-    branches = [aligned, earlier, later]
-
-    def step(carry, s):
-        kb, vb, mb, dkb, dvb, dq = carry
-        owner = (idx - s) % world
-        dq_c, dk_c, dv_c = lax.switch(_zz_branch_index(owner, idx),
-                                      branches, (q, kb, vb, mb))
-        dq = dq + dq_c
-        dkb = dkb + dk_c
-        dvb = dvb + dv_c
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        mb = lax.ppermute(mb, axis_name, perm)
-        dkb = lax.ppermute(dkb, axis_name, perm)
-        dvb = lax.ppermute(dvb, axis_name, perm)
-        return (kb, vb, mb, dkb, dvb, dq), None
-
-    (_, _, _, dk, dv, dq), _ = lax.scan(
-        step,
-        (k, v, mask, jnp.zeros(k.shape, jnp.float32),
-         jnp.zeros(v.shape, jnp.float32), jnp.zeros(q.shape, jnp.float32)),
-        jnp.arange(world),
+    dq, dk, dv = _ring_bwd_scan(
+        q, k, v, mask, axis_name, _zz_branch_index,
+        [aligned, earlier, later],
     )
-    import numpy as _np
-
-    dmask = _np.zeros(mask.shape, jax.dtypes.float0)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dmask
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            _float0_mask(mask))
 
 
 _zigzag_ring_flash.defvjp(_zigzag_fwd, _zigzag_bwd)
