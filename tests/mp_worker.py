@@ -125,6 +125,70 @@ def main() -> None:
         all_losses, np.tile(all_losses[0], (n, 1)), rtol=1e-6
     )
 
+    # sequence parallelism ACROSS processes: a dp x sp mesh whose sp axis
+    # spans the process boundary, causal ring attention rotating K/V
+    # between hosts via ppermute — one GPT train step must be finite and
+    # identical on every process (long-context multi-host evidence the
+    # reference has no analog for)
+    from dear_pytorch_tpu.models import data as gdata
+    from dear_pytorch_tpu.models.gpt import GptConfig, GptLmHeadModel
+    from dear_pytorch_tpu.parallel import sp as SP
+
+    devs = jax.devices()
+    if len(devs) >= 2:
+        sp_deg = 2
+        meshsp = jax.sharding.Mesh(
+            np.asarray(devs[: 2 * (len(devs) // 2)])
+            .reshape(len(devs) // 2, sp_deg),
+            ("dp", "sp"),
+        )
+        cfg = GptConfig(
+            vocab_size=32, hidden_size=16, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=32,
+            max_position_embeddings=8, embd_dropout_prob=0.0,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        )
+        gbatch = gdata.synthetic_gpt_batch(
+            jax.random.PRNGKey(4), 2 * meshsp.shape["dp"], seq_len=8,
+            vocab_size=32,
+        )
+        gparams = GptLmHeadModel(cfg).init(
+            {"params": jax.random.PRNGKey(0)}, gbatch["input_ids"],
+            train=False,
+        )["params"]
+        tssp = build_train_step(
+            SP.make_sp_gpt_loss_fn(
+                SP.sp_gpt_model(cfg, attention="ring"),
+                vocab_size=32, train=False,
+            ),
+            gparams, mesh=meshsp, axis_name=("dp", "sp"),
+            mean_axes=("dp",), batch_spec_fn=SP.bert_sp_batch_specs,
+            threshold_mb=0.01, optimizer=fused_sgd(lr=0.05, momentum=0.9),
+            donate=False,
+        )
+        from dear_pytorch_tpu.benchmarks import runner as _runner
+
+        shardings = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(meshsp, s),
+            SP.bert_sp_batch_specs(gbatch),
+        )
+        gbatch = jax.tree.map(
+            lambda x, sh: _runner.stage_global(np.asarray(x), sh),
+            gbatch, shardings,
+        )
+        stsp = tssp.init(gparams)
+        sp_losses = []
+        for _ in range(2):
+            stsp, msp = tssp.step(stsp, gbatch)
+            sp_losses.append(float(msp["loss"]))
+        assert all(np.isfinite(sp_losses)), sp_losses
+        gathered = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(sp_losses))
+        )
+        np.testing.assert_allclose(
+            gathered, np.tile(gathered[0], (n, 1)), rtol=1e-6
+        )
+
     print(f"MP_WORKER_OK rank={pid}/{n}", flush=True)
 
 
