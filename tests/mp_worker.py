@@ -135,7 +135,8 @@ def main() -> None:
     from dear_pytorch_tpu.parallel import sp as SP
 
     devs = jax.devices()
-    if len(devs) >= 2:
+    sp_enabled = os.environ.get("DEAR_MP_SP", "1").strip() not in ("0", "")
+    if sp_enabled and len(devs) >= 2:
         sp_deg = 2
         meshsp = jax.sharding.Mesh(
             np.asarray(devs[: 2 * (len(devs) // 2)])

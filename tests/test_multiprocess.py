@@ -85,9 +85,13 @@ def _run_via_launcher(repo: str, worker: str, nprocs: int):
 
     script = os.path.join(repo, "launch", "cpu_cluster.sh")
     assert os.access(script, os.X_OK), f"{script} must be executable"
+    env = _base_env(repo)
+    # the direct worlds already exercise the cross-process sp leg; skip its
+    # per-rank compiles here so the launcher world stays fast
+    env["DEAR_MP_SP"] = "0"
     proc = subprocess.Popen(
         [script, str(nprocs), "--", sys.executable, worker],
-        env=_base_env(repo), stdout=subprocess.PIPE,
+        env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True, start_new_session=True,
     )
     try:
