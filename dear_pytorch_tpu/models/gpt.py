@@ -40,6 +40,12 @@ class GptConfig:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02
     dtype: Any = jnp.float32
+    #: > 0 replaces every block's dense MLP with a top-1 (switch) routed
+    #: mixture of experts (`parallel.ep.MoeMlp`); train with
+    #: `parallel.tp.make_tp_train_step(rules=EP_RULES, tp_axis='ep')` to
+    #: shard the experts over an 'ep' mesh axis.
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
 
     @property
     def padded_vocab_size(self) -> int:
@@ -128,11 +134,24 @@ class GptBlock(nn.Module):
 
         y = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_2")(x)
-        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
-                     kernel_init=init, name="mlp_in")(y)
-        y = nn.gelu(y, approximate=True)
-        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, kernel_init=init,
-                     name="mlp_out")(y)
+        if cfg.num_experts > 0:
+            # lazy import: models<->parallel would otherwise cycle
+            # (parallel.sp imports this module)
+            from dear_pytorch_tpu.parallel.ep import MoeMlp
+
+            B_, S_, H_ = y.shape
+            y = MoeMlp(
+                num_experts=cfg.num_experts,
+                mlp_dim=cfg.intermediate_size,
+                capacity_factor=cfg.expert_capacity_factor,
+                dtype=cfg.dtype, name="moe",
+            )(y.reshape(B_ * S_, H_)).reshape(B_, S_, H_)
+        else:
+            y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                         kernel_init=init, name="mlp_in")(y)
+            y = nn.gelu(y, approximate=True)
+            y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, kernel_init=init,
+                         name="mlp_out")(y)
         y = nn.Dropout(cfg.hidden_dropout_prob, deterministic=not train)(y)
         return x + y
 
