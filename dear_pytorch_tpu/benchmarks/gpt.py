@@ -35,6 +35,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sequence-len", type=int, default=1024)
     p.add_argument("--num-hidden-layers", type=int, default=None,
                    help="override depth (scaling studies / smoke tests)")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="> 0 swaps every block's MLP for the top-1 switch "
+                        "MoE (experts replicated under the dp schedules "
+                        "here; shard them over an 'ep' axis via "
+                        "parallel.tp + EP_RULES)")
     p.add_argument("--flash-attention", action="store_true", default=False,
                    help="causal Pallas flash kernel instead of the dense "
                         "triangle-masked attention")
@@ -84,6 +89,8 @@ def main(argv=None) -> runner.BenchResult:
         cfg = dataclasses.replace(
             cfg, num_hidden_layers=args.num_hidden_layers
         )
+    if args.num_experts > 0:
+        cfg = dataclasses.replace(cfg, num_experts=args.num_experts)
     if args.sequence_len > cfg.max_position_embeddings:
         raise SystemExit(f"--sequence-len {args.sequence_len} exceeds "
                          f"max_position_embeddings "
