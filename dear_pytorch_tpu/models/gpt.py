@@ -140,10 +140,18 @@ class GptBlock(nn.Module):
             from dear_pytorch_tpu.parallel.ep import MoeMlp
 
             B_, S_, H_ = y.shape
+            # Decode flattens only B tokens, which would collapse the
+            # expert capacity (C = max(int(cf*B/E), 1)) and silently zero
+            # colliding tokens' MLP outputs — use a drop-free factor there.
+            # Note capacity DROPS are not replayed incrementally: decode
+            # logits match training-time logits exactly iff training was
+            # drop-free too (expert_capacity_factor >= num_experts).
+            cf = (float(cfg.num_experts) if decode
+                  else cfg.expert_capacity_factor)
             y = MoeMlp(
                 num_experts=cfg.num_experts,
                 mlp_dim=cfg.intermediate_size,
-                capacity_factor=cfg.expert_capacity_factor,
+                capacity_factor=cf,
                 dtype=cfg.dtype, name="moe",
             )(y.reshape(B_ * S_, H_)).reshape(B_, S_, H_)
         else:

@@ -138,11 +138,14 @@ def main() -> None:
     sp_enabled = os.environ.get("DEAR_MP_SP", "1").strip() not in ("0", "")
     if sp_enabled and len(devs) >= 2:
         sp_deg = 2
-        meshsp = jax.sharding.Mesh(
+        # transpose so the sp axis pairs devices from DIFFERENT processes
+        # (a straight reshape would pair each process's own local devices
+        # and the ring ppermute would never cross the host boundary)
+        grid = (
             np.asarray(devs[: 2 * (len(devs) // 2)])
-            .reshape(len(devs) // 2, sp_deg),
-            ("dp", "sp"),
+            .reshape(sp_deg, len(devs) // 2).T
         )
+        meshsp = jax.sharding.Mesh(grid, ("dp", "sp"))
         cfg = GptConfig(
             vocab_size=32, hidden_size=16, num_hidden_layers=2,
             num_attention_heads=2, intermediate_size=32,
@@ -167,14 +170,12 @@ def main() -> None:
             threshold_mb=0.01, optimizer=fused_sgd(lr=0.05, momentum=0.9),
             donate=False,
         )
-        from dear_pytorch_tpu.benchmarks import runner as _runner
-
         shardings = jax.tree.map(
             lambda s: jax.sharding.NamedSharding(meshsp, s),
             SP.bert_sp_batch_specs(gbatch),
         )
         gbatch = jax.tree.map(
-            lambda x, sh: _runner.stage_global(np.asarray(x), sh),
+            lambda x, sh: runner.stage_global(np.asarray(x), sh),
             gbatch, shardings,
         )
         stsp = tssp.init(gparams)

@@ -260,3 +260,33 @@ def test_moe_gpt_trains_with_expert_parallelism(mesh):
         losses.append(float(m["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+def test_moe_kv_cache_decode_matches_full_forward():
+    """MoE blocks through the KV-cache decode path: with drop-free routing
+    (expert_capacity_factor >= num_experts) stepwise decode must equal the
+    full forward — the capacity collapse at T=B tokens per tick must not
+    zero colliding tokens."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, num_experts=4,
+                              expert_capacity_factor=8.0)
+    model = GptLmHeadModel(cfg)
+    ids = jnp.asarray(np.random.RandomState(11).randint(0, 61, (4, 10)))
+    params = model.init({"params": jax.random.PRNGKey(0)}, ids,
+                        train=False)["params"]
+    full = model.apply({"params": params}, ids, train=False)
+    cache = model.init(
+        {"params": jax.random.PRNGKey(0)}, ids[:, :1], train=False,
+        decode=True,
+    )["cache"]
+    for t in range(ids.shape[1]):
+        step, vars_out = model.apply(
+            {"params": params, "cache": cache}, ids[:, t:t + 1],
+            train=False, decode=True, position_offset=t, mutable=["cache"],
+        )
+        cache = vars_out["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step[:, 0]), np.asarray(full[:, t]),
+            rtol=2e-4, atol=2e-4,
+        )
