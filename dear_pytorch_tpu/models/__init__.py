@@ -28,6 +28,7 @@ from dear_pytorch_tpu.models.gpt import (  # noqa: F401
     GPT2_SMALL,
     GptConfig,
     GptLmHeadModel,
+    generate,
     gpt_lm_loss,
 )
 from dear_pytorch_tpu.models.densenet import (  # noqa: F401

@@ -120,3 +120,30 @@ def test_driver_runs_real_subprocess(tmp_path):
     ])
     cell = report["mnistnet"]["dear"]["4"]
     assert cell is not None and cell[0] > 0
+
+
+def test_optimizer_env_parsing(monkeypatch):
+    """DEAR_OPTIMIZER_NAME / DEAR_ADAM_BETAS / DEAR_ADAM_EPS reach the
+    fused optimizers through the env layer."""
+    from dear_pytorch_tpu.config import DearConfig
+    from dear_pytorch_tpu.ops.fused_sgd import (
+        LayerwiseShardOptimizer,
+        ShardOptimizer,
+    )
+
+    monkeypatch.setenv("DEAR_OPTIMIZER_NAME", "adamw")
+    monkeypatch.setenv("DEAR_ADAM_BETAS", "0.8,0.95")
+    monkeypatch.setenv("DEAR_ADAM_EPS", "1e-6")
+    cfg = DearConfig.from_env()
+    assert cfg.optimizer_name == "adamw"
+    assert cfg.adam_betas == (0.8, 0.95)
+    assert cfg.adam_eps == 1e-6
+    assert isinstance(cfg.optimizer(), ShardOptimizer)
+
+    monkeypatch.setenv("DEAR_OPTIMIZER_NAME", "lamb")
+    assert isinstance(DearConfig.from_env().optimizer(),
+                      LayerwiseShardOptimizer)
+
+    monkeypatch.setenv("DEAR_OPTIMIZER_NAME", "bogus")
+    with pytest.raises(ValueError, match="optimizer_name"):
+        DearConfig.from_env().optimizer()
