@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import Any, Mapping
 
+import jax
 import numpy as np
 
 from dear_pytorch_tpu.models.bert import BertConfig
@@ -63,6 +64,100 @@ def config_from_hf(hf_config: Any) -> BertConfig:
         layer_norm_eps=get("layer_norm_eps", 1e-12),
         initializer_range=get("initializer_range", 0.02),
     )
+
+
+def bert_to_torch_state_dict(params: Mapping[str, Any],
+                             cfg: "BertConfig") -> dict:
+    """Inverse of `convert_bert_from_torch`: flax params -> a HF
+    ``BertForPreTraining`` state_dict (numpy values; pad rows stripped) —
+    train here, serve on the torch stack."""
+    p = jax.tree.map(_np, dict(params))
+    H, nh = cfg.hidden_size, cfg.num_attention_heads
+    d = H // nh
+    V = cfg.vocab_size
+    out: dict = {}
+
+    def linear(prefix, leaf, in_shape=None):
+        w = leaf["kernel"]
+        if in_shape is not None:
+            w = w.reshape(in_shape)
+        out[prefix + ".weight"] = w.T
+        out[prefix + ".bias"] = leaf["bias"].reshape(-1)
+
+    def layernorm(prefix, leaf):
+        out[prefix + ".weight"] = leaf["scale"]
+        out[prefix + ".bias"] = leaf["bias"]
+
+    wte = p["word_embeddings"]["embedding"][:V]
+    out["bert.embeddings.word_embeddings.weight"] = wte
+    out["bert.embeddings.position_embeddings.weight"] = \
+        p["position_embeddings"]["embedding"]
+    out["bert.embeddings.token_type_embeddings.weight"] = \
+        p["token_type_embeddings"]["embedding"]
+    layernorm("bert.embeddings.LayerNorm", p["embeddings_ln"])
+    for i in range(cfg.num_hidden_layers):
+        blk = p[f"layer_{i}"]
+        hf = f"bert.encoder.layer.{i}"
+        for name in ("query", "key", "value"):
+            linear(f"{hf}.attention.self.{name}",
+                   blk["attention"][name], in_shape=(H, H))
+        linear(f"{hf}.attention.output.dense",
+               blk["attention"]["output"], in_shape=(H, H))
+        layernorm(f"{hf}.attention.output.LayerNorm", blk["attention_ln"])
+        linear(f"{hf}.intermediate.dense", blk["intermediate"])
+        linear(f"{hf}.output.dense", blk["output"])
+        layernorm(f"{hf}.output.LayerNorm", blk["output_ln"])
+    linear("cls.predictions.transform.dense", p["mlm_transform"])
+    layernorm("cls.predictions.transform.LayerNorm", p["mlm_ln"])
+    out["cls.predictions.bias"] = p["mlm_bias"][:V]
+    out["cls.predictions.decoder.weight"] = wte         # tied
+    out["cls.predictions.decoder.bias"] = out["cls.predictions.bias"]
+    linear("bert.pooler.dense", p["pooler"])
+    linear("cls.seq_relationship", p["nsp_classifier"])
+    return out
+
+
+def gpt2_to_torch_state_dict(params: Mapping[str, Any],
+                             cfg: "GptConfig") -> dict:
+    """Inverse of `convert_gpt2_from_torch`: flax params -> a HF
+    ``GPT2LMHeadModel`` state_dict (Conv1D [in, out] layout, fused
+    c_attn, tied lm_head; pad rows stripped)."""
+    import numpy as np
+
+    p = jax.tree.map(_np, dict(params))
+    H, nh = cfg.hidden_size, cfg.num_attention_heads
+    V = cfg.vocab_size
+    out: dict = {}
+    wte = p["wte"]["embedding"][:V]
+    out["transformer.wte.weight"] = wte
+    out["transformer.wpe.weight"] = p["wpe"]["embedding"]
+    out["transformer.ln_f.weight"] = p["ln_f"]["scale"]
+    out["transformer.ln_f.bias"] = p["ln_f"]["bias"]
+    out["lm_head.weight"] = wte                          # tied
+    for i in range(cfg.num_hidden_layers):
+        blk = p[f"h_{i}"]
+        hf = f"transformer.h.{i}"
+        for ln in ("ln_1", "ln_2"):
+            out[f"{hf}.{ln}.weight"] = blk[ln]["scale"]
+            out[f"{hf}.{ln}.bias"] = blk[ln]["bias"]
+        w_qkv = np.concatenate(
+            [blk[n]["kernel"].reshape(H, H)
+             for n in ("query", "key", "value")], axis=1
+        )
+        b_qkv = np.concatenate(
+            [blk[n]["bias"].reshape(-1)
+             for n in ("query", "key", "value")]
+        )
+        out[f"{hf}.attn.c_attn.weight"] = w_qkv          # Conv1D [in, out]
+        out[f"{hf}.attn.c_attn.bias"] = b_qkv
+        out[f"{hf}.attn.c_proj.weight"] = \
+            blk["output"]["kernel"].reshape(H, H)
+        out[f"{hf}.attn.c_proj.bias"] = blk["output"]["bias"]
+        out[f"{hf}.mlp.c_fc.weight"] = blk["mlp_in"]["kernel"]
+        out[f"{hf}.mlp.c_fc.bias"] = blk["mlp_in"]["bias"]
+        out[f"{hf}.mlp.c_proj.weight"] = blk["mlp_out"]["kernel"]
+        out[f"{hf}.mlp.c_proj.bias"] = blk["mlp_out"]["bias"]
+    return out
 
 
 def convert_resnet_from_torch(state_dict: Mapping[str, Any],

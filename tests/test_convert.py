@@ -268,3 +268,74 @@ def test_gpt2_converted_generation_matches_hf():
     got = generate(GptLmHeadModel(cfg), params, jnp.asarray(prompt),
                    max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+def test_bert_export_roundtrip_into_hf():
+    """Train-here-serve-there: exported state_dict loads into a fresh HF
+    BertForPreTraining with strict key matching and reproduces our
+    forward."""
+    from dear_pytorch_tpu.models.convert import (
+        bert_to_torch_state_dict,
+        config_from_hf,
+        convert_bert_from_torch,
+    )
+    from dear_pytorch_tpu.models.bert import BertForPreTraining
+
+    src, hf_cfg = _hf_model(50)
+    cfg = config_from_hf(hf_cfg)
+    params = convert_bert_from_torch(src.state_dict(), cfg)
+
+    dst = transformers.BertForPreTraining(hf_cfg)
+    exported = {k: torch.tensor(v)
+                for k, v in bert_to_torch_state_dict(params, cfg).items()}
+    missing, unexpected = dst.load_state_dict(exported, strict=False)
+    # position_ids buffers are version-dependent; no WEIGHTS may be absent
+    assert not [k for k in missing if "position_ids" not in k], missing
+    assert not unexpected, unexpected
+    dst.eval()
+
+    ids = np.random.RandomState(20).randint(0, 50, (2, 12))
+    with torch.no_grad():
+        ref = dst(input_ids=torch.tensor(ids)).prediction_logits.numpy()
+    ours, _ = BertForPreTraining(cfg).apply(
+        {"params": params}, jnp.asarray(ids), train=False
+    )
+    np.testing.assert_allclose(np.asarray(ours)[..., :50], ref,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_export_roundtrip_into_hf():
+    from dear_pytorch_tpu.models.convert import (
+        convert_gpt2_from_torch,
+        gpt2_to_torch_state_dict,
+        gpt_config_from_hf,
+    )
+    from dear_pytorch_tpu.models.gpt import GptLmHeadModel
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=61, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(3)
+    src = transformers.GPT2LMHeadModel(hf_cfg)
+    cfg = gpt_config_from_hf(hf_cfg)
+    params = convert_gpt2_from_torch(src.state_dict(), cfg)
+
+    dst = transformers.GPT2LMHeadModel(hf_cfg)
+    exported = {k: torch.tensor(v)
+                for k, v in gpt2_to_torch_state_dict(params, cfg).items()}
+    missing, unexpected = dst.load_state_dict(exported, strict=False)
+    # attn.bias causal-mask buffers are constructed, not weights
+    assert not [k for k in missing if ".attn.bias" not in k
+                and ".attn.masked_bias" not in k], missing
+    assert not unexpected, unexpected
+    dst.eval()
+
+    ids = np.random.RandomState(21).randint(0, 61, (2, 10))
+    with torch.no_grad():
+        ref = dst(torch.tensor(ids)).logits.numpy()
+    ours = GptLmHeadModel(cfg).apply(
+        {"params": params}, jnp.asarray(ids), train=False
+    )
+    np.testing.assert_allclose(np.asarray(ours)[..., :61], ref,
+                               rtol=2e-4, atol=2e-4)
