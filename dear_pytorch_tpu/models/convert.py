@@ -294,6 +294,52 @@ def convert_gpt2_from_torch(state_dict: Mapping[str, Any],
     return params
 
 
+def convert_vgg_from_torch(state_dict: Mapping[str, Any]) -> dict:
+    """torchvision VGG ``state_dict()`` -> flax params for `models.vgg.VGG`
+    (the reference accepts vgg11/16/19 by name,
+    dear/imagenet_benchmark.py:88-95).
+
+    Convs map positionally (``features.N`` 4-D weights, in order, to
+    ``conv1..convK``; stride-1 3x3 SAME == torch pad 1, so numerics match).
+    The flatten-order trap: torch flattens NCHW (channel-major) while the
+    flax model flattens NHWC, so the FIRST classifier layer's weight is
+    permuted from ``[out, C*H*W]`` to the ``[H*W*C, out]`` kernel; H=W is
+    inferred from ``in_features / C``. classifier.3/.6 transpose plainly.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    params: dict = {}
+    conv_keys = sorted(
+        (k for k in sd if k.startswith("features.") and k.endswith(".weight")
+         and sd[k].ndim == 4),
+        key=lambda k: int(k.split(".")[1]),
+    )
+    for i, wk in enumerate(conv_keys, start=1):
+        bk = wk[: -len("weight")] + "bias"
+        params[f"conv{i}"] = {
+            "kernel": sd[wk].transpose(2, 3, 1, 0),
+            "bias": sd[bk],
+        }
+    C = sd[conv_keys[-1]].shape[0]
+    w1 = sd["classifier.0.weight"]                     # [out, C*H*W]
+    hw = w1.shape[1] // C
+    side = int(round(hw ** 0.5))
+    if side * side != hw:
+        raise ValueError(
+            f"classifier.0 in_features {w1.shape[1]} is not C*H*W with "
+            f"square H=W (C={C})"
+        )
+    params["fc1"] = {
+        "kernel": w1.reshape(-1, C, side, side)
+        .transpose(0, 2, 3, 1).reshape(w1.shape[0], -1).T,
+        "bias": sd["classifier.0.bias"],
+    }
+    params["fc2"] = {"kernel": sd["classifier.3.weight"].T,
+                     "bias": sd["classifier.3.bias"]}
+    params["fc3"] = {"kernel": sd["classifier.6.weight"].T,
+                     "bias": sd["classifier.6.bias"]}
+    return params
+
+
 def convert_bert_from_torch(state_dict: Mapping[str, Any],
                             cfg: BertConfig) -> dict:
     """HF ``BertForPreTraining.state_dict()`` -> flax params for
