@@ -72,8 +72,7 @@ def bert_to_torch_state_dict(params: Mapping[str, Any],
     ``BertForPreTraining`` state_dict (numpy values; pad rows stripped) —
     train here, serve on the torch stack."""
     p = jax.tree.map(_np, dict(params))
-    H, nh = cfg.hidden_size, cfg.num_attention_heads
-    d = H // nh
+    H = cfg.hidden_size
     V = cfg.vocab_size
     out: dict = {}
 
@@ -122,10 +121,8 @@ def gpt2_to_torch_state_dict(params: Mapping[str, Any],
     """Inverse of `convert_gpt2_from_torch`: flax params -> a HF
     ``GPT2LMHeadModel`` state_dict (Conv1D [in, out] layout, fused
     c_attn, tied lm_head; pad rows stripped)."""
-    import numpy as np
-
     p = jax.tree.map(_np, dict(params))
-    H, nh = cfg.hidden_size, cfg.num_attention_heads
+    H = cfg.hidden_size
     V = cfg.vocab_size
     out: dict = {}
     wte = p["wte"]["embedding"][:V]
@@ -307,6 +304,13 @@ def convert_vgg_from_torch(state_dict: Mapping[str, Any]) -> dict:
     inferred from ``in_features / C``. classifier.3/.6 transpose plainly.
     """
     sd = {k: _np(v) for k, v in state_dict.items()}
+    if any(k.startswith("features.") and k.endswith(".running_mean")
+           for k in sd):
+        raise ValueError(
+            "this looks like a vgg*_bn checkpoint (BatchNorm layers in "
+            "features); the flax VGG is the plain variant — converting "
+            "would silently drop the normalization"
+        )
     params: dict = {}
     conv_keys = sorted(
         (k for k in sd if k.startswith("features.") and k.endswith(".weight")

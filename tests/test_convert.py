@@ -405,3 +405,12 @@ def test_vgg_forward_parity():
 
     # the converted tree's structure matches models.vgg.VGG's naming
     assert set(params) == {"conv1", "conv2", "conv3", "fc1", "fc2", "fc3"}
+
+
+def test_vgg_bn_checkpoint_rejected():
+    from dear_pytorch_tpu.models.convert import convert_vgg_from_torch
+
+    sd = {"features.0.weight": np.zeros((8, 3, 3, 3), np.float32),
+          "features.1.running_mean": np.zeros((8,), np.float32)}
+    with pytest.raises(ValueError, match="vgg.*_bn|BatchNorm"):
+        convert_vgg_from_torch(sd)
