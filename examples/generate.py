@@ -17,7 +17,9 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    import argparse
+
     import dear_pytorch_tpu as dear
     from dear_pytorch_tpu.models import data
     from dear_pytorch_tpu.models.gpt import (
@@ -28,6 +30,11 @@ def main() -> None:
     )
     from dear_pytorch_tpu.ops.fused_sgd import fused_adamw
     from dear_pytorch_tpu.parallel import build_train_step
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--new-tokens", type=int, default=10)
+    args = ap.parse_args(argv)
 
     mesh = dear.init()
     cfg = GptConfig(
@@ -55,15 +62,17 @@ def main() -> None:
         optimizer=fused_adamw(lr=1e-3), donate=False,
     )
     state = ts.init(params)
-    for step in range(20):
+    for step in range(args.steps):
         state, m = ts.step(state, batch)
         if step % 5 == 0:
             print(f"step {step}: loss {float(m['loss']):.4f}")
 
     trained = ts.gather_params(state)
     prompt = batch["input_ids"][:2, :5]
-    greedy = generate(model, trained, prompt, max_new_tokens=10)
-    sampled = generate(model, trained, prompt, max_new_tokens=10,
+    greedy = generate(model, trained, prompt,
+                      max_new_tokens=args.new_tokens)
+    sampled = generate(model, trained, prompt,
+                       max_new_tokens=args.new_tokens,
                        temperature=0.8, top_p=0.9,
                        rng=jax.random.PRNGKey(7))
     print("prompt :", jnp.asarray(prompt).tolist())

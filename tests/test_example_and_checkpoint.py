@@ -268,3 +268,11 @@ def test_elastic_restore_world_resize(mesh, tmp_path):
     with pytest.raises(ValueError, match="plan"):
         ckpt.restore_checkpoint(str(tmp_path), ts4,
                                 template=ts4.init(params))
+
+
+def test_generate_example_smoke(mesh, capsys):
+    m = _load_example("generate.py")
+    m.main(["--steps", "4", "--new-tokens", "3"])
+    out = capsys.readouterr().out
+    assert "greedy :" in out and "sampled:" in out
+    assert "step 0: loss" in out
