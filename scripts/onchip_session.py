@@ -67,7 +67,10 @@ def main() -> int:
     ap.add_argument("--tag", default=None,
                     help="artifact dir suffix (default: UTC timestamp)")
     ap.add_argument("--outdir", default=os.path.join(REPO, "perf"))
-    ap.add_argument("--phase-timeout", type=float, default=1200.0)
+    # Must exceed one COLD compile through the tunnel: the scanned ResNet
+    # program alone took ~20 min to compile remotely on 2026-07-31 (cached
+    # thereafter), so 1200 s timed the bench phase out with zero output.
+    ap.add_argument("--phase-timeout", type=float, default=2400.0)
     ap.add_argument("--skip", default="",
                     help="comma-separated phase names to skip")
     args = ap.parse_args()
