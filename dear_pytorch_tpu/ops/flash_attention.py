@@ -14,10 +14,19 @@ softmax log-sum-exp per row; dQ and dK/dV are computed by two kernels that
 rebuild each P-tile on the fly.
 
 Everything runs under `interpret=True` off-TPU, so the CPU test mesh
-exercises the exact kernel code path. Reference integration point: the
-model zoo's ``attention_impl`` contract (models/bert.py BertSelfAttention);
-the reference framework has no custom kernels at all — its attention is
-whatever HF/torch emits (SURVEY.md §2.8).
+exercises the exact kernel code path.
+
+Layout note (Mosaic, the real-TPU lowering): the last two dims of every
+block must be (8k, 128k) or equal the array's dims — a rank-2 operand
+blocked ``(1, S)`` over a ``[BH, S]`` array is rejected because the
+leading 1 is neither. The per-row vectors (kv mask, lse, delta) therefore
+travel as ``[BH, S, 1]`` inside the kernels (blocks ``(1, bs, 1)``: both
+trailing dims legal), while the public API stays rank-2. interpret=True
+never checks this, which is why only real-chip runs could catch it.
+
+Reference integration point: the model zoo's ``attention_impl`` contract
+(models/bert.py BertSelfAttention); the reference framework has no custom
+kernels at all — its attention is whatever HF/torch emits (SURVEY.md §2.8).
 """
 
 from __future__ import annotations
@@ -28,8 +37,15 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
+
+# Mosaic's default scoped-vmem budget is 16 MB; the dkv backward's stack
+# footprint lands just over it (16.9 MB at BERT-Base shapes, measured
+# on-chip 2026-07-31) and the chip has 128 MB of VMEM, so raise the
+# per-kernel ceiling rather than shrink blocks that already fit the MXU.
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def _interpret() -> bool:
@@ -61,7 +77,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
         k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)   # [bk, D]
         v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         s = q @ k.T                                              # [bq, bk]
-        kv_ok = mask_ref[0, pl.ds(j * bk, bk)] > 0               # [bk]
+        kv_ok = mask_ref[0, pl.ds(j * bk, bk), 0] > 0            # [bk]
         valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
         if causal:
             q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
@@ -80,7 +96,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
     m, l, acc = jax.lax.fori_loop(0, nblocks_eff, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)                                    # all-masked
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    lse_ref[0, :, 0] = m + jnp.log(l)
 
 
 # ---------------------------------------------------------------------------
@@ -93,8 +109,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)                 # [bq, D]
-    lse = lse_ref[0]                                   # [bq]
-    delta = delta_ref[0]                               # [bq]
+    lse = lse_ref[0, :, 0]                             # [bq]
+    delta = delta_ref[0, :, 0]                         # [bq]
     dq = jnp.zeros_like(q)
 
     nblocks = seq_k // bk
@@ -106,7 +122,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         s = q @ k.T
-        kv_ok = mask_ref[0, pl.ds(j * bk, bk)] > 0
+        kv_ok = mask_ref[0, pl.ds(j * bk, bk), 0] > 0
         valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
         if causal:
             q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
@@ -127,7 +143,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)                   # [bk, D]
     v = v_ref[0].astype(jnp.float32)
-    kv_ok = mask_ref[0] > 0                            # [bk]
+    kv_ok = mask_ref[0, :, 0] > 0                      # [bk]
     dk = jnp.zeros_like(k)
     dv = jnp.zeros_like(v)
 
@@ -139,8 +155,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
         do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq)]
-        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        lse = lse_ref[0, pl.ds(i * bq, bq), 0]
+        delta = delta_ref[0, pl.ds(i * bq, bq), 0]
         s = q @ k.T                                              # [bq, bk]
         valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
         if causal:
@@ -171,6 +187,42 @@ def _pick_block(s: int, pref: int = 128) -> int:
     return max(b, 1)
 
 
+def check_mosaic_block(block: tuple, array: tuple) -> None:
+    """Enforce Mosaic's block-shape rule at trace time, on EVERY backend.
+
+    The real-TPU lowering requires the last two dims of each block be
+    divisible by (8, 128) respectively or equal the array's dims.
+    ``interpret=True`` (the CPU test mesh) never applies the rule, so a
+    violating spec sails through the whole suite and dies on first chip
+    contact — exactly what happened with the rank-2 ``(1, S)`` vector specs
+    on 2026-07-31. Calling this from the wrappers makes the CPU tests fail
+    the same way the chip would."""
+    sub, lane = block[-2], block[-1]
+    if sub % 8 and sub != array[-2]:
+        raise ValueError(
+            f"Mosaic-illegal block {block} for array {array}: second-to-last "
+            f"block dim {sub} is neither a multiple of 8 nor the array dim "
+            f"{array[-2]}"
+        )
+    if lane % 128 and lane != array[-1]:
+        raise ValueError(
+            f"Mosaic-illegal block {block} for array {array}: last block dim "
+            f"{lane} is neither a multiple of 128 nor the array dim "
+            f"{array[-1]}"
+        )
+
+
+def _check_specs(specs, array_shapes, loop_slices=()) -> None:
+    """Validate the ACTUAL BlockSpec objects handed to ``pallas_call``
+    (reading ``spec.block_shape`` — no hand-copied shadow list to drift)
+    plus the in-kernel ``pl.ds`` loop-slice layouts, which Mosaic also
+    tiles but which never appear in any BlockSpec."""
+    for spec, arr in zip(specs, array_shapes, strict=True):
+        check_mosaic_block(tuple(spec.block_shape), tuple(arr))
+    for blk, arr in loop_slices:
+        check_mosaic_block(tuple(blk), tuple(arr))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash(q, k, v, kv_mask, scale, causal):
     o, _ = _flash_fwd_impl(q, k, v, kv_mask, scale, causal)
@@ -185,26 +237,36 @@ def _flash_fwd_impl(q, k, v, kv_mask, scale, causal, out_dtype=None):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, seq_k=sk
     )
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
+        pl.BlockSpec((1, sk, 1), lambda i, j: (i, 0, 0)),   # mask
+    ]
+    out_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
+    ]
+    _check_specs(
+        in_specs + out_specs,
+        [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
+         (bh, sq, d), (bh, sq, 1)],
+        # the kernel's fori_loop slices K/V/mask into bk-sized tiles
+        loop_slices=[((1, bk, d), (bh, sk, d)), ((1, bk, 1), (bh, sk, 1))],
+    )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
-            pl.BlockSpec((1, sk), lambda i, j: (i, 0)),         # mask
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, kv_mask)
-    return o, lse
+        compiler_params=_COMPILER_PARAMS,
+    )(q, k, v, kv_mask[:, :, None])
+    return o, lse[:, :, 0]
 
 
 def _flash_fwd(q, k, v, kv_mask, scale, causal):
@@ -229,23 +291,33 @@ def flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal,
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _pick_block(sq), _pick_block(sk)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
+        pl.BlockSpec((1, sk, 1), lambda i, j: (i, 0, 0)),   # mask
+        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
+        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),   # delta
+    ]
+    out_specs = [pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))]
+    _check_specs(
+        in_specs + out_specs,
+        [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
+         (bh, sq, d), (bh, sq, 1), (bh, sq, 1), (bh, sq, d)],
+        loop_slices=[((1, bk, d), (bh, sk, d)), ((1, bk, 1), (bh, sk, 1))],
+    )
     return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, seq_k=sk),
         grid=(bh, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
-            pl.BlockSpec((1, sk), lambda i, j: (i, 0)),         # mask
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # lse
-            pl.BlockSpec((1, bq), lambda i, j: (i, j)),         # delta
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        in_specs=in_specs,
+        out_specs=out_specs[0],
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
         interpret=_interpret(),
-    )(q, k, v, kv_mask, do, lse, delta)
+        compiler_params=_COMPILER_PARAMS,
+    )(q, k, v, kv_mask[:, :, None], do, lse[:, :, None],
+      delta[:, :, None])
 
 
 def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal,
@@ -255,29 +327,41 @@ def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal,
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _pick_block(sq), _pick_block(sk)
+    in_specs = [
+        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v
+        pl.BlockSpec((1, bk, 1), lambda i, j: (i, j, 0)),   # mask
+        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do
+        pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # lse
+        pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # delta
+    ]
+    out_specs = [
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+    ]
+    _check_specs(
+        in_specs + out_specs,
+        [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
+         (bh, sq, d), (bh, sq, 1), (bh, sq, 1),
+         (bh, sk, d), (bh, sk, d)],
+        # the kernel's fori_loop slices q/do/lse/delta into bq-sized tiles
+        loop_slices=[((1, bq, d), (bh, sq, d)), ((1, bq, 1), (bh, sq, 1))],
+    )
     return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, seq_q=sq),
         grid=(bh, sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v
-            pl.BlockSpec((1, bk), lambda i, j: (i, j)),         # mask
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),         # lse
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),         # delta
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), out_dtype or k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), out_dtype or v.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, kv_mask, do, lse, delta)
+        compiler_params=_COMPILER_PARAMS,
+    )(q, k, v, kv_mask[:, :, None], do, lse[:, :, None],
+      delta[:, :, None])
 
 
 def _flash_bwd(scale, causal, res, do):

@@ -150,3 +150,37 @@ def test_bert_end_to_end_with_flash_impl():
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(nsp_f), np.asarray(nsp_d),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_mosaic_block_rule():
+    """Every BlockSpec the wrappers emit must satisfy Mosaic's real-TPU
+    block rule (trailing dims (8k, 128k) or equal to the array's): the CPU
+    interpret path never checks it, so this pins the rule host-side. The
+    (1, S) rank-2 vector specs that passed the whole CPU suite but died on
+    first chip contact (2026-07-31) are the regression under test."""
+    from dear_pytorch_tpu.ops.flash_attention import check_mosaic_block
+
+    # legal: full-dim blocks, 8/128-multiples, trailing singletons
+    check_mosaic_block((1, 128, 64), (384, 128, 64))
+    check_mosaic_block((1, 128, 1), (384, 128, 1))
+    check_mosaic_block((1, 64, 64), (384, 192, 64))
+    # the round-4 on-chip failure shape: rank-2 (1, S) over [BH, S]
+    with pytest.raises(ValueError, match="Mosaic-illegal"):
+        check_mosaic_block((1, 128), (384, 128))
+    # sublane block neither 8-multiple nor full
+    with pytest.raises(ValueError, match="second-to-last"):
+        check_mosaic_block((1, 4, 64), (384, 192, 64))
+    # lane block neither 128-multiple nor full
+    with pytest.raises(ValueError, match="last block dim"):
+        check_mosaic_block((1, 128, 32), (384, 128, 64))
+
+
+def test_wrappers_reject_mosaic_illegal_blocks():
+    """An odd sequence length that forces a tiny non-8-multiple query block
+    must be rejected at trace time on every backend, not at Mosaic lowering
+    on the chip."""
+    rng = jax.random.PRNGKey(0)
+    # S=132 -> _pick_block gives 4 (132 = 4*33): illegal sublane block
+    q = jax.random.normal(rng, (2, 132, 2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="Mosaic-illegal"):
+        flash_attention(q, q, q)
