@@ -13,6 +13,17 @@ Backward is the standard flash recomputation: forward saves only the
 softmax log-sum-exp per row; dQ and dK/dV are computed by two kernels that
 rebuild each P-tile on the fly.
 
+Kernel structure (the part that decides TPU performance): the reduction
+over key/query blocks is a GRID dimension, not an in-kernel loop. The
+innermost grid dim is declared ``arbitrary`` (sequential), the online
+softmax / gradient accumulators live in VMEM scratch that persists across
+those steps, and ``pl.when`` gates the j==0 init and the j==last flush.
+That shape lets Mosaic double-buffer each (1, bk, D) K/V block DMA behind
+the previous tile's compute — the first version of this file instead
+looped over an all-resident K/V block inside one kernel invocation, which
+serialized everything and ran 23x slower than XLA attention at S=1024
+(on-chip A/B, 2026-07-31, perf/onchip_r04/ab_gpt_s1024_*).
+
 Everything runs under `interpret=True` off-TPU, so the CPU test mesh
 exercises the exact kernel code path.
 
@@ -40,63 +51,83 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_BIG = -1e30
-
-# Mosaic's default scoped-vmem budget is 16 MB; the dkv backward's stack
-# footprint lands just over it (16.9 MB at BERT-Base shapes, measured
-# on-chip 2026-07-31) and the chip has 128 MB of VMEM, so raise the
-# per-kernel ceiling rather than shrink blocks that already fit the MXU.
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+# Row-statistic scratch is kept full-lane-width (bq, 128) with every lane
+# holding the same value: full-width loads/stores are the fast path and
+# sidestep sub-lane masked writes.
+_LANES = 128
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Leading (BH, q-or-k block) grid dims are parallel — Mosaic may split
+# them across cores; the innermost reduction dim must stay sequential
+# because the VMEM scratch accumulators carry across it.
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"),
+    vmem_limit_bytes=64 * 1024 * 1024,
+)
+
+
+def _bcast_rows(x, bq):
+    """[bq] or [bq, 1] row statistic -> full-width (bq, LANES)."""
+    return jnp.broadcast_to(x.reshape(bq, 1), (bq, _LANES))
+
+
 # ---------------------------------------------------------------------------
-# forward kernel: grid (BH, Sq/bq); K/V rows resident per grid row
+# forward kernel: grid (BH, Sq/bq, Sk/bk); scratch carries the online softmax
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
-                scale, causal, bq, bk, seq_k):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
-    m = jnp.full((bq,), _NEG_BIG, jnp.float32)
-    l = jnp.zeros((bq,), jnp.float32)
-    acc = jnp.zeros((bq, q.shape[-1]), jnp.float32)
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                m_s, l_s, acc_s, *, scale, causal, bq, bk, nk):
+    qi, kj = pl.program_id(1), pl.program_id(2)
 
-    nblocks = seq_k // bk
-    if causal:
-        # only key blocks at or before this query block contribute
-        nblocks_eff = jnp.minimum(nblocks, (qi + 1) * bq // bk + 1)
-    else:
-        nblocks_eff = nblocks
+    @pl.when(kj == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_BIG)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)   # [bk, D]
-        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = q @ k.T                                              # [bq, bk]
-        kv_ok = mask_ref[0, pl.ds(j * bk, bk), 0] > 0            # [bk]
-        valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
+    # causal: key blocks strictly after this query block contribute nothing
+    work = (kj * bk <= qi * bq + bq - 1) if causal else True
+
+    @pl.when(work)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale                 # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                         # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                        # [bq, bk]
+        valid = jnp.broadcast_to(mask_ref[0, :, 0] > 0, s.shape)
         if causal:
             q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
-            k_pos = j * bk + jax.lax.iota(jnp.int32, bk)
+            k_pos = kj * bk + jax.lax.iota(jnp.int32, bk)
             valid = valid & (k_pos[None, :] <= q_pos[:, None])
         s = jnp.where(valid, s, -jnp.inf)
-        bm = jnp.maximum(jnp.max(s, axis=-1), _NEG_BIG)
+        bm = jnp.maximum(jnp.max(s, axis=-1), _NEG_BIG)          # [bq]
         p = jnp.exp(s - bm[:, None])                             # [bq, bk]
-        m_new = jnp.maximum(m, bm)
-        alpha = jnp.exp(m - m_new)
-        corr = jnp.exp(bm - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1) * corr
-        acc = acc * alpha[:, None] + (p @ v) * corr[:, None]
-        return m_new, l, acc
+        m_prev = m_s[:, :1]                                      # [bq, 1]
+        m_new = jnp.maximum(m_prev, bm[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        corr = jnp.exp(bm[:, None] - m_new)
+        l_new = l_s[:, :1] * alpha + jnp.sum(p, -1, keepdims=True) * corr
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                        # [bq, D]
+        acc_s[...] = acc_s[...] * alpha + pv * corr
+        m_s[...] = _bcast_rows(m_new, bq)
+        l_s[...] = _bcast_rows(l_new, bq)
 
-    m, l, acc = jax.lax.fori_loop(0, nblocks_eff, body, (m, l, acc))
-    l = jnp.maximum(l, 1e-30)                                    # all-masked
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, :, 0] = m + jnp.log(l)
+    @pl.when(kj == nk - 1)
+    def _flush():
+        l = jnp.maximum(l_s[:, :1], 1e-30)                       # all-masked
+        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = m_s[:, 0] + jnp.log(l[:, 0])
 
 
 # ---------------------------------------------------------------------------
@@ -105,74 +136,98 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, scale, causal, bq, bk, seq_k):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)                 # [bq, D]
-    lse = lse_ref[0, :, 0]                             # [bq]
-    delta = delta_ref[0, :, 0]                         # [bq]
-    dq = jnp.zeros_like(q)
+                   delta_ref, dq_ref, dq_s, *, scale, causal, bq, bk, nk):
+    qi, kj = pl.program_id(1), pl.program_id(2)
 
-    nblocks = seq_k // bk
-    nblocks_eff = (
-        jnp.minimum(nblocks, (qi + 1) * bq // bk + 1) if causal else nblocks
-    )
+    @pl.when(kj == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = q @ k.T
-        kv_ok = mask_ref[0, pl.ds(j * bk, bk), 0] > 0
-        valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
+    work = (kj * bk <= qi * bq + bq - 1) if causal else True
+
+    @pl.when(work)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale                 # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                         # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)                       # [bq, D]
+        lse = lse_ref[0, :, 0]                                   # [bq]
+        delta = delta_ref[0, :, 0]                               # [bq]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        valid = jnp.broadcast_to(mask_ref[0, :, 0] > 0, s.shape)
         if causal:
             q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
-            k_pos = j * bk + jax.lax.iota(jnp.int32, bk)
+            k_pos = kj * bk + jax.lax.iota(jnp.int32, bk)
             valid = valid & (k_pos[None, :] <= q_pos[:, None])
         p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)     # [bq, bk]
-        dp = do @ v.T                                            # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                        # [bq, bk]
         ds = p * (dp - delta[:, None])
-        return dq + ds @ k                                       # [bq, D]
+        dq_s[...] = dq_s[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                        # [bq, D]
 
-    dq = jax.lax.fori_loop(0, nblocks_eff, body, dq)
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[0] = (dq_s[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, scale, causal, bq, bk,
-                    seq_q):
-    ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                   # [bk, D]
-    v = v_ref[0].astype(jnp.float32)
-    kv_ok = mask_ref[0, :, 0] > 0                      # [bk]
-    dk = jnp.zeros_like(k)
-    dv = jnp.zeros_like(v)
+                    delta_ref, dk_ref, dv_ref, dk_s, dv_s, *,
+                    scale, causal, bq, bk, nq):
+    ki, qi = pl.program_id(1), pl.program_id(2)
 
-    nblocks = seq_q // bq
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
     # causal: query blocks strictly before this key block contribute nothing
-    start = (ki * bk) // bq if causal else 0
+    work = (qi * bq + bq - 1 >= ki * bk) if causal else True
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq), 0]
-        delta = delta_ref[0, pl.ds(i * bq, bq), 0]
-        s = q @ k.T                                              # [bq, bk]
-        valid = jnp.broadcast_to(kv_ok[None, :], s.shape)
+    @pl.when(work)
+    def _update():
+        k = k_ref[0].astype(jnp.float32)                         # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * scale                 # [bq, D]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]                                   # [bq]
+        delta = delta_ref[0, :, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                        # [bq, bk]
+        valid = jnp.broadcast_to(mask_ref[0, :, 0] > 0, s.shape)
         if causal:
-            q_pos = i * bq + jax.lax.iota(jnp.int32, bq)
+            q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
             k_pos = ki * bk + jax.lax.iota(jnp.int32, bk)
             valid = valid & (k_pos[None, :] <= q_pos[:, None])
         p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
-        dp = do @ v.T
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         ds = p * (dp - delta[:, None])
-        dv = dv + p.T @ do                                       # [bk, D]
-        dk = dk + ds.T @ q        # q is pre-scaled: d(s)/d(k) = q_raw*scale
-        return dk, dv
+        dv_s[...] = dv_s[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                        # [bk, D]
+        # q is pre-scaled: d(s)/d(k) = q_raw*scale
+        dk_s[...] = dk_s[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    dk, dv = jax.lax.fori_loop(start, nblocks, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -212,15 +267,30 @@ def check_mosaic_block(block: tuple, array: tuple) -> None:
         )
 
 
-def _check_specs(specs, array_shapes, loop_slices=()) -> None:
+def _check_specs(specs, array_shapes) -> None:
     """Validate the ACTUAL BlockSpec objects handed to ``pallas_call``
-    (reading ``spec.block_shape`` — no hand-copied shadow list to drift)
-    plus the in-kernel ``pl.ds`` loop-slice layouts, which Mosaic also
-    tiles but which never appear in any BlockSpec."""
+    (reading ``spec.block_shape`` — no hand-copied shadow list to drift)."""
     for spec, arr in zip(specs, array_shapes, strict=True):
         check_mosaic_block(tuple(spec.block_shape), tuple(arr))
-    for blk, arr in loop_slices:
-        check_mosaic_block(tuple(blk), tuple(arr))
+
+
+def _k_index_map(causal, bq, bk):
+    """K/V/mask index map for the (b, qi, kj) grids. Causal grids still
+    step through every (qi, kj) pair, but blocks past the diagonal are
+    ``pl.when``-skipped — clamping the fetch index to the last contributing
+    block means those steps re-request the block already in the window, so
+    Mosaic issues no DMA for them (halves causal K/V traffic)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+    return lambda b, i, j: (b, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+
+
+def _q_index_map_dkv(causal, bq, bk):
+    """q/do/lse/delta index map for the dkv (b, kj, qi) grids: clamp UP to
+    the first contributing query block (see `_k_index_map`)."""
+    if not causal:
+        return lambda b, j, i: (b, i, 0)
+    return lambda b, j, i: (b, jnp.maximum(i, (j * bk) // bq), 0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -233,26 +303,25 @@ def _flash_fwd_impl(q, k, v, kv_mask, scale, causal, out_dtype=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _pick_block(sq), _pick_block(sk)
-    grid = (bh, sq // bq)
+    grid = (bh, sq // bq, sk // bk)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, seq_k=sk
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=sk // bk
     )
+    kmap = _k_index_map(causal, bq, bk)
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
-        pl.BlockSpec((1, sk, 1), lambda i, j: (i, 0, 0)),   # mask
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, bk, d), kmap),                        # k
+        pl.BlockSpec((1, bk, d), kmap),                        # v
+        pl.BlockSpec((1, bk, 1), kmap),                        # mask
     ]
     out_specs = [
-        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
     ]
     _check_specs(
         in_specs + out_specs,
         [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
          (bh, sq, d), (bh, sq, 1)],
-        # the kernel's fori_loop slices K/V/mask into bk-sized tiles
-        loop_slices=[((1, bk, d), (bh, sk, d)), ((1, bk, 1), (bh, sk, 1))],
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -262,6 +331,11 @@ def _flash_fwd_impl(q, k, v, kv_mask, scale, causal, out_dtype=None):
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running max m
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running denom l
+            pltpu.VMEM((bq, d), jnp.float32),        # output accumulator
         ],
         interpret=_interpret(),
         compiler_params=_COMPILER_PARAMS,
@@ -291,29 +365,30 @@ def flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal,
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _pick_block(sq), _pick_block(sk)
+    kmap = _k_index_map(causal, bq, bk)
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # q
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # k
-        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),   # v
-        pl.BlockSpec((1, sk, 1), lambda i, j: (i, 0, 0)),   # mask
-        pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),   # do
-        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),   # lse
-        pl.BlockSpec((1, bq, 1), lambda i, j: (i, j, 0)),   # delta
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, bk, d), kmap),                        # k
+        pl.BlockSpec((1, bk, d), kmap),                        # v
+        pl.BlockSpec((1, bk, 1), kmap),                        # mask
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # do
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),   # delta
     ]
-    out_specs = [pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0))]
+    out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
     _check_specs(
         in_specs + out_specs,
         [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
          (bh, sq, d), (bh, sq, 1), (bh, sq, 1), (bh, sq, d)],
-        loop_slices=[((1, bk, d), (bh, sk, d)), ((1, bk, 1), (bh, sk, 1))],
     )
     return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, seq_k=sk),
-        grid=(bh, sq // bq),
+                          bq=bq, bk=bk, nk=sk // bk),
+        grid=(bh, sq // bq, sk // bk),
         in_specs=in_specs,
         out_specs=out_specs[0],
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
         compiler_params=_COMPILER_PARAMS,
     )(q, k, v, kv_mask[:, :, None], do, lse[:, :, None],
@@ -327,36 +402,39 @@ def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal,
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _pick_block(sq), _pick_block(sk)
+    qmap = _q_index_map_dkv(causal, bq, bk)
     in_specs = [
-        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # q
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # k
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),   # v
-        pl.BlockSpec((1, bk, 1), lambda i, j: (i, j, 0)),   # mask
-        pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),   # do
-        pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # lse
-        pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),   # delta
+        pl.BlockSpec((1, bq, d), qmap),                        # q
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),   # k
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),   # v
+        pl.BlockSpec((1, bk, 1), lambda b, j, i: (b, j, 0)),   # mask
+        pl.BlockSpec((1, bq, d), qmap),                        # do
+        pl.BlockSpec((1, bq, 1), qmap),                        # lse
+        pl.BlockSpec((1, bq, 1), qmap),                        # delta
     ]
     out_specs = [
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
     ]
     _check_specs(
         in_specs + out_specs,
         [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
          (bh, sq, d), (bh, sq, 1), (bh, sq, 1),
          (bh, sk, d), (bh, sk, d)],
-        # the kernel's fori_loop slices q/do/lse/delta into bq-sized tiles
-        loop_slices=[((1, bq, d), (bh, sq, d)), ((1, bq, 1), (bh, sq, 1))],
     )
     return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, seq_q=sq),
-        grid=(bh, sk // bk),
+                          bq=bq, bk=bk, nq=sq // bq),
+        grid=(bh, sk // bk, sq // bq),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), out_dtype or k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), out_dtype or v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=_interpret(),
         compiler_params=_COMPILER_PARAMS,

@@ -1,0 +1,44 @@
+"""Per-grid-step overhead probe: same elementwise work, two grid sizes."""
+import os
+import time
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from dear_pytorch_tpu.benchmarks import runner
+runner.apply_platform_env()
+
+def kern(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0 + 1.0
+
+def run(nblocks, rows_per_block):
+    x = jnp.ones((nblocks * rows_per_block, 512), jnp.float32)
+    f = jax.jit(lambda x: pl.pallas_call(
+        kern,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((rows_per_block, 512), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rows_per_block, 512), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x))
+    o = f(x); jax.block_until_ready(o)
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        o = f(x)
+    float(o[0, 0])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"grid={nblocks:5d} x ({rows_per_block},512): {dt*1e3:8.3f} ms "
+          f"-> {dt/nblocks*1e6:8.2f} us/grid-step", flush=True)
+
+# identical total work (2M rows of 512), different grid granularity
+run(16,   1024)   # 16 big blocks
+run(2048,    8)   # 2048 tiny blocks
+
+# Measured 2026-07-31 on the session's tunneled v5e (perf/onchip_r04/
+# pallas_overhead_probe.txt): grid=16 of (1024,512) blocks -> 70.5 ms
+# (~1 GB/s effective for 67 MB of I/O), grid=2048 of (8,512) -> 3.7 ms
+# (~1.8 us/grid-step, all overhead). XLA-native ops on the same chip hit
+# ~819 GB/s. Conclusion: on THIS container every Pallas custom call's
+# block I/O is relayed through the host (AXON_LOOPBACK_RELAY) at tunnel
+# bandwidth, so kernel-vs-XLA comparisons are unmeasurable here; they
+# must be read on a directly-attached TPU host.
