@@ -115,15 +115,25 @@ def _emit(out: dict) -> bool:
 SMOKE = bool(os.environ.get("DEAR_BENCH_SMOKE"))  # tiny shapes, CPU-safe
 
 
-def _gather_dtype():
-    """Master shards are cast to bf16 BEFORE the per-bucket all-gather by
-    default: the model computes in bf16 anyway (its per-layer cast becomes
-    the identity), and the gather leg's bytes halve — on an 8+ chip mesh
-    that is half the AG traffic on ICI, at world=1 half the HBM read.
-    A/B with DEAR_BENCH_GATHER_DTYPE=f32 (keeps the round-2-and-earlier
-    f32 gather)."""
-    v = os.environ.get("DEAR_BENCH_GATHER_DTYPE", "bf16").strip().lower()
-    return None if v in ("f32", "none", "") else jnp.bfloat16
+def _gather_dtype(world: int):
+    """Cast master shards to bf16 before the per-bucket all-gather ONLY
+    when there is gather traffic to halve (world > 1: half the AG bytes on
+    ICI). At world=1 the gather is a local copy and the pre-cast is pure
+    overhead — the 2026-07-31 on-chip A/B measured f32 gathers at +4.5%
+    BERT-Base throughput and parity on ResNet (1225.37 sen/s in
+    perf/onchip_r04/bench_gather_f32.json vs 1170.92 with bf16 gathers in
+    perf/onchip_r04/bench_rerun.log), so the choice follows the mesh.
+    Override with DEAR_BENCH_GATHER_DTYPE=bf16|f32."""
+    v = os.environ.get("DEAR_BENCH_GATHER_DTYPE", "").strip().lower()
+    if v in ("f32", "fp32", "float32", "none"):
+        return None
+    if v in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    if v:
+        raise SystemExit(
+            f"DEAR_BENCH_GATHER_DTYPE={v!r}: use 'bf16' or 'f32'"
+        )
+    return jnp.bfloat16 if world > 1 else None
 
 WARMUP_BATCHES = 2 if SMOKE else 10
 # 10 iters x 10 scanned steps per timed window: the single end-of-window
@@ -214,7 +224,7 @@ def bench_resnet(mesh):
         threshold_mb=25.0,
         optimizer=fused_sgd(lr=0.01, momentum=0.9),
         comm_dtype=jnp.bfloat16,
-        gather_dtype=_gather_dtype(),
+        gather_dtype=_gather_dtype(mesh.size),
         model_state_template=model_state,
     )
     state = ts.init(params, model_state)
@@ -280,7 +290,7 @@ def bench_bert(mesh, variant: str = "bert_base"):
         threshold_mb=25.0,
         optimizer=fused_sgd(lr=2e-5, momentum=0.0),
         comm_dtype=jnp.bfloat16,
-        gather_dtype=_gather_dtype(),
+        gather_dtype=_gather_dtype(mesh.size),
         rng_seed=42,
     )
     state = ts.init(params)

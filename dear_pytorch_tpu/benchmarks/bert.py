@@ -158,7 +158,7 @@ def main(argv=None) -> runner.BenchResult:
                 b["masked_lm_labels"], b["next_sentence_labels"],
             )
 
-    dear_cfg = runner.config_from_args(args)
+    dear_cfg = runner.config_from_args(args, world=backend.dp_size(mesh))
     ts, stepper = runner.build_stepper(
         dear_cfg, loss_fn, params, mesh, mgwfbp=args.mgwfbp, **extra_build,
     )

@@ -165,7 +165,7 @@ def main(argv=None) -> runner.BenchResult:
             return models.gpt_lm_loss(logits, b["input_ids"],
                                       vocab_size=cfg.vocab_size)
 
-    dear_cfg = runner.config_from_args(args)
+    dear_cfg = runner.config_from_args(args, world=backend.dp_size(mesh))
     ts, stepper = runner.build_stepper(
         dear_cfg, loss_fn, params, mesh, mgwfbp=args.mgwfbp, **extra_build,
     )

@@ -98,7 +98,7 @@ def main(argv=None) -> runner.BenchResult:
      global_bs) = setup_cnn(args, mesh)
     has_bn = model_state is not None
 
-    cfg = runner.config_from_args(args)
+    cfg = runner.config_from_args(args, world=world)
     ts, stepper = runner.build_stepper(
         cfg, loss_fn, params, mesh, model_state=model_state,
         mgwfbp=args.mgwfbp,

@@ -391,10 +391,19 @@ def threshold_mb(args) -> Optional[float]:
     return None if args.threshold is None or args.threshold <= 0 else float(args.threshold)
 
 
-def config_from_args(args, *, fp16_comm: bool = True):
+def config_from_args(args, *, fp16_comm: bool = True,
+                     world: Optional[int] = None):
     """CLI args -> `DearConfig` (env DEAR_* vars fill anything the CLI does
     not own, e.g. weight_decay/nesterov), with the reference's
-    accepted-but-inactive warnings."""
+    accepted-but-inactive warnings.
+
+    ``world``: dp size of the mesh the step will run on. The bf16
+    pre-gather cast halves AG bytes on ICI but is pure overhead when there
+    is no gather traffic — the 2026-07-31 on-chip A/B measured f32 gathers
+    at +4.5% BERT-Base throughput at world=1 (PERF.md round-4) — so
+    world=1 disables it. None (callers that sweep worlds, e.g.
+    benchmarks/scaling.py, where one config serves every cell) keeps the
+    multi-chip bf16 default."""
     import warnings
 
     import jax.numpy as jnp
@@ -437,9 +446,9 @@ def config_from_args(args, *, fp16_comm: bool = True):
         # schedule's precision. bf16-compute kernels see identical inputs
         # (their own cast becomes the identity); the rare fp32-dtype
         # submodule (e.g. the BERT NSP head) sees bf16-rounded params — the
-        # same values fsdp mode feeds it
+        # same values fsdp mode feeds it. Skipped at world=1 (see above).
         gather_dtype=(jnp.bfloat16
-                      if (args.fp16 and fp16_comm
+                      if (args.fp16 and fp16_comm and world != 1
                           and args.mode in ("dear", "fsdp"))
                       else None),
         rng_seed=42,
