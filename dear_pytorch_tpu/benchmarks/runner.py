@@ -237,6 +237,18 @@ def add_common_args(parser) -> None:
                         help="clip gradients to this global L2 norm "
                              "(exact under sharding: shard square-norms "
                              "psum across the mesh)")
+    parser.add_argument("--lr-schedule", type=str, default=None,
+                        choices=["linear", "cosine", "multistep"],
+                        help="lr schedule evaluated ON DEVICE from the "
+                             "global step (exact under --scan-steps): "
+                             "linear/cosine warmup+decay need "
+                             "--total-steps; multistep uses "
+                             "DEAR_LR_MILESTONES/DEAR_LR_GAMMA")
+    parser.add_argument("--warmup-steps", type=int, default=0,
+                        help="linear warmup length for --lr-schedule")
+    parser.add_argument("--total-steps", type=int, default=None,
+                        help="decay horizon for --lr-schedule "
+                             "linear/cosine")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="write a jax.profiler trace of the timed "
                              "region here")
@@ -438,6 +450,14 @@ def config_from_args(args, *, fp16_comm: bool = True,
         lr=args.base_lr,
         momentum=args.momentum,
         clip_norm=args.clip_norm,
+        # lr-schedule flags pass through only when the user set them, so
+        # DEAR_LR_SCHEDULE / DEAR_WARMUP_STEPS / DEAR_TOTAL_STEPS env vars
+        # stay live behind unset flags (from_env overrides win otherwise)
+        **{k: v for k, v in {
+            "lr_schedule": getattr(args, "lr_schedule", None),
+            "warmup_steps": getattr(args, "warmup_steps", 0),
+            "total_steps": getattr(args, "total_steps", None),
+        }.items() if v},
         # fsdp communicates both legs in gather_dtype (RS = gather transpose)
         comm_dtype=(jnp.bfloat16
                     if (args.fp16 and fp16_comm and args.mode != "fsdp")

@@ -64,6 +64,14 @@ class DearConfig:
     adam_eps: float = 1e-8
     clip_norm: Optional[float] = None       # global-L2 gradient clipping
 
+    # lr schedule (ops/schedules.py; None = fixed lr)
+    lr_schedule: Optional[str] = None       # 'linear' | 'cosine' | 'multistep'
+    warmup_steps: int = 0
+    total_steps: Optional[int] = None       # required by linear/cosine
+    end_lr: float = 0.0                     # decay floor (min_lr for cosine)
+    lr_milestones: tuple = ()               # multistep boundaries (steps)
+    lr_gamma: float = 0.1                   # multistep decay factor
+
     # precision
     comm_dtype: Any = None                  # e.g. jnp.bfloat16
     gather_dtype: Any = None                # pre-gather cast (dear/fsdp)
@@ -119,8 +127,16 @@ class DearConfig:
             return v
         if name in ("lr", "momentum", "weight_decay", "density",
                     "cycle_time_s", "partition_mb", "momentum_correction",
-                    "adam_eps"):
+                    "adam_eps", "end_lr", "lr_gamma"):
             return float(raw)
+        if name == "warmup_steps":
+            return int(raw)
+        if name == "total_steps":
+            return None if raw.lower() in ("none", "") else int(raw)
+        if name == "lr_milestones":
+            return tuple(int(x) for x in raw.split(",") if x)
+        if name == "lr_schedule":
+            return None if raw.lower() in ("none", "") else raw
         if name == "adam_betas":
             b1, b2 = raw.split(",")
             return (float(b1), float(b2))
@@ -142,20 +158,22 @@ class DearConfig:
     # -- consumption ---------------------------------------------------------
 
     def optimizer(self):
+        from dear_pytorch_tpu.ops import schedules
         from dear_pytorch_tpu.ops.fused_sgd import (
             fused_adamw,
             fused_lamb,
             fused_sgd,
         )
 
+        lr = schedules.from_config(self)  # float, or step->lr callable
         if self.optimizer_name == "adamw":
             return fused_adamw(
-                lr=self.lr, betas=self.adam_betas, eps=self.adam_eps,
+                lr=lr, betas=self.adam_betas, eps=self.adam_eps,
                 weight_decay=self.weight_decay,
             )
         if self.optimizer_name == "lamb":
             return fused_lamb(
-                lr=self.lr, betas=self.adam_betas, eps=self.adam_eps,
+                lr=lr, betas=self.adam_betas, eps=self.adam_eps,
                 weight_decay=self.weight_decay,
             )
         if self.optimizer_name != "sgd":
@@ -168,7 +186,7 @@ class DearConfig:
         # SGD momentum buffer (wfbp/dopt.py:934-942)
         momentum = 0.0 if self.momentum_correction > 0 else self.momentum
         return fused_sgd(
-            lr=self.lr, momentum=momentum,
+            lr=lr, momentum=momentum,
             weight_decay=self.weight_decay, nesterov=self.nesterov,
         )
 

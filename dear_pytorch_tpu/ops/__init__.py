@@ -18,3 +18,4 @@ from dear_pytorch_tpu.ops.fusion import (  # noqa: F401
     pack_all,
     unpack_all,
 )
+from dear_pytorch_tpu.ops import schedules  # noqa: F401

@@ -27,10 +27,28 @@ import jax.numpy as jnp
 
 class ShardOptimizer(NamedTuple):
     """Pure optimizer over flat buffers: `init(param)->state`,
-    `update(grad, state, param) -> (new_param, new_state)`."""
+    `update(grad, state, param) -> (new_param, new_state)`.
+
+    ``needs_step``: when True (an lr SCHEDULE was passed instead of a
+    float), ``update`` takes a keyword-only ``step`` — the train step
+    supplies the replicated global ``DearState.step`` so the schedule
+    evaluates on-device, exact under the scanned multi-step protocol."""
 
     init: Callable[[jax.Array], Any]
     update: Callable[[jax.Array, Any, jax.Array], tuple[jax.Array, Any]]
+    needs_step: bool = False
+
+
+def _lr_fn(lr) -> tuple[Callable, bool]:
+    """Normalize ``lr: float | (step -> lr)`` to ``(step, dtype) -> lr`` +
+    needs_step. The schedule branch casts its f32 scalar to the param
+    dtype: without the cast ``param - lr_t * d_p`` would silently promote
+    bf16 buffers to f32 — and change the scanned carry's dtype mid-trace.
+    The float branch stays a weak-typed python scalar so fixed-lr numerics
+    (torch-parity-pinned) are untouched."""
+    if callable(lr):
+        return (lambda step, dtype: jnp.asarray(lr(step), dtype)), True
+    return (lambda step, dtype: lr), False
 
 
 class LayerwiseShardOptimizer(NamedTuple):
@@ -46,10 +64,11 @@ class LayerwiseShardOptimizer(NamedTuple):
 
     init: Callable[[jax.Array], Any]
     update: Callable[..., tuple[jax.Array, Any]]
+    needs_step: bool = False
 
 
 def fused_sgd(
-    lr: float,
+    lr,
     momentum: float = 0.0,
     weight_decay: float = 0.0,
     dampening: float = 0.0,
@@ -61,11 +80,14 @@ def fused_sgd(
     buf = momentum * buf + (1 - dampening) * d_p        (after first step)
     d_p = d_p + momentum * buf   if nesterov else buf
     p  -= lr * d_p
+
+    ``lr`` may be a float or a schedule callable (`ops/schedules.py`).
     """
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError("nesterov requires momentum > 0 and zero dampening")
 
     use_momentum = momentum != 0.0
+    lr_at, needs_step = _lr_fn(lr)
 
     def init(param: jax.Array):
         if not use_momentum:
@@ -73,7 +95,8 @@ def fused_sgd(
         # (buf, initialized) — torch seeds the buffer with d_p on first use
         return (jnp.zeros_like(param), jnp.zeros((), jnp.bool_))
 
-    def update(grad, state, param):
+    def update(grad, state, param, *, step=None):
+        lr_t = lr_at(step, param.dtype)
         d_p = grad
         if weight_decay:
             d_p = d_p + weight_decay * param
@@ -84,13 +107,13 @@ def fused_sgd(
             )
             d_p = d_p + momentum * seeded if nesterov else seeded
             state = (seeded, jnp.ones((), jnp.bool_))
-        return param - lr * d_p, state
+        return param - lr_t * d_p, state
 
-    return ShardOptimizer(init, update)
+    return ShardOptimizer(init, update, needs_step)
 
 
 def fused_adamw(
-    lr: float,
+    lr,
     betas: tuple[float, float] = (0.9, 0.999),
     eps: float = 1e-8,
     weight_decay: float = 0.01,
@@ -113,6 +136,7 @@ def fused_adamw(
     b1, b2 = betas
     if not (0.0 <= b1 < 1.0 and 0.0 <= b2 < 1.0):
         raise ValueError(f"betas must be in [0, 1), got {betas}")
+    lr_at, needs_step = _lr_fn(lr)
 
     def init(param: jax.Array):
         return (
@@ -121,12 +145,13 @@ def fused_adamw(
             jnp.zeros((), jnp.int32),        # step count
         )
 
-    def update(grad, state, param):
+    def update(grad, state, param, *, step=None):
+        lr_t = lr_at(step, param.dtype)
         m, v, t = state
         t = t + 1
         grad = grad.astype(param.dtype)
         if weight_decay:
-            param = param * (1.0 - lr * weight_decay)
+            param = param * (1.0 - lr_t * weight_decay)
         # torch updates exp_avg via lerp: m + (1-b1)(g - m) — keep that
         # form so parity with torch.optim.AdamW is rounding-tight
         m = m + (1.0 - b1) * (grad - m)
@@ -137,14 +162,14 @@ def fused_adamw(
         bc1 = 1.0 - jnp.asarray(b1, param.dtype) ** tf
         bc2_sqrt = jnp.sqrt(1.0 - jnp.asarray(b2, param.dtype) ** tf)
         denom = jnp.sqrt(v) / bc2_sqrt + eps
-        new_param = param - (lr / bc1) * m / denom
+        new_param = param - (lr_t / bc1) * m / denom
         return new_param, (m, v, t)
 
-    return ShardOptimizer(init, update)
+    return ShardOptimizer(init, update, needs_step)
 
 
 def fused_lamb(
-    lr: float,
+    lr,
     betas: tuple[float, float] = (0.9, 0.999),
     eps: float = 1e-6,
     weight_decay: float = 0.01,
@@ -168,6 +193,7 @@ def fused_lamb(
     a dummy trailing segment and never move (w=0, g=0 -> u=0).
     """
     b1, b2 = betas
+    lr_at, needs_step = _lr_fn(lr)
 
     def init(param: jax.Array):
         return (
@@ -176,7 +202,9 @@ def fused_lamb(
             jnp.zeros((), jnp.int32),
         )
 
-    def update(grad, state, param, seg_ids, num_segments, psum):
+    def update(grad, state, param, seg_ids, num_segments, psum, *,
+               step=None):
+        lr_t = lr_at(step, param.dtype)
         m, v, t = state
         t = t + 1
         grad = grad.astype(param.dtype)
@@ -198,10 +226,10 @@ def fused_lamb(
         trust = jnp.where(
             (w_norm > 0.0) & (u_norm > 0.0), w_norm / jnp.maximum(u_norm, 1e-12), 1.0
         )
-        new_param = param - lr * trust[seg_ids] * u
+        new_param = param - lr_t * trust[seg_ids] * u
         return new_param, (m, v, t)
 
-    return LayerwiseShardOptimizer(init, update)
+    return LayerwiseShardOptimizer(init, update, needs_step)
 
 
 def sgd_momentum_tree_update(params, momentum_tree, grads, *, lr: float,

@@ -1,0 +1,140 @@
+"""Learning-rate schedules for the fused shard optimizers.
+
+Each factory returns a pure callable ``step -> lr`` (f32 scalar, jnp math,
+so it traces inside the jitted train step — the schedule is evaluated on
+device from ``DearState.step``, never on the host, which keeps the scanned
+multi-step protocol exact: step i inside one ``lax.scan`` program sees the
+same lr a per-step dispatch would).
+
+The reference trains its benchmarks at fixed lr (dear/imagenet_benchmark.py
+feeds a constant ``--base-lr``; dear/bert_benchmark.py likewise), so
+schedules are beyond-reference surface: the shapes here are the standard
+ones its model families are normally trained with — linear warmup+decay
+(BERT pretraining), cosine (GPT), and milestone step decay (torchvision
+ResNet recipes).
+
+Pass the callable anywhere an ``lr`` float is accepted:
+
+    from dear_pytorch_tpu.ops import schedules
+    opt = fused_adamw(lr=schedules.warmup_linear(1e-4, 1000, 100_000))
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _as_f32(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.float32)
+
+
+def constant(base_lr: float) -> Schedule:
+    """Fixed lr as a schedule (lets call sites treat every lr uniformly)."""
+    def lr_at(step):
+        del step
+        return _as_f32(base_lr)
+    return lr_at
+
+
+def warmup_linear(base_lr: float, warmup_steps: int, total_steps: int,
+                  end_lr: float = 0.0) -> Schedule:
+    """Linear warmup 0 -> base_lr over ``warmup_steps``, then linear decay
+    to ``end_lr`` at ``total_steps`` (BERT pretraining's shape). Constant at
+    ``end_lr`` past ``total_steps``."""
+    if total_steps <= warmup_steps:
+        raise ValueError(
+            f"total_steps ({total_steps}) must exceed warmup_steps "
+            f"({warmup_steps})"
+        )
+
+    def lr_at(step):
+        step = _as_f32(step)
+        warm = _as_f32(base_lr) * step / max(warmup_steps, 1)
+        frac = (step - warmup_steps) / (total_steps - warmup_steps)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        decay = _as_f32(base_lr) + frac * (_as_f32(end_lr) - base_lr)
+        return jnp.where(step < warmup_steps, warm, decay)
+
+    return lr_at
+
+
+def warmup_cosine(base_lr: float, warmup_steps: int, total_steps: int,
+                  min_lr: float = 0.0) -> Schedule:
+    """Linear warmup then half-cosine decay to ``min_lr`` (the GPT shape)."""
+    if total_steps <= warmup_steps:
+        raise ValueError(
+            f"total_steps ({total_steps}) must exceed warmup_steps "
+            f"({warmup_steps})"
+        )
+
+    def lr_at(step):
+        step = _as_f32(step)
+        warm = _as_f32(base_lr) * step / max(warmup_steps, 1)
+        frac = (step - warmup_steps) / (total_steps - warmup_steps)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        decay = _as_f32(min_lr) + (_as_f32(base_lr) - min_lr) * cos
+        return jnp.where(step < warmup_steps, warm, decay)
+
+    return lr_at
+
+
+def multistep(base_lr: float, milestones: Sequence[int],
+              gamma: float = 0.1) -> Schedule:
+    """torch ``MultiStepLR`` shape: lr * gamma^(milestones passed) — the
+    torchvision ResNet recipe (e.g. milestones (30, 60, 80) in epochs,
+    expressed here in steps)."""
+    ms = tuple(sorted(int(m) for m in milestones))
+    if any(m < 0 for m in ms):
+        raise ValueError(f"milestones must be non-negative, got {milestones}")
+    ms_arr = jnp.asarray(ms, jnp.float32) if ms else None
+
+    def lr_at(step):
+        if ms_arr is None:
+            return _as_f32(base_lr)
+        passed = jnp.sum(_as_f32(step) >= ms_arr)
+        return _as_f32(base_lr) * _as_f32(gamma) ** passed
+
+    return lr_at
+
+
+def from_config(cfg) -> "float | Schedule":
+    """Resolve a `DearConfig`'s lr fields to a float or schedule callable.
+
+    ``cfg.lr_schedule``: None/'' -> fixed ``cfg.lr``; 'linear' / 'cosine'
+    (need ``cfg.total_steps``); 'multistep' (needs ``cfg.lr_milestones``)."""
+    name = (cfg.lr_schedule or "").strip().lower()
+    if not name or name == "none":
+        return cfg.lr
+    if name in ("linear", "warmup_linear"):
+        return warmup_linear(cfg.lr, cfg.warmup_steps, _total(cfg),
+                             end_lr=cfg.end_lr)
+    if name in ("cosine", "warmup_cosine"):
+        return warmup_cosine(cfg.lr, cfg.warmup_steps, _total(cfg),
+                             min_lr=cfg.end_lr)
+    if name == "multistep":
+        if not cfg.lr_milestones:
+            # empty milestones would silently degenerate to a constant lr —
+            # the misconfiguration symmetric to linear/cosine's missing
+            # total_steps, so reject it the same way
+            raise ValueError(
+                "lr_schedule='multistep' needs lr_milestones "
+                "(DEAR_LR_MILESTONES=30000,60000,...)"
+            )
+        return multistep(cfg.lr, cfg.lr_milestones, gamma=cfg.lr_gamma)
+    raise ValueError(
+        f"lr_schedule must be 'linear', 'cosine' or 'multistep', got "
+        f"{cfg.lr_schedule!r}"
+    )
+
+
+def _total(cfg) -> int:
+    if not cfg.total_steps:
+        raise ValueError(
+            f"lr_schedule={cfg.lr_schedule!r} needs total_steps"
+        )
+    return int(cfg.total_steps)

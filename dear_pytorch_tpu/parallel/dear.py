@@ -661,6 +661,12 @@ def build_train_step(
             metrics["grad_norm"] = gnorm
 
         layerwise = isinstance(optimizer, LayerwiseShardOptimizer)
+        # lr-schedule optimizers evaluate lr(step) on device from the
+        # replicated global counter — exact under multi_step/lax.scan
+        step_kw = (
+            {"step": state.step}
+            if getattr(optimizer, "needs_step", False) else {}
+        )
         new_buffers, new_opt = [], []
         for g, grad in enumerate(bucket_grads):
             if layerwise:
@@ -689,11 +695,11 @@ def build_train_step(
                 seg = jnp.where(pos < b.size, seg, len(b.leaf_ids))
                 new_p, new_o = optimizer.update(
                     grad, state.opt_state[g], state.buffers[g],
-                    seg, len(b.leaf_ids) + 1, psum,
+                    seg, len(b.leaf_ids) + 1, psum, **step_kw,
                 )
             else:
                 new_p, new_o = optimizer.update(
-                    grad, state.opt_state[g], state.buffers[g]
+                    grad, state.opt_state[g], state.buffers[g], **step_kw
                 )
             new_buffers.append(new_p)
             new_opt.append(new_o)
