@@ -60,6 +60,7 @@ def main(argv=None) -> float:
     import dear_pytorch_tpu as dear
     from dear_pytorch_tpu import models
     from dear_pytorch_tpu.models import data
+    from dear_pytorch_tpu.ops import schedules
     from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
     from dear_pytorch_tpu.parallel import build_train_step
     from dear_pytorch_tpu.runtime import pipeline as RP
@@ -81,11 +82,18 @@ def main(argv=None) -> float:
         onehot = jax.nn.one_hot(b["label"], 10)
         return -jnp.mean(jnp.sum(onehot * logits, axis=-1))
 
+    # warmup+cosine over the training horizon: evaluated on device from the
+    # global step, so it resumes correctly from a checkpoint (the restored
+    # DearState.step re-enters the schedule where it left off)
+    lr = schedules.warmup_cosine(
+        0.05, warmup_steps=min(20, args.steps // 10),
+        total_steps=max(args.steps, 1) + 1, min_lr=0.005,
+    )
     ts = build_train_step(
         loss_fn, params, mesh=mesh, mode=args.mode,
         threshold_mb=0.05, accum_steps=args.accum_steps,
         clip_norm=5.0,  # global-norm clipping, exact on shards
-        optimizer=fused_sgd(lr=0.05, momentum=0.9), donate=False,
+        optimizer=fused_sgd(lr=lr, momentum=0.9), donate=False,
     )
 
     ckpt_dir = os.path.join(args.workdir, "ckpts")

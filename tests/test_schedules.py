@@ -137,3 +137,29 @@ def test_multistep_requires_milestones():
 
     with pytest.raises(ValueError, match="needs lr_milestones"):
         schedules.from_config(DearConfig(lr_schedule="multistep"))
+
+
+def test_lamb_schedule_through_dear_step(mesh):
+    """LAMB's layerwise (segment-sum) update path also threads the step:
+    a decayed schedule must move params differently than its base lr."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_lamb
+    from dear_pytorch_tpu.parallel import dear as D
+
+    loss_fn, params, batch = _tiny_problem()
+
+    def run(lr):
+        ts = D.build_train_step(
+            loss_fn, params, mesh=mesh, mode="dear",
+            optimizer=fused_lamb(lr, weight_decay=0.0),
+        )
+        st = ts.init(params)
+        st, _ = ts.multi_step(3)(st, batch)
+        return ts.gather_params(st)
+
+    sched = schedules.multistep(0.1, milestones=(1,), gamma=0.1)
+    got_sched = run(sched)
+    got_fixed = run(0.1)
+    diffs = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), got_sched, got_fixed
+    ))
+    assert max(diffs) > 1e-5  # the decay after step 1 must show up
