@@ -1,0 +1,41 @@
+"""Capture a device trace of the fsdp (ZeRO-3) scanned step: the re-gather
+-in-backward evidence VERDICT asks for on-chip."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+from dear_pytorch_tpu.benchmarks import runner
+runner.apply_platform_env()
+from dear_pytorch_tpu import models
+from dear_pytorch_tpu.comm import backend
+from dear_pytorch_tpu.models import data
+from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+from dear_pytorch_tpu.parallel import dear as D
+
+mesh = backend.init()
+model = models.get_model("resnet50", dtype=jnp.bfloat16)
+batch = data.synthetic_image_batch(jax.random.PRNGKey(0), 64,
+                                   dtype=jnp.bfloat16)
+variables = model.init({"params": jax.random.PRNGKey(0)}, batch["image"],
+                       train=False)
+params, mstate = variables["params"], {"batch_stats": variables["batch_stats"]}
+
+def loss_fn(p, ms, b):
+    logits, new_state = model.apply({"params": p, **ms}, b["image"],
+                                    train=True, mutable=["batch_stats"])
+    return data.softmax_xent(logits, b["label"]), new_state
+
+ts = D.build_train_step(loss_fn, params, mesh=mesh, mode="fsdp",
+                        threshold_mb=25.0,
+                        optimizer=fused_sgd(lr=0.01, momentum=0.9),
+                        gather_dtype=jnp.bfloat16,
+                        model_state_template=mstate)
+state = ts.init(params, mstate)
+step4 = ts.multi_step(4)
+state, m = step4(state, batch)
+float(m["loss"])
+out = "/root/repo/perf/onchip_r04/trace_fsdp"
+with jax.profiler.trace(out):
+    state, m = step4(state, batch)
+    float(m["loss"])
+print("fsdp trace written to", out, flush=True)
