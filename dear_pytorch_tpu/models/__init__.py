@@ -46,6 +46,7 @@ from dear_pytorch_tpu.models.resnet import (  # noqa: F401
     ResNet152,
 )
 from dear_pytorch_tpu.models.vgg import VGG11, VGG16, VGG19  # noqa: F401
+from dear_pytorch_tpu.models.vit import ViTB16, ViTS16  # noqa: F401
 
 _CNN_REGISTRY: dict[str, Callable] = {
     "resnet18": ResNet18,
@@ -61,6 +62,9 @@ _CNN_REGISTRY: dict[str, Callable] = {
     "vgg16": VGG16,
     "vgg19": VGG19,
     "mnistnet": MnistNet,
+    # beyond the reference zoo: vision transformers (models/vit.py)
+    "vit_s16": ViTS16,
+    "vit_b16": ViTB16,
 }
 
 _BERT_REGISTRY: dict[str, Any] = {

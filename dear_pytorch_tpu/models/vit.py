@@ -1,0 +1,157 @@
+"""Vision Transformer (ViT) family — beyond-reference zoo addition.
+
+The reference's CNN zoo is torchvision-by-name (dear/imagenet_benchmark.py:
+88-95; SURVEY.md §2.8); it predates vision transformers. ViT is THE
+TPU-native vision architecture — big dense GEMMs that sit squarely on the
+MXU, no BatchNorm cross-replica traffic (LayerNorm is per-token), and the
+standard demonstration that this framework's transformer machinery
+(attention-impl contract, dp/tp/sp schedules, AdamW + warmup-cosine
+schedules) composes beyond language models.
+
+Standard ViT (Dosovitskiy et al. 2021): patchify via strided conv, prepend
+a learned [CLS] token, add learned position embeddings, pre-LN transformer
+encoder, classify from the [CLS] representation.
+
+Zoo conventions (models/resnet.py): NHWC images, ``dtype`` threads the
+compute dtype (params stay fp32 masters), fp32 classifier head, benchmark
+names in `models.get_model` ("vit_s16", "vit_b16") so the imagenet CLI
+drives it like any CNN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dear_pytorch_tpu.models.bert import dot_product_attention
+
+
+class VitSelfAttention(nn.Module):
+    """Multi-head self-attention over ``[B, S, E]`` tokens (no mask — every
+    patch attends to every patch). ``attention_impl`` follows the model
+    zoo's contract (models/bert.py) so alternative cores can be swapped in;
+    note the token count (e.g. 197 for 224/16 + CLS) is usually
+    flash-block-illegal, so the dense core is the right default here."""
+
+    num_heads: int
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+    attention_impl: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        B, S, E = x.shape
+        if E % self.num_heads:
+            raise ValueError(f"hidden {E} not divisible by {self.num_heads}")
+        head = E // self.num_heads
+
+        def proj(name):
+            return nn.Dense(E, dtype=self.dtype, name=name)(x).reshape(
+                B, S, self.num_heads, head
+            )
+
+        q, k, v = proj("query"), proj("key"), proj("value")
+        impl = self.attention_impl or dot_product_attention
+        rng = None
+        if train and self.dropout_rate > 0.0:
+            rng = self.make_rng("dropout")
+        ctx = impl(q, k, v, None, dropout_rng=rng,
+                   dropout_rate=self.dropout_rate if train else 0.0,
+                   dtype=self.dtype)
+        ctx = ctx.reshape(B, S, E)
+        return nn.Dense(E, dtype=self.dtype, name="out")(ctx)
+
+
+class VitBlock(nn.Module):
+    """Pre-LN encoder block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+    attention_impl: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        h = VitSelfAttention(
+            self.num_heads, dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            attention_impl=self.attention_impl, name="attn",
+        )(h, train=train)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_out")(h)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return x + h
+
+
+class VisionTransformer(nn.Module):
+    """ViT classifier over NHWC images; image size must divide by patch."""
+
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    patch: int = 16
+    num_classes: int = 1000
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    attention_impl: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, H, W, _ = x.shape
+        if H % self.patch or W % self.patch:
+            raise ValueError(
+                f"image {H}x{W} not divisible by patch {self.patch}"
+            )
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.hidden_size, (self.patch, self.patch),
+            strides=(self.patch, self.patch), padding="VALID",
+            dtype=self.dtype, name="patch_embed",
+        )(x)
+        x = x.reshape(B, -1, self.hidden_size)              # [B, S, E]
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, self.hidden_size)
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, self.hidden_size)).astype(x.dtype),
+             x], axis=1,
+        )
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, x.shape[1], self.hidden_size),
+        )
+        x = x + pos.astype(x.dtype)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            x = VitBlock(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                dropout_rate=self.dropout_rate,
+                attention_impl=self.attention_impl, name=f"block{i + 1}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        x = x[:, 0]                                         # [CLS]
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ViTS16(*, dtype=jnp.float32, **kw):
+    """ViT-Small/16: 384h x 12L x 6 heads."""
+    kw = {"hidden_size": 384, "num_layers": 12, "num_heads": 6,
+          "mlp_dim": 1536, **kw}
+    return VisionTransformer(dtype=dtype, **kw)
+
+
+def ViTB16(*, dtype=jnp.float32, **kw):
+    """ViT-Base/16: 768h x 12L x 12 heads."""
+    kw = {"hidden_size": 768, "num_layers": 12, "num_heads": 12,
+          "mlp_dim": 3072, **kw}
+    return VisionTransformer(dtype=dtype, **kw)
