@@ -421,3 +421,65 @@ def convert_bert_from_torch(state_dict: Mapping[str, Any],
             "output_ln": layernorm(f"{hf}.output.LayerNorm"),
         }
     return params
+
+
+def convert_vit_from_torch(state_dict: Mapping[str, Any]) -> dict:
+    """HF ``ViTForImageClassification.state_dict()`` (or ``ViTModel`` — the
+    pooler is unused and a missing classifier maps to nothing) -> flax
+    params for `models.vit.VisionTransformer`.
+
+    Layout mapping: torch Linear weights are ``[out, in]`` -> Dense kernels
+    ``[in, out]`` (transpose); the patch-embed conv is OIHW ->
+    flax HWIO. cls token and position embeddings carry over unchanged
+    (position row 0 is the [CLS] slot in both stacks). Activation caveat
+    (same as the BERT converter): this zoo's MLP gelu is the tanh
+    approximation; real google/vit checkpoints were trained with exact
+    gelu — weight mapping is exact either way, forward parity is
+    rounding-tight when the HF config uses ``hidden_act='gelu_new'``.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    # tolerate the ViTModel prefix ("vit.") used by ViTForImageClassification
+    if any(k.startswith("vit.") for k in sd):
+        sd = {(k[4:] if k.startswith("vit.") else k): v
+              for k, v in sd.items()}
+    # depth comes from the checkpoint itself — a caller-supplied count
+    # could silently truncate it
+    num_layers = 1 + max(
+        int(k.split(".")[2]) for k in sd if k.startswith("encoder.layer.")
+    )
+
+    def linear(name):
+        return {"kernel": sd[f"{name}.weight"].T,
+                "bias": sd[f"{name}.bias"]}
+
+    def ln(name):
+        return {"scale": sd[f"{name}.weight"], "bias": sd[f"{name}.bias"]}
+
+    params: dict = {
+        "cls_token": sd["embeddings.cls_token"],
+        "pos_embed": sd["embeddings.position_embeddings"],
+        "patch_embed": {
+            # OIHW -> HWIO
+            "kernel": sd["embeddings.patch_embeddings.projection.weight"]
+            .transpose(2, 3, 1, 0),
+            "bias": sd["embeddings.patch_embeddings.projection.bias"],
+        },
+        "ln_final": ln("layernorm"),
+    }
+    for i in range(num_layers):
+        hf = f"encoder.layer.{i}"
+        params[f"block{i + 1}"] = {
+            "ln1": ln(f"{hf}.layernorm_before"),
+            "attn": {
+                "query": linear(f"{hf}.attention.attention.query"),
+                "key": linear(f"{hf}.attention.attention.key"),
+                "value": linear(f"{hf}.attention.attention.value"),
+                "out": linear(f"{hf}.attention.output.dense"),
+            },
+            "ln2": ln(f"{hf}.layernorm_after"),
+            "mlp_in": linear(f"{hf}.intermediate.dense"),
+            "mlp_out": linear(f"{hf}.output.dense"),
+        }
+    if "classifier.weight" in sd:
+        params["head"] = linear("classifier")
+    return params

@@ -414,3 +414,39 @@ def test_vgg_bn_checkpoint_rejected():
           "features.1.running_mean": np.zeros((8,), np.float32)}
     with pytest.raises(ValueError, match="vgg.*_bn|BatchNorm"):
         convert_vgg_from_torch(sd)
+
+
+def test_vit_forward_parity():
+    """HF ViTForImageClassification vs our VisionTransformer with converted
+    weights: same image, rounding-tight logits (hidden_act='gelu_new'
+    matches this zoo's tanh gelu, as in the BERT parity test)."""
+    import torch
+
+    from dear_pytorch_tpu.models.convert import convert_vit_from_torch
+    from dear_pytorch_tpu.models.vit import VisionTransformer
+
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, image_size=32, patch_size=8,
+        num_labels=7, hidden_act="gelu_new",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    tmodel = transformers.ViTForImageClassification(hf_cfg).eval()
+
+    rng = np.random.RandomState(0)
+    img_nchw = rng.randn(2, 3, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(img_nchw)).logits.numpy()
+
+    ours = VisionTransformer(
+        hidden_size=32, num_layers=2, num_heads=4, mlp_dim=64,
+        patch=8, num_classes=7,
+    )
+    params = convert_vit_from_torch(tmodel.state_dict())
+    got = ours.apply(
+        {"params": params},
+        jnp.asarray(img_nchw.transpose(0, 2, 3, 1)),  # NCHW -> NHWC
+        train=False,
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
