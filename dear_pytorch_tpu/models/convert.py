@@ -444,9 +444,14 @@ def convert_vit_from_torch(state_dict: Mapping[str, Any]) -> dict:
               for k, v in sd.items()}
     # depth comes from the checkpoint itself — a caller-supplied count
     # could silently truncate it
-    num_layers = 1 + max(
-        int(k.split(".")[2]) for k in sd if k.startswith("encoder.layer.")
-    )
+    layer_ids = [int(k.split(".")[2]) for k in sd
+                 if k.startswith("encoder.layer.")]
+    if not layer_ids:
+        raise ValueError(
+            "state_dict has no 'encoder.layer.N' keys — not an HF ViT "
+            "checkpoint (ViTModel / ViTForImageClassification expected)"
+        )
+    num_layers = 1 + max(layer_ids)
 
     def linear(name):
         return {"kernel": sd[f"{name}.weight"].T,

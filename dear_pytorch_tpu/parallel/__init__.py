@@ -18,6 +18,7 @@ from dear_pytorch_tpu.parallel.pp import (  # noqa: F401
 )
 from dear_pytorch_tpu.parallel.tp import (  # noqa: F401
     BERT_TP_RULES,
+    VIT_TP_RULES,
     TpTrainStep,
     make_tp_train_step,
     param_specs_from_rules,

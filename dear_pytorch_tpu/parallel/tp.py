@@ -71,6 +71,18 @@ BERT_TP_RULES: tuple = (
     (r"word_embeddings/embedding$", lambda tp: jax.P(tp, None)),
 )
 
+#: Megatron placement for the ViT encoder (models/vit.py): plain rank-2
+#: Dense kernels, so columns split the fused head dim (even head split
+#: whenever num_heads % tp == 0; GSPMD reshards otherwise).
+VIT_TP_RULES: tuple = (
+    (r"attn/(query|key|value)/kernel$", lambda tp: jax.P(None, tp)),
+    (r"attn/(query|key|value)/bias$", lambda tp: jax.P(tp)),
+    (r"attn/out/kernel$", lambda tp: jax.P(tp, None)),     # row-parallel
+    (r"mlp_in/kernel$", lambda tp: jax.P(None, tp)),
+    (r"mlp_in/bias$", lambda tp: jax.P(tp)),
+    (r"mlp_out/kernel$", lambda tp: jax.P(tp, None)),
+)
+
 
 def param_specs_from_rules(
     params, rules: Sequence = BERT_TP_RULES, tp_axis: str = TP_AXIS

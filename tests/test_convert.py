@@ -420,8 +420,6 @@ def test_vit_forward_parity():
     """HF ViTForImageClassification vs our VisionTransformer with converted
     weights: same image, rounding-tight logits (hidden_act='gelu_new'
     matches this zoo's tanh gelu, as in the BERT parity test)."""
-    import torch
-
     from dear_pytorch_tpu.models.convert import convert_vit_from_torch
     from dear_pytorch_tpu.models.vit import VisionTransformer
 

@@ -104,3 +104,46 @@ def test_tp_rejects_indivisible():
     mesh = jax.sharding.Mesh(devs, ("dp", "tp"))
     with pytest.raises(ValueError, match="divide"):
         TP.make_tp_train_step(loss_fn, params, mesh=mesh)
+
+
+def test_vit_tp_matches_replicated():
+    """dp x tp ViT under VIT_TP_RULES == the replicated step numerically,
+    and the attention/MLP weights actually shard over 'tp'."""
+    m = models.get_model("vit_s16", num_layers=2, num_classes=8)
+    batch = {
+        "image": jax.random.normal(
+            jax.random.PRNGKey(0), (2 * DP_DEG, 32, 32, 3), jnp.float32
+        ),
+        "label": jnp.arange(2 * DP_DEG) % 8,
+    }
+    params = m.init(
+        {"params": jax.random.PRNGKey(0)}, batch["image"], train=False
+    )["params"]
+
+    def loss_fn(p, b):
+        logits = m.apply({"params": p}, b["image"], train=False)
+        return mdata.softmax_xent(logits, b["label"])
+
+    def run(mesh):
+        ts = TP.make_tp_train_step(
+            loss_fn, params, mesh=mesh, rules=TP.VIT_TP_RULES,
+            lr=0.05, momentum=0.9, donate=False,
+        )
+        state = ts.init(params)
+        losses = []
+        for _ in range(3):
+            state, met = ts.step(state, batch)
+            losses.append(float(met["loss"]))
+        return state, losses
+
+    mesh1 = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:1]).reshape(1, 1), ("dp", "tp")
+    )
+    _, want = run(mesh1)
+    state, got = run(_mesh2d())
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    qk = state.params["block1"]["attn"]["query"]["kernel"]
+    assert "tp" in str(qk.sharding.spec), qk.sharding.spec
+    mlp_down = state.params["block1"]["mlp_out"]["kernel"]
+    assert "tp" in str(mlp_down.sharding.spec), mlp_down.sharding.spec
