@@ -49,9 +49,10 @@ def _mesh2d():
     return jax.sharding.Mesh(devs.reshape(DP_DEG, TP_DEG), ("dp", "tp"))
 
 
-def _run(mesh, params, batch, loss_fn, steps=4):
+def _run(mesh, params, batch, loss_fn, steps=4, **tp_kwargs):
     ts = TP.make_tp_train_step(
         loss_fn, params, mesh=mesh, lr=0.05, momentum=0.9, donate=False,
+        **tp_kwargs,
     )
     state = ts.init(params)
     losses = []
@@ -124,23 +125,13 @@ def test_vit_tp_matches_replicated():
         logits = m.apply({"params": p}, b["image"], train=False)
         return mdata.softmax_xent(logits, b["label"])
 
-    def run(mesh):
-        ts = TP.make_tp_train_step(
-            loss_fn, params, mesh=mesh, rules=TP.VIT_TP_RULES,
-            lr=0.05, momentum=0.9, donate=False,
-        )
-        state = ts.init(params)
-        losses = []
-        for _ in range(3):
-            state, met = ts.step(state, batch)
-            losses.append(float(met["loss"]))
-        return state, losses
-
     mesh1 = jax.sharding.Mesh(
         np.asarray(jax.devices()[:1]).reshape(1, 1), ("dp", "tp")
     )
-    _, want = run(mesh1)
-    state, got = run(_mesh2d())
+    _, _, want = _run(mesh1, params, batch, loss_fn, steps=3,
+                      rules=TP.VIT_TP_RULES)
+    _, state, got = _run(_mesh2d(), params, batch, loss_fn, steps=3,
+                         rules=TP.VIT_TP_RULES)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
     qk = state.params["block1"]["attn"]["query"]["kernel"]
