@@ -34,7 +34,9 @@ state = ts.init(params, mstate)
 step4 = ts.multi_step(4)
 state, m = step4(state, batch)
 float(m["loss"])
-out = "/root/repo/perf/onchip_r04/trace_fsdp"
+out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "perf", "trace_fsdp")
 with jax.profiler.trace(out):
     state, m = step4(state, batch)
     float(m["loss"])

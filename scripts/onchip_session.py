@@ -11,8 +11,11 @@ timeout — a wedge costs one phase, not the session. Artifacts land in
   bench.json    bench.py contract line (the driver metric, captured first)
   profile.txt   component breakdown, dispatch-vs-device, scanned A/B
   trace/        jax.profiler trace (the on-chip overlap artifact)
-  ab_fsdp.txt   fsdp vs dear at world=1
-  ab_flash.txt  BERT flash-attention kernel vs XLA attention
+  ab_fsdp.txt   fsdp vs dear at world=1 (bf16, scanned)
+  ab_flash.txt  BERT flash kernel vs XLA attention (correctness evidence
+                only on this container — Pallas I/O rides the host relay)
+  gpt_headline.txt  GPT-2 S=1024 single-fetch throughput
+  trace_fsdp/   ZeRO-3 re-gather device trace
   summary.json  machine-readable roll-up of the above
 
 Usage:  python scripts/onchip_session.py [--tag r04] [--outdir perf]
@@ -142,8 +145,9 @@ def main() -> int:
             ab.append(run_phase(
                 f"ab_fsdp[{mode}]",
                 [sys.executable, "-m", "dear_pytorch_tpu.benchmarks.imagenet",
-                 "--model", "resnet50", "--batch-size", "64",
-                 "--mode", mode, "--num-warmup-batches", "5",
+                 "--model", "resnet50", "--batch-size", "64", "--fp16",
+                 "--scan-steps", "10",  # unscanned dear rides the relay
+                 "--mode", mode, "--num-warmup-batches", "10",
                  "--num-batches-per-iter", "10", "--num-iters", "3"],
                 os.path.join(outdir, f"ab_fsdp_{mode}.txt"), T,
             ))
@@ -153,8 +157,9 @@ def main() -> int:
     if "ab_flash" not in skip:
         for flag, nm in ((None, "xla"), ("--flash-attention", "flash")):
             cmd = [sys.executable, "-m", "dear_pytorch_tpu.benchmarks.bert",
-                   "--model", "bert_base", "--batch-size", "32",
-                   "--num-warmup-batches", "5", "--num-batches-per-iter",
+                   "--model", "bert_base", "--batch-size", "32", "--fp16",
+                   "--scan-steps", "10",
+                   "--num-warmup-batches", "10", "--num-batches-per-iter",
                    "10", "--num-iters", "3"]
             if flag:
                 cmd.append(flag)
@@ -162,6 +167,22 @@ def main() -> int:
                 f"ab_flash[{nm}]", cmd,
                 os.path.join(outdir, f"ab_flash_{nm}.txt"), T,
             ))
+
+    # 5. GPT long-context headline under the single-fetch protocol.
+    if "gpt" not in skip:
+        results.append(run_phase(
+            "gpt", [sys.executable, "scripts/gpt_headline.py"],
+            os.path.join(outdir, "gpt_headline.txt"), T,
+        ))
+
+    # 6. fsdp device trace (the ZeRO-3 re-gather-in-backward evidence).
+    if "trace_fsdp" not in skip:
+        results.append(run_phase(
+            "trace_fsdp",
+            [sys.executable, "scripts/fsdp_trace.py",
+             os.path.join(outdir, "trace_fsdp")],
+            os.path.join(outdir, "trace_fsdp.txt"), T,
+        ))
 
     _write_summary(outdir, results)
     ok = sum(1 for r in results if r["rc"] == 0)
