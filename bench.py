@@ -64,10 +64,14 @@ PRIMARY_METRIC = "resnet50_bs64_train_img_sec_per_chip"
 
 
 def _bert_baseline():
-    """First captured bert_base sen/s from BENCH_r*.json history, else the
-    pin. The driver stores each round as {"n", "cmd", "rc", "tail",
-    "parsed"} where "parsed" is our contract line (extra_metrics carries the
-    BERT entry) — pin-on-first-capture without manual edits."""
+    """(sen/s, protocol) of the first captured bert_base metric from
+    BENCH_r*.json history, else (pin, None). The driver stores each round
+    as {"n", "cmd", "rc", "tail", "parsed"} where "parsed" is our contract
+    line (extra_metrics carries the BERT entry) — pin-on-first-capture
+    without manual edits. The protocol tag is derived from the resolved
+    round (rounds >= 4 measured single-fetch; earlier rounds charged a
+    tunnel RTT per timed window), not hardcoded, so a backfilled early
+    round can't mislabel the pin."""
     import glob
     import re
 
@@ -77,7 +81,7 @@ def _bert_baseline():
         m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(p))
         if m:
             rounds.append((int(m.group(1)), p))
-    for _, path in sorted(rounds):
+    for n, path in sorted(rounds):
         try:
             with open(path) as f:
                 record = json.load(f)
@@ -92,10 +96,12 @@ def _bert_baseline():
                     and isinstance(m.get("value"), (int, float))
                     and m["value"] > 0
                 ):
-                    return float(m["value"])
+                    protocol = (f"single-fetch-r{n:02d}" if n >= 4
+                                else f"per-iter-fetch-r{n:02d}")
+                    return float(m["value"]), protocol
         except Exception:
             continue
-    return BASELINE_BERT_SEN_SEC
+    return BASELINE_BERT_SEN_SEC, None
 
 
 # The driver contract is ONE JSON line on stdout; the watchdog thread and the
@@ -367,13 +373,13 @@ def bench_bert(mesh, variant: str = "bert_base"):
     }
     if hbm:
         out["peak_hbm_gb"] = round(hbm / 2**30, 3)
-    baseline = None if large else _bert_baseline()
+    baseline, protocol = (None, None) if large else _bert_baseline()
     if baseline:
         out["vs_baseline"] = round(value / baseline, 3)
-        # pin-on-first-capture resolved to the round-4 driver record, which
-        # was measured under the single-fetch protocol — tag it so both
-        # vs_baseline fields in the contract carry their pin's protocol
-        out["baseline_protocol"] = BASELINE_PROTOCOL
+        if protocol:
+            # the protocol of whatever record pin-on-first-capture resolved
+            # to — so both vs_baseline fields carry their own pin's protocol
+            out["baseline_protocol"] = protocol
     return out
 
 

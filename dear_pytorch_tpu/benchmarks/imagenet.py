@@ -30,6 +30,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--model", type=str, default="resnet50",
                    help=f"one of {models.cnn_names()}")
+    p.add_argument("--stem", type=str, default="conv7",
+                   choices=["conv7", "s2d"],
+                   help="ResNet stem: 's2d' = space-to-depth stem, the "
+                        "exact TPU-friendly repack of the 7x7/s2 conv "
+                        "(models/resnet.py)")
     runner.add_common_args(p)
     return p
 
@@ -43,7 +48,12 @@ def setup_cnn(args, mesh):
     """
     world = mesh.shape[DP_AXIS]
     dtype = jnp.bfloat16 if args.fp16 else jnp.float32
-    model = models.get_model(args.model, dtype=dtype)
+    model_kwargs = {}
+    if getattr(args, "stem", "conv7") != "conv7":
+        if not args.model.lower().startswith("resnet"):
+            raise SystemExit("--stem s2d applies to ResNet models only")
+        model_kwargs["stem"] = args.stem
+    model = models.get_model(args.model, dtype=dtype, **model_kwargs)
     image_size = 299 if args.model.lower() == "inceptionv4" else 224
     if args.model.lower() == "mnistnet":
         image_size = 28

@@ -159,6 +159,7 @@ def gpt2_to_torch_state_dict(params: Mapping[str, Any],
 
 def convert_resnet_from_torch(state_dict: Mapping[str, Any],
                               stage_sizes: tuple[int, ...] = (3, 4, 6, 3),
+                              stem: str = "conv7",
                               ) -> tuple[dict, dict]:
     """torchvision ResNet ``state_dict()`` -> ``(params, batch_stats)`` for
     `models.resnet.ResNet` (the reference's headline CNN is torchvision
@@ -172,6 +173,9 @@ def convert_resnet_from_torch(state_dict: Mapping[str, Any],
     torch-aligned padding makes the forward numerically identical.
     ``stage_sizes`` selects the variant ((2,2,2,2) = resnet18, default
     resnet50); bottleneck-vs-basic is inferred from the checkpoint keys.
+    ``stem='s2d'`` targets the space-to-depth model variant: the 7x7
+    stem kernel is repacked with ``resnet.repack_stem_conv7_to_s2d`` so
+    the converted checkpoint stays numerically identical.
     """
     sd = {k: _np(v) for k, v in state_dict.items()}
 
@@ -185,7 +189,14 @@ def convert_resnet_from_torch(state_dict: Mapping[str, Any],
              "var": sd[name + ".running_var"]},
         )
 
-    params: dict = {"stem_conv": conv("conv1")}
+    stem_kernel = conv("conv1")["kernel"]
+    if stem == "s2d":
+        from dear_pytorch_tpu.models.resnet import repack_stem_conv7_to_s2d
+
+        stem_kernel = np.asarray(repack_stem_conv7_to_s2d(stem_kernel))
+    elif stem != "conv7":
+        raise ValueError(f"unknown stem {stem!r}")
+    params: dict = {"stem_conv": {"kernel": stem_kernel}}
     stats: dict = {}
     p, s = bn("bn1")
     params["stem_bn"], stats["stem_bn"] = p, s

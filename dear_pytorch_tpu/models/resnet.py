@@ -24,6 +24,38 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+def space_to_depth(x, block: int = 2):
+    """[N, H, W, C] -> [N, H/b, W/b, C*b*b]; packed channel order is
+    (row-in-block, col-in-block, channel), the order
+    ``repack_stem_conv7_to_s2d`` assumes."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, c * block * block)
+
+
+def repack_stem_conv7_to_s2d(k7):
+    """Fold a [7,7,C,F] stride-2 stem kernel into the equivalent [4,4,4C,F]
+    stride-1 kernel over ``space_to_depth(x, 2)`` input (the MLPerf-style
+    TPU ResNet stem transform). Zero-pads the 7x7 kernel to 8x8 at the
+    front so that packed tap (a, bi) reads original tap u = 2a + bi - 1,
+    then folds the in-block offsets into the channel dim. With conv
+    padding ((2,1),(2,1)) on the packed input this reproduces the original
+    stem exactly (see tests/test_models.py)."""
+    import numpy as np
+
+    # plain numpy: callers are host-side (checkpoint conversion) and a
+    # 7x7xCxF shuffle must not touch a (possibly remote) device
+    k7 = np.asarray(k7)
+    kh, kw, c, f = k7.shape
+    assert (kh, kw) == (7, 7), "stem repack is specific to the 7x7 stride-2 stem"
+    k8 = np.zeros((8, 8, c, f), k7.dtype)
+    k8[1:, 1:] = k7
+    k8 = k8.reshape(4, 2, 4, 2, c, f)       # [a, bi, b, bj, c, f]
+    k4 = k8.transpose(0, 2, 1, 3, 4, 5)     # [a, b, bi, bj, c, f]
+    return k4.reshape(4, 4, 4 * c, f)
+
+
 class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck (ResNet v1.5: stride on the 3x3)."""
 
@@ -89,11 +121,19 @@ class BasicBlock(nn.Module):
 
 
 class ResNet(nn.Module):
+    """``stem='s2d'`` swaps the 7x7/s2 stem for the space-to-depth
+    equivalent (input packed 2x2 into channels, 4x4/s1 kernel): same math
+    (exactly, via ``repack_stem_conv7_to_s2d``), but the conv's reduction
+    dim grows 147->192 and the 224x224x3 input tensor — whose 3-channel
+    lane tiling the MXU hates — never reaches a conv. The standard TPU
+    ResNet trick (used by the public MLPerf ResNet submissions)."""
+
     stage_sizes: Sequence[int]
     block: ModuleDef = BottleneckBlock
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.float32
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -103,9 +143,16 @@ class ResNet(nn.Module):
             epsilon=1e-5, dtype=self.dtype,
         )
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), strides=(2, 2),
-                 padding=((3, 3), (3, 3)),  # torch-aligned stem
-                 use_bias=False, name="stem_conv")(x)
+        if self.stem == "s2d":
+            x = space_to_depth(x, 2)
+            x = conv(self.width, (4, 4), padding=((2, 1), (2, 1)),
+                     use_bias=False, name="stem_conv")(x)
+        elif self.stem == "conv7":
+            x = conv(self.width, (7, 7), strides=(2, 2),
+                     padding=((3, 3), (3, 3)),  # torch-aligned stem
+                     use_bias=False, name="stem_conv")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -73,7 +73,8 @@ def test_bert_baseline_pin_on_first_capture(bench, monkeypatch, tmp_path):
     # _bert_baseline derives its directory from the module's __file__ —
     # patch that, not the process-global os.path.dirname
     monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
-    assert bench._bert_baseline() == 1111.0
+    # protocol tag follows the RESOLVED record's round, not a constant
+    assert bench._bert_baseline() == (1111.0, "per-iter-fetch-r03")
 
 
 def test_smoke_contract_one_json_line():

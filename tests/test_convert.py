@@ -207,6 +207,33 @@ def test_resnet_forward_parity():
     np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
 
 
+def test_resnet_forward_parity_s2d_stem():
+    """convert_resnet_from_torch(stem='s2d') loads a torchvision-shaped
+    checkpoint into the space-to-depth model with identical outputs
+    (even input size — s2d packs 2x2 blocks)."""
+    from dear_pytorch_tpu.models.convert import convert_resnet_from_torch
+    from dear_pytorch_tpu.models.resnet import BottleneckBlock, ResNet
+
+    torch.manual_seed(1)
+    tmodel = _TorchResNet()
+    tmodel.eval()
+    params, stats = convert_resnet_from_torch(
+        tmodel.state_dict(), stage_sizes=(1, 1), stem="s2d"
+    )
+    assert params["stem_conv"]["kernel"].shape == (4, 4, 12, 8)
+    jmodel = ResNet(stage_sizes=(1, 1), width=8, num_classes=4,
+                    block=BottleneckBlock, stem="s2d")
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 34, 34).astype(np.float32)
+    with torch.no_grad():
+        ref = tmodel(torch.tensor(x)).numpy()
+    got = jmodel.apply(
+        {"params": params, "batch_stats": stats},
+        jnp.asarray(x.transpose(0, 2, 3, 1)), train=False,
+    )
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+
 def test_gpt2_forward_parity():
     """HF GPT2LMHeadModel from a local config vs our GptLmHeadModel under
     converted params: logits over the real vocab must match."""

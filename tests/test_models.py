@@ -44,6 +44,52 @@ def test_resnet50_forward_shape():
     assert out.dtype == jnp.float32
 
 
+def test_s2d_stem_exactly_matches_conv7_stem():
+    """The space-to-depth stem with the repacked kernel is the SAME
+    function as the 7x7/s2 stem — bitwise-comparable up to conv reduction
+    order (f32 tolerance). This is what makes the s2d variant a safe perf
+    substitution and keeps torchvision checkpoint conversion valid."""
+    from dear_pytorch_tpu.models import resnet as R
+
+    key = jax.random.PRNGKey(7)
+    x = jax.random.normal(key, (2, 224, 224, 3), jnp.float32)
+    m7 = models.get_model("resnet18")
+    ms = models.get_model("resnet18", stem="s2d")
+    v7 = m7.init({"params": jax.random.PRNGKey(1)}, x, train=False)
+    k7 = v7["params"]["stem_conv"]["kernel"]
+    assert k7.shape == (7, 7, 3, 64)
+    vs = jax.tree.map(lambda a: a, v7)  # copy structure
+    vs["params"] = dict(v7["params"])
+    vs["params"]["stem_conv"] = {
+        "kernel": R.repack_stem_conv7_to_s2d(k7)
+    }
+    out7 = m7.apply(v7, x, train=False)
+    outs = ms.apply(vs, x, train=False)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(out7),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_s2d_stem_param_count_and_grad():
+    """s2d resnet50 keeps the downstream architecture identical (only the
+    stem kernel reshapes 7*7*3 -> 4*4*12 = same 9408+pad... exactly 147->192
+    inputs x 64, so counts differ by the documented zero-pad rows) and
+    trains (grads flow through space_to_depth)."""
+    m = models.get_model("resnet50", stem="s2d")
+    x = jnp.zeros((1, 64, 64, 3))
+    variables = m.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    k = variables["params"]["stem_conv"]["kernel"]
+    assert k.shape == (4, 4, 12, 64)
+
+    def loss(p):
+        out = m.apply({"params": p, **{k2: v for k2, v in variables.items()
+                                       if k2 != "params"}},
+                      x, train=False)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(variables["params"])
+    assert jnp.isfinite(g["stem_conv"]["kernel"]).all()
+
+
 def test_mnistnet_forward():
     m = models.get_model("mnistnet")
     batch = data.synthetic_mnist_batch(jax.random.PRNGKey(0), 4)

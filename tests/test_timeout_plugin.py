@@ -1,0 +1,75 @@
+"""The vendored --timeout plugin (root conftest.py): accepted syntax,
+signal-method single-test failure, thread-method hard exit.
+
+pytest-timeout itself cannot be installed here; these tests pin the
+compatible surface so the suite can be run `python -m pytest
+--timeout=1200` exactly as a reference-scale CI would (the reference's
+own mpirun harness hangs forever on a wedged rank —
+reference common/comm_core/test.sh:29 — which is the failure mode this
+plugin exists to bound)."""
+
+import os
+import pathlib
+
+import pytest
+
+ROOT_CONFTEST = pathlib.Path(__file__).resolve().parent.parent / "conftest.py"
+
+
+@pytest.fixture
+def timeout_pytester(pytester):
+    pytester.makeconftest(ROOT_CONFTEST.read_text())
+    return pytester
+
+
+def test_timeout_option_accepted(timeout_pytester):
+    timeout_pytester.makepyfile("def test_ok():\n    assert True\n")
+    result = timeout_pytester.runpytest_subprocess("--timeout=1200")
+    result.assert_outcomes(passed=1)
+
+
+def test_signal_method_fails_only_the_hung_test(timeout_pytester):
+    timeout_pytester.makepyfile(
+        """
+        import time
+
+        def test_hangs():
+            time.sleep(30)
+
+        def test_survives():
+            assert True
+        """
+    )
+    result = timeout_pytester.runpytest_subprocess("--timeout=1")
+    result.assert_outcomes(failed=1, passed=1)
+    result.stdout.fnmatch_lines(["*timeout: exceeded 1s*"])
+
+
+def test_marker_overrides_cli(timeout_pytester):
+    timeout_pytester.makepyfile(
+        """
+        import time
+        import pytest
+
+        @pytest.mark.timeout(5)
+        def test_marked_slow_ok():
+            time.sleep(1.2)
+        """
+    )
+    result = timeout_pytester.runpytest_subprocess("--timeout=1")
+    result.assert_outcomes(passed=1)
+
+
+def test_thread_method_kills_the_process(timeout_pytester):
+    timeout_pytester.makepyfile(
+        """
+        import time
+
+        def test_hangs():
+            time.sleep(30)
+        """
+    )
+    result = timeout_pytester.runpytest_subprocess(
+        "--timeout=1", "--timeout-method=thread"
+    )
+    assert result.ret == 7
