@@ -63,15 +63,15 @@ BASELINE_BERT_SEN_SEC = None
 PRIMARY_METRIC = "resnet50_bs64_train_img_sec_per_chip"
 
 
-def _bert_baseline():
-    """(sen/s, protocol) of the first captured bert_base metric from
-    BENCH_r*.json history, else (pin, None). The driver stores each round
-    as {"n", "cmd", "rc", "tail", "parsed"} where "parsed" is our contract
-    line (extra_metrics carries the BERT entry) — pin-on-first-capture
-    without manual edits. The protocol tag is derived from the resolved
-    round (rounds >= 4 measured single-fetch; earlier rounds charged a
-    tunnel RTT per timed window), not hardcoded, so a backfilled early
-    round can't mislabel the pin."""
+def _history_baseline(metric: str, fallback=None):
+    """(value, protocol) of the first captured ``metric`` from
+    BENCH_r*.json history, else (fallback, None) — pin-on-first-capture
+    without manual edits. The driver stores each round as {"n", "cmd",
+    "rc", "tail", "parsed"} where "parsed" is our contract line
+    (extra_metrics carries the secondary entries). The protocol tag is
+    derived from the resolved round (rounds >= 4 measured single-fetch;
+    earlier rounds charged a tunnel RTT per timed window), not
+    hardcoded, so a backfilled early round can't mislabel the pin."""
     import glob
     import re
 
@@ -92,7 +92,7 @@ def _bert_baseline():
             for m in candidates:
                 if (
                     isinstance(m, dict)
-                    and m.get("metric") == "bert_base_sen_sec_per_chip"
+                    and m.get("metric") == metric
                     and isinstance(m.get("value"), (int, float))
                     and m["value"] > 0
                 ):
@@ -101,7 +101,12 @@ def _bert_baseline():
                     return float(m["value"]), protocol
         except Exception:
             continue
-    return BASELINE_BERT_SEN_SEC, None
+    return fallback, None
+
+
+def _bert_baseline():
+    return _history_baseline("bert_base_sen_sec_per_chip",
+                             BASELINE_BERT_SEN_SEC)
 
 
 # The driver contract is ONE JSON line on stdout; the watchdog thread and the
@@ -383,6 +388,74 @@ def bench_bert(mesh, variant: str = "bert_base"):
     return out
 
 
+def bench_gpt(mesh):
+    """GPT-2 (124M) S=1024 causal-LM pretraining throughput — the
+    transformer-decoder headline (beyond the reference zoo; harness analog
+    of dear/bert_benchmark.py:160-175). Round-5 configuration from the
+    on-chip sweep (perf/onchip_r05/gpt_sweep/): batch 16, dropout 0 (the
+    modern pretraining default — attention-probs dropout alone draws a
+    [B,12,1024,1024] random mask per layer and halves throughput),
+    streamed logsumexp LM loss, default %8 vocab padding (the %128
+    lane-width A/B was a null result — GptConfig.vocab_pad_multiple).
+    38.9% MFU on-chip vs the r04 headline's 22.9%."""
+    import dataclasses
+
+    from dear_pytorch_tpu import models
+    from dear_pytorch_tpu.models import data
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import dear as D
+
+    batch_size = 2 if SMOKE else 16
+    seq_len = 32 if SMOKE else 1024
+    model = models.get_model("gpt2", dtype=jnp.bfloat16)
+    cfg = model.config
+    replace = dict(embd_dropout_prob=0.0, hidden_dropout_prob=0.0,
+                   attention_probs_dropout_prob=0.0)
+    if SMOKE:
+        replace.update(num_hidden_layers=2, hidden_size=64,
+                       num_attention_heads=4, intermediate_size=128,
+                       vocab_size=128, max_position_embeddings=seq_len)
+    cfg = dataclasses.replace(cfg, **replace)
+    model = models.GptLmHeadModel(cfg)
+    batch = data.synthetic_gpt_batch(
+        jax.random.PRNGKey(0), batch_size, seq_len=seq_len,
+        vocab_size=cfg.vocab_size,
+    )
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        batch["input_ids"], train=False)["params"]
+
+    def loss_fn(p, b, rng):
+        del rng  # dropout-free config
+        logits = model.apply({"params": p}, b["input_ids"], train=True)
+        return models.gpt_lm_loss(logits, b["input_ids"],
+                                  vocab_size=cfg.vocab_size)
+
+    ts = D.build_train_step(
+        loss_fn, params, mesh=mesh, mode="dear", threshold_mb=25.0,
+        optimizer=fused_sgd(lr=0.01, momentum=0.9),
+        comm_dtype=jnp.bfloat16, gather_dtype=_gather_dtype(mesh.size),
+        rng_seed=7,
+    )
+    state = ts.init(params)
+    step_fn, flops, hbm = _compile_once(ts, state, batch)
+    value, secs_per_step, _ = _timed(step_fn, state, batch,
+                                     batch_size * seq_len)
+    out = {
+        "metric": "gpt2_s1024_tok_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "tok/s",
+        "mfu": _mfu(flops, secs_per_step),
+    }
+    if hbm:
+        out["peak_hbm_gb"] = round(hbm / 2**30, 3)
+    baseline, protocol = _history_baseline("gpt2_s1024_tok_sec_per_chip")
+    if baseline:
+        out["vs_baseline"] = round(value / baseline, 3)
+        if protocol:
+            out["baseline_protocol"] = protocol
+    return out
+
+
 def _mfu(flops: float, secs_per_step: float):
     from dear_pytorch_tpu.utils import perf_model
 
@@ -500,6 +573,14 @@ def main() -> None:
             extras.append(bench_vit(mesh))
         except Exception as exc:
             extras.append({"metric": "vit_b16_bs64_train_img_sec_per_chip",
+                           "error": f"{type(exc).__name__}: {exc}"[:200]})
+    if _env_enabled("DEAR_BENCH_GPT"):
+        # decoder headline (round-5 sweep config); DEAR_BENCH_GPT=0 skips
+        dog.arm("gpt", "gpt2_s1024_tok_sec_per_chip")
+        try:
+            extras.append(bench_gpt(mesh))
+        except Exception as exc:
+            extras.append({"metric": "gpt2_s1024_tok_sec_per_chip",
                            "error": f"{type(exc).__name__}: {exc}"[:200]})
     dog.disarm()
     out = dict(resnet)

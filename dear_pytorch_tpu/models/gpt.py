@@ -46,10 +46,27 @@ class GptConfig:
     #: shard the experts over an 'ep' mesh axis.
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
+    #: rematerialize each block in the backward pass (jax.checkpoint over
+    #: GptBlock): activations are recomputed instead of stored, trading
+    #: ~1/3 extra FLOPs in the blocks for O(layers) less live memory —
+    #: the standard TPU recipe for raising batch size (HBM, not MXU, is
+    #: the binding constraint at small batch).
+    remat: bool = False
+
+    #: pad the vocab (and thus the tied LM-head matmul's N dimension) to a
+    #: multiple of this. Default 8 = reference parity (reference
+    #: dear/bert_benchmark.py:72-78) and HF-familiar logits width; 128 (the
+    #: TPU lane width) was A/B-measured on-chip and is a NULL result —
+    #: 88.1k vs 88.6k tok/s, within run noise
+    #: (perf/onchip_r05/gpt_sweep/gpt_sweep_v128.json) — XLA already tiles
+    #: the unaligned N=50264 well, so the default stays interop-friendly.
+    #: Padded ids are dead in the loss and in sampling either way.
+    vocab_pad_multiple: int = 8
 
     @property
     def padded_vocab_size(self) -> int:
-        return ((self.vocab_size + 7) // 8) * 8
+        m = self.vocab_pad_multiple
+        return ((self.vocab_size + m - 1) // m) * m
 
 
 GPT2_SMALL = GptConfig()
@@ -237,9 +254,14 @@ class GptLmHeadModel(nn.Module):
                          embedding_init=init, dtype=cfg.dtype,
                          name="wpe")(pos)
         x = nn.Dropout(cfg.embd_dropout_prob, deterministic=not train)(x)
+        block_cls = GptBlock
+        if cfg.remat and not decode:
+            # static_argnums counts the bound module as arg 0: (self, x,
+            # train, decode) -> the two bools are 2 and 3
+            block_cls = nn.remat(GptBlock, static_argnums=(2, 3))
         for i in range(cfg.num_hidden_layers):
-            x = GptBlock(cfg, attention_impl=self.attention_impl,
-                         name=f"h_{i}")(x, train, decode=decode)
+            x = block_cls(cfg, attention_impl=self.attention_impl,
+                          name=f"h_{i}")(x, train, decode)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_f")(x)
         return wte.attend(x).astype(jnp.float32)
@@ -354,13 +376,21 @@ def generate(
 def gpt_lm_loss(logits, input_ids, *, vocab_size: Optional[int] = None):
     """Next-token cross-entropy: logits[:, t] predict input_ids[:, t+1].
     Padded vocab ids (>= ``vocab_size``) are excluded from the softmax
-    support by masking their logits, so the loss matches an unpadded
-    model's."""
+    support, so the loss matches an unpadded model's.
+
+    Streamed formulation: ``nll = logsumexp(valid logits) - logit[target]``
+    — the identical function to masking + log_softmax + gather (log_softmax
+    IS x - logsumexp(x)), but it never materializes the [B, S, V] log-prob
+    tensor and excludes the padded tail by reduction *slicing* rather than
+    a full-tensor where-mask. At GPT-2 scale ([8, 1024, 50264] f32) the
+    naive form costs ~3 GB of extra HBM round-trips per step; this form
+    reads the logits once. Same-value + same-gradient property is pinned
+    by tests/test_gpt.py::test_gpt_lm_loss_streamed_equivalence."""
     logits = logits[:, :-1]
     targets = input_ids[:, 1:]
-    if vocab_size is not None and vocab_size < logits.shape[-1]:
-        pad = jnp.arange(logits.shape[-1]) >= vocab_size
-        logits = jnp.where(pad[None, None], -1e9, logits)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    V = logits.shape[-1]
+    valid = logits[..., :vocab_size] if (vocab_size is not None
+                                         and vocab_size < V) else logits
+    lse = jax.scipy.special.logsumexp(valid, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)

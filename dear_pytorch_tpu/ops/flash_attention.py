@@ -1,13 +1,23 @@
 """Flash attention as Pallas TPU kernels (forward + backward).
 
-The hot op of every transformer in the zoo. XLA's fused attention is good;
-a hand-tiled kernel is better where it counts on TPU: the whole
+The hot op of every transformer in the zoo: the whole
 score-softmax-weighted-sum pipeline stays in VMEM per (query-block,
 key-block) tile, the S×S score matrix is never materialized in HBM
 (memory O(S·D) instead of O(S²)), and the MXU sees back-to-back
 [bq,D]×[D,bk] / [bq,bk]×[bk,D] matmuls (Dao et al. 2022, blockwise online
 softmax — same math as `parallel.ring_attention`, which distributes ACROSS
 chips what this kernel tiles WITHIN one).
+
+PERFORMANCE STATUS — honest as of round 5: these kernels are validated
+for CORRECTNESS on a real TPU (and bit-compared against XLA attention on
+every backend), but their SPEED against XLA's fused attention is
+unmeasured on every machine this project has touched: the build
+container reaches its chip through a relay that carries each Pallas
+custom call's block I/O at ~1 GB/s (scripts/pallas_overhead_probe.py
+isolates this; perf/onchip_r04/pallas_overhead_probe.txt), drowning
+kernel time 6-20x. The memory claim above is structural; the speed
+claim is a hypothesis until a DIRECT-attached TPU host runs
+`python scripts/flash_ab.py` (one command, prints the A/B).
 
 Backward is the standard flash recomputation: forward saves only the
 softmax log-sum-exp per row; dQ and dK/dV are computed by two kernels that
@@ -235,29 +245,54 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 # ---------------------------------------------------------------------------
 
 
-def _pick_block(s: int, pref: int = 128) -> int:
+def _sublane_multiple(dtype) -> int:
+    """Native sublane tile for a dtype on TPU: (8, 128) tiles hold 32-bit
+    elements; 16-bit operands pack two per 32-bit word -> (16, 128);
+    8-bit -> (32, 128)."""
+    bits = jnp.dtype(dtype).itemsize * 8
+    return {32: 8, 16: 16, 8: 32}.get(bits, 8)
+
+
+def _pick_block(s: int, pref: int = 128, dtype=jnp.float32) -> int:
+    """Largest divisor of ``s`` that is <= ``pref`` by halving — refusing
+    blocks below the dtype's native sublane tile (a bf16 operand blocked
+    at 8 rows passes the naive %8 rule but mis-tiles on chip; the CPU
+    interpreter would never notice)."""
     b = min(s, pref)
     while s % b:
         b //= 2
-    return max(b, 1)
+    b = max(b, 1)
+    need = _sublane_multiple(dtype)
+    if b != s and b % need:
+        raise ValueError(
+            f"flash attention: sequence length {s} only tiles into "
+            f"{b}-row blocks, below the {jnp.dtype(dtype).name} native "
+            f"sublane tile ({need}); pad the sequence to a multiple of "
+            f"{need} (ideally {pref})"
+        )
+    return b
 
 
-def check_mosaic_block(block: tuple, array: tuple) -> None:
+def check_mosaic_block(block: tuple, array: tuple,
+                       dtype=jnp.float32) -> None:
     """Enforce Mosaic's block-shape rule at trace time, on EVERY backend.
 
     The real-TPU lowering requires the last two dims of each block be
-    divisible by (8, 128) respectively or equal the array's dims.
-    ``interpret=True`` (the CPU test mesh) never applies the rule, so a
-    violating spec sails through the whole suite and dies on first chip
-    contact — exactly what happened with the rank-2 ``(1, S)`` vector specs
-    on 2026-07-31. Calling this from the wrappers makes the CPU tests fail
-    the same way the chip would."""
+    divisible by the operand dtype's native tile — (8, 128) for 32-bit,
+    (16, 128) for 16-bit, (32, 128) for 8-bit — or equal the array's
+    dims. ``interpret=True`` (the CPU test mesh) never applies the rule,
+    so a violating spec sails through the whole suite and dies on first
+    chip contact — exactly what happened with the rank-2 ``(1, S)``
+    vector specs on 2026-07-31. Calling this from the wrappers makes the
+    CPU tests fail the same way the chip would."""
+    need = _sublane_multiple(dtype)
     sub, lane = block[-2], block[-1]
-    if sub % 8 and sub != array[-2]:
+    if sub % need and sub != array[-2]:
         raise ValueError(
-            f"Mosaic-illegal block {block} for array {array}: second-to-last "
-            f"block dim {sub} is neither a multiple of 8 nor the array dim "
-            f"{array[-2]}"
+            f"Mosaic-illegal block {block} for array {array} "
+            f"({jnp.dtype(dtype).name}): second-to-last block dim {sub} is "
+            f"neither a multiple of the native sublane tile {need} nor the "
+            f"array dim {array[-2]}"
         )
     if lane % 128 and lane != array[-1]:
         raise ValueError(
@@ -267,11 +302,12 @@ def check_mosaic_block(block: tuple, array: tuple) -> None:
         )
 
 
-def _check_specs(specs, array_shapes) -> None:
+def _check_specs(specs, arrays) -> None:
     """Validate the ACTUAL BlockSpec objects handed to ``pallas_call``
-    (reading ``spec.block_shape`` — no hand-copied shadow list to drift)."""
-    for spec, arr in zip(specs, array_shapes, strict=True):
-        check_mosaic_block(tuple(spec.block_shape), tuple(arr))
+    (reading ``spec.block_shape`` — no hand-copied shadow list to drift).
+    ``arrays`` pairs each spec with ``(shape, dtype)``."""
+    for spec, (shape, dtype) in zip(specs, arrays, strict=True):
+        check_mosaic_block(tuple(spec.block_shape), tuple(shape), dtype)
 
 
 def _k_index_map(causal, bq, bk):
@@ -302,7 +338,8 @@ def _flash(q, k, v, kv_mask, scale, causal):
 def _flash_fwd_impl(q, k, v, kv_mask, scale, causal, out_dtype=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = _pick_block(sq), _pick_block(sk)
+    bq = _pick_block(sq, dtype=q.dtype)
+    bk = _pick_block(sk, dtype=k.dtype)
     grid = (bh, sq // bq, sk // bk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=sk // bk
@@ -318,10 +355,12 @@ def _flash_fwd_impl(q, k, v, kv_mask, scale, causal, out_dtype=None):
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
     ]
+    out_o_dtype = out_dtype or q.dtype
     _check_specs(
         in_specs + out_specs,
-        [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
-         (bh, sq, d), (bh, sq, 1)],
+        [((bh, sq, d), q.dtype), ((bh, sk, d), k.dtype),
+         ((bh, sk, d), v.dtype), ((bh, sk, 1), kv_mask.dtype),
+         ((bh, sq, d), out_o_dtype), ((bh, sq, 1), jnp.float32)],
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -364,7 +403,8 @@ def flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal,
     exposed separately so ring attention can run it per ring step."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = _pick_block(sq), _pick_block(sk)
+    bq = _pick_block(sq, dtype=q.dtype)
+    bk = _pick_block(sk, dtype=k.dtype)
     kmap = _k_index_map(causal, bq, bk)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),   # q
@@ -378,8 +418,11 @@ def flash_pair_dq(q, k, v, kv_mask, do, lse, delta, scale, causal,
     out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
     _check_specs(
         in_specs + out_specs,
-        [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
-         (bh, sq, d), (bh, sq, 1), (bh, sq, 1), (bh, sq, d)],
+        [((bh, sq, d), q.dtype), ((bh, sk, d), k.dtype),
+         ((bh, sk, d), v.dtype), ((bh, sk, 1), kv_mask.dtype),
+         ((bh, sq, d), do.dtype), ((bh, sq, 1), jnp.float32),
+         ((bh, sq, 1), jnp.float32),
+         ((bh, sq, d), out_dtype or q.dtype)],
     )
     return pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -401,7 +444,8 @@ def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal,
     (see `flash_pair_dq`)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = _pick_block(sq), _pick_block(sk)
+    bq = _pick_block(sq, dtype=q.dtype)
+    bk = _pick_block(sk, dtype=k.dtype)
     qmap = _q_index_map_dkv(causal, bq, bk)
     in_specs = [
         pl.BlockSpec((1, bq, d), qmap),                        # q
@@ -418,9 +462,12 @@ def flash_pair_dkv(q, k, v, kv_mask, do, lse, delta, scale, causal,
     ]
     _check_specs(
         in_specs + out_specs,
-        [(bh, sq, d), (bh, sk, d), (bh, sk, d), (bh, sk, 1),
-         (bh, sq, d), (bh, sq, 1), (bh, sq, 1),
-         (bh, sk, d), (bh, sk, d)],
+        [((bh, sq, d), q.dtype), ((bh, sk, d), k.dtype),
+         ((bh, sk, d), v.dtype), ((bh, sk, 1), kv_mask.dtype),
+         ((bh, sq, d), do.dtype), ((bh, sq, 1), jnp.float32),
+         ((bh, sq, 1), jnp.float32),
+         ((bh, sk, d), out_dtype or k.dtype),
+         ((bh, sk, d), out_dtype or v.dtype)],
     )
     return pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,

@@ -1,0 +1,174 @@
+"""Byte-level GPT finetune on REAL text — the causal-LM counterpart of
+examples/mnist.py's real-data story.
+
+Trains a small GPT (byte vocab, 256 entries — no tokenizer dependency)
+on the checked-in real English corpus (examples/data/real_text.txt; see
+examples/data/README.md for provenance) through the full DeAR schedule,
+with a held-out split and a ShardedSampler over training windows, then
+samples a continuation with the KV-cache ``generate()``.
+
+Real natural-language statistics are the point: a model that merely
+memorizes synthetic uniform tokens can't show a bits-per-byte drop, so
+the asserted eval bar (tests/test_example_and_checkpoint.py) fails if
+the delayed-update semantics break actual learning.
+
+Run (any platform; CPU uses the 8-device emulation):
+  python examples/char_gpt.py --steps 300
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import dear_pytorch_tpu as dear
+from dear_pytorch_tpu.models import GptConfig, GptLmHeadModel, gpt_lm_loss
+from dear_pytorch_tpu.models.data import ShardedSampler
+from dear_pytorch_tpu.models.gpt import generate
+from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+from dear_pytorch_tpu.parallel import build_train_step
+
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data",
+                      "real_text.txt")
+
+
+def load_corpus(seq_len: int, holdout_fraction: float = 0.1):
+    """(train_windows [N, S+1] uint8->int32, eval_windows): overlapping
+    byte windows; the +1 column feeds the shifted next-byte loss. The
+    holdout is a contiguous TAIL of the corpus (windows never straddle
+    the split, so eval text is never trained on)."""
+    raw = np.frombuffer(
+        open(CORPUS, "rb").read(), dtype=np.uint8
+    ).astype(np.int32)
+    n_eval = int(len(raw) * holdout_fraction)
+    train, evl = raw[:-n_eval], raw[-n_eval:]
+
+    def windows(arr, stride):
+        n = (len(arr) - seq_len - 1) // stride
+        return np.stack(
+            [arr[i * stride: i * stride + seq_len + 1] for i in range(n)]
+        )
+
+    return windows(train, seq_len // 2), windows(evl, seq_len)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="byte-level GPT on real text")
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.3)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--mode", type=str, default="dear",
+                   choices=["dear", "allreduce", "rsag", "rb"])
+    p.add_argument("--sample-chars", type=int, default=120,
+                   help="0 disables the generation demo")
+    args = p.parse_args(argv)
+
+    mesh = dear.init()
+
+    def log(s):
+        if dear.rank() == 0:
+            print(s, flush=True)
+
+    cfg = GptConfig(
+        vocab_size=256, hidden_size=128, num_hidden_layers=4,
+        num_attention_heads=4, intermediate_size=512,
+        max_position_embeddings=max(args.seq_len, 256),
+        embd_dropout_prob=0.0, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    model = GptLmHeadModel(cfg)
+    train_w, eval_w = load_corpus(args.seq_len)
+    log(f"corpus: {train_w.shape[0]} train / {eval_w.shape[0]} eval "
+        f"windows of {args.seq_len + 1} bytes")
+
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, args.seq_len + 1), jnp.int32), train=False,
+    )["params"]
+    params = dear.broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(prm, batch, rng):
+        del rng  # dropout-free config
+        logits = model.apply({"params": prm}, batch, train=True)
+        return gpt_lm_loss(logits, batch, vocab_size=cfg.vocab_size)
+
+    ts = build_train_step(
+        loss_fn, params, mesh=mesh, mode=args.mode,
+        optimizer=fused_sgd(lr=args.lr, momentum=args.momentum),
+        rng_seed=9,
+    )
+    state = ts.init(params)
+
+    eval_batch = jnp.asarray(eval_w)
+    eval_fn = jax.jit(
+        lambda prm: gpt_lm_loss(
+            model.apply({"params": prm}, eval_batch, train=False),
+            eval_batch, vocab_size=cfg.vocab_size,
+        )
+    )
+
+    def bits_per_byte(s):
+        return float(eval_fn(ts.gather_params(s))) / np.log(2.0)
+
+    log(f"held-out bits/byte before training: {bits_per_byte(state):.3f} "
+        f"(uniform would be {np.log2(256):.1f})")
+    sampler = ShardedSampler(
+        len(train_w), jax.process_count(), jax.process_index(), seed=4
+    )
+    proc_batch = args.batch_size // jax.process_count() or 1
+    if proc_batch > sampler.shard_len:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} needs {proc_batch} windows "
+            f"per process but the corpus yields only {sampler.shard_len} "
+            f"at --seq-len {args.seq_len}; lower one of them"
+        )
+    t0 = time.perf_counter()
+    step = 0
+    epoch = 0
+    while step < args.steps:
+        order = sampler.epoch_indices(epoch)
+        epoch += 1
+        for s in range(len(order) // proc_batch):
+            if step >= args.steps:
+                break
+            idx = order[s * proc_batch:(s + 1) * proc_batch]
+            state, metrics = ts.step(state, jnp.asarray(train_w[idx]))
+            step += 1
+            if step % 50 == 0:
+                log(f"step {step}: train loss "
+                    f"{float(metrics['loss']):.3f}, held-out "
+                    f"{bits_per_byte(state):.3f} bits/byte, "
+                    f"{time.perf_counter() - t0:.1f}s")
+    bpb = bits_per_byte(state)
+    log(f"final held-out: {bpb:.3f} bits/byte")
+
+    if args.sample_chars and dear.rank() == 0:
+        prompt = "The following terms "
+        ids = jnp.asarray(
+            np.frombuffer(prompt.encode(), np.uint8).astype(np.int32)
+        )[None, :]
+        out = generate(model, ts.gather_params(state), ids,
+                       max_new_tokens=args.sample_chars,
+                       temperature=0.8, rng=jax.random.PRNGKey(11))
+        text = bytes(np.asarray(out[0]).astype(np.uint8)).decode(
+            "utf-8", errors="replace")
+        log(f"sample: {text!r}")
+    return bpb
+
+
+if __name__ == "__main__":
+    # an untrained byte model sits at 8.0 bits/byte; 300 quick steps of
+    # this 1.1M-param model land ~4.7-4.8 (measured trajectory: 5.33 @50,
+    # 4.84 @200) — well past "memorized the byte histogram" (~5.6 for
+    # English), i.e. real structure was learned. 5.5 is the honest
+    # smoke bar; serious quality needs a bigger model + more steps.
+    sys.exit(0 if main() < 5.5 else 1)

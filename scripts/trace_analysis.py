@@ -1,0 +1,147 @@
+"""Quantify a jax.profiler device trace: per-category time and EXPOSED
+collective time (the number the DeAR schedule exists to minimize).
+
+Reads the Chrome-format trace JSON written under
+``<dir>/plugins/profile/<ts>/*.trace.json.gz`` (what
+``jax.profiler.start_trace`` emits; same layout as the committed
+round-4 artifacts ``perf/onchip_r04/trace{,_fsdp}``) and reports:
+
+- steps observed (XLA Modules line) and mean ms/step;
+- device time by HLO category (fusion / convolution / all-reduce / ...);
+- **exposed collective %**: time collective ops occupy the
+  synchronous "XLA Ops" timeline, divided by total step time. Ops that
+  XLA managed to overlap run on the "Async XLA Ops" line instead, so
+  the sync-line residue is precisely the serialization the schedule
+  failed to hide. (The reference's claim to exist is hiding this —
+  reference dear/dear_dopt.py:274-308's overlap pipeline.)
+
+Usage:
+  python scripts/trace_analysis.py --trace perf/onchip_r04/trace \
+      [--json out.json] [--top 15]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+COLLECTIVE_MARKERS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "psum", "ppermute",
+)
+
+
+def find_trace_file(trace_dir: str) -> str:
+    pats = [
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.trace.json.gz"),
+        os.path.join(trace_dir, "*.trace.json.gz"),
+    ]
+    for pat in pats:
+        hits = sorted(glob.glob(pat))
+        if hits:
+            return hits[-1]  # newest capture
+    raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
+
+
+def _device_threads(events):
+    """{(pid, tid): line_name} for every process that owns an "XLA Ops"
+    line — works for TPU ('/device:TPU:0') and emulated-CPU mesh traces
+    alike (the python host process has no such thread)."""
+    names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            names[(e["pid"], e["tid"])] = e["args"].get("name", "")
+    xla_pids = {pid for (pid, _), v in names.items() if v == "XLA Ops"}
+    return {k: v for k, v in names.items() if k[0] in xla_pids}
+
+
+def _is_collective(name: str, category: str) -> bool:
+    s = f"{name} {category}".lower()
+    return any(m in s for m in COLLECTIVE_MARKERS)
+
+
+def analyze(trace_path: str, top: int = 15) -> dict:
+    with gzip.open(trace_path) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    lines = _device_threads(events)
+
+    def line_events(substr):
+        keys = {k for k, v in lines.items() if substr in v}
+        return [e for e in events
+                if e.get("ph") == "X" and (e["pid"], e.get("tid")) in keys]
+
+    modules = line_events("XLA Modules")
+    sync_ops = line_events("XLA Ops")
+    async_ops = line_events("Async XLA Ops")
+    if not modules or not sync_ops:
+        raise SystemExit(
+            f"{trace_path}: no XLA Modules/Ops device lines found "
+            "(CPU-only trace or wrong directory?)"
+        )
+
+    total_module_us = sum(e["dur"] for e in modules)
+    by_cat: dict = collections.defaultdict(float)
+    by_op: dict = collections.defaultdict(float)
+    exposed_us = 0.0
+    for e in sync_ops:
+        args = e.get("args", {}) or {}
+        cat = args.get("hlo_category", "") or ""
+        by_cat[cat or "(uncategorized)"] += e["dur"]
+        by_op[e["name"]] += e["dur"]
+        if _is_collective(e["name"], cat):
+            exposed_us += e["dur"]
+    overlapped_us = sum(
+        e["dur"] for e in async_ops
+        if _is_collective(e["name"], (e.get("args", {}) or {})
+                          .get("hlo_category", "") or "")
+    )
+
+    n_steps = len(modules)
+    out = {
+        "trace": trace_path,
+        "steps": n_steps,
+        "ms_per_step": round(total_module_us / n_steps / 1e3, 3),
+        "exposed_collective_pct": round(100 * exposed_us / total_module_us, 3),
+        "overlapped_collective_ms_per_step": round(
+            overlapped_us / n_steps / 1e3, 4),
+        "exposed_collective_ms_per_step": round(exposed_us / n_steps / 1e3, 4),
+        "by_category_ms_per_step": {
+            k: round(v / n_steps / 1e3, 3)
+            for k, v in sorted(by_cat.items(), key=lambda kv: -kv[1])
+        },
+        "top_ops_ms_per_step": {
+            k: round(v / n_steps / 1e3, 3)
+            for k, v in sorted(by_op.items(), key=lambda kv: -kv[1])[:top]
+        },
+    }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", required=True,
+                    help="profile dir (or direct *.trace.json.gz path)")
+    ap.add_argument("--json", help="also write the report here")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args(argv)
+
+    path = (args.trace if args.trace.endswith(".json.gz")
+            else find_trace_file(args.trace))
+    report = analyze(path, args.top)
+    print(json.dumps(report, indent=1))
+    if args.json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json)),
+                    exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,15 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/TPU platform
 os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"  # sitecustomize sets TPU_WORKER_HOSTNAMES
+# No persistent compilation cache in the suite: /tmp/dear_jax_cache can
+# carry XLA:CPU AOT results compiled on a DIFFERENT host CPU generation
+# (this container's /tmp outlives host moves), and loading them is at
+# best a warning and at worst a SIGILL/abort mid-test (observed:
+# cpu_aot_loader "machine features ... prefer-no-scatter" then a fatal
+# abort in a compiled executable). CPU test compiles are cheap; the
+# cache's real value is the TPU tunnel's 20-min compiles, which
+# non-test entry points still get.
+os.environ.setdefault("DEAR_COMPILATION_CACHE_DIR", "off")
 
 import jax  # noqa: E402
 

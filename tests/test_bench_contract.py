@@ -86,6 +86,8 @@ def test_smoke_contract_one_json_line():
         JAX_PLATFORMS="cpu", DEAR_BENCH_SMOKE="1",
         DEAR_BENCH_BERT_LARGE="0", DEAR_BENCH_VIT="0",
         DEAR_DISABLE_DISTRIBUTED="1",
+        # cross-host CPU AOT cache entries can SIGILL (see tests/conftest)
+        DEAR_COMPILATION_CACHE_DIR="off",
         PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
     )
     proc = subprocess.run(
@@ -99,6 +101,6 @@ def test_smoke_contract_one_json_line():
     assert out["metric"] == "resnet50_bs64_train_img_sec_per_chip"
     assert out["value"] > 0 and out["unit"] == "img/s"
     assert {m["metric"] for m in out["extra_metrics"]} == {
-        "bert_base_sen_sec_per_chip"}
-    bert = out["extra_metrics"][0]
-    assert "error" not in bert and bert["value"] > 0, bert
+        "bert_base_sen_sec_per_chip", "gpt2_s1024_tok_sec_per_chip"}
+    for m in out["extra_metrics"]:
+        assert "error" not in m and m["value"] > 0, m

@@ -173,14 +173,27 @@ def test_mosaic_block_rule():
     # lane block neither 128-multiple nor full
     with pytest.raises(ValueError, match="last block dim"):
         check_mosaic_block((1, 128, 32), (384, 128, 64))
+    # dtype-aware sublane rule: 8 rows is legal for f32 but BELOW the
+    # native (16, 128) tile for bf16 — must be rejected for 16-bit
+    check_mosaic_block((1, 8, 128), (4, 256, 128), jnp.float32)
+    with pytest.raises(ValueError, match="sublane tile 16"):
+        check_mosaic_block((1, 8, 128), (4, 256, 128), jnp.bfloat16)
+    with pytest.raises(ValueError, match="sublane tile 32"):
+        check_mosaic_block((1, 16, 128), (4, 256, 128), jnp.int8)
 
 
 def test_wrappers_reject_mosaic_illegal_blocks():
-    """An odd sequence length that forces a tiny non-8-multiple query block
-    must be rejected at trace time on every backend, not at Mosaic lowering
-    on the chip."""
+    """An odd sequence length that forces a tiny sub-tile query block must
+    be rejected at trace time on every backend, not at Mosaic lowering on
+    the chip."""
     rng = jax.random.PRNGKey(0)
-    # S=132 -> _pick_block gives 4 (132 = 4*33): illegal sublane block
+    # S=132 -> largest halving divisor is 4 (132 = 4*33): below every
+    # dtype's sublane tile
     q = jax.random.normal(rng, (2, 132, 2, 8), jnp.float32)
-    with pytest.raises(ValueError, match="Mosaic-illegal"):
+    with pytest.raises(ValueError, match="sublane tile"):
         flash_attention(q, q, q)
+    # the ADVICE.md round-4 scenario: S=136 = 8*17 tiles to 8-row blocks,
+    # which PASSES the naive %8 rule but mis-tiles bf16 on real chips
+    qb = jax.random.normal(rng, (2, 136, 2, 8)).astype(jnp.bfloat16)
+    with pytest.raises(ValueError, match="sublane tile"):
+        flash_attention(qb, qb, qb)

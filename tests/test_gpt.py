@@ -66,11 +66,67 @@ def test_padded_vocab_is_dead_in_loss():
     model, params = _params()
     ids = jnp.asarray(np.random.RandomState(2).randint(0, 61, (2, 16)))
     logits = model.apply({"params": params}, ids, train=False)
-    assert logits.shape[-1] == 64  # padded
+    assert logits.shape[-1] == 64  # padded to vocab_pad_multiple=8
     loss = gpt_lm_loss(logits, ids, vocab_size=61)
     # reference value: softmax over the REAL vocab only
     ref = gpt_lm_loss(logits[..., :61], ids)
     np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)
+
+
+def test_gpt_lm_loss_streamed_equivalence():
+    """The streamed logsumexp formulation must equal the naive
+    mask + log_softmax + gather form in VALUE and GRADIENT (it is the
+    same mathematical function, restructured to avoid materializing the
+    [B, S, V] log-prob tensor)."""
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(2, 9, 64).astype(np.float32)) * 3.0
+    ids = jnp.asarray(rng.randint(0, 61, (2, 9)))
+
+    def naive(lg):
+        lg = lg[:, :-1]
+        targets = ids[:, 1:]
+        pad = jnp.arange(lg.shape[-1]) >= 61
+        lg = jnp.where(pad[None, None], -1e9, lg)
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        return jnp.mean(
+            -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        )
+
+    def streamed(lg):
+        return gpt_lm_loss(lg, ids, vocab_size=61)
+
+    np.testing.assert_allclose(float(streamed(logits)), float(naive(logits)),
+                               rtol=1e-6)
+    g_s = jax.grad(streamed)(logits)
+    g_n = jax.grad(naive)(logits)
+    np.testing.assert_allclose(np.asarray(g_s), np.asarray(g_n),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_remat_config_same_function():
+    """cfg.remat=True must not change values or gradients — only the
+    backward-pass memory/recompute tradeoff."""
+    import dataclasses
+
+    model, params = _params()
+    rmodel = GptLmHeadModel(dataclasses.replace(TINY, remat=True))
+    ids = jnp.asarray(np.random.RandomState(5).randint(0, 61, (2, 16)))
+
+    def loss(m):
+        def f(p):
+            logits = m.apply({"params": p}, ids, train=False)
+            return gpt_lm_loss(logits, ids, vocab_size=61)
+        return f
+
+    base_v, base_g = jax.value_and_grad(loss(model))(params)
+    re_v, re_g = jax.value_and_grad(loss(rmodel))(params)
+    np.testing.assert_allclose(float(re_v), float(base_v), rtol=1e-6)
+    chex = jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        base_g, re_g,
+    )
+    del chex
 
 
 def test_trains_under_dear(mesh):

@@ -151,3 +151,27 @@ def test_hlo_parser_ignores_attribute_refs_and_done_halves():
     assert [o.name for o in hlo.find(ops, "all-gather")] == ["ag.3"]
     assert [o.name for o in hlo.find(ops, "all-gather-done")] == ["ag.4"]
     assert "rs.9" not in hlo.ancestors(ops, "ag.3")
+
+
+def test_dear_overlappability_beats_allreduce_quantitatively(mesh):
+    """The round-5 quantitative overlap claim (scripts/overlap_report.py):
+    mean independent-compute fraction across collectives must be higher
+    for dear than for the naive allreduce schedule. At world=8 XLA's
+    all-reduce combiner collapses allreduce-mode buckets into one
+    terminal all-reduce with ~2.5% overlappable compute; dear's RS/AG
+    decoupling holds ~37% (measured r5: 0.3667 vs 0.025)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "overlap_report.py")
+    spec = importlib.util.spec_from_file_location("overlap_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    dear = mod.hlo_overlap_metric("dear")
+    ar = mod.hlo_overlap_metric("allreduce")
+    assert dear["mean_independent_compute_frac"] is not None
+    assert ar["mean_independent_compute_frac"] is not None
+    assert (dear["mean_independent_compute_frac"]
+            > ar["mean_independent_compute_frac"]), (dear, ar)
