@@ -151,7 +151,10 @@ def main(argv=None):
     bpb = bits_per_byte(state)
     log(f"final held-out: {bpb:.3f} bits/byte")
 
-    if args.sample_chars and dear.rank() == 0:
+    if args.sample_chars:
+        # gather + generate on EVERY rank (gather_params builds an XLA
+        # program over globally-sharded buffers — a rank-0-only call
+        # would deadlock multi-process runs); only rank 0 prints
         prompt = "The following terms "
         ids = jnp.asarray(
             np.frombuffer(prompt.encode(), np.uint8).astype(np.int32)

@@ -160,6 +160,12 @@ def main(argv=None):
     )
     proc_batch = args.batch_size // jax.process_count() or 1
     steps_per_epoch = sampler.shard_len // proc_batch
+    if steps_per_epoch == 0:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} needs {proc_batch} samples "
+            f"per process but this dataset yields only "
+            f"{sampler.shard_len}; lower --batch-size"
+        )
     acc = evaluate(state)  # defined even with --epochs 0
     for epoch in range(args.epochs):
         t0 = time.perf_counter()

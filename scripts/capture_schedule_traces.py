@@ -112,12 +112,18 @@ def main(argv=None) -> int:
                "--batch", str(args.batch)]
         if args.smoke:
             cmd.append("--smoke")
-        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                              timeout=1800)
-        if proc.returncode != 0:
-            summary[mode] = {"error": proc.stderr[-400:]}
+        try:
+            proc = subprocess.run(cmd, env=env, capture_output=True,
+                                  text=True, timeout=1800)
+            if proc.returncode != 0:
+                summary[mode] = {"error": proc.stderr[-400:]}
+                continue
+            report = analyze(find_trace_file(os.path.join(args.out, mode)))
+        except Exception as exc:  # noqa: BLE001 — keep the other modes'
+            # results (TimeoutExpired, missing/unparseable trace, ...)
+            summary[mode] = {"error": f"{type(exc).__name__}: "
+                                      f"{str(exc)[:300]}"}
             continue
-        report = analyze(find_trace_file(os.path.join(args.out, mode)))
         summary[mode] = {
             "ms_per_step": report["ms_per_step"],
             "exposed_collective_pct": report["exposed_collective_pct"],

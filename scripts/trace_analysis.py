@@ -71,8 +71,11 @@ def analyze(trace_path: str, top: int = 15) -> dict:
     events = trace.get("traceEvents", [])
     lines = _device_threads(events)
 
-    def line_events(substr):
-        keys = {k for k, v in lines.items() if substr in v}
+    def line_events(name):
+        # EXACT name match: "XLA Ops" is a substring of "Async XLA Ops",
+        # and counting the async line as synchronous would report
+        # overlapped collectives as exposed — the opposite of the truth
+        keys = {k for k, v in lines.items() if v == name}
         return [e for e in events
                 if e.get("ph") == "X" and (e["pid"], e.get("tid")) in keys]
 
@@ -80,7 +83,7 @@ def analyze(trace_path: str, top: int = 15) -> dict:
     sync_ops = line_events("XLA Ops")
     async_ops = line_events("Async XLA Ops")
     if not modules or not sync_ops:
-        raise SystemExit(
+        raise ValueError(
             f"{trace_path}: no XLA Modules/Ops device lines found "
             "(CPU-only trace or wrong directory?)"
         )

@@ -1,0 +1,150 @@
+"""Minimal reproducer for the round-4 'unscanned dear step = 611 ms'
+anomaly (PERF.md "Open on-chip anomaly").
+
+Round 4 observed: the SAME dear-mode math runs ~611 ms/step when each
+step is its own top-level dispatch, but 29.7 ms/step inside a
+``multi_step(k>=4)`` scan — through this container's tunnel. The
+suspected culprit was the relay intercepting top-level collectives, but
+world=1 programs contain no collective ops at all, so that attribution
+was never tested. This probe times six ladder rungs to isolate which
+ingredient (dispatch itself, donation, dear state threading, or the
+scan) moves the number:
+
+  matmul_chain      plain jitted matmul x10 dispatches (control)
+  resnet_fwd        jitted fwd-only model call x10
+  dear_step         ts.step x10 (the anomaly case)
+  dear_step_nodonate same but donate=False
+  dear_scan_k10     ts.multi_step(10) x1 (the fast case)
+  dear_scan_k1      ts.multi_step(1) x10 (scan wrapper, no batching)
+
+Each rung: warm, then dispatch the whole window back-to-back and fetch
+ONE scalar (bench.py protocol). Writes perf/onchip_r05/unscanned_probe.txt
+via tee by the caller, prints one line per rung.
+
+Usage: python scripts/unscanned_probe.py [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dear_pytorch_tpu import models
+    from dear_pytorch_tpu.benchmarks import runner
+    from dear_pytorch_tpu.comm import backend
+    from dear_pytorch_tpu.models import data
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import dear as D
+
+    runner.apply_platform_env()
+    mesh = backend.init()
+    n = args.iters
+
+    batch_size = 8 if args.smoke else 64
+    size = 64 if args.smoke else 224
+    model = models.get_model("resnet18", dtype=jnp.bfloat16)
+    batch = data.synthetic_image_batch(
+        jax.random.PRNGKey(0), batch_size, image_size=size,
+        dtype=jnp.bfloat16)
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           batch["image"], train=False)
+    params, mstate = variables["params"], {
+        "batch_stats": variables["batch_stats"]}
+
+    def loss_fn(p, ms, b):
+        logits, new_state = model.apply(
+            {"params": p, **ms}, b["image"], train=True,
+            mutable=["batch_stats"])
+        return data.softmax_xent(logits, b["label"]), new_state
+
+    def build(donate):
+        ts = D.build_train_step(
+            loss_fn, params, mesh=mesh, mode="dear", threshold_mb=25.0,
+            optimizer=fused_sgd(lr=0.01, momentum=0.9),
+            comm_dtype=jnp.bfloat16, model_state_template=mstate,
+            donate=donate,
+        )
+        return ts, ts.init(params, mstate)
+
+    def timed(label, fn, fetch, reps):
+        fetch(fn())  # warm/compile
+        fetch(fn())
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(reps):
+            last = fn()
+        fetch(last)  # ONE device->host scalar for the window
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{label:>20}: {dt * 1e3:9.2f} ms/dispatch", flush=True)
+        return dt
+
+    # 1. control: plain matmul chain
+    x0 = jnp.ones((1024, 1024), jnp.bfloat16)
+
+    @jax.jit
+    def chain(x):
+        for _ in range(8):
+            x = jnp.tanh(x @ x0)
+        return x
+
+    xs = {"v": x0}
+    timed("matmul_chain",
+          lambda: chain(xs.__setitem__("v", chain(xs["v"])) or xs["v"]),
+          lambda r: float(jnp.sum(r).astype(jnp.float32)), n)
+
+    # 2. forward-only model call
+    fwd = jax.jit(lambda b: model.apply(
+        {"params": params, **mstate}, b["image"], train=False))
+    timed("resnet_fwd", lambda: fwd(batch),
+          lambda r: float(r.sum().astype(jnp.float32)), n)
+
+    # 3/4. unscanned dear step, with and without donation
+    for label, donate in (("dear_step", True),
+                          ("dear_step_nodonate", False)):
+        ts, state = build(donate)
+        holder = {"s": state}
+
+        def step_once(ts=ts, holder=holder):
+            s, m = ts.step(holder["s"], batch)
+            holder["s"] = s
+            return m
+
+        timed(label, step_once, lambda m: float(m["loss"]), n)
+
+    # 5/6. scanned: k=10 x1 and k=1 x10
+    ts, _ = build(True)
+    for label, k, reps in (("dear_scan_k10", 10, max(n // 10, 1)),
+                           ("dear_scan_k1", 1, n)):
+        runner_fn = ts.multi_step(k)
+        # fresh state per rung: the scan donates its input buffers
+        holder = {"s": ts.init(params, mstate)}
+
+        def scan_once(runner_fn=runner_fn, holder=holder):
+            s, m = runner_fn(holder["s"], batch)
+            holder["s"] = s
+            return m
+
+        dt = timed(label, scan_once, lambda m: float(m["loss"]), reps)
+        print(f"{'':>20}  = {dt / k * 1e3:9.2f} ms/step (k={k})",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,6 +125,25 @@ def main() -> None:
         all_losses, np.tile(all_losses[0], (n, 1)), rtol=1e-6
     )
 
+    # fsdp (ZeRO-3 shape) across the process boundary: AD-transposed
+    # parameter gathers + grad reduce-scatters cross hosts; one step must
+    # be finite and identical everywhere (verdict-r4 #5 asked for a
+    # cross-process fsdp leg alongside the dear one)
+    if os.environ.get("DEAR_MP_FSDP", "1").strip() not in ("0", ""):
+        tsf = build_train_step(
+            loss_fn, tparams, mesh=mesh, mode="fsdp", threshold_mb=0.0001,
+            optimizer=fused_sgd(lr=0.05, momentum=0.9), donate=False,
+        )
+        stf = tsf.init(tparams)
+        stf, mf = tsf.step(stf, batch)
+        f_loss = float(mf["loss"])
+        assert np.isfinite(f_loss)
+        from jax.experimental import multihost_utils as mhu
+
+        f_all = np.asarray(mhu.process_allgather(jnp.asarray([f_loss])))
+        np.testing.assert_allclose(f_all, np.tile(f_all[0], (n, 1)),
+                                   rtol=1e-6)
+
     # sequence parallelism ACROSS processes: a dp x sp mesh whose sp axis
     # spans the process boundary, causal ring attention rotating K/V
     # between hosts via ppermute — one GPT train step must be finite and

@@ -11,13 +11,27 @@ Worlds covered (process_count x local_device_count):
     through launch/cpu_cluster.sh so the launcher contract itself is
     exercised (reference equivalent: the 16-host launch surface,
     pytorch-ddp/launch_torch.sh:24-25).
+  - 8 x 1: the emulated ceiling for process count, through the launcher
+    (reference's validated scale was 64 ranks over 16 hosts,
+    configs/cluster64 — 8 localhost ranks is the max multi-host
+    confidence obtainable without a pod).
   - 2 x 2: multiple ADDRESSABLE devices per process — the TPU-pod shape
     (one process per host, several chips each); collectives cross both the
     intra-process and inter-process boundary in one mesh.
+  - 2 x 4: the 2-D process x device world — the dp x sp mesh's sp axis
+    pairs devices from DIFFERENT processes (ring ppermute crosses the
+    host boundary) while dp spans each host's remaining devices; plus
+    the dear and fsdp data-parallel steps over all 8 devices.
 
-Hang safety: pytest-timeout is not installed (its mark would be inert), so
-every subprocess wait carries an explicit deadline and kills the whole
-process group on expiry — a wedged child cannot wedge the suite.
+Each rank runs the full worker ladder: bootstrap/barrier,
+broadcast_parameters + broadcast_optimizer_state, host allreduce, a dear
+train step, an fsdp train step, sharded staging, and (direct worlds) the
+cross-process ring-attention sp step.
+
+Hang safety: belt and braces — every subprocess wait carries an explicit
+deadline that kills the whole process group on expiry, AND the vendored
+--timeout plugin (root conftest.py) arms a per-test alarm as the
+outer backstop.
 """
 
 import os
@@ -27,7 +41,7 @@ import sys
 
 import pytest
 
-DEADLINE = 240  # seconds per cluster run
+DEADLINE = 240  # seconds per cluster run (scaled up for bigger worlds)
 
 
 def _free_port() -> int:
@@ -43,9 +57,15 @@ def _base_env(repo: str) -> dict:
     return env
 
 
+def _deadline(nprocs: int, local_devices: int) -> int:
+    """Bigger worlds compile more programs on shared host cores."""
+    return DEADLINE + 45 * nprocs * max(local_devices, 1)
+
+
 def _run_direct(repo: str, worker: str, nprocs: int, local_devices: int):
     """Spawn one subprocess per rank with the launcher env contract."""
     port = _free_port()
+    deadline = _deadline(nprocs, local_devices)
     procs = []
     for pid in range(nprocs):
         env = _base_env(repo)
@@ -64,7 +84,7 @@ def _run_direct(repo: str, worker: str, nprocs: int, local_devices: int):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=DEADLINE)
+            out, _ = p.communicate(timeout=deadline)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -85,6 +105,7 @@ def _run_via_launcher(repo: str, worker: str, nprocs: int):
 
     script = os.path.join(repo, "launch", "cpu_cluster.sh")
     assert os.access(script, os.X_OK), f"{script} must be executable"
+    deadline = _deadline(nprocs, 1)
     env = _base_env(repo)
     # the direct worlds already exercise the cross-process sp leg; skip its
     # per-rank compiles here so the launcher world stays fast
@@ -95,12 +116,12 @@ def _run_via_launcher(repo: str, worker: str, nprocs: int):
         stderr=subprocess.STDOUT, text=True, start_new_session=True,
     )
     try:
-        out, _ = proc.communicate(timeout=DEADLINE)
+        out, _ = proc.communicate(timeout=deadline)
     except subprocess.TimeoutExpired as e:
         os.killpg(proc.pid, signal.SIGKILL)
         out, _ = proc.communicate()
         raise AssertionError(
-            f"cpu_cluster.sh wedged past {DEADLINE}s:\n"
+            f"cpu_cluster.sh wedged past {deadline}s:\n"
             f"{(e.stdout or out or '')[-3000:]}"
         ) from e
     assert proc.returncode == 0, out[-3000:]
@@ -113,9 +134,12 @@ def _run_via_launcher(repo: str, worker: str, nprocs: int):
     [
         pytest.param(2, 1, False, id="2procs"),
         pytest.param(4, 1, True, id="4procs-cpu_cluster.sh"),
+        pytest.param(8, 1, True, id="8procs-cpu_cluster.sh"),
         pytest.param(2, 2, False, id="2procs-x-2localdev"),
+        pytest.param(2, 4, False, id="2procs-x-4localdev-2d"),
     ],
 )
+@pytest.mark.timeout(900, method="signal")
 def test_process_cluster(nprocs, local_devices, via_launcher):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = os.path.join(repo, "tests", "mp_worker.py")
