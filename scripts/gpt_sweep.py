@@ -15,6 +15,15 @@ schedule. The three structural suspects, each isolated here:
   bs32_remat   + batch 32 with cfg.remat (block rematerialization trades
                ~1/3 extra block FLOPs for O(layers) less live memory)
   bs32_remat_drop  remat/bs32 with dropout ON (separates the two effects)
+  bs16_nodrop_v128 vocab padded %128 vs the %8 default (A/B: null)
+  medium_bs8_nodrop / medium_bs8_nodrop_remat
+               GPT-2 Medium 350M: dense attention OOMs; remat enables it
+  bs16_nodrop_s512 / bs16_nodrop_s256
+               sequence-length scaling (attention share of the step)
+
+Artifacts land under perf/onchip_r05/gpt_sweep/: the round-5 captures
+are gpt_sweep.json (main ladder), gpt_sweep_v128.json (vocab A/B),
+gpt_scaling.json (S-scaling), gpt_medium.json (350M).
 
 Same measurement discipline as bench.py / conv_sweep.py: scanned k-step
 program, contiguous dispatch queue, ONE end-of-window fetch.
@@ -54,6 +63,20 @@ CONFIGS: dict[str, dict] = {
     # was temporarily 128, so there 'bs16_nodrop' is the %128 leg.)
     "bs16_nodrop_v128": {"batch_size": 16, "dropout": 0.0,
                          "vocab_pad": 128},
+    # scaling studies: model size (medium = 350M, bigger GEMMs should
+    # raise MFU) and sequence length (quantifies the causal-attention
+    # elementwise share of the step)
+    "medium_bs8_nodrop": {"model": "gpt2_medium", "batch_size": 8,
+                          "dropout": 0.0},
+    # 350M dense-attention activations exceed HBM at bs8 (measured OOM:
+    # medium_bs8_nodrop.log) — remat is the ENABLER here, unlike the
+    # 124M case where it only traded FLOPs for nothing. NOTE the mfu
+    # field for remat configs is hardware-flop utilization (XLA counts
+    # recompute); model-flop MFU is ~0.8x that (PERF.md round-5)
+    "medium_bs8_nodrop_remat": {"model": "gpt2_medium", "batch_size": 8,
+                                "dropout": 0.0, "remat": True},
+    "bs16_nodrop_s512": {"batch_size": 16, "dropout": 0.0, "seq": 512},
+    "bs16_nodrop_s256": {"batch_size": 16, "dropout": 0.0, "seq": 256},
 }
 
 
@@ -74,10 +97,11 @@ def run_one(name: str, smoke: bool) -> dict:
     mesh = backend.init()
 
     batch_size = cfg_d.get("batch_size", 8)
-    seq = 64 if smoke else 1024
+    seq = 64 if smoke else cfg_d.get("seq", 1024)
     if smoke:
         batch_size = min(batch_size, 4)
-    model = models.get_model("gpt2", dtype=jnp.bfloat16)
+    model = models.get_model(cfg_d.get("model", "gpt2"),
+                             dtype=jnp.bfloat16)
     mcfg = model.config
     replace: dict = {}
     if smoke:
