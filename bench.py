@@ -408,14 +408,12 @@ def bench_gpt(mesh):
     batch_size = 2 if SMOKE else 16
     seq_len = 32 if SMOKE else 1024
     model = models.get_model("gpt2", dtype=jnp.bfloat16)
-    cfg = model.config
-    replace = dict(embd_dropout_prob=0.0, hidden_dropout_prob=0.0,
-                   attention_probs_dropout_prob=0.0)
+    cfg = models.dropout_free(model.config)
     if SMOKE:
-        replace.update(num_hidden_layers=2, hidden_size=64,
-                       num_attention_heads=4, intermediate_size=128,
-                       vocab_size=128, max_position_embeddings=seq_len)
-    cfg = dataclasses.replace(cfg, **replace)
+        cfg = dataclasses.replace(
+            cfg, num_hidden_layers=2, hidden_size=64,
+            num_attention_heads=4, intermediate_size=128,
+            vocab_size=128, max_position_embeddings=seq_len)
     model = models.GptLmHeadModel(cfg)
     batch = data.synthetic_gpt_batch(
         jax.random.PRNGKey(0), batch_size, seq_len=seq_len,

@@ -40,6 +40,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "MoE (experts replicated under the dp schedules "
                         "here; shard them over an 'ep' axis via "
                         "parallel.tp + EP_RULES)")
+    p.add_argument("--dropout0", action="store_true", default=False,
+                   help="zero every dropout prob (the modern pretraining "
+                        "default and the r5 headline config: attention "
+                        "dropout alone halves S=1024 throughput — PERF.md)")
+    p.add_argument("--remat", action="store_true", default=False,
+                   help="rematerialize blocks in backward (cfg.remat): "
+                        "the enabler for 350M+ dense-attention configs")
     p.add_argument("--flash-attention", action="store_true", default=False,
                    help="causal Pallas flash kernel instead of the dense "
                         "triangle-masked attention")
@@ -91,6 +98,10 @@ def main(argv=None) -> runner.BenchResult:
         )
     if args.num_experts > 0:
         cfg = dataclasses.replace(cfg, num_experts=args.num_experts)
+    if args.dropout0:
+        cfg = models.dropout_free(cfg)
+    if args.remat:
+        cfg = dataclasses.replace(cfg, remat=True)
     if args.sequence_len > cfg.max_position_embeddings:
         raise SystemExit(f"--sequence-len {args.sequence_len} exceeds "
                          f"max_position_embeddings "

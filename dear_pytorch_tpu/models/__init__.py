@@ -123,3 +123,16 @@ def is_bert(name: str) -> bool:
 
 def is_gpt(name: str) -> bool:
     return name.lower() in _GPT_REGISTRY
+
+
+def dropout_free(cfg):
+    """``cfg`` with every ``*dropout*`` probability field zeroed — the ONE
+    place that knows the dropout field list (the benchmark CLIs'
+    ``--dropout0`` and bench.py's GPT headline all call this; a per-site
+    field list would silently drift when a config grows a new dropout
+    knob). Works for any of the model config dataclasses."""
+    import dataclasses
+
+    zeros = {f.name: 0.0 for f in dataclasses.fields(cfg)
+             if "dropout" in f.name}
+    return dataclasses.replace(cfg, **zeros)

@@ -162,3 +162,33 @@ def test_bert_streaming_pipeline(mesh):
          "--pipeline", "native"] + TINY
     )
     assert res.unit == "sen" and res.total_mean > 0
+
+
+def test_dropout0_and_remat_flags_shape_the_config():
+    """--dropout0 / --remat must actually reach the model config (the r5
+    perf decomposition depends on them; a silently-ignored flag would
+    re-measure the dropout-on model and report it as dropout-0). The
+    override logic is the shared models.dropout_free helper — assert it
+    zeroes EVERY dropout field of both config families, and that the
+    parsers accept the flags."""
+    import dataclasses
+
+    from dear_pytorch_tpu import models
+    from dear_pytorch_tpu.benchmarks import bert as bert_cli
+    from dear_pytorch_tpu.benchmarks import gpt as gpt_cli
+
+    for cfg in (models.get_model("gpt2").config,
+                models.get_model("bert_base").config):
+        free = models.dropout_free(cfg)
+        dropout_fields = [f.name for f in dataclasses.fields(free)
+                          if "dropout" in f.name]
+        assert dropout_fields  # the helper must actually find some
+        assert all(getattr(free, n) == 0.0 for n in dropout_fields), free
+        # non-dropout fields untouched
+        assert free.hidden_size == cfg.hidden_size
+
+    g = gpt_cli.build_parser().parse_args(
+        ["--dropout0", "--remat", "--batch-size", "2"])
+    assert g.dropout0 and g.remat
+    b = bert_cli.build_parser().parse_args(["--dropout0"])
+    assert b.dropout0
