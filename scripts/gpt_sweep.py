@@ -77,6 +77,14 @@ CONFIGS: dict[str, dict] = {
                                 "dropout": 0.0, "remat": True},
     "bs16_nodrop_s512": {"batch_size": 16, "dropout": 0.0, "seq": 512},
     "bs16_nodrop_s256": {"batch_size": 16, "dropout": 0.0, "seq": 256},
+    # attention-only checkpoint (recompute probs in backward — the flash
+    # memory idea in pure XLA): kills the per-layer [B,H,S,S] probs
+    # residency + its HBM round trip, enabling bigger batch WITHOUT
+    # whole-block remat
+    "bs16_nodrop_ckattn": {"batch_size": 16, "dropout": 0.0,
+                           "ckpt_attn": True},
+    "bs32_nodrop_ckattn": {"batch_size": 32, "dropout": 0.0,
+                           "ckpt_attn": True},
 }
 
 
@@ -116,9 +124,16 @@ def run_one(name: str, smoke: bool) -> dict:
         replace.update(remat=True)
     if "vocab_pad" in cfg_d:
         replace.update(vocab_pad_multiple=cfg_d["vocab_pad"])
-    if replace:
+    attention_impl = None
+    if cfg_d.get("ckpt_attn"):
+        from dear_pytorch_tpu.models.gpt import (
+            checkpointed_causal_attention_impl,
+        )
+
+        attention_impl = checkpointed_causal_attention_impl()
+    if replace or attention_impl is not None:
         mcfg = dataclasses.replace(mcfg, **replace)
-        model = models.GptLmHeadModel(mcfg)
+        model = models.GptLmHeadModel(mcfg, attention_impl=attention_impl)
 
     batch = data.synthetic_gpt_batch(
         jax.random.PRNGKey(0), batch_size, seq_len=seq,

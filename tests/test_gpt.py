@@ -346,3 +346,30 @@ def test_moe_kv_cache_decode_matches_full_forward():
             np.asarray(step[:, 0]), np.asarray(full[:, t]),
             rtol=2e-4, atol=2e-4,
         )
+
+
+def test_checkpointed_attention_matches_dense():
+    """The attention-only-remat impl is the SAME function as dense causal
+    attention — identical logits and gradients (only backward memory
+    changes)."""
+    from dear_pytorch_tpu.models.gpt import checkpointed_causal_attention_impl
+
+    model, params = _params()
+    cmodel = GptLmHeadModel(TINY,
+                            attention_impl=checkpointed_causal_attention_impl())
+    ids = jnp.asarray(np.random.RandomState(8).randint(0, 61, (2, 16)))
+
+    def loss(m):
+        def f(p):
+            return gpt_lm_loss(m.apply({"params": p}, ids, train=False),
+                               ids, vocab_size=61)
+        return f
+
+    v0, g0 = jax.value_and_grad(loss(model))(params)
+    v1, g1 = jax.value_and_grad(loss(cmodel))(params)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        g0, g1,
+    )
