@@ -59,6 +59,16 @@ BASELINE_PROTOCOL = "single-fetch-r04"
 # found in BENCH_r*.json history (pin-on-first-capture — no manual edit
 # needed when the first on-chip BERT number lands). None until then.
 BASELINE_BERT_SEN_SEC = None
+# GPT pin: the metric joined the driver contract in round 5, so there is
+# no BENCH_r*.json history yet; until one exists, the pin is the round-4
+# on-chip headline measured under the SAME single-fetch scanned protocol
+# (perf/onchip_r04/gpt_headline.txt: 48,121 tok/s at S=1024 — the
+# pre-optimization configuration this round's sweep started from).
+BASELINE_GPT_TOK_SEC = 48121.0
+# deliberately its own literal, not an alias of BASELINE_PROTOCOL: this
+# tags the GPT pin's capture protocol, which stays r04-single-fetch even
+# if the ResNet pin is later re-based under a different protocol
+BASELINE_GPT_PROTOCOL = "single-fetch-r04"
 
 PRIMARY_METRIC = "resnet50_bs64_train_img_sec_per_chip"
 
@@ -446,11 +456,11 @@ def bench_gpt(mesh):
     }
     if hbm:
         out["peak_hbm_gb"] = round(hbm / 2**30, 3)
-    baseline, protocol = _history_baseline("gpt2_s1024_tok_sec_per_chip")
+    baseline, protocol = _history_baseline(
+        "gpt2_s1024_tok_sec_per_chip", BASELINE_GPT_TOK_SEC)
     if baseline:
         out["vs_baseline"] = round(value / baseline, 3)
-        if protocol:
-            out["baseline_protocol"] = protocol
+        out["baseline_protocol"] = protocol or BASELINE_GPT_PROTOCOL
     return out
 
 
