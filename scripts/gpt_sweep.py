@@ -20,10 +20,16 @@ schedule. The three structural suspects, each isolated here:
                GPT-2 Medium 350M: dense attention OOMs; remat enables it
   bs16_nodrop_s512 / bs16_nodrop_s256
                sequence-length scaling (attention share of the step)
+  bs16_nodrop_ckattn / bs32_nodrop_ckattn
+               attention-only checkpoint (memory win, throughput null)
+  large_bs4_nodrop_remat
+               GPT-2 Large 774M single-chip capability probe
+               (remat + checkpointed attention)
 
 Artifacts land under perf/onchip_r05/gpt_sweep/: the round-5 captures
 are gpt_sweep.json (main ladder), gpt_sweep_v128.json (vocab A/B),
-gpt_scaling.json (S-scaling), gpt_medium.json (350M).
+gpt_scaling.json (S-scaling), gpt_medium.json (350M),
+gpt_ckattn.json (checkpointed attention), gpt_large.json (774M).
 
 Same measurement discipline as bench.py / conv_sweep.py: scanned k-step
 program, contiguous dispatch queue, ONE end-of-window fetch.
@@ -85,6 +91,11 @@ CONFIGS: dict[str, dict] = {
                            "ckpt_attn": True},
     "bs32_nodrop_ckattn": {"batch_size": 32, "dropout": 0.0,
                            "ckpt_attn": True},
+    # capability probe: 774M on ONE v5e chip (remat + checkpointed
+    # attention = the minimal-memory dense config)
+    "large_bs4_nodrop_remat": {"model": "gpt2_large", "batch_size": 4,
+                               "dropout": 0.0, "remat": True,
+                               "ckpt_attn": True},
 }
 
 
