@@ -1,0 +1,114 @@
+"""Compatibility gate for older jax releases (no new dependencies).
+
+The framework is written against the current jax surface — ``jax.P``,
+top-level ``jax.shard_map(..., check_vma=)``, the ``jax_num_cpu_devices``
+config option — but the deployment contract (ROADMAP: no package installs)
+means it must also run on whatever jax the host container bakes in. On a
+jax that predates those names (observed: 0.4.37), importing the package
+would die at the first ``jax.P`` and the 8-device CPU emulation would
+silently collapse to world=1.
+
+``ensure()`` installs the missing aliases once, idempotently:
+
+  - ``jax.P``               -> ``jax.sharding.PartitionSpec``
+  - ``jax.shard_map``       -> ``jax.experimental.shard_map.shard_map`` with
+                               the ``check_vma`` kwarg translated to the old
+                               spelling ``check_rep``
+  - ``jax.tree`` is present on every release this gate targets (>= 0.4.25)
+    and is not touched.
+
+On a current jax every branch is a no-op ``hasattr`` check. The package
+``__init__`` calls ``ensure()`` before any submodule import, so direct
+imports of any module (``import dear_pytorch_tpu.parallel.dear``) are
+covered too.
+
+``set_cpu_device_count(n)`` is the version-spanning spelling of "emulate n
+CPU devices": the ``jax_num_cpu_devices`` config where it exists, else the
+``XLA_FLAGS --xla_force_host_platform_device_count`` escape hatch (which
+the CPU client reads at creation, so it works as long as no backend is
+live yet — same precondition the config path has).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+_ensured = False
+
+
+def ensure() -> None:
+    """Install old-jax aliases for the new-jax names this package uses."""
+    global _ensured
+    if _ensured:
+        return
+    _ensured = True
+    if not hasattr(jax, "P"):
+        jax.P = jax.sharding.PartitionSpec
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f=None, /, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            if f is None:
+                return functools.partial(shard_map, **kwargs)
+            return _shard_map(f, **kwargs)
+
+        jax.shard_map = shard_map
+        logger.debug("jax_compat: aliased jax.shard_map for jax %s",
+                     jax.__version__)
+    from jax import lax
+
+    if not hasattr(lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of the constant 1 is special-cased to the static axis
+            # size (the pre-axis_size spelling) — stays a trace-time int
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
+
+
+def set_cpu_device_count(n: int, *, scrub_env: bool = False) -> bool:
+    """Ask for ``n`` emulated CPU devices, whatever this jax calls it.
+
+    Returns True when a mechanism was applied (not a guarantee it took
+    effect: both paths require that no XLA backend is initialized yet —
+    the same precondition `backend._apply_platform_env` documents).
+
+    ``scrub_env=True`` (the pytest conftest uses it): on the XLA_FLAGS
+    fallback path, create the CPU client immediately (the flag is read
+    exactly once, at client creation) and then RESTORE the previous
+    ``XLA_FLAGS`` — otherwise the injected flag would leak through
+    ``os.environ`` into every subprocess a test spawns and silently force
+    their worlds to ``n`` devices. Only safe in a process that wants no
+    distributed bootstrap (touching the backend locks in a single-process
+    world), which a pytest run is by construction.
+    """
+    n = int(n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return True
+    except AttributeError:
+        pass  # older jax: fall through to the XLA flag
+    flag = f"--xla_force_host_platform_device_count={n}"
+    prior = os.environ.get("XLA_FLAGS")
+    # replace any existing count flag rather than keeping it: a stale
+    # value would silently win while this call claims n was applied
+    kept = [f for f in (prior or "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    os.environ["XLA_FLAGS"] = " ".join(kept + [flag])
+    logger.debug("jax_compat: CPU device count via XLA_FLAGS (%s)", flag)
+    if scrub_env:
+        jax.devices()  # consume the flag: the client is process-local
+        if prior is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = prior
+    return True

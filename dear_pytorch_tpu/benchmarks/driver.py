@@ -8,7 +8,10 @@ reference's per-config mpirun did), with:
     not re-run (reference benchmarks.py:86-115 via exp.log),
   - log scraping of the ``Total <unit>/sec on N <DEV>(s): mean +-ci`` lines
     (reference extract_log, benchmarks.py:119-128),
-  - ``reports.json`` aggregation (benchmarks.py:142-151).
+  - ``reports.json`` aggregation (benchmarks.py:142-151), including a
+    ``telemetry`` block: sweep-level cell accounting plus each cell's
+    ``TELEMETRY`` snapshot (printed by the runner when ``DEAR_TELEMETRY``
+    is set in the environment — see docs/OBSERVABILITY.md).
 
 Methods are schedule configurations of the SAME framework (the reference
 compares separate per-directory implementations; here one --mode/--flags
@@ -73,6 +76,9 @@ DEFAULT_TASKS = "resnet50:64,densenet201:32,inceptionv4:64,bert_base:64,bert:32"
 _RESULT_RE = re.compile(
     r"Total (?:img|sen)/sec on (\d+) \w+\(s\): ([\d.]+) \+-([\d.]+)"
 )
+# the runner's per-run telemetry snapshot (one JSON object per line,
+# printed when DEAR_TELEMETRY is enabled in the cell's environment)
+_TELEMETRY_RE = re.compile(r"^TELEMETRY (\{.*\})\s*$")
 
 BERT_MODELS = ("bert", "bert_base", "bert_large")
 GPT_MODELS = ("gpt2", "gpt2_medium", "gpt2_large")
@@ -89,6 +95,24 @@ def extract_log(logfile: str) -> Optional[tuple[float, float]]:
             if m:
                 result = (float(m.group(2)), float(m.group(3)))
     return result
+
+
+def extract_telemetry(logfile: str) -> Optional[dict]:
+    """The last TELEMETRY snapshot a cell printed, or None (cells only
+    print one when DEAR_TELEMETRY is set; an unparsable line is treated
+    as absent rather than sinking the sweep)."""
+    if not os.path.exists(logfile):
+        return None
+    snap = None
+    with open(logfile) as f:
+        for line in f:
+            m = _TELEMETRY_RE.match(line)
+            if m:
+                try:
+                    snap = json.loads(m.group(1))
+                except json.JSONDecodeError:
+                    pass
+    return snap
 
 
 def cell_cmd(model: str, bs: int, method: str, extra: list[str]) -> list[str]:
@@ -118,6 +142,8 @@ def run_sweep(args) -> dict:
 
     os.makedirs(args.logdir, exist_ok=True)
     report: dict = {}
+    telemetry: dict = {"cells_run": 0, "cells_skipped": 0,
+                       "cells_failed": 0, "per_cell": {}}
     for model, bs in tasks:
         for method in methods:
             for nw in nworkers:
@@ -126,6 +152,7 @@ def run_sweep(args) -> dict:
                 prior = extract_log(logfile)
                 if prior is not None:
                     print(f"[skip] {tag}: {prior[0]:.1f} (from log)")
+                    telemetry["cells_skipped"] += 1
                 else:
                     extra = ["--num-warmup-batches", str(args.warmup),
                              "--num-batches-per-iter", str(args.batches),
@@ -150,10 +177,17 @@ def run_sweep(args) -> dict:
                     prior = extract_log(logfile)
                     status = f"{prior[0]:.1f}" if prior else "FAILED"
                     print(f"[done] {tag}: {status}")
+                    telemetry["cells_run"] += 1
+                    if prior is None:
+                        telemetry["cells_failed"] += 1
                 report.setdefault(model, {}).setdefault(method, {})[
                     str(nw or "all")
                 ] = list(prior) if prior else None
+                cell_snap = extract_telemetry(logfile)
+                if cell_snap is not None:
+                    telemetry["per_cell"][tag] = cell_snap
 
+    report["telemetry"] = telemetry
     report_path = os.path.join(args.logdir, "reports.json")
     with open(report_path, "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)

@@ -18,6 +18,7 @@ TPU-native differences (deliberate):
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
 from typing import Any, Callable, Optional, Sequence
 
@@ -145,6 +146,17 @@ def run_timed(
             per_device_conf=res.per_device_conf,
             iter_time_mean=res.iter_time_mean,
         )
+    # Telemetry block: when DEAR_TELEMETRY is on, one scrape-able line per
+    # run (the batch driver lifts it into reports.json) and one JSONL
+    # record (read back via `read_metrics`; the dict travels as a JSON
+    # string because MetricsLogger records hold scalars).
+    from dear_pytorch_tpu.observability import snapshot
+
+    snap = snapshot()
+    if snap["enabled"]:
+        log("TELEMETRY " + json.dumps(snap))
+        if metrics is not None:
+            metrics.log(kind="telemetry", telemetry=json.dumps(snap))
     return res
 
 

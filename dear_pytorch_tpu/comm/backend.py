@@ -131,7 +131,10 @@ def _apply_platform_env() -> None:
         if plats:
             jax.config.update("jax_platforms", plats)
         if ndev:
-            jax.config.update("jax_num_cpu_devices", ndev)
+            from dear_pytorch_tpu import _jax_compat
+
+            # jax_num_cpu_devices where it exists, XLA_FLAGS on older jax
+            _jax_compat.set_cpu_device_count(ndev)
     except Exception as exc:  # backend already initialized: keep it
         logger.debug("platform env not applied: %s", exc)
     # Persistent compilation cache: the session TPU's first compile costs

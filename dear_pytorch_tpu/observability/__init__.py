@@ -1,0 +1,61 @@
+"""Unified telemetry: one event model over the repo's three logging backends.
+
+Before this package, the framework had three uncoordinated observability
+surfaces — `utils.metrics.MetricsLogger` (JSONL records),
+`utils.chrome_trace.TraceWriter` (chrome-trace events) and
+`utils.profiling.StepTimer` / α-β fits — none of which the training path
+actually fed. This package defines the shared event model (spans, instant
+events, monotonic counters) and the consumers:
+
+  - `tracer`   — thread-safe span/event tracer with pluggable exporters
+                 onto the existing TraceWriter / MetricsLogger backends;
+                 process-global instance gated by ``DEAR_TELEMETRY``;
+                 near-zero overhead when disabled.
+  - `counters` — static per-bucket communication accounting derived from a
+                 `FusionPlan` (bytes reduce-scattered / all-gathered per
+                 bucket per step for every schedule mode).
+  - `overlap`  — the overlap-efficiency auditor: XLA cost analysis + α-β
+                 ICI fits + measured step time -> exposed-vs-hidden
+                 communication per schedule mode.
+  - `report`   — text/JSON rendering + ``python -m
+                 dear_pytorch_tpu.observability.report`` entry point.
+
+The hot-path contract: instrumented code asks ``get_tracer()`` (a module
+attribute read) and checks ``.enabled`` before doing anything else, so a
+disabled tracer costs one attribute lookup per step.
+"""
+
+from dear_pytorch_tpu.observability.tracer import (  # noqa: F401
+    ChromeTraceExporter,
+    JsonlExporter,
+    MemoryExporter,
+    NullTracer,
+    Tracer,
+    configure,
+    configure_from_env,
+    disable,
+    get_tracer,
+    set_tracer,
+    snapshot,
+)
+
+# `counters`/`overlap`/`report` import the jax-using side of the repo
+# (ops.fusion, utils.hlo); resolve them lazily so hot-path users of the
+# tracer (runtime/pipeline.py) never pay that import.
+_LAZY = {
+    "BucketCommRow": "counters",
+    "CommAccounting": "counters",
+    "plan_comm_accounting": "counters",
+    "audit_train_step": "overlap",
+    "OverlapReport": "overlap",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    module = importlib.import_module(f"dear_pytorch_tpu.observability.{mod}")
+    return getattr(module, name)

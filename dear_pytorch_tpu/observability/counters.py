@@ -1,0 +1,165 @@
+"""Per-bucket communication accounting, derived statically from a FusionPlan.
+
+The reference could only count communication by intercepting NCCL calls;
+here the schedule is static metadata (`ops.fusion.FusionPlan` + the mode),
+so bytes-per-step is computable exactly, before the first step runs:
+
+  - `plan_comm_accounting(plan, mode=...)` — per-bucket payload and
+    estimated wire bytes for each collective leg of the chosen schedule.
+  - `CommAccounting.totals(steps)` — cumulative bytes after N steps,
+    joined with the runtime counters (steps, rebuilds, compiles, tuner
+    trials) the instrumented call sites feed into the global tracer.
+
+Payload vs wire: *payload* is the flat padded buffer each collective
+carries (``padded_size × itemsize``). *wire* is the ring-algorithm
+estimate of bytes a single device actually moves on the interconnect:
+reduce-scatter and all-gather each move ``(world-1)/world × payload``; a
+ring all-reduce moves twice that; reduce+broadcast is modeled as two full
+payload transfers (the root link is the bottleneck). These match the
+α-β models in `utils.perf_model`, so the overlap auditor's predicted
+times and this module's byte counts can never drift apart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from dear_pytorch_tpu.ops import fusion as F
+
+#: collective legs per schedule mode (mirrors parallel/dear.py's device_step)
+MODE_LEGS = {
+    "dear": ("reduce_scatter", "all_gather"),
+    "fsdp": ("reduce_scatter", "all_gather"),
+    "rsag": ("reduce_scatter", "all_gather"),
+    "bytescheduler": ("reduce_scatter", "all_gather"),
+    "allreduce": ("all_reduce",),
+    "rb": ("reduce", "broadcast"),
+}
+
+
+def _wire_factor(leg: str, world: int) -> float:
+    """Ring-estimate fraction of the payload one device moves for ``leg``."""
+    if world <= 1:
+        return 0.0
+    ring = (world - 1) / world
+    return {
+        "reduce_scatter": ring,
+        "all_gather": ring,
+        "all_reduce": 2.0 * ring,   # RS + AG decomposition
+        "reduce": 1.0,              # root receives the full payload
+        "broadcast": 1.0,           # root sends the full payload
+    }[leg]
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketCommRow:
+    """One bucket's per-step communication, one row per collective leg."""
+
+    bucket: int
+    leg: str                 # 'reduce_scatter' | 'all_gather' | ...
+    tensors: int             # parameters fused into this bucket
+    elements: int            # unpadded element count
+    padded_elements: int
+    payload_bytes: int       # padded_size × itemsize of the comm dtype
+    wire_bytes: float        # ring estimate of per-device interconnect bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class CommAccounting:
+    """Static per-step schedule accounting + runtime-counter join."""
+
+    mode: str
+    world: int
+    num_buckets: int
+    rows: tuple[BucketCommRow, ...]
+
+    @property
+    def payload_bytes_per_step(self) -> int:
+        return sum(r.payload_bytes for r in self.rows)
+
+    @property
+    def wire_bytes_per_step(self) -> float:
+        return sum(r.wire_bytes for r in self.rows)
+
+    def leg_bytes_per_step(self, leg: str) -> int:
+        return sum(r.payload_bytes for r in self.rows if r.leg == leg)
+
+    def totals(self, steps: Optional[int] = None,
+               runtime_counters: Optional[dict] = None) -> dict:
+        """JSON-safe cumulative accounting.
+
+        ``steps`` defaults to the global tracer's ``dear.steps`` counter
+        (what `parallel/dear.py` increments); ``runtime_counters``
+        defaults to the global tracer's snapshot, folding in rebuild /
+        compile / tuner-trial counts.
+        """
+        if runtime_counters is None:
+            from dear_pytorch_tpu.observability import tracer as T
+
+            runtime_counters = T.get_tracer().counters()
+        if steps is None:
+            steps = int(runtime_counters.get("dear.steps", 0))
+        per_leg = {}
+        for r in self.rows:
+            leg = per_leg.setdefault(r.leg, {"payload_bytes": 0,
+                                             "wire_bytes": 0.0})
+            leg["payload_bytes"] += r.payload_bytes * steps
+            leg["wire_bytes"] += r.wire_bytes * steps
+        return {
+            "mode": self.mode,
+            "world": self.world,
+            "num_buckets": self.num_buckets,
+            "steps": steps,
+            "payload_bytes_per_step": self.payload_bytes_per_step,
+            "wire_bytes_per_step": round(self.wire_bytes_per_step, 1),
+            "per_leg": per_leg,
+            "plan_rebuilds": int(runtime_counters.get(
+                "autotune.rebuilds", 0)),
+            "compiles": int(runtime_counters.get("dear.compiles", 0)),
+            "tuner_trials": int(runtime_counters.get(
+                "autotune.trials", 0)),
+        }
+
+    def as_dicts(self) -> list[dict]:
+        return [dataclasses.asdict(r) for r in self.rows]
+
+
+def plan_comm_accounting(
+    plan: F.FusionPlan,
+    *,
+    mode: str = "dear",
+    comm_itemsize: int = 4,
+    gather_itemsize: Optional[int] = None,
+) -> CommAccounting:
+    """Static communication accounting for ``plan`` under ``mode``.
+
+    ``comm_itemsize`` is the gradient-leg dtype size in bytes
+    (``comm_dtype`` — 2 for bf16); ``gather_itemsize`` the parameter
+    all-gather leg's (``gather_dtype``, 'dear'/'fsdp' only; defaults to
+    ``comm_itemsize``). At ``world=1`` every wire estimate is 0 — the
+    collectives are local copies, which is also what the compiled program
+    contains.
+    """
+    if mode not in MODE_LEGS:
+        raise ValueError(f"mode must be one of {sorted(MODE_LEGS)}, "
+                         f"got {mode!r}")
+    gather_itemsize = (comm_itemsize if gather_itemsize is None
+                      else gather_itemsize)
+    rows = []
+    for b in plan.buckets:
+        for leg in MODE_LEGS[mode]:
+            itemsize = (gather_itemsize if leg == "all_gather"
+                        and mode in ("dear", "fsdp") else comm_itemsize)
+            payload = b.padded_size * itemsize
+            rows.append(BucketCommRow(
+                bucket=b.index,
+                leg=leg,
+                tensors=len(b.leaf_ids),
+                elements=b.size,
+                padded_elements=b.padded_size,
+                payload_bytes=payload,
+                wire_bytes=payload * _wire_factor(leg, plan.world),
+            ))
+    return CommAccounting(mode=mode, world=plan.world,
+                          num_buckets=plan.num_buckets, rows=tuple(rows))

@@ -1,0 +1,236 @@
+"""Render overlap audits and telemetry summaries; runnable entry point.
+
+``python -m dear_pytorch_tpu.observability.report`` builds a bucketed MLP
+train step per schedule mode on the 8-device emulated CPU mesh, measures
+(a) per-mode step time, (b) communication-free compute time via the 'dear'
+schedule's ``exclude_parts`` ablation, and (c) a live α-β interconnect fit
+(`overlap.fit_interconnect`), then prints the per-mode overlap-efficiency
+report — ideal vs measured step time, exposed vs hidden communication per
+bucket — and optionally writes the same content as JSON.
+
+This is the consumer the three old logging backends never had: the same
+report assembles inside `bench.py` / the benchmark CLIs as their
+``telemetry`` JSON block (`observability.snapshot` + `OverlapReport
+.to_dict`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from dear_pytorch_tpu.observability.overlap import OverlapReport
+
+_MS = 1e3
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GB"
+
+
+def _opt_ms(v: Optional[float]) -> str:
+    return "n/a" if v is None else f"{v * _MS:.3f} ms"
+
+
+def render_text(rep: OverlapReport) -> str:
+    """Human-readable overlap audit: headline ratios, then the bucket
+    table, then the structural HLO cross-check."""
+    lines = [
+        f"== overlap audit: mode={rep.mode} "
+        f"(world={rep.world}, {rep.num_buckets} buckets) ==",
+        f"  interconnect fit: alpha={rep.alpha:.3e} s  "
+        f"beta={rep.beta:.3e} s/B"
+        + (f"  flops/step={rep.flops_per_step:.3e}"
+           if rep.flops_per_step else ""),
+        f"  compute {_opt_ms(rep.compute_time_s)}   "
+        f"comm(unoverlapped) {_opt_ms(rep.comm_time_s)}   "
+        f"measured {_opt_ms(rep.measured_step_s)}",
+        f"  serial {_opt_ms(rep.serial_step_s)}   "
+        f"ideal {_opt_ms(rep.ideal_step_s)}   "
+        + (f"overlap efficiency {rep.overlap_efficiency * 100:.1f}%"
+           if rep.overlap_efficiency is not None
+           else "overlap efficiency n/a"),
+        f"  exposed comm {_opt_ms(rep.exposed_comm_s)}   "
+        f"hidden comm {_opt_ms(rep.hidden_comm_s)}",
+        "  bucket  leg             payload      pred     exposed    hidden",
+    ]
+    for leg in rep.legs:
+        lines.append(
+            f"  {leg.bucket:>6}  {leg.leg:<14}  "
+            f"{_fmt_bytes(leg.payload_bytes):>9}  "
+            f"{_opt_ms(leg.pred_time_s):>9}  "
+            f"{_opt_ms(leg.exposed_s):>9}  {_opt_ms(leg.hidden_s):>9}"
+        )
+    if rep.hlo and "collectives" in rep.hlo:
+        parts = [
+            f"{kind} x{v['count']} indep-frac "
+            f"{v['mean_independent_compute_frac']}"
+            for kind, v in rep.hlo["collectives"].items()
+        ]
+        mean = rep.hlo.get("mean_independent_compute_frac")
+        lines.append("  HLO: " + "; ".join(parts)
+                     + (f" (mean {mean})" if mean is not None else ""))
+    if rep.model_note:
+        lines.append(f"  NOTE: {rep.model_note}")
+    return "\n".join(lines)
+
+
+def render_comparison(reports: dict[str, OverlapReport]) -> str:
+    """One-line-per-mode summary table — the "*why* they differ" view."""
+    lines = [
+        "== mode comparison ==",
+        "  mode           measured     comm    exposed    hidden   overlap",
+    ]
+    for mode, r in reports.items():
+        eff = ("n/a" if r.overlap_efficiency is None
+               else f"{r.overlap_efficiency * 100:.0f}%")
+        lines.append(
+            f"  {mode:<13} {_opt_ms(r.measured_step_s):>9} "
+            f"{_opt_ms(r.comm_time_s):>9} {_opt_ms(r.exposed_comm_s):>9} "
+            f"{_opt_ms(r.hidden_comm_s):>9} {eff:>8}"
+        )
+    return "\n".join(lines)
+
+
+def render_telemetry(snap: dict) -> str:
+    """Counters + per-span aggregates from `observability.snapshot()`."""
+    lines = [f"== telemetry (enabled={snap.get('enabled')}) =="]
+    for k, v in sorted(snap.get("counters", {}).items()):
+        lines.append(f"  counter {k} = {v:g}")
+    for name, agg in sorted(snap.get("spans", {}).items()):
+        lines.append(
+            f"  span {name}: x{agg['count']}  "
+            f"total {agg['total_us'] / 1e3:.3f} ms"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# entry point: world=N CPU-emulated audit of the schedule modes
+# ---------------------------------------------------------------------------
+
+
+def _mlp(n_layers: int, width: int):
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(jax.random.PRNGKey(0), n_layers)
+    params = {
+        f"l{i:02d}": {"w": jax.random.normal(ks[i], (width, width)) * 0.1,
+                      "b": jnp.zeros((width,))}
+        for i in range(n_layers)
+    }
+
+    def loss(p, b):
+        x, y = b
+        for i in range(n_layers):
+            x = jnp.tanh(x @ p[f"l{i:02d}"]["w"] + p[f"l{i:02d}"]["b"])
+        return jnp.mean((x - y) ** 2)
+
+    return params, loss
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="overlap-efficiency audit on the emulated CPU mesh")
+    ap.add_argument("--modes", default="dear,allreduce",
+                    help="comma list of schedule modes to audit")
+    ap.add_argument("--world", type=int, default=8,
+                    help="emulated CPU device count")
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=32,
+                    help="global batch (split over the mesh)")
+    ap.add_argument("--steps", type=int, default=10,
+                    help="timed steps per mode")
+    ap.add_argument("--json", default=None,
+                    help="also write the full report as JSON here")
+    ap.add_argument("--no-hlo", action="store_true",
+                    help="skip the structural HLO metric (faster)")
+    args = ap.parse_args(argv)
+
+    # Force the emulated multi-device CPU world BEFORE backend init — the
+    # audit is meaningless at world=1 (no collectives in the program).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["DEAR_NUM_CPU_DEVICES"] = str(args.world)
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    os.environ.setdefault("DEAR_COMPILATION_CACHE_DIR", "off")
+
+    import jax.numpy as jnp
+
+    from dear_pytorch_tpu.comm import backend
+    from dear_pytorch_tpu.observability import configure, snapshot
+    from dear_pytorch_tpu.observability import overlap as OV
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+
+    if os.environ.get(T.TELEMETRY_ENV) is None:
+        configure()  # in-memory: the phase breakdown below needs spans
+
+    mesh = backend.init()
+    world = mesh.size
+    params, loss = _mlp(args.layers, args.width)
+    batch = (jnp.zeros((args.batch, args.width)),
+             jnp.zeros((args.batch, args.width)))
+
+    def build(mode: str, **kw):
+        return build_train_step(
+            loss, params, mesh=mesh, mode=mode, nearby_layers=1,
+            optimizer=fused_sgd(lr=0.01, momentum=0.9), donate=False, **kw,
+        )
+
+    print(f"fitting interconnect alpha-beta on {mesh} ...", flush=True)
+    alpha, beta = OV.fit_interconnect(mesh)
+
+    # communication-free compute time: the 'dear' schedule's ablation
+    # switches (reference exclude_parts) — a measured number, not a model
+    ts_compute = build("dear",
+                      exclude_parts=("reducescatter", "allgather"))
+    compute_s, _ = OV.measure_step_time(
+        ts_compute, ts_compute.init(params), batch, steps=args.steps)
+    print(f"compute-only step (exclude_parts ablation): "
+          f"{compute_s * _MS:.3f} ms", flush=True)
+
+    reports: dict[str, OverlapReport] = {}
+    for mode in [m.strip() for m in args.modes.split(",") if m.strip()]:
+        ts = build(mode)
+        measured, state = OV.measure_step_time(
+            ts, ts.init(params), batch, steps=args.steps)
+        reports[mode] = OV.audit_train_step(
+            ts, state, batch, alpha=alpha, beta=beta, mode=mode,
+            measured_step_s=measured, compute_time_s=compute_s,
+            include_hlo=not args.no_hlo,
+        )
+        print(render_text(reports[mode]), flush=True)
+
+    if len(reports) > 1:
+        print(render_comparison(reports), flush=True)
+    print(render_telemetry(snapshot()), flush=True)
+
+    if args.json:
+        payload = {
+            "world": world,
+            "alpha": alpha,
+            "beta": beta,
+            "compute_time_s": compute_s,
+            "modes": {m: r.to_dict() for m, r in reports.items()},
+            "telemetry": snapshot(),
+        }
+        d = os.path.dirname(os.path.abspath(args.json))
+        os.makedirs(d, exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,7 +74,11 @@ def _interpret() -> bool:
 # Leading (BH, q-or-k block) grid dims are parallel — Mosaic may split
 # them across cores; the innermost reduction dim must stay sequential
 # because the VMEM scratch accumulators carry across it.
-_COMPILER_PARAMS = pltpu.CompilerParams(
+# (`CompilerParams` is the current pallas name; older jax spells it
+# `TPUCompilerParams` — same dataclass.)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+_COMPILER_PARAMS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary"),
     vmem_limit_bytes=64 * 1024 * 1024,
 )
@@ -514,6 +518,16 @@ def flash_attention(
     ``kv_mask``: optional key-validity mask ``[B, S_k]`` (True = attend).
     Differentiable (flash backward). Sequence lengths must divide by the
     chosen block (128 or the largest power-of-two divisor).
+
+    Sequence-length constraint (dtype-dependent): the block picked by
+    halving 128 down to a divisor of ``S`` must be at least the dtype's
+    native sublane tile — 8 rows for f32, **16 for bf16/f16**, 32 for
+    8-bit types. A length whose largest such divisor falls below the tile
+    (e.g. ``S=136`` in bf16: largest halving divisor 8) raises
+    ``ValueError`` at trace time on every backend, because on a real TPU
+    that block would mis-tile; pad the sequence to a multiple of 16
+    (ideally 128). ``S`` at or below the preferred block (one block total)
+    is always legal.
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]

@@ -83,6 +83,8 @@ from jax import lax
 from dear_pytorch_tpu.comm import backend
 from dear_pytorch_tpu.comm import collectives as C
 from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.observability import counters as _tel_counters
+from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.ops import compression as Z
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.ops.fused_sgd import (
@@ -803,6 +805,34 @@ def build_train_step(
             specs,
         )
 
+    # ---- telemetry ---------------------------------------------------------
+    # Static per-step communication accounting for this (plan, mode). The
+    # hot path pays two dict adds + one span per step when telemetry is ON
+    # and a single attribute check when it is off (the contract
+    # scripts/check_telemetry_overhead.py measures).
+    _leaf_itemsize = (
+        jnp.dtype(plan.leaves[0].dtype).itemsize if plan.leaves else 4
+    )
+    _acct = _tel_counters.plan_comm_accounting(
+        plan, mode=mode,
+        comm_itemsize=(jnp.dtype(comm_dtype).itemsize
+                       if comm_dtype is not None else _leaf_itemsize),
+        gather_itemsize=(jnp.dtype(gather_dtype).itemsize
+                         if gather_dtype is not None else None),
+    )
+    _leg_bytes = {
+        leg: _acct.leg_bytes_per_step(leg)
+        for leg in sorted({r.leg for r in _acct.rows})
+    }
+    _tr = _telemetry.get_tracer()
+    if _tr.enabled:
+        _tr.count("dear.plan_builds")
+        _tr.event(
+            "dear.plan_built", mode=mode, world=world,
+            buckets=plan.num_buckets, total_elements=plan.total_size,
+            payload_bytes_per_step=_acct.payload_bytes_per_step,
+        )
+
     _compiled: dict = {}
 
     def _mapped(state: DearState, batch):
@@ -821,6 +851,13 @@ def build_train_step(
         key = jax.tree.structure((state, batch))
         fn = _compiled.get(key)
         if fn is None:
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                # a jit-cache miss: a fresh trace+compile will run on the
+                # first call of the returned fn
+                tr.count("dear.compiles")
+                tr.event("dear.compile", mode=mode,
+                         cached_programs=len(_compiled))
             fn = jax.jit(
                 _mapped(state, batch),
                 donate_argnums=(0,) if donate else (),
@@ -829,7 +866,14 @@ def build_train_step(
         return fn
 
     def step(state: DearState, batch):
-        return _jitted(state, batch)(state, batch)
+        tr = _telemetry.get_tracer()
+        if not tr.enabled:
+            return _jitted(state, batch)(state, batch)
+        tr.count("dear.steps")
+        for leg, nbytes in _leg_bytes.items():
+            tr.count(f"dear.{leg}_bytes", nbytes)
+        with tr.span("dear.step", mode=mode):
+            return _jitted(state, batch)(state, batch)
 
     def lower(state: DearState, batch):
         return _jitted(state, batch).lower(state, batch)
@@ -846,6 +890,10 @@ def build_train_step(
         cached = _multi_compiled.get(n)
         if cached is not None:
             return cached
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("dear.multi_step_compiles")
+            tr.event("dear.multi_step_compile", mode=mode, n=n)
 
         def fn(state: DearState, batch):
             mapped = _mapped(state, batch)

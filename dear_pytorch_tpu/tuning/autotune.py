@@ -29,6 +29,7 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import numpy as np
 
+from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.parallel import dear as D
 from dear_pytorch_tpu.tuning.bo import Tuner
@@ -181,6 +182,7 @@ class AutoTuner:
     def _rebuild(self, state, **plan_kwargs):
         from dear_pytorch_tpu.utils.checkpoint import plan_fingerprint
 
+        tr = _telemetry.get_tracer()
         old_ts = self.ts
         new_ts = D.build_train_step(
             self._loss_fn, self._template, **plan_kwargs,
@@ -190,11 +192,21 @@ class AutoTuner:
             # a different threshold that bucketizes identically: skip the
             # repack/re-jit AND keep the current (still valid) measurement
             # window
+            if tr.enabled:
+                tr.event("autotune.plan_unchanged",
+                         kwargs=repr(plan_kwargs)[:120])
             self._log(f"autotune: plan unchanged by {plan_kwargs}; no rebuild")
             return state
-        state = repack_state(state, old_ts, new_ts)
+        with tr.span("autotune.rebuild", strategy=self.strategy,
+                     buckets=new_ts.plan.num_buckets):
+            state = repack_state(state, old_ts, new_ts)
         self.ts = new_ts
         self.rebuilds += 1
+        if tr.enabled:
+            tr.count("autotune.rebuilds")
+            tr.event("autotune.rebuilt", strategy=self.strategy,
+                     buckets=new_ts.plan.num_buckets,
+                     kwargs=repr(plan_kwargs)[:120])
         if self.tuner is not None:
             self.tuner.notify_rebuild()
         self._log(
@@ -215,6 +227,11 @@ class AutoTuner:
                 float(metrics["loss"])
             proposal = self.tuner.step()
             if proposal is not None:
+                tr = _telemetry.get_tracer()
+                if tr.enabled:
+                    tr.count("autotune.trials")
+                    tr.event("autotune.proposal",
+                             threshold_mb=float(proposal))
                 state = self._rebuild(state, threshold_mb=float(proposal))
         elif not self._switched and self._host_step >= self._warmup_steps:
             times = (
@@ -224,6 +241,11 @@ class AutoTuner:
             )
             flags = wait_time_flags(times, self._cycle)
             self._switched = True
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                tr.count("autotune.trials")
+                tr.event("autotune.wait_time_decision",
+                         buckets=int(sum(flags)), cycle_time_s=self._cycle)
             if sum(flags) > 1:  # one bucket already == current plan
                 state = self._rebuild(state, flags=flags)
         return state, metrics

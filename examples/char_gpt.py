@@ -47,16 +47,25 @@ def load_corpus(seq_len: int, holdout_fraction: float = 0.1):
     raw = np.frombuffer(
         open(CORPUS, "rb").read(), dtype=np.uint8
     ).astype(np.int32)
-    n_eval = int(len(raw) * holdout_fraction)
+    # max(1, ...): a tiny corpus or holdout_fraction would otherwise give
+    # n_eval=0, and raw[:-0] is the EMPTY train split (opaque np.stack
+    # failure downstream instead of this check)
+    n_eval = max(1, int(len(raw) * holdout_fraction))
     train, evl = raw[:-n_eval], raw[-n_eval:]
 
-    def windows(arr, stride):
+    def windows(arr, stride, split):
         n = (len(arr) - seq_len - 1) // stride
+        if n < 1:
+            raise SystemExit(
+                f"corpus too small: the {split} split has {len(arr)} bytes, "
+                f"not enough for one window of seq_len+1={seq_len + 1}; "
+                f"lower --seq-len or grow {CORPUS}"
+            )
         return np.stack(
             [arr[i * stride: i * stride + seq_len + 1] for i in range(n)]
         )
 
-    return windows(train, seq_len // 2), windows(evl, seq_len)
+    return windows(train, seq_len // 2, "train"), windows(evl, seq_len, "eval")
 
 
 def main(argv=None):

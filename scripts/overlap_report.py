@@ -37,20 +37,20 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 MODES = ("dear", "allreduce", "fsdp")
-COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
-                    "collective-permute", "all-to-all")
 
 
 def hlo_overlap_metric(mode: str) -> dict:
     """Compile a bucketed MLP train step at world=8 on the emulated CPU
-    mesh and score each collective's independent-compute fraction."""
+    mesh and score each collective's independent-compute fraction (the
+    metric itself lives in `observability.overlap.hlo_collective_stats`
+    — one implementation for this script, the auditor, and the suite)."""
     import jax
     import jax.numpy as jnp
 
     from dear_pytorch_tpu.comm import backend
+    from dear_pytorch_tpu.observability.overlap import hlo_collective_stats
     from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
     from dear_pytorch_tpu.parallel import build_train_step
-    from dear_pytorch_tpu.utils import hlo
 
     mesh = backend.init()
     n_layers = 4
@@ -74,40 +74,7 @@ def hlo_overlap_metric(mode: str) -> dict:
     state = ts.init(params)
     batch = (jnp.zeros((32, 256)), jnp.zeros((32, 256)))
     text = ts.lower(state, batch).compile().as_text()
-    ops = hlo.parse_entry(text)
-    computes = hlo.compute_ops(ops)
-    if not computes:
-        return {"error": "no compute ops parsed"}
-    anc_of_compute = {c.name: hlo.ancestors(ops, c.name) for c in computes}
-
-    per_kind: dict = {}
-    fractions = []
-    for kind in COLLECTIVE_KINDS:
-        colls = hlo.find(ops, kind)
-        if not colls:
-            continue
-        kind_fracs = []
-        for coll in colls:
-            coll_anc = hlo.ancestors(ops, coll.name)
-            indep = sum(
-                1 for c in computes
-                if c.name not in coll_anc
-                and coll.name not in anc_of_compute[c.name]
-            )
-            kind_fracs.append(indep / len(computes))
-        per_kind[kind] = {
-            "count": len(colls),
-            "mean_independent_compute_frac": round(
-                sum(kind_fracs) / len(kind_fracs), 4),
-        }
-        fractions.extend(kind_fracs)
-    return {
-        "n_compute_ops": len(computes),
-        "collectives": per_kind,
-        "mean_independent_compute_frac": (
-            round(sum(fractions) / len(fractions), 4) if fractions else None
-        ),
-    }
+    return hlo_collective_stats(text)
 
 
 def main(argv=None) -> int:

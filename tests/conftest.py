@@ -24,10 +24,17 @@ os.environ.setdefault("DEAR_COMPILATION_CACHE_DIR", "off")
 
 import jax  # noqa: E402
 
+from dear_pytorch_tpu import _jax_compat  # noqa: E402  (installs jax.P etc.)
+
 # jax may already be imported by sitecustomize with JAX_PLATFORMS=axon baked
 # in; the config update works as long as no backend has been initialized yet.
+# The device count goes through the compat helper: jax_num_cpu_devices on
+# current jax, the XLA_FLAGS escape hatch on older releases. scrub_env
+# keeps the fallback flag OUT of os.environ so subprocess-spawning tests
+# (bench smoke, examples, multiprocess clusters) don't inherit an 8-device
+# world they never asked for.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+_jax_compat.set_cpu_device_count(8, scrub_env=True)
 
 import pytest  # noqa: E402
 
