@@ -486,10 +486,12 @@ def _mfu(flops: float, secs_per_step: float):
 
 class _Watchdog:
     """Per-phase hang guard: the session's tunneled TPU backend is known to
-    hang indefinitely (device init / compile RPCs) when the tunnel drops. A
-    daemon thread + ``os._exit`` fires even while the main thread is stuck in
-    a C call, which a signal handler would not. Each phase gets its own
-    budget (``arm`` resets the clock), and once the primary metric exists a
+    hang indefinitely (device init / compile RPCs) when the tunnel drops.
+    Built on `resilience.watchdog.StepWatchdog` (daemon thread +
+    ``os._exit`` fires even while the main thread is stuck in a C call,
+    which a signal handler would not; the firing report carries open
+    telemetry spans and every thread's stack). Each phase gets its own
+    budget (``arm`` beats the clock), and once the primary metric exists a
     late hang emits the partial result and exits 0 — a wedged second metric
     must not sink the primary. Disable with DEAR_BENCH_WATCHDOG_SECS=0."""
 
@@ -497,48 +499,53 @@ class _Watchdog:
         self.secs = float(os.environ.get("DEAR_BENCH_WATCHDOG_SECS", "2400"))
         self.primary = None
         self.extras: list = []  # completed secondary metrics so far
-        self._timer = None
+        self._dog = None
+        self._phase = ""
+        self._metric = ""
 
     def arm(self, phase: str, metric: str) -> None:
         if self.secs <= 0:
             return
-        self.disarm()
+        self._phase, self._metric = phase, metric
+        if self._dog is None:
+            from dear_pytorch_tpu.resilience import StepWatchdog
 
-        def fire():
-            sys.stderr.write(
-                f"bench.py watchdog: phase {phase!r} still running after "
-                f"{self.secs:.0f}s — device backend likely wedged (tunnel "
-                "down?); aborting\n"
-            )
-            sys.stderr.flush()
-            err = {
-                "metric": metric,
-                "error": f"watchdog: {phase} wedged after {self.secs:.0f}s",
-            }
-            if self.primary is not None:
-                out = dict(self.primary)
-                # keep every secondary metric that already completed; if the
-                # phase finished right at the timeout its result is already
-                # in extras — don't also report it as wedged
-                done = list(self.extras)
-                if not any(m.get("metric") == metric for m in done):
-                    done.append(err)
-                out["extra_metrics"] = done
-                _emit(out)
-                os._exit(0)
-            # no primary yet: still honor the one-JSON-line contract so a
-            # red round leaves machine-readable evidence, then exit red
-            _emit(dict(err, metric=PRIMARY_METRIC))
-            os._exit(3)
-
-        self._timer = threading.Timer(self.secs, fire)
-        self._timer.daemon = True
-        self._timer.start()
+            self._dog = StepWatchdog(
+                self.secs, on_timeout=self._fire, name="bench-watchdog"
+            ).start()
+        self._dog.beat(phase=phase, metric=metric)
 
     def disarm(self) -> None:
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
+        if self._dog is not None:
+            self._dog.pause()
+
+    def _fire(self, report) -> None:
+        phase, metric = self._phase, self._metric
+        sys.stderr.write(
+            f"bench.py watchdog: phase {phase!r} still running after "
+            f"{report.waited_s:.0f}s — device backend likely wedged (tunnel "
+            "down?); aborting\n"
+        )
+        sys.stderr.flush()
+        err = {
+            "metric": metric,
+            "error": f"watchdog: {phase} wedged after {self.secs:.0f}s",
+        }
+        if self.primary is not None:
+            out = dict(self.primary)
+            # keep every secondary metric that already completed; if the
+            # phase finished right at the timeout its result is already
+            # in extras — don't also report it as wedged
+            done = list(self.extras)
+            if not any(m.get("metric") == metric for m in done):
+                done.append(err)
+            out["extra_metrics"] = done
+            _emit(out)
+            os._exit(0)
+        # no primary yet: still honor the one-JSON-line contract so a
+        # red round leaves machine-readable evidence, then exit red
+        _emit(dict(err, metric=PRIMARY_METRIC))
+        os._exit(3)
 
 
 def main() -> None:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
 from typing import Any, Callable, Optional, Sequence
 
@@ -98,31 +99,51 @@ def run_timed(
     world = backend.device_count() if world is None else world
     steps_per_call = max(int(steps_per_call), 1)
 
-    log("Running warmup...")
-    for _ in range(num_warmup_batches):
-        step_fn()
-    if sync is not None:
-        sync()
+    # opt-in per-iteration hang guard: a wedged collective mid-benchmark
+    # otherwise blocks forever with no diagnosis. DEAR_STEP_WATCHDOG_SECS
+    # sets the heartbeat deadline (one timed iteration must finish within
+    # it); on timeout the watchdog dumps open telemetry spans + thread
+    # stacks and aborts with the last completed iteration number. It only
+    # arms at the first timed iteration — warmup (jit compilation, tens of
+    # minutes through the TPU tunnel) stays under bench.py's coarser
+    # phase watchdog instead.
+    dog_secs = float(os.environ.get("DEAR_STEP_WATCHDOG_SECS", "0"))
+    dog = None
+    if dog_secs > 0:
+        from dear_pytorch_tpu.resilience import StepWatchdog
 
-    log("Running benchmark...")
-    per_iter, iter_times = [], []
-    for x in range(num_iters):
-        t0 = time.perf_counter()
-        for _ in range(num_batches_per_iter):
+        dog = StepWatchdog(dog_secs, name="bench-step-watchdog").start()
+    try:
+        log("Running warmup...")
+        for _ in range(num_warmup_batches):
             step_fn()
         if sync is not None:
             sync()
-        dt = time.perf_counter() - t0
-        thr = batch_size * num_batches_per_iter / dt
-        log(f"Iter #{x}: {thr:.1f} {unit}/sec per {dev}")
-        per_iter.append(thr)
-        # per REAL train step, independent of the scanned-dispatch shape
-        iter_times.append(dt / (num_batches_per_iter * steps_per_call))
-        if metrics is not None:
-            metrics.log(
-                iter=x, **{f"{unit}_per_sec_per_device": thr},
-                step_time_s=dt / (num_batches_per_iter * steps_per_call),
-            )
+
+        log("Running benchmark...")
+        per_iter, iter_times = [], []
+        for x in range(num_iters):
+            if dog is not None:
+                dog.beat(phase="timed", iter=x)
+            t0 = time.perf_counter()
+            for _ in range(num_batches_per_iter):
+                step_fn()
+            if sync is not None:
+                sync()
+            dt = time.perf_counter() - t0
+            thr = batch_size * num_batches_per_iter / dt
+            log(f"Iter #{x}: {thr:.1f} {unit}/sec per {dev}")
+            per_iter.append(thr)
+            # per REAL train step, independent of the scanned-dispatch shape
+            iter_times.append(dt / (num_batches_per_iter * steps_per_call))
+            if metrics is not None:
+                metrics.log(
+                    iter=x, **{f"{unit}_per_sec_per_device": thr},
+                    step_time_s=dt / (num_batches_per_iter * steps_per_call),
+                )
+    finally:
+        if dog is not None:
+            dog.stop()
 
     res = BenchResult(
         unit=unit,

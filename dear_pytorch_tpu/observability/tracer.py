@@ -177,10 +177,12 @@ class _Span:
         tr = self._tracer
         self._t0 = tr._now_us()
         self._depth = tr._push()
+        tr._enter_live(self)
         return self
 
     def __exit__(self, *exc):
         tr = self._tracer
+        tr._exit_live(self)
         tr._pop()
         rec = SpanRecord(self.name, self._t0, tr._now_us() - self._t0,
                          tr._tid(), self._depth, self.attrs)
@@ -221,6 +223,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._local = threading.local()
         self._tids: dict[int, int] = {}
+        self._live: dict[int, "_Span"] = {}
 
     # -- time / thread bookkeeping ------------------------------------------
 
@@ -243,6 +246,14 @@ class Tracer:
     def _pop(self) -> None:
         self._local.depth = max(getattr(self._local, "depth", 1) - 1, 0)
 
+    def _enter_live(self, span: "_Span") -> None:
+        with self._lock:
+            self._live[id(span)] = span
+
+    def _exit_live(self, span: "_Span") -> None:
+        with self._lock:
+            self._live.pop(id(span), None)
+
     # -- the event model -----------------------------------------------------
 
     def span(self, name: str, **attrs) -> _Span:
@@ -263,6 +274,23 @@ class Tracer:
         """Point-in-time copy of every counter."""
         with self._lock:
             return dict(self._counters)
+
+    def live_spans(self) -> list[dict]:
+        """Snapshot of currently-OPEN spans (entered, not yet exited),
+        oldest first — what the host is inside of right now. This is what
+        `resilience.watchdog.StepWatchdog` dumps when a step hangs."""
+        now = self._now_us()
+        with self._lock:
+            live = list(self._live.values())
+        out = []
+        for s in live:
+            t0 = getattr(s, "_t0", None)
+            if t0 is None:  # racing __enter__; not meaningfully open yet
+                continue
+            out.append({"name": s.name, "age_us": round(now - t0, 3),
+                        "attrs": dict(s.attrs)})
+        out.sort(key=lambda d: -d["age_us"])
+        return out
 
     def add_exporter(self, exporter: Exporter) -> None:
         self._exporters.append(exporter)
@@ -290,6 +318,9 @@ class NullTracer:
 
     def counters(self) -> dict[str, float]:
         return {}
+
+    def live_spans(self) -> list[dict]:
+        return []
 
     def add_exporter(self, exporter) -> None:  # noqa: ARG002
         raise RuntimeError(

@@ -1,0 +1,43 @@
+"""Resilience: fault injection, step watchdog, preemption handling, retry.
+
+The reference DeAR has no failure handling at all — any MPI/NCCL error
+aborts the process and its batch driver retries whole jobs (SURVEY.md §5).
+This package makes every recovery path in the framework first-class,
+*exercised* code:
+
+  - `inject`    — deterministic, step-scheduled chaos (NaN gradients,
+                  raised step errors, hung steps, corrupted checkpoints,
+                  simulated SIGTERM preemption) via ``DEAR_FAULTS`` or
+                  code, so recovery is testable in CI
+                  (`scripts/chaos_check.py`).
+  - `watchdog`  — heartbeat-fed hang detector: dumps open telemetry spans
+                  + Python stacks and aborts with the last-good step.
+  - `preempt`   — SIGTERM -> flag -> emergency synchronous checkpoint at
+                  the next step boundary (`GuardedTrainer` polls it).
+  - `retry`     — bounded deterministic retry/backoff for transient
+                  host-side I/O (checkpoint sidecars, pipeline fetches).
+
+Recovery itself stays in `utils.guard.GuardedTrainer` (rollback, checksum
+fallback, retention) and `utils.checkpoint` (manifests, pruning); this
+package supplies the machinery around it. See docs/RESILIENCE.md.
+"""
+
+from dear_pytorch_tpu.resilience.inject import (  # noqa: F401
+    FAULT_ENV,
+    Fault,
+    FaultInjector,
+    InjectedFault,
+    corrupt_latest_checkpoint,
+    parse_faults,
+    poison_pytree,
+)
+from dear_pytorch_tpu.resilience.preempt import PreemptionHandler  # noqa: F401
+from dear_pytorch_tpu.resilience.retry import (  # noqa: F401
+    RetryError,
+    retry_call,
+    retryable,
+)
+from dear_pytorch_tpu.resilience.watchdog import (  # noqa: F401
+    StepWatchdog,
+    WatchdogReport,
+)

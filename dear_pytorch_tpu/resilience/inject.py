@@ -1,0 +1,266 @@
+"""Deterministic fault injection — the chaos layer that makes recovery
+paths *testable*.
+
+The reference's failure handling could only be validated by killing real
+cluster jobs; this framework's recovery code (`utils.guard.GuardedTrainer`
+rollback, checkpoint fallback, preemption saves, the step watchdog) would
+otherwise be best-effort branches nothing ever exercises. `FaultInjector`
+schedules faults at exact trainer step numbers (or pseudo-randomly from a
+seed — still fully deterministic), so a chaos run is reproducible
+byte-for-byte and CI can assert the *recovery*, not just the fault.
+
+Fault kinds (all fire exactly once per scheduled entry):
+
+  ``nan``           poison the step's batch (first float leaf -> NaN), so
+                    real NaN gradients flow through the real train step
+  ``exc``           raise `InjectedFault` from inside the guarded step
+  ``hang``          sleep ``arg`` seconds before the step (a hung
+                    collective, as seen by the host) — watchdog fodder
+  ``ckpt_corrupt``  flip bytes in the newest committed checkpoint payload
+                    on disk (exercises the checksum-manifest fallback)
+  ``preempt``       SIGTERM to the own process (a simulated maintenance
+                    preemption; pair with `resilience.preempt`)
+
+Enable from the environment — ``DEAR_FAULTS="nan@6,exc@9,hang@12:0.5,
+ckpt_corrupt@15,preempt@18"`` — or construct a `FaultInjector` in code and
+hand it to `GuardedTrainer`. Telemetry (when enabled): counter
+``faults.injected`` plus one ``fault.injected`` event per firing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+FAULT_ENV = "DEAR_FAULTS"
+
+KINDS = ("nan", "exc", "hang", "ckpt_corrupt", "preempt")
+
+__all__ = [
+    "FAULT_ENV", "KINDS", "Fault", "InjectedFault", "FaultInjector",
+    "parse_faults", "poison_pytree", "corrupt_latest_checkpoint",
+]
+
+
+class InjectedFault(RuntimeError):
+    """The exception an ``exc`` fault raises inside the train step."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault: ``kind`` fires at trainer step ``step``
+    (1-based, counting attempted steps); ``arg`` is kind-specific
+    (``hang`` seconds; unused otherwise)."""
+
+    kind: str
+    step: int
+    arg: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}: valid kinds are "
+                f"{', '.join(KINDS)}"
+            )
+        if self.step < 1:
+            raise ValueError(f"fault step must be >= 1, got {self.step}")
+
+
+def parse_faults(spec: str) -> Tuple[Fault, ...]:
+    """Parse a ``kind@step[:arg]`` comma list into `Fault`s."""
+    out: List[Fault] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, sep, rest = part.partition("@")
+        if not sep:
+            raise ValueError(
+                f"{FAULT_ENV}: bad fault spec {part!r} "
+                "(use kind@step[:arg], e.g. 'nan@6' or 'hang@12:0.5')"
+            )
+        step_s, _, arg_s = rest.partition(":")
+        try:
+            step = int(step_s)
+            arg = float(arg_s) if arg_s else 0.0
+        except ValueError as exc:
+            raise ValueError(
+                f"{FAULT_ENV}: bad fault spec {part!r}: {exc}"
+            ) from None
+        out.append(Fault(kind=kind, step=step, arg=arg))
+    return tuple(out)
+
+
+def poison_pytree(tree):
+    """Copy of ``tree`` with the first floating-point leaf's first element
+    set to NaN — real NaN gradients through the real backward pass."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for i, leaf in enumerate(leaves):
+        dt = getattr(leaf, "dtype", None)
+        if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+            continue
+        if isinstance(leaf, np.ndarray):
+            leaf = leaf.copy()
+            leaf.reshape(-1)[0] = np.nan
+        else:
+            shape = leaf.shape
+            leaf = jnp.reshape(
+                jnp.reshape(leaf, (-1,)).at[0].set(jnp.nan), shape
+            )
+        leaves[i] = leaf
+        break
+    else:
+        raise ValueError("no floating-point leaf to poison in this batch")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def corrupt_latest_checkpoint(directory: str) -> Optional[int]:
+    """Overwrite the head of the largest payload file in the newest
+    committed checkpoint with garbage; returns the corrupted step (None
+    when no checkpoint exists). Deterministic: same tree -> same bytes."""
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+
+    step = ckpt.latest_step(directory)
+    if step is None:
+        return None
+    root = os.path.join(directory, f"step_{step:010d}")
+    target, size = None, -1
+    for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            s = os.path.getsize(p)
+            if s > size:
+                target, size = p, s
+    if target is None:
+        return None
+    with open(target, "r+b") as f:
+        f.write(b"\xff" * min(64, max(size, 1)))
+    logger.warning("inject: corrupted checkpoint step %d (%s)", step, target)
+    return step
+
+
+class FaultInjector:
+    """Fires scheduled `Fault`s at their step numbers.
+
+    Call sites (`GuardedTrainer.step` wires both):
+
+      - ``before_step(step, directory=...)`` — raises / hangs / corrupts /
+        preempts when a matching fault is due,
+      - ``poison_batch(step, batch)`` — applies a due ``nan`` fault.
+
+    Every fault fires exactly once; ``fired`` records the history and
+    ``pending`` what is still scheduled.
+    """
+
+    def __init__(self, faults: Sequence[Fault] = (), *,
+                 kill: bool = True):
+        self._by_step: Dict[int, List[Fault]] = {}
+        for f in faults:
+            self._by_step.setdefault(int(f.step), []).append(f)
+        self.fired: List[Fault] = []
+        # kill=False turns ``preempt`` into a no-op marker (tests that
+        # assert scheduling without installing a SIGTERM handler)
+        self._kill = kill
+
+    @classmethod
+    def from_env(cls, env: Optional[str] = None) -> Optional["FaultInjector"]:
+        """Injector from ``DEAR_FAULTS`` (None when unset/empty)."""
+        raw = (env if env is not None
+               else os.environ.get(FAULT_ENV, "")).strip()
+        if not raw:
+            return None
+        return cls(parse_faults(raw))
+
+    @classmethod
+    def from_seed(cls, seed: int, *, horizon: int, rate: float = 0.02,
+                  kinds: Sequence[str] = ("nan", "exc")) -> "FaultInjector":
+        """Pseudo-random but fully deterministic schedule: each step in
+        ``[1, horizon]`` carries one fault with probability ``rate``, the
+        kind drawn uniformly from ``kinds``. Same seed -> same schedule."""
+        rng = np.random.default_rng(seed)
+        faults = [
+            Fault(kind=str(rng.choice(list(kinds))), step=step)
+            for step in range(1, int(horizon) + 1)
+            if rng.random() < rate
+        ]
+        return cls(faults)
+
+    @property
+    def pending(self) -> int:
+        return sum(len(v) for v in self._by_step.values())
+
+    def _take(self, step: int, kinds: Tuple[str, ...]) -> List[Fault]:
+        due = self._by_step.get(int(step))
+        if not due:
+            return []
+        taken = [f for f in due if f.kind in kinds]
+        if taken:
+            remaining = [f for f in due if f.kind not in kinds]
+            if remaining:
+                self._by_step[int(step)] = remaining
+            else:
+                del self._by_step[int(step)]
+            self.fired.extend(taken)
+            tr = _telemetry.get_tracer()
+            for f in taken:
+                logger.warning("inject: firing %s at step %d", f.kind, step)
+                if tr.enabled:
+                    tr.count("faults.injected")
+                    tr.event("fault.injected", kind=f.kind, step=f.step,
+                             arg=f.arg)
+        return taken
+
+    def before_step(self, step: int, *,
+                    directory: Optional[str] = None) -> None:
+        """Fire every non-batch fault due at ``step``. Raises
+        `InjectedFault` for an ``exc`` fault (after firing any co-scheduled
+        hang/corrupt/preempt, so stacked faults all land)."""
+        raise_after = None
+        for f in self._take(step, ("hang", "ckpt_corrupt", "preempt", "exc")):
+            if f.kind == "hang":
+                time.sleep(f.arg)
+            elif f.kind == "ckpt_corrupt":
+                if directory is not None:
+                    corrupt_latest_checkpoint(directory)
+                else:
+                    logger.warning(
+                        "inject: ckpt_corrupt at step %d skipped "
+                        "(no checkpoint directory at this call site)", step)
+            elif f.kind == "preempt":
+                if self._kill:
+                    os.kill(os.getpid(), signal.SIGTERM)
+            else:  # exc
+                raise_after = f
+        if raise_after is not None:
+            raise InjectedFault(
+                f"injected step failure at step {raise_after.step}"
+            )
+
+    def poison_batch(self, step: int, batch):
+        """Apply a due ``nan`` fault to ``batch`` (returned unchanged
+        otherwise). A batch with no floating-point leaf (all-integer
+        token batches) cannot carry a NaN — the fault degrades to an
+        `InjectedFault` step error so the recovery path still fires
+        instead of the chaos harness killing the run it is testing."""
+        if self._take(step, ("nan",)):
+            try:
+                return poison_pytree(batch)
+            except ValueError as exc:
+                raise InjectedFault(
+                    f"nan fault at step {step} found no float leaf to "
+                    f"poison ({exc}); degraded to a step error"
+                ) from None
+        return batch

@@ -1,0 +1,88 @@
+"""Preemption handling: turn SIGTERM into a checkpointed, resumable exit.
+
+TPU pods get maintenance-preempted with a grace window; the reference
+simply dies (whole-job retry by its batch driver, losing everything since
+the last manual save). `PreemptionHandler` installs a SIGTERM handler that
+*only sets a flag* — signal-safe, no I/O in the handler — and the training
+loop (`utils.guard.GuardedTrainer.step` checks it every step) performs a
+synchronous emergency save through `utils.checkpoint` at the next step
+boundary, then surfaces ``preempted=True`` so the loop can exit cleanly.
+A relaunch resumes from that save: zero loss of progress inside one
+checkpoint interval.
+
+The handler chains to any previously-installed SIGTERM handler on exit
+(context-manager protocol restores it), and `resilience.inject`'s
+``preempt`` fault delivers a real ``os.kill(getpid(), SIGTERM)`` so this
+path is exercised in CI, not just in production.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+__all__ = ["PreemptionHandler"]
+
+
+class PreemptionHandler:
+    """Flag-setting signal handler; install via ``with`` (or `install` /
+    `restore`). Thread-safe to poll from any thread; signals are only
+    *delivered* to the main thread, which is where `install` must run."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._prev: dict = {}
+        self._event = threading.Event()
+        self.count = 0
+        self._installed = False
+
+    # -- signal plumbing -----------------------------------------------------
+
+    def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
+        self.count += 1
+        self._event.set()
+        # no I/O here beyond logging: the actual save happens at the next
+        # step boundary, on the training thread, where device state is
+        # coherent
+        logger.warning(
+            "preempt: received signal %d (count %d); emergency checkpoint "
+            "at the next step boundary", signum, self.count,
+        )
+
+    def install(self) -> "PreemptionHandler":
+        if not self._installed:
+            for s in self._signals:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            self._installed = True
+        return self
+
+    def restore(self) -> None:
+        if self._installed:
+            for s, prev in self._prev.items():
+                signal.signal(s, prev)
+            self._prev.clear()
+            self._installed = False
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.restore()
+
+    # -- loop-facing surface -------------------------------------------------
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self) -> None:
+        """Acknowledge a handled preemption (tests; multi-phase loops that
+        checkpoint and keep going until the platform actually kills them)."""
+        self._event.clear()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)

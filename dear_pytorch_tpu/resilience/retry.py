@@ -1,0 +1,105 @@
+"""Retry/backoff for transient host-side failures.
+
+The reference has no retry anywhere (SURVEY.md §5: CHECK macros abort the
+process; its batch driver retries at whole-job granularity). The host-side
+I/O this framework does — checkpoint sidecar writes on shared filesystems,
+ring-buffer batch fetches racing a slow producer — fails transiently in
+ways a bounded, deterministic retry absorbs for free. Device-side faults
+are explicitly OUT of scope: a failed collective or a NaN loss is
+`utils.guard.GuardedTrainer`'s job (rollback), not a retry's (the same
+poisoned input would fail again).
+
+Backoff is deterministic (exponential, no jitter): recovery paths must be
+reproducible under test, and nothing here contends with other processes on
+a shared resource at retry granularity. Telemetry (when enabled): counters
+``retry.calls`` / ``retry.retries`` / ``retry.giveups`` and one
+``retry.attempt_failed`` event per absorbed failure, so retries surface in
+the telemetry JSON blocks instead of vanishing into a log.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+__all__ = ["RetryError", "retry_call", "retryable"]
+
+
+class RetryError(RuntimeError):
+    """Every attempt failed; the last attempt's exception is the cause."""
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    attempts: int = 3,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+    backoff: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError, TimeoutError),
+    name: Optional[str] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying on ``retry_on`` exceptions.
+
+    Up to ``attempts`` total attempts with deterministic exponential
+    backoff (``base_delay_s * backoff**k``, capped at ``max_delay_s``).
+    An exception outside ``retry_on`` propagates immediately — only
+    plausibly-transient failures are retried. When every attempt fails,
+    raises `RetryError` chained to the last failure (the original
+    exception stays inspectable via ``__cause__``).
+    """
+    attempts = max(int(attempts), 1)
+    label = name or getattr(fn, "__qualname__", repr(fn))
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("retry.calls")
+    last: Optional[BaseException] = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            last = exc
+            if attempt == attempts:
+                break
+            delay = min(base_delay_s * backoff ** (attempt - 1), max_delay_s)
+            logger.warning(
+                "retry: %s attempt %d/%d failed (%s: %s); retrying in %.3fs",
+                label, attempt, attempts, type(exc).__name__, exc, delay,
+            )
+            if tr.enabled:
+                tr.count("retry.retries")
+                tr.event("retry.attempt_failed", name=label, attempt=attempt,
+                         error=type(exc).__name__, delay_s=delay)
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            if delay > 0:
+                sleep(delay)
+    if tr.enabled:
+        tr.count("retry.giveups")
+    raise RetryError(
+        f"{label} failed after {attempts} attempts "
+        f"(last: {type(last).__name__}: {last})"
+    ) from last
+
+
+def retryable(**policy):
+    """Decorator form of `retry_call` — ``@retryable(attempts=5)``."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args, name=fn.__qualname__,
+                              **policy, **kwargs)
+
+        return wrapped
+
+    return deco

@@ -1,0 +1,185 @@
+"""Step watchdog: detect a hung training step and say where it hung.
+
+`utils.guard.GuardedTrainer` can only *log* a slow interval after the step
+returns — a truly hung collective (tunnel drop, wedged device RPC, a
+deadlocked host thread) never returns, and the reference's answer was an
+operator watching mpirun output (SURVEY.md §5). `StepWatchdog` is a
+daemon thread fed per-step heartbeats; when no beat arrives within the
+deadline it
+
+  1. snapshots the telemetry tracer's OPEN spans (what the host was inside
+     of — `observability.tracer.Tracer.live_spans`),
+  2. dumps every Python thread's stack via ``faulthandler``,
+  3. emits a ``watchdog.timeout`` telemetry event + counter, and
+  4. invokes ``on_timeout(report)`` — by default logging the last-good
+     step and hard-exiting (``os._exit``), which fires even while the main
+     thread is stuck inside a C call a signal handler could never
+     interrupt.
+
+Heartbeats carry arbitrary context (``beat(step=n, last_good_step=k)``)
+that lands in the report, so the abort message names the last checkpointed
+step a relaunch will resume from. ``pause()`` disarms between phases
+(deliberate idle is not a hang).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Callable, NamedTuple, Optional
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+__all__ = ["WatchdogReport", "StepWatchdog"]
+
+
+class WatchdogReport(NamedTuple):
+    """What the watchdog knew when it fired."""
+
+    name: str
+    waited_s: float          # time since the last heartbeat
+    deadline_s: float
+    beat_info: dict          # kwargs of the last beat (step, last_good_step)
+    live_spans: list         # open tracer spans at firing time
+
+
+class StepWatchdog:
+    """Deadline on the gap between heartbeats; see the module docstring.
+
+    Usage::
+
+        with StepWatchdog(deadline_s=300) as dog:
+            for batch in batches:
+                state, m = trainer.step(state, batch)
+                dog.beat(step=trainer.steps_seen,
+                         last_good_step=trainer._last_good_step)
+
+    The deadline only arms at the first ``beat()`` (startup compile time
+    does not count against it unless you beat before it). ``on_timeout``
+    replaces the default abort — after a custom handler runs, the watchdog
+    pauses itself until the next beat, so one hang fires once.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float,
+        *,
+        on_timeout: Optional[Callable[[WatchdogReport], None]] = None,
+        poll_s: Optional[float] = None,
+        dump_stacks: bool = True,
+        exit_code: int = 13,
+        name: str = "watchdog",
+    ):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        self.name = name
+        self._on_timeout = on_timeout
+        self._dump_stacks = dump_stacks
+        self._exit_code = exit_code
+        self._poll_s = (max(min(self.deadline_s / 4.0, 1.0), 0.01)
+                        if poll_s is None else float(poll_s))
+        self._lock = threading.Lock()
+        self._last_beat: Optional[float] = None  # None = paused/unarmed
+        self._beat_info: dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = 0
+        self.last_report: Optional[WatchdogReport] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"dear-{self.name}", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(self._poll_s * 4, 1.0))
+            self._thread = None
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- heartbeats ----------------------------------------------------------
+
+    def beat(self, **info) -> None:
+        """Record a heartbeat; ``info`` lands in a later report verbatim."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            if info:
+                self._beat_info = info
+
+    def pause(self) -> None:
+        """Disarm until the next `beat` (idle between phases is not a
+        hang)."""
+        with self._lock:
+            self._last_beat = None
+
+    # -- the poll thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                last, info = self._last_beat, dict(self._beat_info)
+            if last is None:
+                continue
+            waited = time.monotonic() - last
+            if waited <= self.deadline_s:
+                continue
+            self._fire(waited, info)
+
+    def _fire(self, waited: float, info: dict) -> None:
+        tr = _telemetry.get_tracer()
+        live = tr.live_spans() if tr.enabled else []
+        report = WatchdogReport(
+            name=self.name, waited_s=waited, deadline_s=self.deadline_s,
+            beat_info=info, live_spans=live,
+        )
+        self.fired += 1
+        self.last_report = report
+        if tr.enabled:
+            tr.count("watchdog.timeouts")
+            tr.event("watchdog.timeout", waited_s=round(waited, 3),
+                     deadline_s=self.deadline_s,
+                     open_spans=";".join(s["name"] for s in live)[:200],
+                     **{k: v for k, v in info.items()
+                        if isinstance(v, (int, float, str))})
+        logger.critical(
+            "%s: no heartbeat for %.1fs (deadline %.1fs); last beat: %s; "
+            "open telemetry spans: %s",
+            self.name, waited, self.deadline_s, info or "never detailed",
+            [s["name"] for s in live] or "none (telemetry off?)",
+        )
+        if self._dump_stacks:
+            sys.stderr.write(
+                f"\n+++ {self.name}: hung step — thread stacks follow +++\n"
+            )
+            faulthandler.dump_traceback(file=sys.stderr)
+            sys.stderr.flush()
+        # one hang fires once; a later beat re-arms
+        with self._lock:
+            self._last_beat = None
+        if self._on_timeout is not None:
+            self._on_timeout(report)
+        else:
+            last_good = info.get("last_good_step")
+            logger.critical(
+                "%s: aborting; resume from checkpoint step %s",
+                self.name, last_good if last_good is not None else "<none>",
+            )
+            os._exit(self._exit_code)

@@ -24,6 +24,8 @@ measurement window after each rebuild is discarded as warmup
 
 from __future__ import annotations
 
+import logging
+import math
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -37,6 +39,8 @@ from dear_pytorch_tpu.tuning.wait_time import (
     estimate_layer_backward_times,
     wait_time_flags,
 )
+
+logger = logging.getLogger("dear_pytorch_tpu")
 
 
 def _repack_bucket_states(old_states, old_plan, new_plan):
@@ -143,7 +147,11 @@ class AutoTuner:
         **build_kwargs: Any,
     ):
         if strategy not in ("bo", "wait_time"):
-            raise ValueError(f"unknown strategy {strategy!r}")
+            raise ValueError(
+                f"unknown strategy {strategy!r}: valid strategies are "
+                "'bo' (Bayesian optimization over the fusion threshold) "
+                "and 'wait_time' (layer-timing split flags)"
+            )
         self.strategy = strategy
         self._loss_fn = loss_fn
         self._template = params_template
@@ -162,6 +170,11 @@ class AutoTuner:
                 loss_fn, params_template, threshold_mb=threshold_mb,
                 **self._build_kwargs,
             )
+            # trial sandboxing bookkeeping: the threshold compiled into the
+            # live plan, and the last one that produced a finite loss (the
+            # revert target when a trial fails or diverges)
+            self._live_threshold = float(threshold_mb)
+            self._last_good_threshold = float(threshold_mb)
         else:
             self.tuner = None
             self._cycle = cycle_time_s
@@ -215,6 +228,37 @@ class AutoTuner:
         )
         return state
 
+    def _trial_infeasible(self, state, bad_threshold: float, why: str):
+        """Sandbox a failed/diverged BO trial: record it as infeasible
+        (dominated observation, consumed trial) and revert the live plan
+        to the last known-good threshold — the tuning run survives.
+        Returns the (possibly reverted) state."""
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("autotune.trial_failures")
+            tr.event("autotune.trial_infeasible",
+                     threshold_mb=float(bad_threshold), why=why[:120])
+        self._log(
+            f"autotune: trial threshold {bad_threshold:.4f} MB infeasible "
+            f"({why}); reverting to {self._last_good_threshold:.4f} MB"
+        )
+        self.tuner.mark_infeasible(
+            float(bad_threshold), revert_to=self._last_good_threshold
+        )
+        if self._live_threshold != self._last_good_threshold:
+            try:
+                state = self._rebuild(
+                    state, threshold_mb=self._last_good_threshold
+                )
+                self._live_threshold = self._last_good_threshold
+            except Exception as exc:  # revert itself failed: keep running
+                logger.error(
+                    "autotune: revert rebuild to %.4f MB failed (%s); "
+                    "continuing on the trial plan",
+                    self._last_good_threshold, exc,
+                )
+        return state
+
     def step(self, state, batch):
         state, metrics = self.ts.step(state, batch)
         self._host_step += 1
@@ -224,15 +268,47 @@ class AutoTuner:
                 # clock: otherwise it would time host dispatch, not the
                 # device step (a scalar fetch is also tunnel-safe where
                 # block_until_ready on remote buffers is not)
-                float(metrics["loss"])
+                loss = float(metrics["loss"])
+                if not math.isfinite(loss) \
+                        and self._live_threshold != self._last_good_threshold:
+                    # the active trial diverged: plan repacks are
+                    # numerically exact, so this usually means a pathological
+                    # bucketization (memory/compile trouble) — record the
+                    # trial infeasible and fall back; parameter recovery is
+                    # the guard's job, not the tuner's
+                    state = self._trial_infeasible(
+                        state, self._live_threshold, "non-finite loss"
+                    )
+                    return state, metrics
             proposal = self.tuner.step()
             if proposal is not None:
+                # a NEW proposal means the live threshold survived a full
+                # measurement window of finite losses: only now does it
+                # become the revert target (a trial that diverges on its
+                # second step must still have a known-good plan to fall
+                # back to)
+                self._last_good_threshold = self._live_threshold
                 tr = _telemetry.get_tracer()
                 if tr.enabled:
                     tr.count("autotune.trials")
                     tr.event("autotune.proposal",
                              threshold_mb=float(proposal))
-                state = self._rebuild(state, threshold_mb=float(proposal))
+                try:
+                    state = self._rebuild(state, threshold_mb=float(proposal))
+                except Exception as exc:
+                    # a bad proposal must not kill the tuning run: the
+                    # rebuild never installed (repack_state is functional —
+                    # `state` is unchanged on a raise)
+                    logger.error(
+                        "autotune: rebuild for trial %.4f MB raised %s: %s",
+                        float(proposal), type(exc).__name__, exc,
+                    )
+                    state = self._trial_infeasible(
+                        state, float(proposal),
+                        f"rebuild raised {type(exc).__name__}",
+                    )
+                else:
+                    self._live_threshold = float(proposal)
         elif not self._switched and self._host_step >= self._warmup_steps:
             times = (
                 self._layer_times
@@ -247,5 +323,18 @@ class AutoTuner:
                 tr.event("autotune.wait_time_decision",
                          buckets=int(sum(flags)), cycle_time_s=self._cycle)
             if sum(flags) > 1:  # one bucket already == current plan
-                state = self._rebuild(state, flags=flags)
+                try:
+                    state = self._rebuild(state, flags=flags)
+                except Exception as exc:
+                    # stay on the (feasible) single-bucket plan
+                    if tr.enabled:
+                        tr.count("autotune.trial_failures")
+                        tr.event("autotune.trial_infeasible",
+                                 strategy="wait_time",
+                                 why=type(exc).__name__)
+                    logger.error(
+                        "autotune: wait_time split rebuild failed (%s: %s); "
+                        "keeping the all-layers bucket",
+                        type(exc).__name__, exc,
+                    )
         return state, metrics

@@ -128,6 +128,7 @@ class Tuner:
         self._timestamps: list[float] = []
         self._warmup = True
         self._best: Optional[tuple[float, float]] = None
+        self._feasible_ys: list[float] = []  # real measurements only
         self.finished = False
 
     def _record(self) -> Optional[float]:
@@ -148,11 +149,42 @@ class Tuner:
         self._warmup = True
         self._timestamps = []
 
+    def mark_infeasible(self, x: float, *,
+                        revert_to: Optional[float] = None,
+                        penalty: Optional[float] = None) -> None:
+        """Record trial point ``x`` as infeasible (its rebuild failed or it
+        diverged): register a dominated observation so the GP steers away,
+        count it as a consumed trial, and reset the measurement window
+        (the failed attempt's wall time must not contaminate timing).
+        ``revert_to`` is the threshold actually still live (the rebuild
+        never happened); ``penalty`` overrides the default dominated value
+        (10x the worst FEASIBLE measurement — prior penalties excluded so
+        consecutive infeasible trials don't compound and blow up the GP's
+        y-standardization — or 1e6 before any real observation)."""
+        if penalty is None:
+            penalty = (10.0 * max(self._feasible_ys)
+                       if self._feasible_ys else 1e6)
+        self._opt.register(float(x), float(penalty))
+        self._num_steps += 1
+        self._timestamps = []
+        if revert_to is not None:
+            self._current = float(revert_to)
+        self._log(
+            f"BO Tuning step [{self._num_steps - 1}], param: {x:.4f} "
+            f"INFEASIBLE (penalty {penalty:.4g}); staying at "
+            f"{self._current:.4f}"
+        )
+
     def step(self) -> Optional[float]:
         if self.finished:
             return None
         if self._num_steps >= self._max:
             self.finished = True
+            if self._best is None:
+                # every trial was infeasible: nothing to adopt
+                self._log("BO Tuning finished: no feasible measurement; "
+                          f"keeping param {self._current:.4f}")
+                return None
             point, t = self._best
             self._log(
                 f"BO Tuning optimal param: {point:.4f}, "
@@ -170,6 +202,7 @@ class Tuner:
         )
         if self._best is None or iter_time < self._best[1]:
             self._best = (self._current, iter_time)
+        self._feasible_ys.append(iter_time)
         self._opt.register(self._current, iter_time)
         nxt = self._opt.suggest()
         self._num_steps += 1

@@ -8,12 +8,29 @@ checkpointing is: save the pytree + a fingerprint of the fusion plan it was
 packed under. On restore the fingerprint is checked against the live train
 step's plan — restoring into a re-bucketed setup is an error with a pointer
 to `tuning.autotune.repack_state` (which converts between plans).
+
+Durability hardening (the resilience layer's contract):
+
+  - every synchronous save's sidecar carries a **checksum manifest**
+    (per-file sha256 + size over the committed step dir); `verify_checkpoint`
+    re-hashes it and `latest_valid_step` walks newest->oldest past corrupted
+    payloads, so a bit-flipped or truncated checkpoint degrades to the
+    previous valid step instead of a poisoned restore. Async saves commit
+    after the sidecar is written — backfill with `write_manifest` once
+    `wait_for_checkpoints` returns (`GuardedTrainer.finalize` does).
+  - `prune_checkpoints` is the keep-last-k retention GC (shared by
+    `GuardedTrainer`), and `prune_orphaned_tmp` clears crash-leftover Orbax
+    atomic-write temp dirs on startup — previously they were only excluded
+    from listings, never deleted.
+  - sidecar I/O goes through `resilience.retry` (transient shared-fs
+    failures must not kill the save path the guard depends on).
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 from typing import Optional
 
@@ -21,6 +38,9 @@ import jax
 
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.parallel import dear as D
+from dear_pytorch_tpu.resilience.retry import retry_call
+
+logger = logging.getLogger("dear_pytorch_tpu")
 
 
 def plan_fingerprint(plan: F.FusionPlan) -> str:
@@ -124,9 +144,147 @@ def save_checkpoint(
         # a crash mid-write leaves an orphan sidecar, never a broken restore
         meta = {"plan": plan_fingerprint(plan), "step": step,
                 "plan_desc": plan_desc(plan)}
-        with open(os.path.join(directory, f"meta_{step:010d}.json"), "w") as f:
-            json.dump(meta, f)
+        # checksum manifest over the committed files: only the sync path has
+        # them on disk here; async saves backfill via `write_manifest` after
+        # `wait_for_checkpoints` (manifest=None verifies vacuously)
+        meta["manifest"] = None if asynchronous else _build_manifest(path)
+        _write_sidecar(directory, step, meta)
     return path
+
+
+def _file_digest(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()[:16]
+
+
+def _build_manifest(step_dir: str) -> dict:
+    """``{relpath: {"sha256": h16, "bytes": n}}`` over every regular file
+    in the committed step dir."""
+    out = {}
+    root = os.path.abspath(step_dir)
+    for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            rel = os.path.relpath(p, root)
+            out[rel] = {"sha256": _file_digest(p),
+                        "bytes": os.path.getsize(p)}
+    return out
+
+
+def _write_sidecar(directory: str, step: int, meta: dict) -> None:
+    """Atomic sidecar write with retry (transient shared-fs failures must
+    not kill the save path the guard's recovery depends on)."""
+    path = os.path.join(directory, f"meta_{step:010d}.json")
+
+    def _write():
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
+    retry_call(_write, name="checkpoint.sidecar_write",
+               retry_on=(OSError,), attempts=3, base_delay_s=0.05)
+
+
+def write_manifest(directory: str, step: int) -> bool:
+    """Backfill the checksum manifest for a COMMITTED async save (call
+    after `wait_for_checkpoints`). Returns False when the step dir or its
+    sidecar is missing (the async write failed) — nothing to manifest."""
+    if jax.process_index() != 0:
+        return False
+    step_dir = _ckpt_dir(directory, step)
+    meta_path = os.path.join(directory, f"meta_{step:010d}.json")
+    if not (os.path.isdir(step_dir) and os.path.exists(meta_path)):
+        return False
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["manifest"] = _build_manifest(step_dir)
+    _write_sidecar(directory, step, meta)
+    return True
+
+
+def verify_checkpoint(directory: str, step: int) -> bool:
+    """Re-hash a checkpoint against its sidecar manifest.
+
+    False on a missing/unreadable sidecar or any size/digest mismatch.
+    True when the manifest matches — or is absent (pre-manifest and
+    unfinalized-async checkpoints verify vacuously; they predate the
+    durability contract).
+    """
+    meta_path = os.path.join(directory, f"meta_{step:010d}.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return False
+    manifest = meta.get("manifest")
+    if not manifest:
+        return True
+    root = _ckpt_dir(directory, step)
+    for rel, ent in manifest.items():
+        p = os.path.join(root, rel)
+        try:
+            if os.path.getsize(p) != ent["bytes"]:
+                return False
+            if _file_digest(p) != ent["sha256"]:
+                return False
+        except OSError:
+            return False
+    return True
+
+
+#: (directory, step) pairs already reported corrupt — a corrupted dir stays
+#: on disk until retention rotates it out, and every later restore walk
+#: would otherwise re-count the SAME corruption event (bounded: retention
+#: keeps the step population small)
+_corrupt_reported: set = set()
+
+
+def latest_valid_step(directory: str, *,
+                      below: Optional[int] = None) -> Optional[int]:
+    """Newest step whose checkpoint verifies; walks past corrupted ones
+    (logged + counted ONCE per corrupted step as ``ckpt.corrupt_detected``)
+    instead of handing a poisoned payload to restore. ``below`` restricts
+    to strictly older steps (the guard's fallback walk)."""
+    from dear_pytorch_tpu.observability import tracer as _telemetry
+
+    if not os.path.isdir(directory):
+        return None
+    steps = sorted((
+        int(name[len("step_"):])
+        for name in os.listdir(directory)
+        if name.startswith("step_") and name[len("step_"):].isdigit()
+        and (below is None or int(name[len("step_"):]) < below)
+    ), reverse=True)
+    for step in steps:
+        if verify_checkpoint(directory, step):
+            return step
+        # the sidecar mtime distinguishes a RE-written checkpoint at a
+        # reused step number (post-rollback replay) from the same
+        # already-reported corruption event
+        meta_path = os.path.join(directory, f"meta_{step:010d}.json")
+        try:
+            stamp = int(os.path.getmtime(meta_path))
+        except OSError:
+            stamp = 0
+        key = (os.path.abspath(directory), step, stamp)
+        if key not in _corrupt_reported:
+            _corrupt_reported.add(key)
+            logger.error(
+                "checkpoint: step %d failed checksum verification; "
+                "falling back to the previous checkpoint", step,
+            )
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                tr.count("ckpt.corrupt_detected")
+                tr.event("ckpt.corrupt", step=step)
+    return None
 
 
 def wait_for_checkpoints() -> None:
@@ -134,6 +292,13 @@ def wait_for_checkpoints() -> None:
     No-op when none are in flight."""
     if _async_ckptr is not None:
         _async_ckptr.wait_until_finished()
+
+
+def has_async_checkpointer() -> bool:
+    """True once any async save ran in this process — after which an
+    Orbax tmp dir in a checkpoint directory may be a live in-flight
+    write, not a crash leftover."""
+    return _async_ckptr is not None
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -149,6 +314,115 @@ def latest_step(directory: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def _default_step(directory: str) -> Optional[int]:
+    """Step choice for ``step=None`` restores. Single-host: the newest
+    checkpoint passing checksum verification (corruption fallback).
+    Multi-host: every process MUST restore the same step, and the
+    verification walk decides per process (one host's transient fs read
+    error would silently pick an older step there, desynchronizing
+    replicas) — so use the newest committed step deterministically and
+    let a corrupt payload fail the restore loudly for whole-job
+    relaunch."""
+    if jax.process_count() > 1:
+        return latest_step(directory)
+    return latest_valid_step(directory)
+
+
+def prune_orphaned_tmp(directory: str) -> list[str]:
+    """Delete crash-orphaned Orbax atomic-write temp dirs
+    (``step_XXXXXXXXXX.orbax-checkpoint-tmp-N``) — call on STARTUP, before
+    any async save is in flight (they were previously only excluded from
+    listings, accumulating forever after crashes). Returns (and logs) what
+    was removed."""
+    import shutil
+
+    if jax.process_index() != 0 or not os.path.isdir(directory):
+        return []
+    removed = []
+    for name in sorted(os.listdir(directory)):
+        if name.startswith("step_") and ".orbax-checkpoint-tmp" in name:
+            shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+            removed.append(name)
+    if removed:
+        logger.warning(
+            "checkpoint: pruned %d crash-orphaned Orbax tmp dir(s) under "
+            "%s: %s", len(removed), directory, ", ".join(removed),
+        )
+    return removed
+
+
+def prune_checkpoints(
+    directory: str, *, max_keep: int,
+    skip_tmp_step: Optional[int] = None,
+) -> None:
+    """Keep-last-k retention GC (shared with `GuardedTrainer`): keep the
+    newest ``max_keep`` committed checkpoints; delete older step dirs and
+    their sidecars, crash-leftover Orbax atomic-write temp dirs, and
+    orphan sidecars whose save never committed. ``skip_tmp_step`` protects
+    a legitimately in-flight async write's temp dir (and its eagerly
+    written sidecar) from the sweep."""
+    import shutil
+
+    if jax.process_index() != 0:
+        return
+    max_keep = max(int(max_keep), 1)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    steps = sorted(
+        int(name[len("step_"):])
+        for name in names
+        if name.startswith("step_") and name[len("step_"):].isdigit()
+    )
+    # crash-leftover Orbax atomic-write temp dirs are never restorable;
+    # delete them too, or a crash-restart loop fills the disk the
+    # retention policy exists to protect
+    for name in names:
+        if name.startswith("step_") and ".orbax-checkpoint-tmp" in name:
+            if (skip_tmp_step is not None
+                    and name.startswith(f"step_{skip_tmp_step:010d}.")):
+                continue  # in-flight async write, not a crash leftover
+            shutil.rmtree(
+                os.path.join(directory, name), ignore_errors=True
+            )
+    for s in steps[:-max_keep]:
+        shutil.rmtree(
+            os.path.join(directory, f"step_{s:010d}"),
+            ignore_errors=True,
+        )
+        try:
+            os.remove(os.path.join(directory, f"meta_{s:010d}.json"))
+        except OSError:
+            pass
+    # orphan sidecars: meta written eagerly for a save that never
+    # committed (async failure / crash mid-write). Restores never read
+    # them (they go through committed dirs), but a crash-restart loop
+    # would accumulate them unboundedly. Ditto .json.tmp leftovers from a
+    # crash between the sidecar tmp write and its atomic rename (safe to
+    # sweep: sidecars are written and pruned by process 0 only, and the
+    # guard prunes after the write completes).
+    committed = set(steps)
+    for name in names:
+        if name.startswith("meta_") and name.endswith(".json.tmp"):
+            try:
+                os.remove(os.path.join(directory, name))
+            except OSError:
+                pass
+            continue
+        if not (name.startswith("meta_") and name.endswith(".json")):
+            continue
+        digits = name[len("meta_"):-len(".json")]
+        if not digits.isdigit():
+            continue
+        s = int(digits)
+        if s not in committed and s != skip_tmp_step:
+            try:
+                os.remove(os.path.join(directory, name))
+            except OSError:
+                pass
+
+
 def restore_checkpoint(
     directory: str,
     ts: D.TrainStep,
@@ -157,16 +431,21 @@ def restore_checkpoint(
     template: Optional[D.DearState] = None,
 ) -> D.DearState:
     """Restore into the layout of ``ts`` (shardings taken from a template
-    state — ``ts.init`` output — or built fresh here).
+    state — ``ts.init`` output — or built fresh here). When ``step`` is
+    None, restores the newest checkpoint that passes checksum
+    verification — a corrupted newest checkpoint degrades to the previous
+    valid one instead of a DATA_LOSS error mid-restore (single-host only:
+    see `_default_step`).
 
     Raises if the checkpoint was written under a different fusion plan.
     """
     import orbax.checkpoint as ocp
 
     if step is None:
-        step = latest_step(directory)
+        step = _default_step(directory)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
+            raise FileNotFoundError(
+                f"no (valid) checkpoints under {directory}")
     meta_path = os.path.join(directory, f"meta_{step:010d}.json")
     with open(meta_path) as f:
         meta = json.load(f)
@@ -228,9 +507,10 @@ def elastic_restore(
     from dear_pytorch_tpu.tuning.autotune import repack_state
 
     if step is None:
-        step = latest_step(directory)
+        step = _default_step(directory)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
+            raise FileNotFoundError(
+                f"no (valid) checkpoints under {directory}")
     with open(os.path.join(directory, f"meta_{step:010d}.json")) as f:
         meta = json.load(f)
     if "plan_desc" not in meta:

@@ -21,6 +21,24 @@ few donated steps. `GuardedTrainer` wraps a `TrainStep` with:
 This is single-program recovery (the process survives). Whole-process
 elasticity (host loss on a pod) composes on top: the same periodic
 checkpoints are what a relaunched job restores from.
+
+The resilience layer (`dear_pytorch_tpu.resilience`, docs/RESILIENCE.md)
+plugs in here:
+
+  - **fault injection**: a `FaultInjector` (or ``DEAR_FAULTS`` in the
+    environment) fires deterministic NaN/exception/hang/corruption/
+    preemption faults inside the guarded step, so every branch below is
+    exercised code (`scripts/chaos_check.py`),
+  - **watchdog heartbeats**: pass a `StepWatchdog` and every completed
+    step beats it with the last-good checkpoint step,
+  - **preemption**: pass a `PreemptionHandler` and a SIGTERM triggers a
+    verified, synchronous emergency checkpoint at the next step boundary
+    (``metrics["preempted"]`` tells the loop to exit),
+  - **corruption fallback**: restores verify the sidecar checksum
+    manifest and walk back past corrupted checkpoints,
+  - **telemetry**: every recovery event lands in `observability` counters
+    (``guard.rollbacks``, ``guard.restores``, ``guard.steps_skipped``,
+    ...) so it shows up in `bench.py` telemetry blocks.
 """
 
 from __future__ import annotations
@@ -32,6 +50,8 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.resilience import inject as _inject
 from dear_pytorch_tpu.utils import checkpoint as ckpt
 
 logger = logging.getLogger("dear_pytorch_tpu")
@@ -63,6 +83,9 @@ class GuardedTrainer:
         max_keep: int = 3,
         on_rollback: Optional[Callable[[int, int], None]] = None,
         async_checkpoints: bool = False,
+        injector: Optional[Any] = None,
+        watchdog: Optional[Any] = None,
+        preemption: Optional[Any] = None,
     ):
         self.ts = ts
         self.directory = directory
@@ -72,6 +95,14 @@ class GuardedTrainer:
         self.max_recoveries = max_recoveries
         self.max_keep = max(int(max_keep), 1)
         self.on_rollback = on_rollback
+        # resilience hooks: an explicit injector wins; otherwise consult
+        # DEAR_FAULTS (None when unset — zero per-step overhead)
+        self._injector = (injector if injector is not None
+                          else _inject.FaultInjector.from_env())
+        self._watchdog = watchdog
+        self._preemption = preemption
+        self._preempt_handled = False
+        self._preempt_saved_step: Optional[int] = None
         self._template = None
         self._params_template = params_template
         self.recoveries = 0          # CONSECUTIVE rollbacks without a new
@@ -81,6 +112,13 @@ class GuardedTrainer:
         self._last_good_step = None
         self._last_check_t = None
         self._last_check_steps = 0
+        # startup GC: a previous crash may have left unrestorable Orbax
+        # atomic-write temp dirs. Skipped once this process has ever run
+        # an async save — a second trainer on the same directory must not
+        # sweep the first one's legitimately in-flight write (the
+        # post-save prune, which knows the in-flight step, covers GC then).
+        if not ckpt.has_async_checkpointer():
+            ckpt.prune_orphaned_tmp(directory)
 
     # -- internals -----------------------------------------------------------
 
@@ -110,9 +148,17 @@ class GuardedTrainer:
             # exception (e.g. a sidecar failure after AsyncCheckpointer
             # created its tmp dir), so its tmp dir must survive the prune.
             logger.error("guard: async checkpoint save failed: %s", exc)
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                tr.count("guard.checkpoint_failures")
+                tr.event("guard.checkpoint_failed", step=step,
+                         error=type(exc).__name__)
             self._prune(skip_tmp_step=step)
             return False
         self._last_good_step = step
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("guard.checkpoints")
         # async: the save's own atomic-write temp dir is legitimately alive
         # right now — pruning it would corrupt the in-flight write
         self._prune(
@@ -124,61 +170,10 @@ class GuardedTrainer:
     def _prune(self, skip_tmp_step: Optional[int] = None) -> None:
         """Keep the newest ``max_keep`` checkpoints (the guard only ever
         restores the latest; unbounded retention would eventually fill the
-        filesystem and crash the very trainer meant to survive faults)."""
-        if jax.process_index() != 0:
-            return
-        import os
-        import shutil
-
-        try:
-            names = os.listdir(self.directory)
-        except OSError:
-            return
-        steps = sorted(
-            int(name[len("step_"):])
-            for name in names
-            if name.startswith("step_") and name[len("step_"):].isdigit()
-        )
-        # crash-leftover Orbax atomic-write temp dirs
-        # (step_XXXXXXXXXX.orbax-checkpoint-tmp-N) are never restorable;
-        # delete them too, or a crash-restart loop fills the disk the
-        # retention policy exists to protect
-        for name in names:
-            if name.startswith("step_") and ".orbax-checkpoint-tmp" in name:
-                if (skip_tmp_step is not None
-                        and name.startswith(f"step_{skip_tmp_step:010d}.")):
-                    continue  # in-flight async write, not a crash leftover
-                shutil.rmtree(
-                    os.path.join(self.directory, name), ignore_errors=True
-                )
-        for s in steps[: -self.max_keep]:
-            shutil.rmtree(
-                os.path.join(self.directory, f"step_{s:010d}"),
-                ignore_errors=True,
-            )
-            try:
-                os.remove(
-                    os.path.join(self.directory, f"meta_{s:010d}.json")
-                )
-            except OSError:
-                pass
-        # orphan sidecars: meta written eagerly for a save that never
-        # committed (async failure / crash mid-write). Restores never read
-        # them (they go through committed dirs), but a crash-restart loop
-        # would accumulate them unboundedly.
-        committed = set(steps)
-        for name in names:
-            if not (name.startswith("meta_") and name.endswith(".json")):
-                continue
-            digits = name[len("meta_"):-len(".json")]
-            if not digits.isdigit():
-                continue
-            s = int(digits)
-            if s not in committed and s != skip_tmp_step:
-                try:
-                    os.remove(os.path.join(self.directory, name))
-                except OSError:
-                    pass
+        filesystem and crash the very trainer meant to survive faults).
+        The GC itself lives in `utils.checkpoint.prune_checkpoints`."""
+        ckpt.prune_checkpoints(self.directory, max_keep=self.max_keep,
+                               skip_tmp_step=skip_tmp_step)
 
     def _restore(self, cause: Optional[BaseException] = None):
         # an async save may still be in flight: its step dir only appears
@@ -193,21 +188,75 @@ class GuardedTrainer:
                 "guard: in-flight async checkpoint failed (%s); restoring "
                 "the newest committed checkpoint instead", exc,
             )
-        step = ckpt.latest_step(self.directory)
-        if step is None:
+        tr = _telemetry.get_tracer()
+        if jax.process_count() > 1:
+            # multi-host: every process must restore the SAME step. The
+            # verification/fallback walk below decides per process (a
+            # transient local fs error on one host would silently pick an
+            # older step there, desynchronizing replicas) — so restore the
+            # newest committed step deterministically and let a failure
+            # crash for whole-job relaunch, same policy as local step
+            # exceptions above.
+            step = ckpt.latest_step(self.directory)
+            if step is None:
+                raise DivergenceError(
+                    "training failed before the first checkpoint; nothing "
+                    "to restore (see the chained cause)"
+                ) from cause
+            state = ckpt.restore_checkpoint(
+                self.directory, self.ts, step=step,
+                template=self._template_state(),
+            )
+            self._template = None
+            logger.warning("guard: rolled back to checkpoint step %d", step)
+            return state, step
+        # single-host: walk newest -> oldest. Checksum verification skips
+        # corrupted payloads up front, and a restore that still fails
+        # (manifest-less async save torn mid-write, unreadable shard)
+        # falls back to the next older checkpoint instead of killing the
+        # run.
+        last_exc: Optional[BaseException] = cause
+        failed_steps: list[int] = []
+        step = ckpt.latest_valid_step(self.directory)
+        while step is not None:
+            try:
+                state = ckpt.restore_checkpoint(
+                    self.directory, self.ts, step=step,
+                    template=self._template_state(),
+                )
+            except Exception as exc:
+                logger.error(
+                    "guard: restore of checkpoint step %d failed (%s: %s); "
+                    "falling back to the previous checkpoint",
+                    step, type(exc).__name__, exc,
+                )
+                if tr.enabled:
+                    tr.count("guard.ckpt_fallbacks")
+                    tr.event("guard.ckpt_fallback", step=step,
+                             error=type(exc).__name__)
+                failed_steps.append(step)
+                last_exc = exc
+                step = ckpt.latest_valid_step(self.directory, below=step)
+                continue
+            # the template is only needed for structure/shardings during
+            # the restore; caching it would permanently double device memory
+            self._template = None
+            logger.warning("guard: rolled back to checkpoint step %d", step)
+            if tr.enabled:
+                tr.count("guard.restores")
+                tr.event("guard.restore", step=step)
+            return state, step
+        self._template = None
+        if not failed_steps:
             raise DivergenceError(
                 "training failed before the first checkpoint; nothing to "
                 "restore (see the chained cause; if it is a NaN loss, "
                 "lower the lr or reduce checkpoint_every)"
             ) from cause
-        state = ckpt.restore_checkpoint(
-            self.directory, self.ts, template=self._template_state()
-        )
-        # the template is only needed for structure/shardings during the
-        # restore; caching it would permanently double device memory
-        self._template = None
-        logger.warning("guard: rolled back to checkpoint step %d", step)
-        return state, step
+        raise DivergenceError(
+            f"no restorable checkpoint under {self.directory}: steps "
+            f"{failed_steps} failed to restore (newest failure chained)"
+        ) from last_exc
 
     def _check(self, metrics) -> bool:
         loss = float(jax.device_get(metrics["loss"]))
@@ -217,9 +266,17 @@ class GuardedTrainer:
 
     def step(self, state, batch):
         """One guarded step. May return a ROLLED-BACK state instead of the
-        stepped one when divergence or a device error is detected."""
+        stepped one when divergence or a device error is detected; a
+        handled preemption sets ``metrics["preempted"]`` (exit the loop)."""
         error: Optional[BaseException] = None
+        tr = _telemetry.get_tracer()
         try:
+            if self._injector is not None:
+                # faults fire INSIDE the guarded region: an injected
+                # exception takes the same recovery path a real one would
+                attempt = self.steps_seen + 1
+                self._injector.before_step(attempt, directory=self.directory)
+                batch = self._injector.poison_batch(attempt, batch)
             new_state, metrics = self.ts.step(state, batch)
             self.steps_seen += 1
             is_ckpt = self.steps_seen % self.checkpoint_every == 0
@@ -228,6 +285,8 @@ class GuardedTrainer:
             # unchecked state could immortalize NaN-poisoned parameters
             # (rollback would then restore the poison)
             healthy = not is_check or self._check(metrics)
+            if is_check and not healthy and tr.enabled:
+                tr.count("guard.nan_detected")
         except (FloatingPointError, RuntimeError) as exc:
             if jax.process_count() > 1:
                 # a LOCAL exception must not trigger a local rollback on a
@@ -239,6 +298,9 @@ class GuardedTrainer:
                 # makes the same decision).
                 raise
             logger.error("guard: step raised %s: %s", type(exc).__name__, exc)
+            if tr.enabled:
+                tr.count("guard.step_errors")
+                tr.event("guard.step_error", error=type(exc).__name__)
             healthy, new_state, metrics, error = False, None, None, exc
             is_check = is_ckpt = False
 
@@ -277,9 +339,38 @@ class GuardedTrainer:
                 ) from error
             restored, at_step = self._restore(cause=error)
             self._last_check_t = None  # restore time must not skew timing
+            if tr.enabled:
+                # counted only after the restore actually happened — the
+                # give-up/restore-failure paths above must not inflate the
+                # forensics counters
+                tr.count("guard.rollbacks")
+                tr.count("guard.steps_skipped")  # the bad batch is skipped
+                tr.event("guard.rollback", recoveries=self.recoveries,
+                         restored_step=at_step)
             if self.on_rollback is not None:
                 self.on_rollback(self.recoveries, at_step)
-            return restored, {"loss": float("nan"), "rolled_back": True}
+            if self._watchdog is not None:
+                # a completed recovery is liveness too
+                self._watchdog.beat(step=self.steps_seen,
+                                    last_good_step=at_step)
+            out = {"loss": float("nan"), "rolled_back": True}
+            if (self._preemption is not None and self._preemption.requested
+                    and not self._preempt_handled):
+                # SIGTERM during an unhealthy stretch: the restored state
+                # IS the newest durable checkpoint — nothing to save;
+                # signal the loop to exit now instead of burning the grace
+                # window replaying steps
+                self._preempt_handled = True
+                self._preempt_saved_step = at_step
+                logger.warning(
+                    "guard: preemption during rollback — durable step is "
+                    "the restored checkpoint %d", at_step,
+                )
+            if self._preempt_handled:
+                out["preempted"] = True
+                if self._preempt_saved_step is not None:
+                    out["preempt_checkpoint_step"] = self._preempt_saved_step
+            return restored, out
 
         if is_ckpt and self._save(new_state):
             # persisted healthy progress: a future rollback is a NEW
@@ -288,14 +379,123 @@ class GuardedTrainer:
             # resetting would let a diverge/rollback loop spin forever past
             # max_recoveries.
             self.recoveries = 0
+        if (self._preemption is not None and self._preemption.requested
+                and not self._preempt_handled):
+            saved = self._emergency_save(new_state, metrics)
+            self._preempt_handled = True
+            self._preempt_saved_step = saved
+            metrics = dict(metrics)
+            metrics["preempted"] = True
+            if saved is not None:
+                metrics["preempt_checkpoint_step"] = saved
+        elif self._preempt_handled:
+            # keep signalling until the loop actually exits
+            metrics = dict(metrics)
+            metrics["preempted"] = True
+            if self._preempt_saved_step is not None:
+                metrics["preempt_checkpoint_step"] = self._preempt_saved_step
+        if self._watchdog is not None:
+            self._watchdog.beat(step=self.steps_seen,
+                                last_good_step=self._last_good_step)
         return new_state, metrics
+
+    def _emergency_save(self, state, metrics) -> Optional[int]:
+        """Preemption checkpoint: synchronous, verified, at the current
+        step — the grace window is short, so no async handoff. Returns the
+        persisted step (None when the state could not be verified)."""
+        tr = _telemetry.get_tracer()
+        try:
+            healthy = self._check(metrics)
+        except Exception as exc:
+            logger.error("guard: preemption-save loss check failed: %s", exc)
+            healthy = False
+        if not healthy:
+            # the periodic-save invariant holds under preemption too: an
+            # unverified state must never become the newest checkpoint
+            logger.error(
+                "guard: preemption save SKIPPED (non-finite loss); newest "
+                "durable step stays %s", self._last_good_step,
+            )
+            return None
+        step = int(jax.device_get(state.step))
+        if step == self._last_good_step:
+            if not self.async_checkpoints:
+                logger.warning(
+                    "guard: preemption at step %d — already checkpointed",
+                    step,
+                )
+                if tr.enabled:
+                    # the preemption WAS handled with a durable checkpoint;
+                    # landing on a boundary must not vanish from telemetry
+                    tr.count("guard.preempt_saves")
+                    tr.event("guard.preempt_save", step=step)
+                return step
+            # the newest async save may still be an UNCOMMITTED enqueue:
+            # make it durable before claiming it as the resume point
+            try:
+                ckpt.wait_for_checkpoints()
+            except Exception as exc:
+                logger.error(
+                    "guard: in-flight async save failed during preemption "
+                    "(%s); writing a fresh synchronous checkpoint", exc,
+                )
+                # fall through to the fresh synchronous save below
+            else:
+                ckpt.write_manifest(self.directory, step)
+                logger.warning(
+                    "guard: preemption at step %d — async checkpoint "
+                    "committed and manifested", step,
+                )
+                if tr.enabled:
+                    tr.count("guard.preempt_saves")
+                    tr.event("guard.preempt_save", step=step)
+                return step
+        else:
+            try:
+                # don't race an in-flight async save
+                ckpt.wait_for_checkpoints()
+            except Exception as exc:
+                logger.error(
+                    "guard: in-flight async save failed during preemption "
+                    "(%s); writing a fresh synchronous checkpoint", exc,
+                )
+        try:
+            ckpt.save_checkpoint(self.directory, state, self.ts.plan,
+                                 asynchronous=False)
+        except Exception as exc:
+            # the grace window must still end in a clean preempted exit:
+            # a failed emergency save (disk full, shared-fs error) means
+            # the relaunch resumes from the previous durable step, which
+            # beats dying mid-save with the loop never told to stop
+            logger.error(
+                "guard: preemption save FAILED (%s: %s); newest durable "
+                "step stays %s", type(exc).__name__, exc,
+                self._last_good_step,
+            )
+            if tr.enabled:
+                tr.count("guard.checkpoint_failures")
+                tr.event("guard.checkpoint_failed", step=step,
+                         error=type(exc).__name__)
+            return None
+        self._last_good_step = step
+        self._prune()
+        logger.warning("guard: preemption checkpoint committed at step %d",
+                       step)
+        if tr.enabled:
+            tr.count("guard.preempt_saves")
+            tr.event("guard.preempt_save", step=step)
+        return step
 
     def finalize(self) -> None:
         """Wait for in-flight async checkpoint writes and surface their
         errors. Call when training ends (or use the trainer as a context
         manager) — otherwise a failed LAST async save is silently dropped
-        and resume finds an older step than `_last_good_step` claims."""
+        and resume finds an older step than `_last_good_step` claims.
+        Once committed, the newest async save's checksum manifest is
+        backfilled so a relaunch can verify it."""
         ckpt.wait_for_checkpoints()
+        if self.async_checkpoints and self._last_good_step is not None:
+            ckpt.write_manifest(self.directory, self._last_good_step)
 
     def __enter__(self):
         return self

@@ -7,13 +7,21 @@ framework adds on top of the DeAR schedule:
   - ZeRO-3 'fsdp' schedule (or any other --mode) via `build_train_step`,
   - crash-safe progress: `GuardedTrainer` with ASYNC checkpoints (NaN
     rollback, retention, divergence circuit breaker),
-  - resume-from-latest on startup,
+  - resume-from-latest on startup (crash-orphaned Orbax tmp dirs pruned
+    first),
+  - preemption safety: SIGTERM triggers a verified synchronous emergency
+    checkpoint at the next step boundary, then a clean exit — a relaunch
+    resumes from it (`resilience.PreemptionHandler`),
   - streaming host input via `runtime` pipelines,
   - structured JSONL metrics (`MetricsLogger`).
 
 Run (emulated):
   JAX_PLATFORMS=cpu DEAR_NUM_CPU_DEVICES=8 python examples/production.py \
       --steps 40 --workdir /tmp/run
+
+Chaos-test the recovery paths (docs/RESILIENCE.md):
+  DEAR_FAULTS="nan@6,exc@9" JAX_PLATFORMS=cpu DEAR_NUM_CPU_DEVICES=8 \
+      python examples/production.py --steps 40 --workdir /tmp/run
 """
 
 from __future__ import annotations
@@ -97,11 +105,17 @@ def main(argv=None) -> float:
     )
 
     ckpt_dir = os.path.join(args.workdir, "ckpts")
+    # (crash-orphaned Orbax tmp dirs are GC'd by GuardedTrainer.__init__)
     start = 0
-    if ckpt.latest_step(ckpt_dir) is not None:  # resume-from-latest
+    # resume-from-latest: pick the newest checkpoint passing checksum
+    # verification ONCE (the walk re-hashes payloads — don't pay it twice)
+    # and restore that explicit step; an all-corrupt dir starts fresh
+    # instead of crashing at startup
+    resume_step = ckpt.latest_valid_step(ckpt_dir)
+    if resume_step is not None:
         try:
             state = ckpt.restore_checkpoint(
-                ckpt_dir, ts, template=ts.init(params)
+                ckpt_dir, ts, step=resume_step, template=ts.init(params)
             )
         except ValueError:
             # layout changed since the checkpoint (different world size
@@ -115,19 +129,23 @@ def main(argv=None) -> float:
     else:
         state = ts.init(params)
 
+    from dear_pytorch_tpu.resilience import PreemptionHandler
+
     pipe = RP.NumpyPipeline(RP.mnist_spec(global_bs))
+    preempt = PreemptionHandler()
     guard = GuardedTrainer(
         ts, ckpt_dir, params,
         check_every=args.log_every,
         checkpoint_every=args.checkpoint_every,
         async_checkpoints=True,
+        preemption=preempt,
     )
     guard.steps_seen = start  # keep the cadence aligned after resume
     metrics_path = os.path.join(args.workdir, "metrics.jsonl")
     if start > 0 and os.path.exists(metrics_path):
         _truncate_metrics(metrics_path, start)
     last_loss = float("nan")
-    with guard, MetricsLogger(metrics_path, append=start > 0) as ml:
+    with preempt, guard, MetricsLogger(metrics_path, append=start > 0) as ml:
         try:
             # host-side step mirror: fetching state.step every iteration
             # would sync host and device per step, killing the async
@@ -135,6 +153,20 @@ def main(argv=None) -> float:
             cur = start
             while cur < args.steps:
                 state, m = guard.step(state, pipe.next())
+                if m.get("preempted"):
+                    # exit cleanly for relaunch; report what is actually
+                    # durable — the emergency save is skipped when the
+                    # state could not be verified (or the write failed)
+                    saved = m.get("preempt_checkpoint_step")
+                    ml.log(event="preempted", saved_step=saved)
+                    if saved is not None:
+                        print(f"preempted: emergency checkpoint at step "
+                              f"{saved}; exiting for relaunch")
+                    else:
+                        print("preempted: emergency save skipped/failed; "
+                              "relaunch resumes from the last periodic "
+                              "checkpoint")
+                    break
                 if m.get("rolled_back"):
                     cur = int(jax.device_get(state.step))
                     # replayed steps re-log their numbers (latest wins)

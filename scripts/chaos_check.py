@@ -1,0 +1,262 @@
+"""Chaos check: zero-loss-of-progress recovery under a multi-fault storm.
+
+One short guarded training run (CPU world=8 emulation, tiny MLP) absorbs —
+via `dear_pytorch_tpu.resilience` fault injection — a NaN-poisoned batch, a
+raised step exception, a corrupted newest checkpoint, and a SIGTERM
+preemption; then a simulated relaunch resumes and finishes. Asserts:
+
+  - every fault fired and every recovery landed (3 rollbacks, checksum
+    fallback past the corrupted checkpoint, a verified emergency save),
+  - the relaunch resumes EXACTLY at the emergency checkpoint's step
+    (zero loss of progress since the save),
+  - the chaos run's final loss is at least as converged as the fault-free
+    run one rollback window earlier (faults cost at most the replayed
+    window, never the run),
+  - a separate injected hang fires the step watchdog, whose report names
+    the last-good checkpointed step.
+
+CI entry: tests/test_resilience.py drives `run()` in-process under the
+tier-1 marker scheme. Standalone:
+
+  python scripts/chaos_check.py [--steps 20] [--workdir /tmp/chaos]
+
+Prints one JSON summary line; exit 0 iff every assertion held.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# -- tiny deterministic workload (mirrors the test suite's MLP scale) ---------
+
+
+def _mlp_params(key):
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(key)
+    return {
+        "dense": {"kernel": jax.random.normal(k1, (12, 32)) * 0.1,
+                  "bias": jnp.zeros((32,))},
+        "out": {"kernel": jax.random.normal(k2, (32, 4)) * 0.1,
+                "bias": jnp.zeros((4,))},
+    }
+
+
+def _loss_fn(params, batch):
+    import jax
+    import jax.numpy as jnp
+
+    x, y = batch
+    h = jnp.tanh(x @ params["dense"]["kernel"] + params["dense"]["bias"])
+    logits = h @ params["out"]["kernel"] + params["out"]["bias"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(logp * jax.nn.one_hot(y, 4), axis=-1))
+
+
+def _data(key, n=64):
+    """Learnable task: labels come from a fixed random teacher, so the
+    loss decreases monotonically enough for the rollback-window tolerance
+    comparison to be meaningful."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(key, (n, 12))
+    teacher = jax.random.normal(jax.random.PRNGKey(42), (12, 4))
+    return x, jnp.argmax(x @ teacher, axis=-1)
+
+
+def _check(cond, what, failures):
+    status = "ok" if cond else "FAIL"
+    print(f"chaos_check: [{status}] {what}")
+    if not cond:
+        failures.append(what)
+
+
+def run(steps: int = 20, checkpoint_every: int = 4,
+        workdir: str | None = None) -> dict:
+    """Run every chaos phase; returns the summary dict (key ``passed``)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from dear_pytorch_tpu.comm import backend
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.resilience import (
+        Fault, FaultInjector, PreemptionHandler, StepWatchdog,
+    )
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    backend.init()
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_")
+    failures: list[str] = []
+
+    # a live tracer so recovery counters are assertable; restored on exit
+    prev_tracer = T._tracer
+    tracer = T.Tracer([T.MemoryExporter()])
+    T.set_tracer(tracer)
+    try:
+        params = _mlp_params(jax.random.PRNGKey(0))
+        ts = build_train_step(
+            _loss_fn, params, threshold_mb=0.0008, donate=False,
+            optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        )
+        batches = [_data(jax.random.PRNGKey(100 + i))
+                   for i in range(4 * steps)]
+
+        def guarded(subdir, **kw):
+            kw.setdefault("check_every", 1)
+            kw.setdefault("checkpoint_every", checkpoint_every)
+            return GuardedTrainer(ts, os.path.join(workdir, subdir),
+                                  params, **kw)
+
+        # -- phase 1: fault-free reference ---------------------------------
+        tr = guarded("clean")
+        state = ts.init(params)
+        clean_losses = []
+        for b in batches[:steps]:
+            state, m = tr.step(state, b)
+            clean_losses.append(float(m["loss"]))
+
+        # -- phase 2: the storm --------------------------------------------
+        # attempts: nan@6 (rollback), exc@9 (rollback), ckpt_corrupt@13
+        # (newest checkpoint poisoned on disk), nan@14 (rollback must fall
+        # back PAST the corrupted checkpoint), preempt@17 (SIGTERM ->
+        # emergency save -> exit)
+        inj = FaultInjector([
+            Fault(kind="nan", step=6),
+            Fault(kind="exc", step=9),
+            Fault(kind="ckpt_corrupt", step=13),
+            Fault(kind="nan", step=14),
+            Fault(kind="preempt", step=17),
+        ])
+        chaos_dir = os.path.join(workdir, "chaos")
+        rollbacks = []
+        preempted_at = None
+        with PreemptionHandler() as pre:
+            tr = guarded("chaos", injector=inj, preemption=pre)
+            tr.on_rollback = lambda n, at: rollbacks.append((n, at))
+            state = ts.init(params)
+            for b in batches:
+                state, m = tr.step(state, b)
+                if m.get("preempted"):
+                    preempted_at = int(jax.device_get(state.step))
+                    break
+        counters = tracer.counters()
+        _check(inj.pending == 0, "every scheduled fault fired", failures)
+        _check(len(rollbacks) == 3,
+               f"3 rollbacks (nan, exc, nan-past-corruption); got "
+               f"{rollbacks}", failures)
+        _check(counters.get("ckpt.corrupt_detected", 0) >= 1,
+               "checksum manifest caught the corrupted checkpoint",
+               failures)
+        _check(len(rollbacks) == 3 and rollbacks[2][1] < rollbacks[1][1]
+               + 2 * checkpoint_every,
+               "third rollback fell back past the corrupted newest "
+               "checkpoint", failures)
+        _check(preempted_at is not None
+               and counters.get("guard.preempt_saves", 0) == 1,
+               "SIGTERM produced exactly one emergency save", failures)
+
+        # -- phase 3: simulated relaunch -----------------------------------
+        resumed_at = ckpt.latest_valid_step(chaos_dir)
+        _check(resumed_at == preempted_at,
+               f"relaunch resumes at the emergency checkpoint "
+               f"(step {preempted_at}): zero loss of progress", failures)
+        state = ckpt.restore_checkpoint(chaos_dir, ts,
+                                        template=ts.init(params))
+        tr = guarded("chaos")
+        tr.steps_seen = int(resumed_at or 0)
+        chaos_losses = []
+        bi = steps
+        while int(jax.device_get(state.step)) < steps:
+            state, m = tr.step(state, batches[bi])
+            bi += 1
+            if not m.get("rolled_back"):
+                chaos_losses.append(float(m["loss"]))
+        chaos_final = chaos_losses[-1]
+        # rollback-window tolerance: the chaos run reached the same update
+        # count, so it must be at least as converged as the clean run one
+        # checkpoint window earlier
+        ref = clean_losses[steps - 1 - checkpoint_every]
+        _check(np.isfinite(chaos_final) and chaos_final <= ref + 1e-6,
+               f"final chaos loss {chaos_final:.4f} within rollback-window "
+               f"tolerance of fault-free run (<= {ref:.4f})", failures)
+
+        # -- phase 4: watchdog on a hung step ------------------------------
+        inj = FaultInjector([Fault(kind="hang", step=3, arg=0.8)])
+        tr = guarded("hang", injector=inj, checkpoint_every=2)
+        state = ts.init(params)
+        for b in batches[:2]:
+            state, _ = tr.step(state, b)  # step-2 checkpoint
+        fired = []
+        with StepWatchdog(0.25, on_timeout=fired.append,
+                          poll_s=0.02) as dog:
+            tr._watchdog = dog
+            dog.beat(step=2, last_good_step=2)
+            state, _ = tr.step(state, batches[2])  # hangs 0.8s
+        _check(len(fired) == 1, "watchdog fired on the injected hang",
+               failures)
+        _check(bool(fired) and
+               fired[0].beat_info.get("last_good_step") == 2,
+               "watchdog report names the last-good step (2)", failures)
+
+        summary = {
+            "passed": not failures,
+            "steps": steps,
+            "clean_final_loss": round(clean_losses[-1], 4),
+            "chaos_final_loss": round(chaos_final, 4),
+            "tolerance_ref_loss": round(ref, 4),
+            "rollbacks": rollbacks,
+            "preempted_at": preempted_at,
+            "resumed_at": resumed_at,
+            "faults_injected": int(counters.get("faults.injected", 0)),
+            "guard_counters": {k: v for k, v in tracer.counters().items()
+                               if k.startswith(("guard.", "ckpt.",
+                                                "faults.", "watchdog."))},
+            "failures": failures,
+        }
+        return summary
+    finally:
+        T.set_tracer(prev_tracer)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="multi-fault recovery check (see module docstring)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--checkpoint-every", type=int, default=4)
+    ap.add_argument("--workdir", type=str, default=None)
+    args = ap.parse_args(argv)
+
+    summary = run(steps=args.steps, checkpoint_every=args.checkpoint_every,
+                  workdir=args.workdir)
+    print(json.dumps(summary))
+    print("CHAOS CHECK " + ("PASSED" if summary["passed"] else "FAILED"))
+    return 0 if summary["passed"] else 1
+
+
+if __name__ == "__main__":
+    # standalone: emulate the 8-device CPU world the test suite uses
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DEAR_COMPILATION_CACHE_DIR", "off")
+    import jax
+
+    from dear_pytorch_tpu import _jax_compat
+
+    jax.config.update("jax_platforms", "cpu")
+    _jax_compat.set_cpu_device_count(
+        int(os.environ.get("DEAR_NUM_CPU_DEVICES", "8")), scrub_env=True)
+    sys.exit(main())
