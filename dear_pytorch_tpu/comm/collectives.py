@@ -259,3 +259,19 @@ def allreduce(x, average: bool = True):
     gathered = multihost_utils.process_allgather(jnp.asarray(x))
     total = gathered.sum(axis=0)
     return total / jax.process_count() if average else total
+
+
+def host_allgather(x):
+    """Host-level allgather: every process's value stacked on a new
+    leading axis of length ``process_count`` (index-ordered). The
+    single-process fast path never touches `jax.distributed`. This is the
+    host collective the resilience cluster layer
+    (`resilience.cluster.AllgatherTransport`) builds its consensus
+    exchanges on."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray(x)[None, ...]
+    from jax.experimental import multihost_utils  # pragma: no cover
+
+    return np.asarray(multihost_utils.process_allgather(jnp.asarray(x)))

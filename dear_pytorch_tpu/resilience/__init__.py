@@ -16,12 +16,25 @@ This package makes every recovery path in the framework first-class,
                   the next step boundary (`GuardedTrainer` polls it).
   - `retry`     — bounded deterministic retry/backoff for transient
                   host-side I/O (checkpoint sidecars, pipeline fetches).
+  - `cluster`   — host-level consensus for multi-process recovery:
+                  consensus checkpoint restore, the any-rank-unhealthy
+                  health exchange (peer-aware failure + preemption
+                  propagation), the cross-replica desync sentinel, and
+                  bounded-timeout dead-peer detection.
 
 Recovery itself stays in `utils.guard.GuardedTrainer` (rollback, checksum
 fallback, retention) and `utils.checkpoint` (manifests, pruning); this
 package supplies the machinery around it. See docs/RESILIENCE.md.
 """
 
+from dear_pytorch_tpu.resilience.cluster import (  # noqa: F401
+    ClusterCoordinator,
+    ClusterError,
+    DesyncError,
+    HealthVerdict,
+    LocalTransport,
+    PeerTimeout,
+)
 from dear_pytorch_tpu.resilience.inject import (  # noqa: F401
     FAULT_ENV,
     Fault,

@@ -25,6 +25,14 @@ Enable from the environment — ``DEAR_FAULTS="nan@6,exc@9,hang@12:0.5,
 ckpt_corrupt@15,preempt@18"`` — or construct a `FaultInjector` in code and
 hand it to `GuardedTrainer`. Telemetry (when enabled): counter
 ``faults.injected`` plus one ``fault.injected`` event per firing.
+
+**Rank targeting** (multi-host chaos): suffix a spec with ``:rN`` to fire
+the fault on process ``N`` only — ``DEAR_FAULTS="nan@6:r1,exc@9:r0"``
+NaN-poisons rank 1's step-6 batch and raises on rank 0 at step 9; other
+ranks *skip* the fault (recorded in ``FaultInjector.skipped``, never
+``fired``). Arg and rank compose: ``hang@12:0.5:r1``. This is what makes
+the coordinated recovery paths (`resilience.cluster`) testable: one rank
+fails, every rank must recover identically.
 """
 
 from __future__ import annotations
@@ -60,11 +68,13 @@ class InjectedFault(RuntimeError):
 class Fault:
     """One scheduled fault: ``kind`` fires at trainer step ``step``
     (1-based, counting attempted steps); ``arg`` is kind-specific
-    (``hang`` seconds; unused otherwise)."""
+    (``hang`` seconds; unused otherwise); ``rank`` restricts the fault to
+    one process index (None = every rank)."""
 
     kind: str
     step: int
     arg: float = 0.0
+    rank: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -74,10 +84,17 @@ class Fault:
             )
         if self.step < 1:
             raise ValueError(f"fault step must be >= 1, got {self.step}")
+        if self.rank is not None and self.rank < 0:
+            raise ValueError(
+                f"fault rank must be a process index >= 0, got {self.rank}")
+
+
+_SPEC_FORMAT = ("use kind@step[:arg][:rRANK], e.g. 'nan@6', 'hang@12:0.5' "
+                "or rank-targeted 'nan@6:r1,exc@9:r0'")
 
 
 def parse_faults(spec: str) -> Tuple[Fault, ...]:
-    """Parse a ``kind@step[:arg]`` comma list into `Fault`s."""
+    """Parse a ``kind@step[:arg][:rRANK]`` comma list into `Fault`s."""
     out: List[Fault] = []
     for part in spec.split(","):
         part = part.strip()
@@ -86,24 +103,47 @@ def parse_faults(spec: str) -> Tuple[Fault, ...]:
         kind, sep, rest = part.partition("@")
         if not sep:
             raise ValueError(
-                f"{FAULT_ENV}: bad fault spec {part!r} "
-                "(use kind@step[:arg], e.g. 'nan@6' or 'hang@12:0.5')"
+                f"{FAULT_ENV}: bad fault spec {part!r} ({_SPEC_FORMAT})"
             )
-        step_s, _, arg_s = rest.partition(":")
+        step_s, *toks = rest.split(":")
         try:
             step = int(step_s)
-            arg = float(arg_s) if arg_s else 0.0
         except ValueError as exc:
             raise ValueError(
                 f"{FAULT_ENV}: bad fault spec {part!r}: {exc}"
             ) from None
-        out.append(Fault(kind=kind, step=step, arg=arg))
+        arg, rank = 0.0, None
+        for tok in toks:
+            if tok[:1] in ("r", "R"):
+                if not tok[1:].isdigit():
+                    raise ValueError(
+                        f"{FAULT_ENV}: bad rank spec {tok!r} in {part!r}: "
+                        f"a rank is 'r' + a process index ({_SPEC_FORMAT})"
+                    )
+                if rank is not None:
+                    raise ValueError(
+                        f"{FAULT_ENV}: duplicate rank spec in {part!r} "
+                        f"({_SPEC_FORMAT})"
+                    )
+                rank = int(tok[1:])
+                continue
+            try:
+                arg = float(tok)
+            except ValueError:
+                raise ValueError(
+                    f"{FAULT_ENV}: bad fault spec {part!r}: {tok!r} is "
+                    f"neither a float arg nor an rRANK ({_SPEC_FORMAT})"
+                ) from None
+        out.append(Fault(kind=kind, step=step, arg=arg, rank=rank))
     return tuple(out)
 
 
 def poison_pytree(tree):
-    """Copy of ``tree`` with the first floating-point leaf's first element
-    set to NaN — real NaN gradients through the real backward pass."""
+    """Copy of ``tree`` with every element of the first floating-point
+    leaf set to NaN — real NaN gradients through the real backward pass.
+    The whole leaf (not one element) is poisoned so the fault lands no
+    matter which *shard* of a globally sharded batch this process
+    materializes — the contract rank-targeted ``nan`` faults rely on."""
     import jax
     import jax.numpy as jnp
 
@@ -113,13 +153,9 @@ def poison_pytree(tree):
         if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
             continue
         if isinstance(leaf, np.ndarray):
-            leaf = leaf.copy()
-            leaf.reshape(-1)[0] = np.nan
+            leaf = np.full_like(leaf, np.nan)
         else:
-            shape = leaf.shape
-            leaf = jnp.reshape(
-                jnp.reshape(leaf, (-1,)).at[0].set(jnp.nan), shape
-            )
+            leaf = jnp.full_like(leaf, jnp.nan)
         leaves[i] = leaf
         break
     else:
@@ -162,18 +198,33 @@ class FaultInjector:
       - ``poison_batch(step, batch)`` — applies a due ``nan`` fault.
 
     Every fault fires exactly once; ``fired`` records the history and
-    ``pending`` what is still scheduled.
+    ``pending`` what is still scheduled. Rank-targeted faults
+    (``Fault(rank=N)`` / ``kind@step:rN``) fire only when ``own_rank``
+    (default: ``jax.process_index()``, resolved lazily so construction
+    can precede distributed bootstrap) matches; on other ranks they are
+    consumed into ``skipped`` at their step, so schedules drain
+    identically on every process.
     """
 
     def __init__(self, faults: Sequence[Fault] = (), *,
-                 kill: bool = True):
+                 kill: bool = True, own_rank: Optional[int] = None):
         self._by_step: Dict[int, List[Fault]] = {}
         for f in faults:
             self._by_step.setdefault(int(f.step), []).append(f)
         self.fired: List[Fault] = []
+        self.skipped: List[Fault] = []  # rank-targeted, not this rank
+        self._own_rank = own_rank
         # kill=False turns ``preempt`` into a no-op marker (tests that
         # assert scheduling without installing a SIGTERM handler)
         self._kill = kill
+
+    @property
+    def own_rank(self) -> int:
+        if self._own_rank is None:
+            import jax
+
+            self._own_rank = jax.process_index()
+        return self._own_rank
 
     @classmethod
     def from_env(cls, env: Optional[str] = None) -> Optional["FaultInjector"]:
@@ -206,21 +257,35 @@ class FaultInjector:
         due = self._by_step.get(int(step))
         if not due:
             return []
-        taken = [f for f in due if f.kind in kinds]
-        if taken:
-            remaining = [f for f in due if f.kind not in kinds]
-            if remaining:
-                self._by_step[int(step)] = remaining
+        matched = [f for f in due if f.kind in kinds]
+        if not matched:
+            return []
+        remaining = [f for f in due if f.kind not in kinds]
+        if remaining:
+            self._by_step[int(step)] = remaining
+        else:
+            del self._by_step[int(step)]
+        # rank-targeted faults are consumed everywhere but fire only on
+        # their rank — every process's schedule drains at the same steps
+        taken, skipped = [], []
+        for f in matched:
+            if f.rank is None or f.rank == self.own_rank:
+                taken.append(f)
             else:
-                del self._by_step[int(step)]
-            self.fired.extend(taken)
-            tr = _telemetry.get_tracer()
-            for f in taken:
-                logger.warning("inject: firing %s at step %d", f.kind, step)
-                if tr.enabled:
-                    tr.count("faults.injected")
-                    tr.event("fault.injected", kind=f.kind, step=f.step,
-                             arg=f.arg)
+                skipped.append(f)
+        self.fired.extend(taken)
+        self.skipped.extend(skipped)
+        tr = _telemetry.get_tracer()
+        for f in skipped:
+            logger.info("inject: %s at step %d targets rank %d "
+                        "(this is rank %d); skipped",
+                        f.kind, step, f.rank, self.own_rank)
+        for f in taken:
+            logger.warning("inject: firing %s at step %d", f.kind, step)
+            if tr.enabled:
+                tr.count("faults.injected")
+                tr.event("fault.injected", kind=f.kind, step=f.step,
+                         arg=f.arg)
         return taken
 
     def before_step(self, step: int, *,

@@ -12,9 +12,13 @@ poisoned input would fail again).
 Backoff is deterministic (exponential, no jitter): recovery paths must be
 reproducible under test, and nothing here contends with other processes on
 a shared resource at retry granularity. Telemetry (when enabled): counters
-``retry.calls`` / ``retry.retries`` / ``retry.giveups`` and one
-``retry.attempt_failed`` event per absorbed failure, so retries surface in
-the telemetry JSON blocks instead of vanishing into a log.
+``retry.calls`` (guarded call sites entered), ``retry.attempts`` (every
+attempt, first tries included — ``attempts - calls`` is the absorbed-
+failure volume a dashboard alerts on), ``retry.retries`` (re-attempts
+after an absorbed failure) and ``retry.giveups`` (every attempt failed),
+plus one ``retry.attempt_failed`` event per absorbed failure — so retries
+surface in the telemetry JSON blocks (docs/OBSERVABILITY.md) instead of
+vanishing into a log.
 """
 
 from __future__ import annotations
@@ -65,6 +69,8 @@ def retry_call(
     last: Optional[BaseException] = None
     for attempt in range(1, attempts + 1):
         try:
+            if tr.enabled:
+                tr.count("retry.attempts")
             return fn(*args, **kwargs)
         except retry_on as exc:
             last = exc
@@ -77,7 +83,9 @@ def retry_call(
             )
             if tr.enabled:
                 tr.count("retry.retries")
-                tr.event("retry.attempt_failed", name=label, attempt=attempt,
+                # the guarded call's label travels as `call` (`name` is
+                # the event's own name in the Tracer.event signature)
+                tr.event("retry.attempt_failed", call=label, attempt=attempt,
                          error=type(exc).__name__, delay_s=delay)
             if on_retry is not None:
                 on_retry(attempt, exc)

@@ -47,6 +47,25 @@ class WatchdogReport(NamedTuple):
     deadline_s: float
     beat_info: dict          # kwargs of the last beat (step, last_good_step)
     live_spans: list         # open tracer spans at firing time
+    process_index: int = 0   # which rank's dump this is (multi-host logs)
+    faults: str = ""         # active DEAR_FAULTS schedule, if any
+
+
+def _process_index() -> int:
+    """This process's rank for dump headers; 0 when jax is unusable (the
+    watchdog must never crash while reporting a crash)."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _active_faults() -> str:
+    from dear_pytorch_tpu.resilience.inject import FAULT_ENV
+
+    return os.environ.get(FAULT_ENV, "").strip()
 
 
 class StepWatchdog:
@@ -91,6 +110,7 @@ class StepWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.fired = 0
+        self.kicked = 0
         self.last_report: Optional[WatchdogReport] = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -143,34 +163,52 @@ class StepWatchdog:
                 continue
             self._fire(waited, info)
 
-    def _fire(self, waited: float, info: dict) -> None:
+    def _make_report(self, waited: float, info: dict) -> WatchdogReport:
         tr = _telemetry.get_tracer()
         live = tr.live_spans() if tr.enabled else []
-        report = WatchdogReport(
+        return WatchdogReport(
             name=self.name, waited_s=waited, deadline_s=self.deadline_s,
             beat_info=info, live_spans=live,
+            process_index=_process_index(), faults=_active_faults(),
         )
+
+    def _dump(self, report: WatchdogReport, cause: str) -> None:
+        """The forensic dump, correlatable across ranks: the header names
+        this process's rank and the active fault schedule, so interleaved
+        multi-host hang logs can be lined up by rank and replayed."""
+        if not self._dump_stacks:
+            return
+        sys.stderr.write(
+            f"\n+++ {report.name} [rank {report.process_index}] "
+            f"faults={report.faults or '-'}: {cause} — thread stacks "
+            "follow +++\n"
+        )
+        faulthandler.dump_traceback(file=sys.stderr)
+        sys.stderr.flush()
+
+    def _fire(self, waited: float, info: dict) -> None:
+        tr = _telemetry.get_tracer()
+        report = self._make_report(waited, info)
+        live = report.live_spans
         self.fired += 1
         self.last_report = report
         if tr.enabled:
             tr.count("watchdog.timeouts")
             tr.event("watchdog.timeout", waited_s=round(waited, 3),
                      deadline_s=self.deadline_s,
+                     rank=report.process_index,
                      open_spans=";".join(s["name"] for s in live)[:200],
                      **{k: v for k, v in info.items()
                         if isinstance(v, (int, float, str))})
         logger.critical(
-            "%s: no heartbeat for %.1fs (deadline %.1fs); last beat: %s; "
-            "open telemetry spans: %s",
-            self.name, waited, self.deadline_s, info or "never detailed",
+            "%s [rank %d]: no heartbeat for %.1fs (deadline %.1fs); last "
+            "beat: %s; open telemetry spans: %s; active faults: %s",
+            self.name, report.process_index, waited, self.deadline_s,
+            info or "never detailed",
             [s["name"] for s in live] or "none (telemetry off?)",
+            report.faults or "none",
         )
-        if self._dump_stacks:
-            sys.stderr.write(
-                f"\n+++ {self.name}: hung step — thread stacks follow +++\n"
-            )
-            faulthandler.dump_traceback(file=sys.stderr)
-            sys.stderr.flush()
+        self._dump(report, "hung step")
         # one hang fires once; a later beat re-arms
         with self._lock:
             self._last_beat = None
@@ -183,3 +221,31 @@ class StepWatchdog:
                 self.name, last_good if last_good is not None else "<none>",
             )
             os._exit(self._exit_code)
+
+    def kick(self, reason: str, **info) -> WatchdogReport:
+        """Produce the forensic dump IMMEDIATELY, without waiting for the
+        heartbeat deadline and without the default abort — the cluster
+        layer calls this when a bounded consensus exchange times out
+        (dead-peer detection), just before degrading to a crash, so the
+        hang evidence (open spans, every thread's stack, rank, fault
+        schedule) lands in the log first. Returns the report; never
+        exits."""
+        with self._lock:
+            merged = {**self._beat_info, **info}
+        report = self._make_report(0.0, merged)
+        self.kicked += 1
+        self.last_report = report
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("watchdog.kicks")
+            tr.event("watchdog.kick", reason=reason,
+                     rank=report.process_index,
+                     **{k: v for k, v in merged.items()
+                        if isinstance(v, (int, float, str))})
+        logger.critical(
+            "%s [rank %d]: kicked (%s); last beat: %s; active faults: %s",
+            self.name, report.process_index, reason,
+            merged or "never detailed", report.faults or "none",
+        )
+        self._dump(report, reason)
+        return report

@@ -24,6 +24,19 @@ Durability hardening (the resilience layer's contract):
     from listings, never deleted.
   - sidecar I/O goes through `resilience.retry` (transient shared-fs
     failures must not kill the save path the guard depends on).
+
+Storage models: the default is a SHARED checkpoint directory (GCS/NFS —
+process 0 owns sidecars and retention, orbax writes shards
+cooperatively). ``DEAR_CKPT_SHARED=0`` declares **per-host storage**
+(local SSD per host): every process owns its directory outright —
+sidecars, manifests and retention run on every rank, and saves use a
+dependency-light local format (raw-bytes blob + JSON index, atomic
+rename commit) instead of orbax's cooperative writer, whose numpy path
+hardcodes a process-0 writer. Per-host views can then genuinely diverge
+(one host's disk corrupts a step the others kept) — which is exactly
+what the cluster layer's consensus restore
+(`resilience.cluster.ClusterCoordinator.consensus_restore_step` over
+`valid_steps`) reconciles.
 """
 
 from __future__ import annotations
@@ -101,6 +114,116 @@ def _ckpt_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:010d}")
 
 
+# ---------------------------------------------------------------------------
+# Per-host (non-shared) checkpoint storage
+# ---------------------------------------------------------------------------
+
+SHARED_ENV = "DEAR_CKPT_SHARED"
+
+#: Filenames of the local (per-host) checkpoint format.
+_LOCAL_INDEX = "dear_local.json"
+_LOCAL_BLOB = "dear_local.bin"
+_LOCAL_TMP_MARK = ".local-tmp"
+
+
+def per_host_storage() -> bool:
+    """True when ``DEAR_CKPT_SHARED=0`` declares per-host checkpoint
+    directories (local SSD per host, not GCS/NFS): every process owns its
+    directory outright, so sidecar/manifest/retention I/O runs on every
+    rank and multi-process saves use the local format below."""
+    return os.environ.get(SHARED_ENV, "").strip().lower() in (
+        "0", "false", "no")
+
+
+def _owns_directory_io() -> bool:
+    """Which process performs sidecar/retention I/O in a checkpoint
+    directory: rank 0 on shared storage (one writer), every rank when the
+    storage is per-host."""
+    return jax.process_index() == 0 or per_host_storage()
+
+
+def local_save(step_dir: str, state) -> None:
+    """Write ``state`` (any pytree of arrays/scalars) in the local
+    per-host format: one raw-bytes blob plus a JSON index of
+    (dtype, shape, offset) per leaf, committed by atomic directory
+    rename. Dependency-light on purpose — orbax's replicated-numpy writer
+    hardcodes a process-0 writer, which per-host storage must not have —
+    and restores only ever go through a structure *template*, so no
+    treedef needs serializing. Handles every jax dtype (bf16 included):
+    leaves travel as raw bytes. Overwrites an existing step dir: replay
+    after a consensus rollback legitimately re-reaches a step whose
+    corrupted dir is still on disk, and that stale dir must not fail the
+    fresh save (os.rename onto a non-empty dir raises)."""
+    import shutil
+
+    import numpy as np
+
+    host = [np.asarray(jax.device_get(x))
+            for x in jax.tree_util.tree_leaves(state)]
+    tmp = step_dir + _LOCAL_TMP_MARK
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)  # crash leftover from an interrupted save
+    os.makedirs(tmp, exist_ok=True)
+    index, off = [], 0
+    with open(os.path.join(tmp, _LOCAL_BLOB), "wb") as f:
+        for arr in host:
+            raw = arr.tobytes()
+            index.append({"dtype": str(arr.dtype),
+                          "shape": list(arr.shape), "offset": off,
+                          "nbytes": len(raw)})
+            f.write(raw)
+            off += len(raw)
+    with open(os.path.join(tmp, _LOCAL_INDEX), "w") as f:
+        json.dump({"leaves": index}, f)
+    if os.path.isdir(step_dir):
+        # stale dir from before a rollback: replace via rename-ASIDE, not
+        # rmtree-then-rename — deleting first would open a crash window
+        # (seconds for large payloads) in which the only committed copy of
+        # this step is gone; two renames narrow it to microseconds
+        aside = step_dir + _LOCAL_TMP_MARK + "-old"
+        if os.path.isdir(aside):
+            shutil.rmtree(aside)
+        os.rename(step_dir, aside)
+        os.rename(tmp, step_dir)  # the committed step dir appears atomically
+        shutil.rmtree(aside, ignore_errors=True)
+    else:
+        os.rename(tmp, step_dir)  # the committed step dir appears atomically
+
+
+def is_local_checkpoint(step_dir: str) -> bool:
+    return os.path.exists(os.path.join(step_dir, _LOCAL_INDEX))
+
+
+def local_restore(step_dir: str, template):
+    """Restore a `local_save` checkpoint into the structure AND device
+    placement of ``template`` (each leaf is `jax.device_put` onto the
+    template leaf's sharding)."""
+    import numpy as np
+
+    with open(os.path.join(step_dir, _LOCAL_INDEX)) as f:
+        index = json.load(f)["leaves"]
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    if len(t_leaves) != len(index):
+        raise ValueError(
+            f"local checkpoint under {step_dir} has {len(index)} leaves "
+            f"but the template has {len(t_leaves)} — restoring into a "
+            "different model/optimizer structure"
+        )
+    with open(os.path.join(step_dir, _LOCAL_BLOB), "rb") as f:
+        blob = f.read()
+    out = []
+    for ent, t in zip(index, t_leaves):
+        n = _prod(ent["shape"]) if ent["shape"] else 1
+        arr = np.frombuffer(
+            blob, dtype=np.dtype(ent["dtype"]), count=n,
+            offset=ent["offset"],
+        ).reshape(ent["shape"])
+        if isinstance(t, jax.Array):
+            arr = jax.device_put(arr, t.sharding)
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 _async_ckptr = None
 
 
@@ -131,23 +254,35 @@ def save_checkpoint(
 
     step = int(jax.device_get(state.step))
     path = _ckpt_dir(directory, step)
+    if jax.process_count() > 1 and per_host_storage():
+        # per-host storage: this process owns the whole directory, so it
+        # writes the whole state — through the local format (orbax's
+        # replicated-numpy writer hardcodes a process-0 writer). Always
+        # synchronous: a per-host save has no cooperative commit to
+        # overlap, and the guard's durability contract stays simple.
+        if asynchronous:
+            logger.warning(
+                "checkpoint: per-host storage saves synchronously "
+                "(asynchronous=True ignored)")
+        local_save(path, state)
     # Hand Orbax the live (possibly sharded) arrays: each process writes its
     # addressable shards. A jax.device_get here would fail on non-addressable
     # shards in multi-host runs and replicate everything through host RAM.
-    if asynchronous:
+    elif asynchronous:
         _get_async_checkpointer().save(os.path.abspath(path), state)
     else:
         ocp.PyTreeCheckpointer().save(os.path.abspath(path), state)
-    if jax.process_index() == 0:  # one writer for the sidecar on shared fs
+    if _owns_directory_io():  # one writer per DIRECTORY for the sidecar
         # written eagerly even for async saves: restore only ever reaches a
         # sidecar through a COMMITTED step dir (latest_step scans dirs), so
         # a crash mid-write leaves an orphan sidecar, never a broken restore
         meta = {"plan": plan_fingerprint(plan), "step": step,
                 "plan_desc": plan_desc(plan)}
-        # checksum manifest over the committed files: only the sync path has
-        # them on disk here; async saves backfill via `write_manifest` after
-        # `wait_for_checkpoints` (manifest=None verifies vacuously)
-        meta["manifest"] = None if asynchronous else _build_manifest(path)
+        # checksum manifest over the committed files: only the sync paths
+        # have them on disk here; async saves backfill via `write_manifest`
+        # after `wait_for_checkpoints` (manifest=None verifies vacuously)
+        has_files = not asynchronous or is_local_checkpoint(path)
+        meta["manifest"] = _build_manifest(path) if has_files else None
         _write_sidecar(directory, step, meta)
     return path
 
@@ -196,7 +331,7 @@ def write_manifest(directory: str, step: int) -> bool:
     """Backfill the checksum manifest for a COMMITTED async save (call
     after `wait_for_checkpoints`). Returns False when the step dir or its
     sidecar is missing (the async write failed) — nothing to manifest."""
-    if jax.process_index() != 0:
+    if not _owns_directory_io():
         return False
     step_dir = _ckpt_dir(directory, step)
     meta_path = os.path.join(directory, f"meta_{step:010d}.json")
@@ -246,45 +381,67 @@ def verify_checkpoint(directory: str, step: int) -> bool:
 _corrupt_reported: set = set()
 
 
-def latest_valid_step(directory: str, *,
-                      below: Optional[int] = None) -> Optional[int]:
-    """Newest step whose checkpoint verifies; walks past corrupted ones
-    (logged + counted ONCE per corrupted step as ``ckpt.corrupt_detected``)
-    instead of handing a poisoned payload to restore. ``below`` restricts
-    to strictly older steps (the guard's fallback walk)."""
+def _report_corrupt(directory: str, step: int) -> None:
+    """Log + count one corruption event per (directory, step, sidecar
+    mtime) — the mtime distinguishes a RE-written checkpoint at a reused
+    step number (post-rollback replay) from an already-reported event."""
     from dear_pytorch_tpu.observability import tracer as _telemetry
 
+    meta_path = os.path.join(directory, f"meta_{step:010d}.json")
+    try:
+        stamp = int(os.path.getmtime(meta_path))
+    except OSError:
+        stamp = 0
+    key = (os.path.abspath(directory), step, stamp)
+    if key in _corrupt_reported:
+        return
+    _corrupt_reported.add(key)
+    logger.error(
+        "checkpoint: step %d failed checksum verification; "
+        "falling back to the previous checkpoint", step,
+    )
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("ckpt.corrupt_detected")
+        tr.event("ckpt.corrupt", step=step)
+
+
+def valid_steps(directory: str, *, below: Optional[int] = None,
+                limit: Optional[int] = None) -> list[int]:
+    """Every committed step whose checkpoint passes checksum verification,
+    newest first (at most ``limit`` of them; ``below`` restricts to
+    strictly older steps). Corrupted steps are walked past, logged +
+    counted ONCE per corrupted step as ``ckpt.corrupt_detected``. This is
+    both the guard's fallback walk (via `latest_valid_step`) and one
+    host's *local view* for the cluster layer's consensus restore
+    (`resilience.cluster.ClusterCoordinator.consensus_restore_step`):
+    every process contributes its verified steps and the pod restores the
+    newest step valid everywhere."""
     if not os.path.isdir(directory):
-        return None
+        return []
     steps = sorted((
         int(name[len("step_"):])
         for name in os.listdir(directory)
         if name.startswith("step_") and name[len("step_"):].isdigit()
         and (below is None or int(name[len("step_"):]) < below)
     ), reverse=True)
+    out: list[int] = []
     for step in steps:
         if verify_checkpoint(directory, step):
-            return step
-        # the sidecar mtime distinguishes a RE-written checkpoint at a
-        # reused step number (post-rollback replay) from the same
-        # already-reported corruption event
-        meta_path = os.path.join(directory, f"meta_{step:010d}.json")
-        try:
-            stamp = int(os.path.getmtime(meta_path))
-        except OSError:
-            stamp = 0
-        key = (os.path.abspath(directory), step, stamp)
-        if key not in _corrupt_reported:
-            _corrupt_reported.add(key)
-            logger.error(
-                "checkpoint: step %d failed checksum verification; "
-                "falling back to the previous checkpoint", step,
-            )
-            tr = _telemetry.get_tracer()
-            if tr.enabled:
-                tr.count("ckpt.corrupt_detected")
-                tr.event("ckpt.corrupt", step=step)
-    return None
+            out.append(step)
+            if limit is not None and len(out) >= limit:
+                break
+        else:
+            _report_corrupt(directory, step)
+    return out
+
+
+def latest_valid_step(directory: str, *,
+                      below: Optional[int] = None) -> Optional[int]:
+    """Newest step whose checkpoint verifies (the corruption-fallback
+    walk): `valid_steps` stopped at the first hit."""
+    steps = valid_steps(directory, below=below, limit=1)
+    return steps[0] if steps else None
 
 
 def wait_for_checkpoints() -> None:
@@ -336,11 +493,12 @@ def prune_orphaned_tmp(directory: str) -> list[str]:
     was removed."""
     import shutil
 
-    if jax.process_index() != 0 or not os.path.isdir(directory):
+    if not _owns_directory_io() or not os.path.isdir(directory):
         return []
     removed = []
     for name in sorted(os.listdir(directory)):
-        if name.startswith("step_") and ".orbax-checkpoint-tmp" in name:
+        if name.startswith("step_") and (
+                ".orbax-checkpoint-tmp" in name or _LOCAL_TMP_MARK in name):
             shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
             removed.append(name)
     if removed:
@@ -363,7 +521,7 @@ def prune_checkpoints(
     written sidecar) from the sweep."""
     import shutil
 
-    if jax.process_index() != 0:
+    if not _owns_directory_io():
         return
     max_keep = max(int(max_keep), 1)
     try:
@@ -375,11 +533,12 @@ def prune_checkpoints(
         for name in names
         if name.startswith("step_") and name[len("step_"):].isdigit()
     )
-    # crash-leftover Orbax atomic-write temp dirs are never restorable;
-    # delete them too, or a crash-restart loop fills the disk the
-    # retention policy exists to protect
+    # crash-leftover atomic-write temp dirs (orbax or the local per-host
+    # format) are never restorable; delete them too, or a crash-restart
+    # loop fills the disk the retention policy exists to protect
     for name in names:
-        if name.startswith("step_") and ".orbax-checkpoint-tmp" in name:
+        if name.startswith("step_") and (
+                ".orbax-checkpoint-tmp" in name or _LOCAL_TMP_MARK in name):
             if (skip_tmp_step is not None
                     and name.startswith(f"step_{skip_tmp_step:010d}.")):
                 continue  # in-flight async write, not a crash leftover
@@ -439,8 +598,6 @@ def restore_checkpoint(
 
     Raises if the checkpoint was written under a different fusion plan.
     """
-    import orbax.checkpoint as ocp
-
     if step is None:
         step = _default_step(directory)
         if step is None:
@@ -459,6 +616,13 @@ def restore_checkpoint(
         )
     if template is None:
         raise ValueError("pass template=ts.init(...) output for shardings")
+    if is_local_checkpoint(_ckpt_dir(directory, step)):
+        # per-host local format: bytes -> template structure + shardings
+        # (no orbax involved — per-host mode must stay usable where
+        # orbax's cooperative multihost writer is not)
+        return local_restore(_ckpt_dir(directory, step), template)
+    import orbax.checkpoint as ocp
+
     ckptr = ocp.PyTreeCheckpointer()
     # restore INTO the template's structure (a structureless restore returns
     # a dict whose alphabetical key order would scramble DearState fields)

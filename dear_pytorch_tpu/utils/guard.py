@@ -36,9 +36,21 @@ plugs in here:
     (``metrics["preempted"]`` tells the loop to exit),
   - **corruption fallback**: restores verify the sidecar checksum
     manifest and walk back past corrupted checkpoints,
+  - **cluster coordination**: on multi-process runs every recovery
+    decision is a *consensus* decision through a
+    `resilience.cluster.ClusterCoordinator` (created automatically;
+    ``DEAR_CLUSTER=0`` restores the legacy crash-for-relaunch behavior):
+    a per-check-interval any-rank-unhealthy exchange turns a local
+    exception or NaN on one rank into the SAME rollback on all ranks,
+    restores go to the newest checkpoint verified on *every* host, a
+    desync sentinel fingerprints the replicated loss to catch silent
+    replica divergence, and a preemption signal seen by one rank
+    propagates so emergency saves stay cooperative. A hung peer trips the
+    exchange's bounded timeout and degrades to the old crash behavior
+    (after kicking the watchdog's forensic dump) instead of deadlocking,
   - **telemetry**: every recovery event lands in `observability` counters
     (``guard.rollbacks``, ``guard.restores``, ``guard.steps_skipped``,
-    ...) so it shows up in `bench.py` telemetry blocks.
+    ``cluster.*``, ...) so it shows up in `bench.py` telemetry blocks.
 """
 
 from __future__ import annotations
@@ -51,6 +63,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.resilience import cluster as _cluster
 from dear_pytorch_tpu.resilience import inject as _inject
 from dear_pytorch_tpu.utils import checkpoint as ckpt
 
@@ -59,6 +72,12 @@ logger = logging.getLogger("dear_pytorch_tpu")
 
 class DivergenceError(RuntimeError):
     """Raised when training diverges and no checkpoint exists to restore."""
+
+
+class PeerLostError(RuntimeError):
+    """A peer never reached the coordinated health sync (hung or dead
+    host); raised after the forensic dump so the job crashes for
+    whole-job relaunch instead of deadlocking."""
 
 
 class GuardedTrainer:
@@ -86,6 +105,7 @@ class GuardedTrainer:
         injector: Optional[Any] = None,
         watchdog: Optional[Any] = None,
         preemption: Optional[Any] = None,
+        coordinator: Optional[Any] = None,
     ):
         self.ts = ts
         self.directory = directory
@@ -101,6 +121,20 @@ class GuardedTrainer:
                           else _inject.FaultInjector.from_env())
         self._watchdog = watchdog
         self._preemption = preemption
+        # cluster coordination: an explicit coordinator wins; multi-process
+        # runs get one automatically (consensus recovery is the default
+        # multi-host policy) unless DEAR_CLUSTER=0 keeps the legacy
+        # crash-for-relaunch branches.
+        if (coordinator is None and jax.process_count() > 1
+                and _cluster.enabled_by_env()):
+            # the namespace must be identical on every rank — never derive
+            # it from the directory, which is rank-specific under per-host
+            # checkpoint storage; the coordinator's SPMD instance counter
+            # already separates multiple trainers in one process
+            coordinator = _cluster.ClusterCoordinator(namespace="guard")
+        self._coordinator = coordinator
+        self._pending_error: Optional[BaseException] = None
+        self._peer_preempt = False
         self._preempt_handled = False
         self._preempt_saved_step: Optional[int] = None
         self._template = None
@@ -121,6 +155,26 @@ class GuardedTrainer:
             ckpt.prune_orphaned_tmp(directory)
 
     # -- internals -----------------------------------------------------------
+
+    @property
+    def _coordinated(self) -> bool:
+        """True when recovery decisions go through the cluster consensus
+        protocol (a coordinator over a real multi-process world)."""
+        return (self._coordinator is not None
+                and self._coordinator.process_count > 1)
+
+    @property
+    def _preempt_requested(self) -> bool:
+        """Should this step act on a preemption? Coordinated runs act only
+        once the signal has propagated through the health sync, so every
+        rank performs the (cooperative, collective) emergency save at the
+        same boundary — a lone rank's save would wedge the pod. The cost:
+        up to one check interval of propagation latency, so on coordinated
+        runs size ``check_every`` such that ``check_every × step_time`` is
+        well inside the platform's preemption grace window."""
+        if self._coordinated:
+            return self._peer_preempt
+        return self._preemption is not None and self._preemption.requested
 
     def _template_state(self):
         if self._template is None:
@@ -189,13 +243,49 @@ class GuardedTrainer:
                 "the newest committed checkpoint instead", exc,
             )
         tr = _telemetry.get_tracer()
+        if self._coordinated:
+            # multi-host consensus restore: every process contributes its
+            # locally VERIFIED steps and all restore the newest step valid
+            # on every host — a checkpoint corrupted anywhere degrades the
+            # whole pod to the previous common step, in lockstep, instead
+            # of crashing (old policy) or desynchronizing (per-host walk).
+            # On SHARED storage all ranks see one directory, so rank 0
+            # verifies for everyone (N ranks re-hashing identical
+            # multi-GB files would multiply recovery latency for nothing);
+            # per-host storage genuinely has one view per rank.
+            if ckpt.per_host_storage() or self._coordinator.index == 0:
+                local = ckpt.valid_steps(
+                    self.directory, limit=self._coordinator.max_candidates)
+            else:
+                local = None  # defer to rank 0's verification
+            step = self._coordinator.consensus_restore_step(local)
+            if step is None:
+                raise DivergenceError(
+                    "no checkpoint step is verified on every host; "
+                    "nothing commonly restorable (see the chained cause)"
+                ) from cause
+            # every rank is now committed to this step: a restore failure
+            # here must propagate (crash for whole-job relaunch) — falling
+            # back locally would desynchronize replicas.
+            state = ckpt.restore_checkpoint(
+                self.directory, self.ts, step=step,
+                template=self._template_state(),
+            )
+            self._template = None
+            logger.warning(
+                "guard: consensus rollback to checkpoint step %d", step)
+            if tr.enabled:
+                tr.count("guard.restores")
+                tr.event("guard.restore", step=step, consensus=1)
+            return state, step
         if jax.process_count() > 1:
-            # multi-host: every process must restore the SAME step. The
-            # verification/fallback walk below decides per process (a
-            # transient local fs error on one host would silently pick an
-            # older step there, desynchronizing replicas) — so restore the
-            # newest committed step deterministically and let a failure
-            # crash for whole-job relaunch, same policy as local step
+            # legacy multi-host (DEAR_CLUSTER=0 / no coordinator): every
+            # process must restore the SAME step. The verification/
+            # fallback walk below decides per process (a transient local
+            # fs error on one host would silently pick an older step
+            # there, desynchronizing replicas) — so restore the newest
+            # committed step deterministically and let a failure crash
+            # for whole-job relaunch, same policy as local step
             # exceptions above.
             step = ckpt.latest_step(self.directory)
             if step is None:
@@ -262,6 +352,24 @@ class GuardedTrainer:
         loss = float(jax.device_get(metrics["loss"]))
         return math.isfinite(loss)
 
+    def _attempt(self, state, batch, tr):
+        """Run one step attempt and its cadence bookkeeping. The normal
+        path and the coordinated deferred-error path MUST share this:
+        every rank has to reach the consensus sync at the same attempt
+        number, so the steps_seen/is_check arithmetic cannot be allowed
+        to diverge between the two call sites."""
+        new_state, metrics = self.ts.step(state, batch)
+        self.steps_seen += 1
+        is_ckpt = self.steps_seen % self.checkpoint_every == 0
+        is_check = self.steps_seen % self.check_every == 0 or is_ckpt
+        # a checkpoint step ALWAYS verifies first: persisting an
+        # unchecked state could immortalize NaN-poisoned parameters
+        # (rollback would then restore the poison)
+        healthy = not is_check or self._check(metrics)
+        if is_check and not healthy and tr.enabled:
+            tr.count("guard.nan_detected")
+        return new_state, metrics, is_ckpt, is_check, healthy
+
     # -- public --------------------------------------------------------------
 
     def step(self, state, batch):
@@ -270,6 +378,7 @@ class GuardedTrainer:
         handled preemption sets ``metrics["preempted"]`` (exit the loop)."""
         error: Optional[BaseException] = None
         tr = _telemetry.get_tracer()
+        dispatched = False
         try:
             if self._injector is not None:
                 # faults fire INSIDE the guarded region: an injected
@@ -277,32 +386,63 @@ class GuardedTrainer:
                 attempt = self.steps_seen + 1
                 self._injector.before_step(attempt, directory=self.directory)
                 batch = self._injector.poison_batch(attempt, batch)
-            new_state, metrics = self.ts.step(state, batch)
-            self.steps_seen += 1
-            is_ckpt = self.steps_seen % self.checkpoint_every == 0
-            is_check = self.steps_seen % self.check_every == 0 or is_ckpt
-            # a checkpoint step ALWAYS verifies first: persisting an
-            # unchecked state could immortalize NaN-poisoned parameters
-            # (rollback would then restore the poison)
-            healthy = not is_check or self._check(metrics)
-            if is_check and not healthy and tr.enabled:
-                tr.count("guard.nan_detected")
+            dispatched = True
+            new_state, metrics, is_ckpt, is_check, healthy = \
+                self._attempt(state, batch, tr)
         except (FloatingPointError, RuntimeError) as exc:
-            if jax.process_count() > 1:
-                # a LOCAL exception must not trigger a local rollback on a
-                # multi-host run: the other processes would step on while
-                # this one restores, silently desynchronizing replicas.
-                # Crash instead — whole-job relaunch restores every process
-                # from the same periodic checkpoints (the NaN path below is
-                # safe: the checked loss is replicated, so every process
-                # makes the same decision).
+            if self._coordinated:
+                # coordinated multi-host: a LOCAL failure must not fork
+                # the SPMD program. An exception raised BEFORE the step
+                # dispatched (injected faults, host-side input bugs) lets
+                # this rank still run the real step — peers' in-flight
+                # collectives need its participation — and defer the
+                # verdict to the next health sync, where every rank rolls
+                # back together. A failure DURING the dispatched step
+                # cannot be papered over: re-raise, and peers degrade
+                # through their bounded sync timeout.
+                if tr.enabled:
+                    tr.count("guard.step_errors")
+                    tr.event("guard.step_error", error=type(exc).__name__)
+                if dispatched:
+                    logger.error(
+                        "guard: dispatched step raised %s: %s — cannot "
+                        "stay in lockstep; crashing for whole-job relaunch",
+                        type(exc).__name__, exc)
+                    raise
+                logger.error(
+                    "guard: step raised %s: %s (deferred to the "
+                    "coordinated health sync)", type(exc).__name__, exc)
+                self._pending_error = exc
+                if self._injector is not None:
+                    # a batch fault co-scheduled at THIS attempt (e.g.
+                    # "exc@8:r0,nan@8") must still be consumed — fault
+                    # schedules drain identically on every rank, and the
+                    # poison just makes this already-doomed attempt's
+                    # loss non-finite too
+                    try:
+                        batch = self._injector.poison_batch(
+                            self.steps_seen + 1, batch)
+                    except _inject.InjectedFault:
+                        pass  # already deferring an error for this attempt
+                new_state, metrics, is_ckpt, is_check, healthy = \
+                    self._attempt(state, batch, tr)
+            elif jax.process_count() > 1:
+                # legacy multi-host (DEAR_CLUSTER=0 / no coordinator): a
+                # local rollback would desynchronize replicas (the other
+                # processes step on while this one restores). Crash
+                # instead — whole-job relaunch restores every process
+                # from the same periodic checkpoints (the NaN path below
+                # is safe: the checked loss is replicated, so every
+                # process makes the same decision).
                 raise
-            logger.error("guard: step raised %s: %s", type(exc).__name__, exc)
-            if tr.enabled:
-                tr.count("guard.step_errors")
-                tr.event("guard.step_error", error=type(exc).__name__)
-            healthy, new_state, metrics, error = False, None, None, exc
-            is_check = is_ckpt = False
+            else:
+                logger.error("guard: step raised %s: %s",
+                             type(exc).__name__, exc)
+                if tr.enabled:
+                    tr.count("guard.step_errors")
+                    tr.event("guard.step_error", error=type(exc).__name__)
+                healthy, new_state, metrics, error = False, None, None, exc
+                is_check = is_ckpt = False
 
         if is_check and healthy:
             # timing across the sync interval: under async dispatch only a
@@ -330,6 +470,46 @@ class GuardedTrainer:
             self._last_check_t = now
             self._last_check_steps = self.steps_seen
 
+        if self._coordinated and is_check:
+            # the per-check-interval consensus point: any-rank-unhealthy,
+            # the desync-sentinel fingerprint of the replicated loss, and
+            # preemption propagation — all in ONE bounded exchange. Every
+            # rank reaches this at the same attempt number (steps_seen
+            # advances on every attempt, including deferred-error ones).
+            local_ok = healthy and self._pending_error is None
+            fp = ""
+            if healthy and metrics is not None:
+                fp = _cluster.ClusterCoordinator.fingerprint(
+                    jax.device_get(metrics["loss"]))
+            try:
+                verdict = self._coordinator.health_check(
+                    ok=local_ok, fingerprint=fp, step=self.steps_seen,
+                    preempted=(self._preemption is not None
+                               and self._preemption.requested
+                               and not self._preempt_handled),
+                )
+            except _cluster.PeerTimeout:
+                # dead-peer detection: dump forensics (open spans + all
+                # thread stacks) through the watchdog, then degrade to
+                # the old crash-for-relaunch behavior.
+                if self._watchdog is not None:
+                    self._watchdog.kick(
+                        "cluster peer timeout", step=self.steps_seen,
+                        last_good_step=self._last_good_step)
+                if self._pending_error is not None:
+                    raise PeerLostError(
+                        "a peer never reached the coordinated health "
+                        "sync; crashing for whole-job relaunch"
+                    ) from self._pending_error
+                raise
+            if verdict.any_preempted:
+                self._peer_preempt = True
+            if not verdict.ok:
+                if error is None:
+                    error = self._pending_error
+                healthy = False
+            self._pending_error = None
+
         if not healthy:
             self.recoveries += 1
             if self.recoveries > self.max_recoveries:
@@ -354,8 +534,7 @@ class GuardedTrainer:
                 self._watchdog.beat(step=self.steps_seen,
                                     last_good_step=at_step)
             out = {"loss": float("nan"), "rolled_back": True}
-            if (self._preemption is not None and self._preemption.requested
-                    and not self._preempt_handled):
+            if self._preempt_requested and not self._preempt_handled:
                 # SIGTERM during an unhealthy stretch: the restored state
                 # IS the newest durable checkpoint — nothing to save;
                 # signal the loop to exit now instead of burning the grace
@@ -379,8 +558,7 @@ class GuardedTrainer:
             # resetting would let a diverge/rollback loop spin forever past
             # max_recoveries.
             self.recoveries = 0
-        if (self._preemption is not None and self._preemption.requested
-                and not self._preempt_handled):
+        if self._preempt_requested and not self._preempt_handled:
             saved = self._emergency_save(new_state, metrics)
             self._preempt_handled = True
             self._preempt_saved_step = saved

@@ -21,6 +21,19 @@ tier-1 marker scheme. Standalone:
   python scripts/chaos_check.py [--steps 20] [--workdir /tmp/chaos]
 
 Prints one JSON summary line; exit 0 iff every assertion held.
+
+**Multi-process mode** (``--procs 2``): the fault storm runs through the
+2-process launcher env contract (JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID — the same contract
+launch/cpu_cluster.sh and tests/test_multiprocess.py speak). Each rank
+trains an independent replica with a per-host checkpoint directory
+(``DEAR_CKPT_SHARED=0``) and absorbs RANK-TARGETED faults — a NaN on
+rank 1, a raised exception on rank 0, a corrupted newest checkpoint on
+rank 0 — and every recovery must be a `resilience.cluster` consensus:
+the parent asserts that all ranks rolled back to IDENTICAL steps (the
+corrupted-checkpoint rollback landing on the newest commonly verified
+step) and finished in lockstep. Driven by
+tests/test_resilience.py::test_chaos_check_two_process_storm in tier-1.
 """
 
 from __future__ import annotations
@@ -233,25 +246,219 @@ def run(steps: int = 20, checkpoint_every: int = 4,
         T.set_tracer(prev_tracer)
 
 
+def run_worker(steps: int, checkpoint_every: int, workdir: str) -> dict:
+    """One rank of the multi-process storm (spawned by `run_procs` with
+    the launcher env contract already in the environment). Independent
+    replica, per-host checkpoints, rank-targeted faults, consensus
+    recovery — every rollback must land on the same step on every rank."""
+    os.environ["DEAR_CKPT_SHARED"] = "0"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dear_pytorch_tpu.comm import backend
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.resilience import Fault, FaultInjector
+    from dear_pytorch_tpu.resilience import cluster as CL
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    backend.init()  # joins the cluster from the launcher env contract
+    pid, n = jax.process_index(), jax.process_count()
+    failures: list[str] = []
+    tracer = T.Tracer([T.MemoryExporter()])
+    T.set_tracer(tracer)
+
+    mesh = jax.sharding.Mesh(np.asarray(jax.local_devices()), ("dp",))
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, threshold_mb=0.0008, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    batches = [_data(jax.random.PRNGKey(100 + i)) for i in range(steps + 4)]
+
+    # the storm, rank-targeted: nan on rank 1 only; a raised exception on
+    # rank 0 only; rank 0's newest checkpoint corrupted on ITS OWN disk,
+    # so the following (everywhere) nan forces a consensus restore past a
+    # view only one host has.
+    inj = FaultInjector([
+        Fault(kind="nan", step=5, rank=1),
+        Fault(kind="exc", step=8, rank=0),
+        Fault(kind="ckpt_corrupt", step=3 * checkpoint_every + 1, rank=0),
+        Fault(kind="nan", step=3 * checkpoint_every + 2),
+    ])
+    tr = GuardedTrainer(
+        ts, os.path.join(workdir, f"rank{pid}"), params,
+        check_every=1, checkpoint_every=checkpoint_every, injector=inj,
+    )
+    _check(tr._coordinated, "guard auto-coordinates across processes",
+           failures)
+    rollbacks = []
+    tr.on_rollback = lambda c, at: rollbacks.append(at)
+    state = ts.init(params)
+    losses = []
+    for b in batches[:steps]:
+        state, m = tr.step(state, b)
+        if not m.get("rolled_back"):
+            losses.append(float(m["loss"]))
+    counters = tracer.counters()
+
+    _check(inj.pending == 0, "every scheduled fault fired or was skipped",
+           failures)
+    _check(len(rollbacks) == 3,
+           f"3 coordinated rollbacks (remote nan, remote exc, "
+           f"nan-past-corruption); got {rollbacks}", failures)
+    _check(counters.get("cluster.consensus_restores", 0) >= 3,
+           "every restore went through cluster consensus", failures)
+    if pid == 0:
+        _check(counters.get("ckpt.corrupt_detected", 0) >= 1,
+               "rank 0's checksum walk caught its corrupted checkpoint",
+               failures)
+    _check(bool(losses) and np.isfinite(losses[-1]),
+           "storm run finished with a finite loss", failures)
+
+    # cross-rank consistency: every rank saw identical rollback steps and
+    # finished on identical losses (host-level exchange: no device
+    # collectives, so this works on any cluster jax.distributed joins)
+    co = CL.ClusterCoordinator(namespace="chaos-verify")
+    views = co.exchange("verdict", json.dumps(
+        {"rollbacks": rollbacks, "final_loss": losses[-1] if losses else None}
+    ))
+    parsed = [json.loads(v) for v in views]
+    _check(all(p["rollbacks"] == parsed[0]["rollbacks"] for p in parsed),
+           f"identical rollback steps on every rank: "
+           f"{[p['rollbacks'] for p in parsed]}", failures)
+    _check(all(p["final_loss"] is not None and
+               abs(p["final_loss"] - parsed[0]["final_loss"]) < 1e-6
+               for p in parsed),
+           "replicas finished in lockstep (identical final loss)", failures)
+
+    summary = {
+        "passed": not failures,
+        "rank": pid,
+        "nprocs": n,
+        "rollbacks": rollbacks,
+        "final_loss": losses[-1] if losses else None,
+        "fired": [f.kind for f in inj.fired],
+        "skipped": [f.kind for f in inj.skipped],
+        "cluster_counters": {k: v for k, v in counters.items()
+                             if k.startswith(("cluster.", "guard.",
+                                              "ckpt.", "faults."))},
+        "failures": failures,
+    }
+    print("CHAOS_MP " + json.dumps(summary), flush=True)
+    return summary
+
+
+def run_procs(nprocs: int, steps: int, checkpoint_every: int,
+              workdir: str | None) -> dict:
+    """Parent of the multi-process storm: spawns ``nprocs`` workers with
+    the launcher env contract and aggregates their verdicts."""
+    import socket
+    import subprocess
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_mp_")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(nprocs):
+        env = dict(os.environ)
+        env.pop("DEAR_DISABLE_DISTRIBUTED", None)
+        env.pop("DEAR_NUM_CPU_DEVICES", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = str(nprocs)
+        env["JAX_PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--steps", str(steps),
+             "--checkpoint-every", str(checkpoint_every),
+             "--workdir", workdir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    outs, timed_out = [], False
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            for q in procs:
+                q.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    per_rank, failures = [], []
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        line = next((ln for ln in out.splitlines()
+                     if ln.startswith("CHAOS_MP ")), None)
+        if timed_out or p.returncode != 0 or line is None:
+            failures.append(f"rank {pid} failed (rc={p.returncode}, "
+                            f"timed_out={timed_out}): {out[-1500:]}")
+            continue
+        rank_summary = json.loads(line[len("CHAOS_MP "):])
+        per_rank.append(rank_summary)
+        if not rank_summary["passed"]:
+            failures.append(f"rank {pid}: {rank_summary['failures']}")
+    if per_rank and not all(r["rollbacks"] == per_rank[0]["rollbacks"]
+                            for r in per_rank):
+        failures.append(
+            f"ranks disagree on rollback steps: "
+            f"{[r['rollbacks'] for r in per_rank]}")
+    return {"passed": not failures, "procs": nprocs, "steps": steps,
+            "per_rank": per_rank, "failures": failures}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="multi-fault recovery check (see module docstring)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--checkpoint-every", type=int, default=4)
     ap.add_argument("--workdir", type=str, default=None)
+    ap.add_argument("--procs", type=int, default=1,
+                    help="run the storm over N coordinated processes "
+                         "(launcher env contract; rank-targeted faults)")
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one storm rank
     args = ap.parse_args(argv)
 
-    summary = run(steps=args.steps, checkpoint_every=args.checkpoint_every,
-                  workdir=args.workdir)
-    print(json.dumps(summary))
+    if args.worker:
+        summary = run_worker(steps=args.steps,
+                             checkpoint_every=args.checkpoint_every,
+                             workdir=args.workdir)
+    elif args.procs > 1:
+        summary = run_procs(args.procs, steps=args.steps,
+                            checkpoint_every=args.checkpoint_every,
+                            workdir=args.workdir)
+        print(json.dumps(summary))
+    else:
+        summary = run(steps=args.steps,
+                      checkpoint_every=args.checkpoint_every,
+                      workdir=args.workdir)
+        print(json.dumps(summary))
     print("CHAOS CHECK " + ("PASSED" if summary["passed"] else "FAILED"))
     return 0 if summary["passed"] else 1
 
 
 if __name__ == "__main__":
-    # standalone: emulate the 8-device CPU world the test suite uses
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("DEAR_COMPILATION_CACHE_DIR", "off")
+    if "--worker" in sys.argv:
+        # storm rank: the launcher env contract (coordinator address,
+        # process id) drives backend.init(); each rank keeps its single
+        # local CPU device — the 8-device emulation below is the
+        # single-process world's shape, not the cluster's
+        sys.exit(main())
+    if any(a == "--procs" or a.startswith("--procs=") for a in sys.argv):
+        # parent of the multi-process storm: pure process supervisor, no
+        # jax in this process (the workers own the devices)
+        sys.exit(main())
+    # standalone single-process: emulate the 8-device CPU world the test
+    # suite uses
     import jax
 
     from dear_pytorch_tpu import _jax_compat

@@ -7,6 +7,19 @@ only early-return from: `jax.distributed` bootstrap through `dear.init()`,
 `collectives.allreduce`, and a dear-mode train step over a global mesh whose
 devices live in different processes (reference equivalence: the
 mpirun-driven common/comm_core/tests/test_comm.py invariants).
+
+``DEAR_MP_MODE=resilience`` runs the coordinated-recovery ladder instead
+(`resilience.cluster` through a real 2-process `GuardedTrainer`): each
+rank trains an independent replica (local mesh, per-host checkpoint
+directory via ``DEAR_CKPT_SHARED=0``) and ALL recovery coordination is
+host-level — which keeps the ladder runnable even where the XLA CPU
+backend cannot execute cross-process device collectives. Legs: a
+rank-LOCAL NaN and a rank-LOCAL raised exception must produce the SAME
+rollback on every rank; a newest checkpoint corrupted on ONE host must
+degrade both ranks to the newest commonly verified step (no crash); a
+diverging replica must trip the desync sentinel and be rolled back into
+lockstep; a SIGTERM on one rank must propagate into a cooperative
+emergency save on all ranks.
 """
 
 import os
@@ -22,7 +35,174 @@ import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 
+def _resilience_main() -> None:
+    """Coordinated multi-host recovery over a REAL 2-process cluster.
+
+    Each rank trains its own replica on a LOCAL mesh (lockstep comes from
+    identical seeds/batches, as in data-parallel training) with a
+    PER-HOST checkpoint directory — so a rank-local fault really is
+    local, a corrupted checkpoint really is one host's view, and every
+    recovery decision must flow through `resilience.cluster`'s host-level
+    consensus. Every leg asserts that all ranks end in the identical
+    recovered state (the DeAR lockstep invariant)."""
+    import json
+
+    import dear_pytorch_tpu as dear
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.resilience import (
+        Fault, FaultInjector, PreemptionHandler, corrupt_latest_checkpoint,
+    )
+    from dear_pytorch_tpu.resilience import cluster as CL
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    os.environ["DEAR_CKPT_SHARED"] = "0"  # per-host checkpoint storage
+    dear.init()  # joins the cluster: the coordination service comes alive
+    n = int(os.environ["JAX_NUM_PROCESSES"])
+    pid = jax.process_index()
+    assert jax.process_count() == n and ckpt.per_host_storage()
+    workdir = os.path.join(os.environ["DEAR_MP_WORKDIR"], f"rank{pid}")
+
+    tracer = T.Tracer([T.MemoryExporter()])
+    T.set_tracer(tracer)
+
+    # host-level assertion collective: every rank must hold the same values
+    probe = CL.ClusterCoordinator(namespace="assert")
+
+    def assert_replicated(tag, vals):
+        views = probe.exchange(tag, json.dumps([float(v) for v in vals]))
+        ref = json.loads(views[0])
+        for v in views[1:]:
+            np.testing.assert_allclose(json.loads(v), ref, rtol=1e-6)
+
+    # replica training is process-local: collectives over a 1-device mesh
+    mesh = jax.sharding.Mesh(np.asarray(jax.local_devices()), ("dp",))
+
+    def loss_fn(p, b):
+        x, y = b
+        pred = jnp.tanh(x @ p["w1"]) @ p["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    tparams = {
+        "w1": jax.random.normal(k, (8, 16)) * 0.3,
+        "w2": jax.random.normal(jax.random.fold_in(k, 1), (16, 4)) * 0.3,
+    }
+    ts = build_train_step(
+        loss_fn, tparams, mesh=mesh, mode="dear", threshold_mb=0.0001,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9), donate=False,
+    )
+
+    bk = jax.random.PRNGKey(7)
+
+    def batch_at(i):
+        kk = jax.random.fold_in(bk, i)
+        return (jax.random.normal(kk, (8, 8)),
+                jax.random.normal(jax.random.fold_in(kk, 1), (8, 4)))
+
+    def run_leg(subdir, injector, steps, batch_fn=batch_at, preemption=None):
+        tr = GuardedTrainer(
+            ts, os.path.join(workdir, subdir), tparams,
+            check_every=1, checkpoint_every=4, injector=injector,
+            preemption=preemption,
+        )
+        assert tr._coordinated, "2-process guard must auto-coordinate"
+        rolls, losses = [], []
+        tr.on_rollback = lambda c, at: rolls.append(at)
+        state = ts.init(tparams)
+        last_m = {}
+        for i in range(steps):
+            state, last_m = tr.step(state, batch_fn(i))
+            if not last_m.get("rolled_back"):
+                losses.append(float(last_m["loss"]))
+            if last_m.get("preempted"):
+                break
+        return tr, state, rolls, losses, last_m
+
+    # leg A — NaN on rank 1 ONLY: rank 0's replica is perfectly healthy,
+    # yet the health sync must roll BOTH ranks back to the same step.
+    inj = FaultInjector([Fault(kind="nan", step=6, rank=1)])
+    _, _, rolls, losses, _ = run_leg("legA", inj, 8)
+    assert rolls == [4], rolls
+    assert_replicated("legA.roll", [rolls[0]])
+    assert_replicated("legA.loss", losses[-2:])  # resumed in lockstep
+    if pid == 1:
+        assert [f.kind for f in inj.fired] == ["nan"] and not inj.skipped
+    else:
+        assert not inj.fired and [f.kind for f in inj.skipped] == ["nan"]
+
+    # leg B — raised exception on rank 0 ONLY (host-side, pre-dispatch):
+    # the old policy crashed the whole job for relaunch; now the failing
+    # rank completes the step, defers to the sync, and BOTH ranks roll
+    # back to the identical step and resume to matching losses.
+    inj = FaultInjector([Fault(kind="exc", step=6, rank=0)])
+    _, _, rolls, losses, _ = run_leg("legB", inj, 8)
+    assert rolls == [4], rolls
+    assert_replicated("legB.roll", [rolls[0]])
+    assert_replicated("legB.loss", losses[-2:])
+
+    # leg C — newest checkpoint corrupted on ONE host: rank 0's local
+    # walk sees only step 4 while rank 1 still verifies {8, 4}; consensus
+    # must restore the newest COMMONLY verified step (4) on both ranks,
+    # with no crash (the ISSUE acceptance scenario).
+    tr, state, rolls, _, _ = run_leg("legC", None, 8)  # ckpts at 4 and 8
+    if pid == 0:
+        assert corrupt_latest_checkpoint(os.path.join(workdir, "legC")) == 8
+        assert ckpt.valid_steps(os.path.join(workdir, "legC")) == [4]
+    else:
+        assert ckpt.valid_steps(os.path.join(workdir, "legC")) == [8, 4]
+    x, y = batch_at(9)
+    state, m = tr.step(state, (jnp.full_like(x, jnp.nan), y))
+    assert m.get("rolled_back"), m
+    restored = int(jax.device_get(state.step))
+    assert restored == 4, restored  # past the corrupted 8, on BOTH ranks
+    assert_replicated("legC.step", [restored])
+
+    # leg D — desync sentinel end to end: rank 1 trains one step on the
+    # WRONG batch (a diverging dataloader); every loss stays finite, yet
+    # the fingerprint exchange flags the divergence and rolls both ranks
+    # back into lockstep.
+    def skewed(i):
+        if pid == 1 and i == 5:  # attempt 6: silently divergent input
+            return batch_at(1000 + i)
+        return batch_at(i)
+
+    before = tracer.counters().get("cluster.desync_detected", 0)
+    _, _, rolls, losses, _ = run_leg("legD", None, 8, batch_fn=skewed)
+    assert rolls == [4], rolls
+    assert tracer.counters().get("cluster.desync_detected", 0) > before
+    assert_replicated("legD.loss", losses[-2:])  # back in lockstep
+
+    # leg E — preemption propagation: SIGTERM lands on rank 1 only; the
+    # sync propagates it and BOTH ranks perform the cooperative emergency
+    # save at the same boundary.
+    inj = FaultInjector([Fault(kind="preempt", step=6, rank=1)])
+    with PreemptionHandler() as pre:
+        _, state, _, _, m = run_leg("legE", inj, 10, preemption=pre)
+    assert m.get("preempted"), m
+    saved = m.get("preempt_checkpoint_step")
+    assert saved == int(jax.device_get(state.step)) == 6, (saved, m)
+    assert ckpt.latest_valid_step(os.path.join(workdir, "legE")) == 6
+    assert_replicated("legE.saved", [saved])
+
+    # leg F — coordinator primitives against hand-built divergent views
+    co = CL.ClusterCoordinator(namespace="probe")
+    assert co.consensus_restore_step([8, 4] if pid == 0 else [4]) == 4
+    v = co.health_check(ok=True, fingerprint=f"fp{pid}", step=1)
+    assert v.desync and not v.ok
+    v = co.health_check(ok=(pid != 1), step=2, preempted=(pid == 1))
+    assert v.unhealthy_ranks == (1,) and v.any_preempted and not v.ok
+    v = co.health_check(ok=True, fingerprint="same", step=3)
+    assert v.ok and not v.desync
+
+    print(f"MP_RESILIENCE_OK rank={pid}/{n}", flush=True)
+
+
 def main() -> None:
+    if os.environ.get("DEAR_MP_MODE", "").strip() == "resilience":
+        return _resilience_main()
     import dear_pytorch_tpu as dear
     from dear_pytorch_tpu.comm import backend
     from dear_pytorch_tpu.comm import collectives as C

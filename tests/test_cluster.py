@@ -1,0 +1,350 @@
+"""Cluster coordination layer (`resilience.cluster`) unit tests.
+
+The consensus protocol is exercised here WITHOUT a real multi-process
+cluster: N coordinators on N threads share one `LocalTransport` and
+behave like N ranks (the real 2-process cluster legs live in
+tests/test_multiprocess.py::test_coordinated_recovery_cluster and
+scripts/chaos_check.py --procs 2). Also covers the per-host local
+checkpoint format these protocols restore from.
+"""
+
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.observability import tracer as T
+from dear_pytorch_tpu.resilience import cluster as CL
+from dear_pytorch_tpu.utils import checkpoint as ckpt
+
+
+def run_ranks(n, fn, *, timeout_s=5.0):
+    """Run ``fn(coordinator, rank)`` on ``n`` thread-ranks sharing one
+    LocalTransport; returns the per-rank results, re-raising the first
+    failure."""
+    transport = CL.LocalTransport(n)
+    cos = [
+        CL.ClusterCoordinator(
+            namespace="t", process_index=i, process_count=n,
+            transport=transport, timeout_s=timeout_s, instance=0,
+        )
+        for i in range(n)
+    ]
+    results, errs = [None] * n, [None] * n
+
+    def work(i):
+        try:
+            results[i] = fn(cos[i], i)
+        except BaseException as exc:  # noqa: BLE001 - surfaced below
+            errs[i] = exc
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for e in errs:
+        if e is not None:
+            raise e
+    return results
+
+
+# -- exchange / consensus -----------------------------------------------------
+
+
+def test_exchange_is_index_ordered():
+    out = run_ranks(3, lambda co, i: co.exchange("hello", f"msg{i}"))
+    assert out == [["msg0", "msg1", "msg2"]] * 3
+
+
+def test_consensus_restore_step_intersects_views():
+    views = {0: [12, 8, 4], 1: [8, 4], 2: [12, 8]}
+    out = run_ranks(3, lambda co, i: co.consensus_restore_step(views[i]))
+    assert out == [8, 8, 8]  # newest step EVERY rank verified
+
+
+def test_consensus_restore_step_no_common_step():
+    out = run_ranks(2, lambda co, i: co.consensus_restore_step([4] if i
+                                                               else [8]))
+    assert out == [None, None]
+
+
+def test_consensus_restore_step_deferring_ranks():
+    """None = "no local opinion" (shared storage: rank 0 verifies for
+    everyone); deferring ranks are excluded from the intersection, and
+    all-deferred yields nothing restorable."""
+    out = run_ranks(3, lambda co, i: co.consensus_restore_step(
+        [12, 8] if i == 0 else None))
+    assert out == [12, 12, 12]
+    out = run_ranks(2, lambda co, i: co.consensus_restore_step(None))
+    assert out == [None, None]
+
+
+def test_consensus_caps_candidates():
+    co = CL.ClusterCoordinator(process_index=0, process_count=1,
+                               max_candidates=2)
+    # single-process fast path: newest of the capped local view
+    assert co.consensus_restore_step(list(range(100))) == 99
+
+
+# -- health verdicts ----------------------------------------------------------
+
+
+def test_health_check_all_ok():
+    out = run_ranks(2, lambda co, i: co.health_check(
+        ok=True, fingerprint="same", step=1))
+    for v in out:
+        assert v.ok and not v.desync and not v.any_preempted
+        assert v.unhealthy_ranks == ()
+
+
+def test_health_check_any_rank_unhealthy_propagates():
+    out = run_ranks(3, lambda co, i: co.health_check(ok=(i != 1), step=2))
+    for v in out:
+        assert not v.ok and v.unhealthy_ranks == (1,) and not v.desync
+
+
+def test_health_check_desync_sentinel():
+    out = run_ranks(2, lambda co, i: co.health_check(
+        ok=True, fingerprint=f"fp{i}", step=3))
+    for v in out:
+        assert v.desync and not v.ok and v.unhealthy_ranks == ()
+
+
+def test_health_check_preempt_propagates():
+    out = run_ranks(2, lambda co, i: co.health_check(
+        ok=True, fingerprint="same", step=4, preempted=(i == 0)))
+    for v in out:
+        assert v.any_preempted and v.ok  # preemption is not ill health
+
+
+def test_unhealthy_rank_fingerprint_not_a_desync():
+    # a NaN rank has no meaningful fingerprint: its (empty or stale) value
+    # must not masquerade as replica divergence
+    out = run_ranks(2, lambda co, i: co.health_check(
+        ok=(i == 0), fingerprint="live" if i == 0 else "", step=5))
+    for v in out:
+        assert not v.ok and v.unhealthy_ranks == (1,) and not v.desync
+
+
+# -- timeouts (dead-peer detection) -------------------------------------------
+
+
+def test_exchange_peer_timeout():
+    co = CL.ClusterCoordinator(
+        namespace="solo", process_index=0, process_count=2,
+        transport=CL.LocalTransport(2), timeout_s=0.2, instance=0)
+    with pytest.raises(CL.PeerTimeout, match="no peer published"):
+        co.exchange("health", "ok")  # rank 1 never shows up
+
+
+def test_barrier_peer_timeout():
+    co = CL.ClusterCoordinator(
+        namespace="solo", process_index=0, process_count=2,
+        transport=CL.LocalTransport(2), timeout_s=0.2, instance=0)
+    with pytest.raises(CL.PeerTimeout):
+        co.barrier("b")
+
+
+def test_cluster_counters():
+    prev = T._tracer
+    tracer = T.Tracer([T.MemoryExporter()])
+    T.set_tracer(tracer)
+    try:
+        run_ranks(2, lambda co, i: co.health_check(
+            ok=True, fingerprint=f"fp{i}", step=1))
+        co = CL.ClusterCoordinator(
+            namespace="solo", process_index=0, process_count=2,
+            transport=CL.LocalTransport(2), timeout_s=0.1, instance=0)
+        with pytest.raises(CL.PeerTimeout):
+            co.exchange("x", "y")
+        c = tracer.counters()
+        assert c["cluster.exchanges"] >= 3
+        assert c["cluster.health_checks"] == 2
+        assert c["cluster.desync_detected"] == 2
+        assert c["cluster.peer_timeouts"] == 1
+    finally:
+        T.set_tracer(prev)
+
+
+# -- single-process fast paths ------------------------------------------------
+
+
+def test_single_process_fast_paths():
+    co = CL.ClusterCoordinator(process_index=0, process_count=1)
+    assert co.exchange("t", "x") == ["x"]
+    assert co.consensus_restore_step([8, 4]) == 8
+    assert co.consensus_restore_step([]) is None
+    v = co.health_check(ok=True, fingerprint="f")
+    assert v.ok and not v.desync
+    co.barrier()  # no transport, no-op
+
+
+def test_fingerprint_is_bit_exact():
+    fp = CL.ClusterCoordinator.fingerprint
+    assert fp(1.5) == fp(1.5)
+    assert fp(1.5) != fp(1.5 + 1e-12)
+    assert fp(np.float32(2.0)) != fp(np.float64(2.0))  # dtype-tagged
+    # the FULL buffer is hashed: arrays agreeing on a prefix but
+    # diverging later must not collide (the desync sentinel's contract)
+    a = np.zeros(100, np.float32)
+    b = a.copy()
+    b[50] = 1.0
+    assert fp(a) != fp(b)
+    assert fp(a.reshape(4, 25)) != fp(a)  # shape-tagged
+
+
+def test_enabled_by_env(monkeypatch):
+    monkeypatch.delenv(CL.CLUSTER_ENV, raising=False)
+    assert CL.enabled_by_env()
+    monkeypatch.setenv(CL.CLUSTER_ENV, "0")
+    assert not CL.enabled_by_env()
+
+
+def test_unknown_transport_name_lists_valid_ones():
+    with pytest.raises(ValueError, match="'kv' and 'allgather'"):
+        CL.ClusterCoordinator(process_index=0, process_count=2,
+                              transport="carrier-pigeon")
+
+
+# -- the allgather transport (encode/decode; single-process collective) -------
+
+
+def test_allgather_transport_roundtrip():
+    t = CL.AllgatherTransport(0, 1)
+    t.set("ns/tag/0/0", "payload-π")  # non-ascii survives the byte slot
+    assert t.get("ns/tag/0/0", 1.0) == "payload-π"
+    t.delete("ns/tag/0/0")
+    t.barrier("ns/b/0", 1.0)
+
+
+def test_allgather_transport_rejects_oversized_payload():
+    t = CL.AllgatherTransport(0, 1)
+    with pytest.raises(CL.ClusterError, match="byte"):
+        t.set("ns/tag/0/0", "x" * 4096)
+
+
+# -- the per-host local checkpoint format -------------------------------------
+
+
+def test_local_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    tree = {
+        "w": jnp.arange(6.0, dtype=jnp.float32).reshape(2, 3),
+        "b16": jnp.ones((3,), dtype=jnp.bfloat16) * 1.5,
+        "step": np.int64(7),
+        "empty": np.zeros((0, 4), np.float32),
+    }
+    d = str(tmp_path / "step_0000000007")
+    ckpt.local_save(d, tree)
+    assert ckpt.is_local_checkpoint(d)
+    out = ckpt.local_restore(d, tree)
+    assert out["w"].dtype == jnp.float32 and out["b16"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+    np.testing.assert_array_equal(
+        np.asarray(out["b16"], np.float32),
+        np.asarray(tree["b16"], np.float32))
+    assert int(out["step"]) == 7
+    assert out["empty"].shape == (0, 4)
+    # restored jax leaves land on the template's devices
+    assert isinstance(out["w"], jax.Array)
+
+
+def test_local_checkpoint_commit_is_atomic(tmp_path):
+    d = str(tmp_path / "step_0000000001")
+    ckpt.local_save(d, {"x": np.ones((2,))})
+    assert os.path.isdir(d)
+    assert not os.path.exists(d + ckpt._LOCAL_TMP_MARK)  # renamed away
+
+
+def test_local_checkpoint_overwrites_stale_step_dir(tmp_path):
+    """Replay after a consensus rollback re-reaches a step whose
+    corrupted dir is still on disk — the fresh save must replace it, not
+    crash on the rename (and a crash-leftover tmp dir must not break the
+    next save either)."""
+    d = str(tmp_path / "step_0000000004")
+    ckpt.local_save(d, {"x": np.ones((2,))})
+    os.makedirs(d + ckpt._LOCAL_TMP_MARK)  # interrupted-save leftover
+    ckpt.local_save(d, {"x": np.full((2,), 7.0)})
+    out = ckpt.local_restore(d, {"x": np.zeros((2,))})
+    np.testing.assert_array_equal(out["x"], np.full((2,), 7.0))
+    assert not os.path.exists(d + ckpt._LOCAL_TMP_MARK)
+    assert not os.path.exists(d + ckpt._LOCAL_TMP_MARK + "-old")
+
+
+def test_local_checkpoint_rejects_structure_mismatch(tmp_path):
+    d = str(tmp_path / "step_0000000002")
+    ckpt.local_save(d, {"x": np.ones((2,)), "y": np.zeros((1,))})
+    with pytest.raises(ValueError, match="different model"):
+        ckpt.local_restore(d, {"x": np.ones((2,))})
+
+
+def test_per_host_storage_env(monkeypatch):
+    monkeypatch.delenv(ckpt.SHARED_ENV, raising=False)
+    assert not ckpt.per_host_storage()
+    monkeypatch.setenv(ckpt.SHARED_ENV, "0")
+    assert ckpt.per_host_storage()
+
+
+# -- the coordinated guard paths, driven single-process via a stub ------------
+
+
+class _StubCoordinator:
+    """Plays a 2-process coordinator against a single-process guard: the
+    verdict/consensus logic is scripted, so the guard's coordinated
+    branches (deferred errors, co-scheduled fault drain, consensus
+    rollback) are unit-testable without a cluster."""
+
+    process_count = 2
+    index = 0
+    max_candidates = 16
+
+    def __init__(self):
+        self.health_calls = []
+
+    def health_check(self, ok, *, fingerprint="", step=None,
+                     preempted=False):
+        self.health_calls.append((step, ok))
+        return CL.HealthVerdict(
+            ok=ok, unhealthy_ranks=() if ok else (0,), desync=False,
+            any_preempted=False, fingerprints=(fingerprint,))
+
+    def consensus_restore_step(self, local_steps):
+        return max(local_steps) if local_steps else None
+
+
+def test_coordinated_guard_drains_stacked_faults(tmp_path, mesh):
+    """A nan co-scheduled with a deferred exc at the SAME attempt must
+    still be consumed (schedules drain identically on every rank), and
+    the guard must take the consensus rollback path."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.resilience import Fault, FaultInjector
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, threshold_mb=0.0008, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    inj = FaultInjector([Fault(kind="exc", step=6, rank=0),
+                         Fault(kind="nan", step=6)], own_rank=0)
+    co = _StubCoordinator()
+    tr = GuardedTrainer(ts, str(tmp_path / "g"), params, check_every=1,
+                        checkpoint_every=4, injector=inj, coordinator=co)
+    assert tr._coordinated
+    rolls = []
+    tr.on_rollback = lambda c, at: rolls.append(at)
+    state = ts.init(params)
+    for i in range(8):
+        state, _ = tr.step(state, _data(jax.random.PRNGKey(100 + i)))
+    assert inj.pending == 0, "stacked same-step faults must both drain"
+    assert sorted(f.kind for f in inj.fired) == ["exc", "nan"]
+    assert rolls == [4]
+    # the guard synced at every check interval (check_every=1)
+    assert len(co.health_calls) == 8

@@ -62,7 +62,8 @@ def _deadline(nprocs: int, local_devices: int) -> int:
     return DEADLINE + 45 * nprocs * max(local_devices, 1)
 
 
-def _run_direct(repo: str, worker: str, nprocs: int, local_devices: int):
+def _run_direct(repo: str, worker: str, nprocs: int, local_devices: int,
+                extra_env: dict | None = None, expect: str = "MP_WORKER_OK"):
     """Spawn one subprocess per rank with the launcher env contract."""
     port = _free_port()
     deadline = _deadline(nprocs, local_devices)
@@ -75,6 +76,8 @@ def _run_direct(repo: str, worker: str, nprocs: int, local_devices: int):
         env["JAX_PROCESS_ID"] = str(pid)
         if local_devices > 1:
             env["DEAR_NUM_CPU_DEVICES"] = str(local_devices)
+        if extra_env:
+            env.update(extra_env)
         procs.append(
             subprocess.Popen(
                 [sys.executable, worker], env=env,
@@ -90,9 +93,11 @@ def _run_direct(repo: str, worker: str, nprocs: int, local_devices: int):
                 q.kill()
             raise
         outs.append(out)
+    expects = (expect,) if isinstance(expect, str) else tuple(expect)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {pid} failed:\n{out[-3000:]}"
-        assert f"MP_WORKER_OK rank={pid}/{nprocs}" in out, out[-3000:]
+        for exp in expects:
+            assert f"{exp} rank={pid}/{nprocs}" in out, out[-3000:]
 
 
 def _run_via_launcher(repo: str, worker: str, nprocs: int):
@@ -147,3 +152,28 @@ def test_process_cluster(nprocs, local_devices, via_launcher):
         _run_via_launcher(repo, worker, nprocs)
     else:
         _run_direct(repo, worker, nprocs, local_devices)
+
+
+@pytest.mark.timeout(600, method="signal")
+def test_coordinated_recovery_cluster(tmp_path):
+    """The coordinated-recovery ladder (mp_worker resilience mode) over a
+    real 2-process cluster: a rank-LOCAL NaN / raised exception produces
+    the SAME rollback on every rank; a newest checkpoint corrupted on ONE
+    host restores the newest COMMONLY verified step on both processes
+    with no crash; a silently diverging replica trips the desync sentinel
+    and is rolled back into lockstep; a SIGTERM on one rank propagates
+    into a cooperative emergency save on all ranks (ISSUE-3 acceptance).
+
+    Unlike the worlds above, every cross-rank decision here is HOST-level
+    (the coordination-service KV store) — no cross-process device
+    collectives — so this runs wherever `jax.distributed` bootstraps,
+    including CPU containers whose XLA backend cannot execute
+    multiprocess computations."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "mp_worker.py")
+    _run_direct(
+        repo, worker, 2, 1,
+        extra_env={"DEAR_MP_MODE": "resilience",
+                   "DEAR_MP_WORKDIR": str(tmp_path)},
+        expect="MP_RESILIENCE_OK",
+    )

@@ -73,6 +73,44 @@ def test_parse_faults_grammar():
     assert FaultInjector.from_env("nan@2").pending == 1
 
 
+def test_rank_targeted_fault_grammar():
+    faults = parse_faults("nan@6:r1,exc@9:r0,hang@12:0.5:r1,ckpt_corrupt@15")
+    assert [(f.kind, f.rank) for f in faults] == [
+        ("nan", 1), ("exc", 0), ("hang", 1), ("ckpt_corrupt", None)]
+    assert faults[2].arg == 0.5
+    # rank-first spelling composes too
+    assert parse_faults("hang@3:r1:0.25")[0] == Fault(
+        kind="hang", step=3, arg=0.25, rank=1)
+    # malformed rank specs raise WITH the valid format in the message
+    with pytest.raises(ValueError, match=r"kind@step\[:arg\]\[:rRANK\]"):
+        parse_faults("nan@6:rX")
+    with pytest.raises(ValueError, match="neither a float arg nor an rRANK"):
+        parse_faults("nan@6:banana")
+    with pytest.raises(ValueError, match="duplicate rank"):
+        parse_faults("nan@6:r0:r1")
+    with pytest.raises(ValueError, match="process index >= 0"):
+        Fault(kind="nan", step=3, rank=-2)
+
+
+def test_rank_targeted_faults_fire_only_on_their_rank():
+    """Other ranks consume the fault into ``skipped`` at the same step —
+    schedules drain identically everywhere (the lockstep invariant the
+    coordinated chaos tests rely on)."""
+    sched = [Fault(kind="exc", step=2, rank=1), Fault(kind="nan", step=3)]
+    mine = FaultInjector(sched, own_rank=1)
+    theirs = FaultInjector(sched, own_rank=0)
+    with pytest.raises(InjectedFault):
+        mine.before_step(2)
+    theirs.before_step(2)  # no raise: not this rank's fault
+    assert [f.kind for f in theirs.skipped] == ["exc"]
+    assert [f.kind for f in mine.fired] == ["exc"]
+    # the untargeted nan still fires on every rank
+    for inj in (mine, theirs):
+        with pytest.raises(InjectedFault):  # int batch -> degraded error
+            inj.poison_batch(3, {"ids": np.zeros((2,), np.int32)})
+        assert inj.pending == 0
+
+
 def test_nan_fault_on_integer_batch_degrades_to_step_error():
     """An all-int batch (BERT/GPT token specs) cannot carry a NaN: the
     fault must degrade to an InjectedFault — which the guard recovers
@@ -120,6 +158,61 @@ def test_retry_recovers_then_gives_up():
     with pytest.raises(ValueError):
         retry_call(bug, base_delay_s=0.0)
     assert calls == ["bug"]
+
+
+def test_retry_telemetry_counters():
+    """Retries must be visible in telemetry: `retry.attempts` counts every
+    attempt (firsts included), `retry.giveups` exhausted calls — the
+    docs/OBSERVABILITY.md counter-table contract."""
+    from dear_pytorch_tpu.observability import tracer as T
+
+    prev = T._tracer
+    tracer = T.Tracer([T.MemoryExporter()])
+    T.set_tracer(tracer)
+    try:
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 3:
+                raise OSError("transient")
+            return 1
+
+        def doomed():
+            raise OSError("x")
+
+        retry_call(flaky, base_delay_s=0.0)           # 3 attempts, absorbed
+        with pytest.raises(RetryError):
+            retry_call(doomed, attempts=2, base_delay_s=0.0)  # 2, giveup
+        c = tracer.counters()
+        assert c["retry.calls"] == 2
+        assert c["retry.attempts"] == 5
+        assert c["retry.retries"] == 3
+        assert c["retry.giveups"] == 1
+    finally:
+        T.set_tracer(prev)
+
+
+def test_watchdog_report_carries_rank_and_fault_schedule(monkeypatch):
+    """Multi-host hang logs correlate by rank: the dump/report names
+    `jax.process_index()` and the active DEAR_FAULTS schedule; `kick()`
+    produces the same forensics on demand (the cluster layer's dead-peer
+    path) without aborting."""
+    monkeypatch.setenv("DEAR_FAULTS", "hang@3:0.5:r1")
+    fired = []
+    with StepWatchdog(0.05, on_timeout=fired.append, poll_s=0.01) as dog:
+        dog.beat(step=7, last_good_step=4)
+        import time as _time
+
+        _time.sleep(0.3)
+    assert len(fired) == 1
+    rep = fired[0]
+    assert rep.process_index == jax.process_index()
+    assert rep.faults == "hang@3:0.5:r1"
+    kicked = dog.kick("cluster peer timeout", step=9)
+    assert dog.kicked == 1 and kicked.faults == "hang@3:0.5:r1"
+    assert kicked.beat_info["step"] == 9
+    assert kicked.beat_info["last_good_step"] == 4  # merged from the beat
 
 
 # -- injected faults through the guard ----------------------------------------
@@ -192,6 +285,21 @@ def test_corrupted_checkpoint_falls_back_to_previous(tsp, tmp_path):
     state, m = tr.step(state, (x.at[0, 0].set(jnp.nan), y))
     assert m.get("rolled_back")
     assert rollbacks == [(1, 4)]  # NOT the corrupted step 8
+
+
+def test_valid_steps_walks_past_corruption(tsp, tmp_path):
+    """`valid_steps` (one host's local view for the cluster layer's
+    consensus restore) lists every verifying step newest-first and drops
+    corrupted ones."""
+    params, ts, tr = _guard(tsp, tmp_path)
+    d = str(tmp_path / "g")
+    state = ts.init(params)
+    for b in _batches(12):
+        state, _ = tr.step(state, b)  # checkpoints at 4, 8, 12
+    assert ckpt.valid_steps(d) == [12, 8, 4]
+    assert ckpt.valid_steps(d, limit=2) == [12, 8]
+    corrupt_latest_checkpoint(d)
+    assert ckpt.valid_steps(d) == [8, 4]
 
 
 def test_preemption_emergency_save_and_resume(tsp, tmp_path):
@@ -306,6 +414,31 @@ def test_chaos_check_script_passes(mesh, tmp_path):
     assert summary["resumed_at"] == summary["preempted_at"]
     assert summary["guard_counters"]["guard.rollbacks"] == 3
     assert summary["guard_counters"]["watchdog.timeouts"] == 1
+
+
+@pytest.mark.timeout(420, method="signal")
+def test_chaos_check_two_process_storm(tmp_path):
+    """scripts/chaos_check.py --procs 2: the fault storm through the
+    2-process launcher env contract — rank-targeted NaN/exception/
+    checkpoint-corruption faults, per-host checkpoint directories, and
+    every recovery a cluster consensus. The parent asserts all ranks
+    rolled back to identical steps and finished in lockstep."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "chaos_check.py")
+    env = dict(os.environ)
+    env.pop("DEAR_DISABLE_DISTRIBUTED", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, "--procs", "2", "--steps", "16",
+         "--workdir", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=360,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]
 
 
 # -- autotuner sandboxing -----------------------------------------------------
