@@ -217,11 +217,16 @@ def main(argv=None) -> runner.BenchResult:
             float(holder["metrics"]["loss"])
 
     metrics_log = runner.metrics_from_args(args)
+    # with --mfu, one AOT cost analysis BEFORE timing: the run-health
+    # monitor watches live per-iteration MFU, log_mfu reuses the flops
+    flops = (runner.step_flops(ts, holder["state"], batch)
+             if args.mfu else None)
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     try:
         result = runner.run_timed(
             step_fn, unit="sen", sync=sync, metrics=metrics_log,
+            flops_per_step=flops,
             **timed_kwargs,
         )
     finally:
@@ -234,7 +239,7 @@ def main(argv=None) -> runner.BenchResult:
                f"{result.total_mean * args.sequence_len:.0f}")
     if args.mfu:
         runner.log_mfu(getattr(stepper, "ts", ts), holder["state"], batch,
-                       result)
+                       result, flops=flops)
     return result
 
 

@@ -143,6 +143,10 @@ def main(argv=None) -> runner.BenchResult:
             float(holder["metrics"]["loss"])
 
     metrics_log = runner.metrics_from_args(args)
+    # with --mfu, one AOT cost analysis BEFORE timing: the run-health
+    # monitor watches live per-iteration MFU, log_mfu reuses the flops
+    flops = (runner.step_flops(ts, holder["state"], batch)
+             if args.mfu else None)
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     try:
@@ -151,6 +155,7 @@ def main(argv=None) -> runner.BenchResult:
             unit="img",
             sync=sync,
             metrics=metrics_log,
+            flops_per_step=flops,
             **timed_kwargs,
         )
     finally:
@@ -160,9 +165,10 @@ def main(argv=None) -> runner.BenchResult:
             metrics_log.close()
         close()
     if args.mfu:
-        # the autotuner may have re-bucketed: use its CURRENT step
+        # the autotuner may have re-bucketed: use its CURRENT step (the
+        # precomputed flops short-circuits the recompile when present)
         runner.log_mfu(getattr(stepper, "ts", ts), holder["state"], batch,
-                       result)
+                       result, flops=flops)
     return result
 
 

@@ -70,6 +70,29 @@ def device_name() -> str:
     return {"tpu": "TPU", "cpu": "CPU", "gpu": "GPU"}.get(plat, plat.upper())
 
 
+def _cost_dict(cost) -> dict:
+    """`Compiled.cost_analysis()` returns a dict on current jax but a
+    one-element LIST of dicts on the 0.4.x line this container bakes —
+    normalize so `.get("flops")` works on both."""
+    if isinstance(cost, (list, tuple)):
+        return cost[0] if cost else {}
+    return cost or {}
+
+
+def step_flops(ts, state, batch) -> Optional[float]:
+    """Per-step FLOPs from XLA cost analysis of the compiled train step
+    (one AOT compile; None where cost analysis is unavailable). Compute
+    this BEFORE `run_timed` and pass it as ``flops_per_step`` so the
+    anomaly monitor can watch live MFU; hand the same value to `log_mfu`
+    to avoid a second compile."""
+    try:
+        cost = _cost_dict(ts.lower(state, batch).compile().cost_analysis())
+        flops = float(cost.get("flops", 0.0))
+        return flops or None
+    except Exception:
+        return None
+
+
 def run_timed(
     step_fn: Callable[[], Any],
     *,
@@ -82,6 +105,7 @@ def run_timed(
     world: Optional[int] = None,
     metrics=None,
     steps_per_call: int = 1,
+    flops_per_step: Optional[float] = None,
 ) -> BenchResult:
     """Run the warmup + timed-iteration protocol around ``step_fn``.
 
@@ -93,7 +117,9 @@ def run_timed(
     iteration plus a final summary record. ``steps_per_call`` says how many
     REAL train steps one ``step_fn()`` call performs (the scanned
     protocol) so reported step times stay per-step; ``batch_size`` must
-    then be the items per CALL.
+    then be the items per CALL. ``flops_per_step`` (see `step_flops`)
+    lets the run-health anomaly monitor watch live MFU per iteration
+    (`health.mfu_drop`; needs a device with a known peak, i.e. TPU).
     """
     dev = device_name()
     world = backend.device_count() if world is None else world
@@ -121,6 +147,27 @@ def run_timed(
             sync()
 
         log("Running benchmark...")
+        # run health on the timed loop: every iteration lands in the
+        # flight ring and feeds the anomaly detectors (a mid-benchmark
+        # step-time spike or input stall raises health.* counters that
+        # end up in the TELEMETRY block); both gates are no-ops when
+        # telemetry is off
+        from dear_pytorch_tpu.observability import anomaly as _anomaly
+        from dear_pytorch_tpu.observability import flight as _flight
+        from dear_pytorch_tpu.observability import tracer as _tracer
+
+        fl = _flight.get_recorder()
+        # per-phase ring: bench.py reuses the process-global recorder
+        # across models, and the end-of-run step-time gauges below must
+        # not mix this phase's quantiles with the previous workload's
+        fl.clear()
+        tr = _tracer.get_tracer()
+        monitor = None
+        if tr.enabled and _anomaly.AnomalyMonitor.enabled_by_env():
+            overrides = {"tracer": tr}
+            if not os.environ.get("DEAR_HEALTH_WARMUP", "").strip():
+                overrides["warmup"] = 2  # few timed iters: arm early
+            monitor = _anomaly.AnomalyMonitor.from_env(**overrides)
         per_iter, iter_times = [], []
         for x in range(num_iters):
             if dog is not None:
@@ -135,11 +182,24 @@ def run_timed(
             log(f"Iter #{x}: {thr:.1f} {unit}/sec per {dev}")
             per_iter.append(thr)
             # per REAL train step, independent of the scanned-dispatch shape
-            iter_times.append(dt / (num_batches_per_iter * steps_per_call))
+            step_time_s = dt / (num_batches_per_iter * steps_per_call)
+            iter_times.append(step_time_s)
+            if fl.enabled:
+                fl.record((x + 1) * num_batches_per_iter * steps_per_call,
+                          step_time_s=step_time_s, iter=x)
+            if monitor is not None:
+                mfu = None
+                if flops_per_step:
+                    from dear_pytorch_tpu.utils import perf_model
+
+                    mfu = perf_model.mfu(flops_per_step, step_time_s,
+                                         jax.devices()[0])
+                monitor.observe(step=x, step_time_s=step_time_s,
+                                counters=tr.counters(), mfu=mfu)
             if metrics is not None:
                 metrics.log(
                     iter=x, **{f"{unit}_per_sec_per_device": thr},
-                    step_time_s=dt / (num_batches_per_iter * steps_per_call),
+                    step_time_s=step_time_s,
                 )
     finally:
         if dog is not None:
@@ -178,6 +238,15 @@ def run_timed(
         log("TELEMETRY " + json.dumps(snap))
         if metrics is not None:
             metrics.log(kind="telemetry", telemetry=json.dumps(snap))
+        # feed any prom:/stream: sinks one end-of-run snapshot
+        from dear_pytorch_tpu.observability import export as _export
+
+        gauges = {"step_time_mean_seconds": res.iter_time_mean}
+        st = fl.step_time_stats() if fl.enabled else {}
+        if st:
+            gauges.update(step_time_p50_seconds=st["p50_s"],
+                          step_time_max_seconds=st["max_s"])
+        _export.write_streams(snap, gauges, tracer=tr)  # never raises
     return res
 
 
@@ -399,15 +468,20 @@ def make_batch_source(args, spec, sharding, template_batch):
     return next_batch, pl.close
 
 
-def log_mfu(ts, state, batch, result: BenchResult) -> Optional[float]:
+def log_mfu(ts, state, batch, result: BenchResult,
+            flops: Optional[float] = None) -> Optional[float]:
     """Log achieved FLOP/s + MFU for the compiled train step (enable with
     ``--mfu``). ``result.iter_time_mean`` is per REAL step under every
-    protocol (run_timed's steps_per_call accounting)."""
+    protocol (run_timed's steps_per_call accounting). ``flops`` reuses a
+    `step_flops` value computed before the timed run (no second AOT
+    compile)."""
     from dear_pytorch_tpu.utils import perf_model
 
     try:
-        cost = ts.lower(state, batch).compile().cost_analysis()
-        flops = float(cost.get("flops", 0.0))
+        if flops is None:
+            cost = _cost_dict(
+                ts.lower(state, batch).compile().cost_analysis())
+            flops = float(cost.get("flops", 0.0))
     except Exception as exc:  # cost analysis is best-effort on all backends
         log(f"MFU: unavailable ({type(exc).__name__}: {exc})")
         return None

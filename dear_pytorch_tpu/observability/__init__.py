@@ -20,6 +20,20 @@ events, monotonic counters) and the consumers:
   - `report`   — text/JSON rendering + ``python -m
                  dear_pytorch_tpu.observability.report`` entry point.
 
+The continuous run-health layer (docs/OBSERVABILITY.md "Run health"):
+
+  - `flight`    — bounded per-step flight recorder (the last N steps of
+                  context, dumped by watchdog forensics and rollbacks).
+  - `aggregate` — cluster-wide digest merge + straggler detection over the
+                  host-level coordination cadence.
+  - `export`    — streaming exporters (Prometheus text file, rotating
+                  JSONL health stream) + the shared `JsonlWriter` backend.
+  - `anomaly`   — online detectors (step-time spike, loss spike/plateau,
+                  input stall, MFU drop) and the offline bench-regression
+                  gate behind ``scripts/bench_gate.py``.
+  - `redaction` — secret/env redaction every exported env block passes
+                  through.
+
 The hot-path contract: instrumented code asks ``get_tracer()`` (a module
 attribute read) and checks ``.enabled`` before doing anything else, so a
 disabled tracer costs one attribute lookup per step.
@@ -48,6 +62,21 @@ _LAZY = {
     "plan_comm_accounting": "counters",
     "audit_train_step": "overlap",
     "OverlapReport": "overlap",
+    # run-health layer
+    "FlightRecorder": "flight",
+    "NullFlightRecorder": "flight",
+    "get_recorder": "flight",
+    "AnomalyMonitor": "anomaly",
+    "compare_bench": "anomaly",
+    "bench_metrics": "anomaly",
+    "MetricAggregator": "aggregate",
+    "local_digest": "aggregate",
+    "merge_digests": "aggregate",
+    "JsonlWriter": "export",
+    "PromFileExporter": "export",
+    "HealthStreamExporter": "export",
+    "write_streams": "export",
+    "redact_env": "redaction",
 }
 
 

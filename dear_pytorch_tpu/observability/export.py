@@ -1,0 +1,285 @@
+"""Streaming run-health exporters + the shared JSONL writer.
+
+Two consumers needed a machine-scrapeable view of a LIVE run (not a
+post-hoc trace file): external monitoring (Prometheus node scrapers read
+a text-exposition file) and log shippers (append-only JSONL). Both plug
+into the existing tracer exporter protocol (duck-typed ``span``/``event``/
+``close``) plus one extra hook — ``export(snapshot, gauges=None)`` — that
+the run-health layer calls on its aggregation cadence with the current
+counter snapshot and derived gauges (step-time quantiles, straggler rank,
+anomaly state). The ``DEAR_TELEMETRY`` grammar gains two sink kinds:
+
+  DEAR_TELEMETRY=prom:/tmp/dear.prom            Prometheus text file
+  DEAR_TELEMETRY=stream:/tmp/health.jsonl       append-only health stream
+  DEAR_TELEMETRY=prom:/t.prom,stream:/h.jsonl,chrome:/c.json   all mix
+
+`JsonlWriter` is the ONE append-only JSON-lines backend in the repo:
+`utils.metrics.MetricsLogger` (the training-metrics API), the tracer's
+`JsonlExporter`, and the health stream all write through it — same
+json-safety rules (no bare NaN/Infinity tokens), same eager flush, same
+optional size-based rotation — so every ``.jsonl`` the framework emits
+parses with `utils.metrics.read_metrics`.
+
+Stdlib-only at module level (no jax): loadable standalone by the overhead
+probe, and usable from the watchdog path while the process is wedged.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import re
+import threading
+import time
+from typing import IO, Optional
+
+__all__ = [
+    "JsonlWriter", "PromFileExporter", "HealthStreamExporter",
+    "write_streams",
+]
+
+
+def _json_safe(v):
+    """NaN/Inf are not strict JSON (stringified), and numpy/jax scalars
+    and arrays coerce to host python values — duck-typed via ``tolist``
+    so this module never imports numpy/jax. Recursive."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return repr(v)
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if not isinstance(v, (str, bytes, bool, int, float, type(None))):
+        to_list = getattr(v, "tolist", None)  # ndarray/np scalar/jax Array
+        if callable(to_list):
+            return _json_safe(to_list())
+    return v
+
+
+class JsonlWriter:
+    """Append-only JSON-lines writer: one object per line, flushed eagerly
+    (a crashed run keeps everything up to the failure), with optional
+    size-based rotation (``path`` -> ``path.1`` -> ... -> ``path.N``)."""
+
+    def __init__(self, path: str, *, append: bool = False,
+                 max_bytes: Optional[int] = None, backups: int = 2):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.backups = max(int(backups), 1)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f: Optional[IO[str]] = open(path, "a" if append else "w")
+
+    @staticmethod
+    def json_safe(v):
+        return _json_safe(v)
+
+    def write(self, rec: dict) -> None:
+        line = json.dumps(_json_safe(rec)) + "\n"
+        with self._lock:
+            if self._f is None:
+                raise ValueError(f"JsonlWriter({self.path!r}) is closed")
+            self._f.write(line)
+            self._f.flush()
+            if self.max_bytes and self._f.tell() >= self.max_bytes:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        """Shift ``path.i`` -> ``path.i+1`` (oldest dropped) and reopen a
+        fresh ``path`` — bounded disk for always-on streams."""
+        self._f.close()
+        for i in range(self.backups - 1, 0, -1):
+            src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "w")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prom_name(name: str, *, namespace: str = "dear") -> str:
+    """``guard.rollbacks`` -> ``dear_guard_rollbacks`` (Prometheus metric
+    names allow ``[a-zA-Z0-9_]`` only)."""
+    return f"{namespace}_{_PROM_BAD.sub('_', name)}"
+
+
+def _resolve_rank_path(path: str) -> str:
+    """Substitute a literal ``{rank}`` placeholder with this process's
+    rank. Multi-host runs usually export one identical ``DEAR_TELEMETRY``
+    to every rank; on SHARED storage the snapshot sinks would then race
+    (every rank rewriting one .prom file, rotation renames colliding) —
+    ``prom:/shared/dear.{rank}.prom`` gives each rank its own file.
+    Resolved lazily (first write), because the grammar is parsed before
+    ``jax.distributed`` may be initialized."""
+    if "{rank}" not in path:
+        return path
+    from dear_pytorch_tpu.observability.tracer import process_index
+
+    return path.replace("{rank}", str(process_index()))
+
+
+class PromFileExporter:
+    """Prometheus text-exposition snapshot file, rewritten atomically on
+    every ``export`` call — point a node-exporter textfile collector (or
+    any scraper) at it. Counters export as ``counter``, derived gauges as
+    ``gauge``; the header carries the redacted ``DEAR_*`` environment so a
+    scraped alert can name the run configuration without leaking
+    credentials. The path may carry a ``{rank}`` placeholder (see
+    `_resolve_rank_path`) for shared-storage multi-host runs."""
+
+    def __init__(self, path: str, *, namespace: str = "dear"):
+        self._raw_path = path
+        self._path: Optional[str] = None
+        self.namespace = namespace
+        self._lock = threading.Lock()
+
+    @property
+    def path(self) -> str:
+        if self._path is None:
+            self._path = _resolve_rank_path(self._raw_path)
+            d = os.path.dirname(os.path.abspath(self._path))
+            os.makedirs(d, exist_ok=True)
+        return self._path
+
+    # tracer exporter protocol (span/event streams are not prom material)
+    def span(self, rec) -> None:  # noqa: ARG002
+        pass
+
+    def event(self, rec) -> None:  # noqa: ARG002
+        pass
+
+    def export(self, snapshot: dict, gauges: Optional[dict] = None) -> None:
+        from dear_pytorch_tpu.observability import redaction
+
+        lines = ["# dear_pytorch_tpu run-health snapshot"]
+        for k, v in redaction.redact_env().items():
+            lines.append(f"# env {k}={v}")
+        for name, value in sorted((snapshot or {}).get(
+                "counters", {}).items()):
+            pname = prom_name(name, namespace=self.namespace)
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {value:g}")
+        for name, value in sorted((gauges or {}).items()):
+            if value is None or isinstance(value, (str, bool)):
+                continue
+            pname = prom_name(name, namespace=self.namespace)
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {value:g}")
+        body = "\n".join(lines) + "\n"
+        tmp = f"{self.path}.tmp"
+        with self._lock:
+            # atomic replace: a scraper never reads a half-written file
+            with open(tmp, "w") as f:
+                f.write(body)
+            os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        pass
+
+
+class HealthStreamExporter:
+    """Append-only JSONL health stream with rotation: one record per
+    aggregation interval — counters, gauges, and (when present) the merged
+    cluster view — parseable back with `utils.metrics.read_metrics`. The
+    path may carry a ``{rank}`` placeholder (see `_resolve_rank_path`);
+    the file opens lazily at the first record so the rank is known."""
+
+    def __init__(self, path: str, *, max_bytes: int = 4 * 2 ** 20,
+                 backups: int = 2):
+        self._raw_path = path
+        self._max_bytes = max_bytes
+        self._backups = backups
+        self._w: Optional[JsonlWriter] = None
+        self._closed = False
+        self._t0 = time.time()
+
+    @property
+    def path(self) -> str:
+        return self._writer().path
+
+    def _writer(self) -> JsonlWriter:
+        if self._w is None:
+            self._w = JsonlWriter(
+                _resolve_rank_path(self._raw_path), append=True,
+                max_bytes=self._max_bytes, backups=self._backups)
+        return self._w
+
+    def span(self, rec) -> None:  # noqa: ARG002
+        pass
+
+    def event(self, rec) -> None:  # noqa: ARG002
+        pass
+
+    def export(self, snapshot: dict, gauges: Optional[dict] = None) -> None:
+        if self._closed:
+            return
+        rec = {"kind": "health", "time": round(time.time() - self._t0, 6)}
+        if snapshot:
+            rec["counters"] = snapshot.get("counters", {})
+        if gauges:
+            rec["gauges"] = gauges
+        self._writer().write(rec)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._w is not None:
+            self._w.close()
+
+
+def write_streams(snapshot: Optional[dict] = None,
+                  gauges: Optional[dict] = None, tracer=None) -> int:
+    """Push ``snapshot``/``gauges`` to every streaming exporter attached
+    to the (given or global) tracer; returns how many exporters wrote.
+    Cheap no-op when telemetry is off or no ``prom:``/``stream:`` sink is
+    configured — callers may invoke it on every aggregation interval.
+
+    Never raises: a monitoring sink failing (full disk, read-only volume,
+    NFS hiccup) must neither take down the run being monitored nor starve
+    the OTHER sinks — each exporter is fed independently, a failure
+    counts ``health.export_errors`` and logs once per sink (retried every
+    interval, so a recovered volume resumes streaming)."""
+    if tracer is None:
+        from dear_pytorch_tpu.observability import tracer as T
+
+        tracer = T.get_tracer()
+    if not tracer.enabled:
+        return 0
+    exporters = [e for e in tracer.exporters() if hasattr(e, "export")]
+    if not exporters:
+        return 0
+    if snapshot is None:
+        snapshot = {"counters": tracer.counters()}
+    wrote = 0
+    for e in exporters:
+        try:
+            e.export(snapshot, gauges)
+            wrote += 1
+        except Exception as exc:
+            tracer.count("health.export_errors")
+            if not getattr(e, "_export_error_logged", False):
+                try:
+                    e._export_error_logged = True
+                except Exception:
+                    pass
+                logging.getLogger("dear_pytorch_tpu").warning(
+                    "telemetry export via %s failed (%s: %s); training "
+                    "continues, this sink retries each interval",
+                    type(e).__name__, type(exc).__name__, exc)
+    return wrote

@@ -8,11 +8,14 @@ The event model (shared by every consumer in this package):
   counter  a named monotonic accumulator — ``tracer.count("steps")``,
            ``tracer.count("rs_bytes", 1.5e6)``
 
-Exporters adapt records onto the repo's existing backends: chrome trace
+Exporters adapt records onto the repo's shared backends: chrome trace
 (`utils.chrome_trace.TraceWriter` — view in Perfetto) and JSONL
-(`utils.metrics.MetricsLogger` — parse back with `read_metrics`), plus an
-in-memory exporter for tests and report assembly. An exporter sees every
-finished span and instant event; counters are pull-only (snapshot).
+(`observability.export.JsonlWriter`, the one JSON-lines backend —
+parse back with `utils.metrics.read_metrics`), plus an in-memory exporter
+for tests and report assembly, and the run-health snapshot sinks
+(`observability.export.PromFileExporter` / `HealthStreamExporter`). An
+exporter sees every finished span and instant event; counters are
+pull-only (snapshot).
 
 Process-global tracer: ``get_tracer()`` returns the module-global instance
 — a `NullTracer` unless telemetry was enabled by ``configure(...)`` or the
@@ -21,7 +24,12 @@ Process-global tracer: ``get_tracer()`` returns the module-global instance
   DEAR_TELEMETRY=1                          counters + in-memory events
   DEAR_TELEMETRY=chrome:/tmp/t.json         + chrome trace file
   DEAR_TELEMETRY=jsonl:/tmp/t.jsonl         + JSONL event log
-  DEAR_TELEMETRY=chrome:/a.json,jsonl:/b.jsonl   both
+  DEAR_TELEMETRY=prom:/tmp/dear.prom        + Prometheus text snapshot file
+  DEAR_TELEMETRY=stream:/tmp/health.jsonl   + rotating JSONL health stream
+  DEAR_TELEMETRY=chrome:/a.json,jsonl:/b.jsonl   any comma mix of sinks
+
+(`prom:` / `stream:` are snapshot sinks fed on the run-health aggregation
+cadence — see `observability.export` — not per-span streams.)
 
 Disabled-mode cost contract (asserted by
 ``scripts/check_telemetry_overhead.py`` and tests/test_observability.py):
@@ -54,7 +62,7 @@ __all__ = [
     "SpanRecord", "EventRecord", "Exporter", "MemoryExporter",
     "ChromeTraceExporter", "JsonlExporter", "Tracer", "NullTracer",
     "get_tracer", "set_tracer", "configure", "configure_from_env",
-    "disable", "snapshot", "TELEMETRY_ENV",
+    "disable", "snapshot", "process_index", "TELEMETRY_ENV",
 ]
 
 TELEMETRY_ENV = "DEAR_TELEMETRY"
@@ -135,31 +143,62 @@ class ChromeTraceExporter(Exporter):
 
 
 class JsonlExporter(Exporter):
-    """Spans/events as JSONL records on a `utils.metrics.MetricsLogger`
-    (``kind`` discriminates; `read_metrics` round-trips them)."""
+    """Spans/events as JSONL records through the shared
+    `observability.export.JsonlWriter` backend — the same line format and
+    json-safety rules every other ``.jsonl`` in the repo uses, so
+    `utils.metrics.read_metrics` round-trips them (``kind``
+    discriminates). Also accepts an existing `utils.metrics.MetricsLogger`
+    (whose records then additionally carry its ``time`` field)."""
 
-    def __init__(self, path_or_logger, *, all_ranks: bool = False):
-        from dear_pytorch_tpu.utils.metrics import MetricsLogger
-
-        if isinstance(path_or_logger, MetricsLogger):
-            self._logger, self._owned = path_or_logger, False
+    def __init__(self, path_or_writer, *, all_ranks: bool = False):
+        self._log = None        # MetricsLogger compatibility path
+        self._writer = None
+        self._owned = False
+        if hasattr(path_or_writer, "log"):          # a MetricsLogger
+            self._log = path_or_writer.log
+        elif hasattr(path_or_writer, "write"):      # a JsonlWriter
+            self._writer = path_or_writer
         else:
-            self._logger = MetricsLogger(path_or_logger, all_ranks=all_ranks)
+            from dear_pytorch_tpu.observability.export import JsonlWriter
+
+            if not all_ranks and process_index() != 0:
+                return  # inactive rank: drop records (matches MetricsLogger)
+            self._writer = JsonlWriter(path_or_writer)
             self._owned = True
 
+    def _write(self, **rec) -> None:
+        if self._log is not None:
+            self._log(**rec)
+        elif self._writer is not None:
+            self._writer.write(rec)
+
     def span(self, rec: SpanRecord) -> None:
-        self._logger.log(kind="span", name=rec.name,
-                         t0_us=round(rec.t0_us, 3),
-                         dur_us=round(rec.dur_us, 3),
-                         tid=rec.tid, depth=rec.depth, **rec.attrs)
+        self._write(kind="span", name=rec.name,
+                    t0_us=round(rec.t0_us, 3),
+                    dur_us=round(rec.dur_us, 3),
+                    tid=rec.tid, depth=rec.depth, **rec.attrs)
 
     def event(self, rec: EventRecord) -> None:
-        self._logger.log(kind="event", name=rec.name,
-                         ts_us=round(rec.ts_us, 3), **rec.attrs)
+        self._write(kind="event", name=rec.name,
+                    ts_us=round(rec.ts_us, 3), **rec.attrs)
 
     def close(self) -> None:
-        if self._owned:
-            self._logger.close()
+        if self._owned and self._writer is not None:
+            self._writer.close()
+
+
+def process_index() -> int:
+    """This process's rank, tolerantly: 0 when jax is absent or unusable
+    (a plain-python process is its own rank 0; a crashing-backend process
+    must still be able to report). The ONE rank lookup every
+    observability/resilience reporter shares — watchdog dump headers,
+    rank-0-gated sinks, cluster digests."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
 
 
 class _Span:
@@ -295,6 +334,11 @@ class Tracer:
     def add_exporter(self, exporter: Exporter) -> None:
         self._exporters.append(exporter)
 
+    def exporters(self) -> tuple:
+        """Read-only view of the attached exporters (the public surface
+        for snapshot-sink discovery — see `export.write_streams`)."""
+        return tuple(self._exporters)
+
     def close(self) -> None:
         for e in self._exporters:
             e.close()
@@ -328,6 +372,9 @@ class NullTracer:
             f"set {TELEMETRY_ENV} before adding exporters"
         )
 
+    def exporters(self) -> tuple:
+        return ()
+
     def close(self) -> None:
         pass
 
@@ -357,11 +404,14 @@ def set_tracer(tracer) -> None:
 
 
 def configure(*, chrome: Optional[str] = None, jsonl: Optional[str] = None,
+              prom: Optional[str] = None, stream: Optional[str] = None,
               memory: bool = True,
               exporters: Sequence[Exporter] = ()) -> Tracer:
     """Enable telemetry with the given sinks and install the tracer
     process-globally. Returns the live tracer. The in-memory exporter is
-    on by default so `snapshot()` always has events to summarize."""
+    on by default so `snapshot()` always has events to summarize.
+    ``prom``/``stream`` attach the run-health snapshot sinks
+    (`observability.export`), fed on the aggregation cadence."""
     exp: list[Exporter] = list(exporters)
     if memory:
         exp.append(MemoryExporter())
@@ -369,9 +419,24 @@ def configure(*, chrome: Optional[str] = None, jsonl: Optional[str] = None,
         exp.append(ChromeTraceExporter(chrome))
     if jsonl:
         exp.append(JsonlExporter(jsonl))
+    exp.extend(_stream_exporters(prom, stream))
     tracer = Tracer(exp)
     set_tracer(tracer)
     return tracer
+
+
+def _stream_exporters(prom: Optional[str], stream: Optional[str]) -> list:
+    """Snapshot-sink exporters for the ``prom:``/``stream:`` specs (lazy
+    import: the export module is only loaded when a sink asks for it)."""
+    out: list = []
+    if prom or stream:
+        from dear_pytorch_tpu.observability import export as _export
+
+        if prom:
+            out.append(_export.PromFileExporter(prom))
+        if stream:
+            out.append(_export.HealthStreamExporter(stream))
+    return out
 
 
 def disable() -> None:
@@ -388,7 +453,8 @@ def configure_from_env(env: Optional[str] = None):
 
     Spec grammar: falsy ('', '0', 'false', 'no', unset) -> disabled;
     '1'/'true'/'mem' -> counters + memory exporter; otherwise a comma list
-    of ``chrome:<path>`` / ``jsonl:<path>`` sink specs.
+    of ``chrome:<path>`` / ``jsonl:<path>`` / ``prom:<path>`` /
+    ``stream:<path>`` sink specs.
     """
     global _tracer
     with _config_lock:
@@ -399,25 +465,25 @@ def configure_from_env(env: Optional[str] = None):
         if raw.lower() in ("", "0", "false", "no"):
             _tracer = _NULL_TRACER
             return _tracer
-        chrome = jsonl = None
+        sinks: dict[str, Optional[str]] = {
+            "chrome": None, "jsonl": None, "prom": None, "stream": None}
         if raw.lower() not in ("1", "true", "yes", "mem", "memory"):
             for part in raw.split(","):
                 kind, _, path = part.strip().partition(":")
-                if kind == "chrome" and path:
-                    chrome = path
-                elif kind == "jsonl" and path:
-                    jsonl = path
+                if kind in sinks and path:
+                    sinks[kind] = path
                 else:
                     raise ValueError(
                         f"{TELEMETRY_ENV}: bad sink spec {part!r} (use "
-                        "'1', 'chrome:<path>', 'jsonl:<path>' or a comma "
-                        "list of the latter two)"
+                        "'1', or a comma list of 'chrome:<path>', "
+                        "'jsonl:<path>', 'prom:<path>', 'stream:<path>')"
                     )
         exp: list[Exporter] = [MemoryExporter()]
-        if chrome:
-            exp.append(ChromeTraceExporter(chrome))
-        if jsonl:
-            exp.append(JsonlExporter(jsonl))
+        if sinks["chrome"]:
+            exp.append(ChromeTraceExporter(sinks["chrome"]))
+        if sinks["jsonl"]:
+            exp.append(JsonlExporter(sinks["jsonl"]))
+        exp.extend(_stream_exporters(sinks["prom"], sinks["stream"]))
         _tracer = Tracer(exp)
         return _tracer
 

@@ -8,7 +8,9 @@ daemon thread fed per-step heartbeats; when no beat arrives within the
 deadline it
 
   1. snapshots the telemetry tracer's OPEN spans (what the host was inside
-     of — `observability.tracer.Tracer.live_spans`),
+     of — `observability.tracer.Tracer.live_spans`) and the flight
+     recorder's ring (`observability.flight` — the last N steps of
+     context, with the redacted DEAR_* environment),
   2. dumps every Python thread's stack via ``faulthandler``,
   3. emits a ``watchdog.timeout`` telemetry event + counter, and
   4. invokes ``on_timeout(report)`` — by default logging the last-good
@@ -30,7 +32,8 @@ import os
 import sys
 import threading
 import time
-from typing import Callable, NamedTuple, Optional
+from types import MappingProxyType
+from typing import Callable, Mapping, NamedTuple, Optional, Sequence
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
 
@@ -49,17 +52,17 @@ class WatchdogReport(NamedTuple):
     live_spans: list         # open tracer spans at firing time
     process_index: int = 0   # which rank's dump this is (multi-host logs)
     faults: str = ""         # active DEAR_FAULTS schedule, if any
+    # immutable defaults: NamedTuple defaults are class-level shared
+    # instances, so a mutable [] / {} here would let one report's edits
+    # leak into every later default-constructed report
+    flight: Sequence = ()           # flight ring (last N step records)
+    env: Mapping = MappingProxyType({})  # redacted DEAR_* env context
 
 
 def _process_index() -> int:
-    """This process's rank for dump headers; 0 when jax is unusable (the
-    watchdog must never crash while reporting a crash)."""
-    try:
-        import jax
-
-        return int(jax.process_index())
-    except Exception:
-        return 0
+    """This process's rank for dump headers (the shared tolerant lookup:
+    the watchdog must never crash while reporting a crash)."""
+    return _telemetry.process_index()
 
 
 def _active_faults() -> str:
@@ -164,12 +167,27 @@ class StepWatchdog:
             self._fire(waited, info)
 
     def _make_report(self, waited: float, info: dict) -> WatchdogReport:
+        from dear_pytorch_tpu.observability import flight as _flight
+        from dear_pytorch_tpu.observability import redaction as _redaction
+
         tr = _telemetry.get_tracer()
         live = tr.live_spans() if tr.enabled else []
+        # tolerant context gathering: the watchdog must never crash while
+        # reporting a crash — e.g. a typo'd DEAR_FLIGHT raises ValueError
+        # on FIRST recorder resolution, which may well happen right here
+        try:
+            ring = _flight.get_recorder().records()
+        except Exception:
+            ring = []
+        try:
+            env = _redaction.redact_env()
+        except Exception:
+            env = {}
         return WatchdogReport(
             name=self.name, waited_s=waited, deadline_s=self.deadline_s,
             beat_info=info, live_spans=live,
             process_index=_process_index(), faults=_active_faults(),
+            flight=ring, env=env,
         )
 
     def _dump(self, report: WatchdogReport, cause: str) -> None:
@@ -184,6 +202,20 @@ class StepWatchdog:
             "follow +++\n"
         )
         faulthandler.dump_traceback(file=sys.stderr)
+        if report.flight:
+            # the last N steps of context (flight ring): what the run was
+            # doing, step by step, before it hung. One JSON line so
+            # multi-rank logs stay machine-separable; env context is
+            # already redacted by _make_report.
+            import json
+
+            sys.stderr.write(
+                f"+++ {report.name} [rank {report.process_index}] flight "
+                f"ring ({len(report.flight)} records) +++\n"
+            )
+            sys.stderr.write(json.dumps(
+                {"flight": list(report.flight),
+                 "env": dict(report.env)}) + "\n")
         sys.stderr.flush()
 
     def _fire(self, waited: float, info: dict) -> None:

@@ -50,18 +50,34 @@ plugs in here:
     (after kicking the watchdog's forensic dump) instead of deadlocking,
   - **telemetry**: every recovery event lands in `observability` counters
     (``guard.rollbacks``, ``guard.restores``, ``guard.steps_skipped``,
-    ``cluster.*``, ...) so it shows up in `bench.py` telemetry blocks.
+    ``cluster.*``, ...) so it shows up in `bench.py` telemetry blocks,
+  - **run health** (docs/OBSERVABILITY.md "Run health"): with telemetry
+    enabled every step lands in the `observability.flight` ring (dumped
+    on rollback and by watchdog forensics), the check cadence feeds the
+    `observability.anomaly` detectors (``health.*`` counters; set
+    ``DEAR_HEALTH_KICK=1`` to escalate an anomaly into a watchdog
+    forensic dump), coordinated runs piggyback an
+    `observability.aggregate` digest exchange on the health sync (rank 0
+    holds the merged cluster snapshot in ``merged_health`` — straggler
+    rank, fleet counters), and any configured ``prom:``/``stream:``
+    exporters are fed each interval.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import math
+import os
 import time
 from typing import Any, Callable, Optional
 
 import jax
 
+from dear_pytorch_tpu.observability import aggregate as _aggregate
+from dear_pytorch_tpu.observability import anomaly as _anomaly
+from dear_pytorch_tpu.observability import export as _export
+from dear_pytorch_tpu.observability import flight as _flight
 from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.resilience import cluster as _cluster
 from dear_pytorch_tpu.resilience import inject as _inject
@@ -133,6 +149,24 @@ class GuardedTrainer:
             # already separates multiple trainers in one process
             coordinator = _cluster.ClusterCoordinator(namespace="guard")
         self._coordinator = coordinator
+        # run-health layer: flight ring (enabled alongside telemetry; see
+        # the _flight property), anomaly detectors on the check cadence,
+        # and — on coordinated runs — the digest aggregation that rides
+        # the health exchanges.
+        self._anomaly: Optional[_anomaly.AnomalyMonitor] = None
+        if (_telemetry.get_tracer().enabled
+                and _anomaly.AnomalyMonitor.enabled_by_env()):
+            self._anomaly = _anomaly.AnomalyMonitor.from_env(
+                on_anomaly=self._on_anomaly)
+        self._aggregator: Optional[_aggregate.MetricAggregator] = None
+        if self._coordinated and hasattr(self._coordinator, "exchange"):
+            # aggregation needs the raw exchange primitive; a scripted
+            # verdict-only coordinator (tests) simply skips it
+            self._aggregator = _aggregate.MetricAggregator(
+                self._coordinator)
+        self.merged_health: Optional[dict] = None
+        self._prev_step_t: Optional[float] = None
+        self._last_loss: Optional[float] = None
         self._pending_error: Optional[BaseException] = None
         self._peer_preempt = False
         self._preempt_handled = False
@@ -155,6 +189,15 @@ class GuardedTrainer:
             ckpt.prune_orphaned_tmp(directory)
 
     # -- internals -----------------------------------------------------------
+
+    @property
+    def _flight(self):
+        """The process-global flight recorder, resolved per access (one
+        module-dict lookup) rather than cached at construction — the ring
+        follows `tracer.configure()`/`disable()` after the trainer is
+        built, keeping guard dumps in step with the watchdog's and the
+        digest's view of it."""
+        return _flight.get_recorder()
 
     @property
     def _coordinated(self) -> bool:
@@ -350,7 +393,55 @@ class GuardedTrainer:
 
     def _check(self, metrics) -> bool:
         loss = float(jax.device_get(metrics["loss"]))
+        self._last_loss = loss  # the run-health layer reuses the fetch
         return math.isfinite(loss)
+
+    def _on_anomaly(self, kind: str, detail: dict) -> None:
+        """Escalation hook for the online detectors: always logged; with
+        ``DEAR_HEALTH_KICK=1`` an anomaly additionally triggers the step
+        watchdog's immediate forensic dump (open spans, thread stacks,
+        flight ring) — for hunting creeping regressions that never quite
+        hang. A tuner harness can install its own monitor with an
+        ``on_anomaly`` that calls ``Tuner.mark_infeasible`` instead."""
+        logger.warning("guard: health anomaly %s: %s", kind, detail)
+        if (self._watchdog is not None
+                and os.environ.get("DEAR_HEALTH_KICK", "").strip().lower()
+                in ("1", "true", "yes", "on")):
+            self._watchdog.kick(
+                f"health anomaly: {kind}",
+                **{k: v for k, v in detail.items()
+                   if isinstance(v, (int, float, str))})
+
+    def _health_tick(self, tr, per_step_s: Optional[float]) -> None:
+        """Per-check-interval run-health work: feed the anomaly detectors
+        and push the current snapshot to any streaming exporters. Host-
+        side only and O(#counters) — stays off the dispatch path."""
+        if self._anomaly is not None:
+            self._anomaly.observe(
+                step=self.steps_seen, step_time_s=per_step_s,
+                loss=self._last_loss,
+                counters=tr.counters() if tr.enabled else None)
+        if not tr.enabled:
+            return
+        gauges: dict = {}
+        if self._flight.enabled:
+            st = self._flight.step_time_stats()
+            if st:
+                gauges["step_time_p50_seconds"] = st["p50_s"]
+                gauges["step_time_p90_seconds"] = st["p90_s"]
+                gauges["step_time_max_seconds"] = st["max_s"]
+        if per_step_s is not None:
+            gauges["check_interval_step_seconds"] = round(per_step_s, 6)
+        merged = self.merged_health
+        if merged:
+            if merged.get("straggler_rank") is not None:
+                gauges["cluster_straggler_rank"] = merged["straggler_rank"]
+            if merged.get("straggler_skew") is not None:
+                gauges["cluster_straggler_skew"] = merged["straggler_skew"]
+        # write_streams never raises: a failing monitoring sink counts
+        # health.export_errors and logs once, training continues
+        _export.write_streams({"counters": tr.counters()}, gauges,
+                              tracer=tr)
 
     def _attempt(self, state, batch, tr):
         """Run one step attempt and its cadence bookkeeping. The normal
@@ -378,6 +469,18 @@ class GuardedTrainer:
         handled preemption sets ``metrics["preempted"]`` (exit the loop)."""
         error: Optional[BaseException] = None
         tr = _telemetry.get_tracer()
+        fl = self._flight
+        self._last_loss = None
+        step_dt: Optional[float] = None
+        if fl.enabled:
+            # per-step cadence for the flight ring: the gap between step()
+            # entries covers the WHOLE loop (input fetch included — under
+            # async dispatch this is dispatch cadence, not device time;
+            # the check-interval timing below is the fetched truth)
+            now0 = time.perf_counter()
+            if self._prev_step_t is not None:
+                step_dt = now0 - self._prev_step_t
+            self._prev_step_t = now0
         dispatched = False
         try:
             if self._injector is not None:
@@ -444,6 +547,11 @@ class GuardedTrainer:
                 healthy, new_state, metrics, error = False, None, None, exc
                 is_check = is_ckpt = False
 
+        if fl.enabled:
+            fl.record(self.steps_seen, step_time_s=step_dt,
+                      loss=self._last_loss, checked=int(is_check))
+
+        per_step_s: Optional[float] = None
         if is_check and healthy:
             # timing across the sync interval: under async dispatch only a
             # checked (fetched) step gives a meaningful wall-clock point;
@@ -452,6 +560,7 @@ class GuardedTrainer:
             interval = self.steps_seen - self._last_check_steps
             if self._last_check_t is not None and interval > 0:
                 per_step = (now - self._last_check_t) / interval
+                per_step_s = per_step
                 if (
                     self.ema_step_s is not None
                     and per_step > 10 * self.ema_step_s
@@ -488,6 +597,12 @@ class GuardedTrainer:
                                and self._preemption.requested
                                and not self._preempt_handled),
                 )
+                if self._aggregator is not None:
+                    # metric aggregation rides the same cadence (and the
+                    # same bounded deadline): one lockstep digest exchange
+                    # per health sync. Every rank computes the identical
+                    # merged snapshot; rank 0's is the exported copy.
+                    self.merged_health = self._aggregator.exchange()
             except _cluster.PeerTimeout:
                 # dead-peer detection: dump forensics (open spans + all
                 # thread stacks) through the watchdog, then degrade to
@@ -510,6 +625,9 @@ class GuardedTrainer:
                 healthy = False
             self._pending_error = None
 
+        if is_check:
+            self._health_tick(tr, per_step_s)
+
         if not healthy:
             self.recoveries += 1
             if self.recoveries > self.max_recoveries:
@@ -517,8 +635,22 @@ class GuardedTrainer:
                     f"diverged {self.recoveries} consecutive times "
                     f"(max_recoveries={self.max_recoveries})"
                 ) from error
+            if fl.enabled:
+                # every failure report ships the last N steps of context:
+                # one JSON line (counter deltas, live spans, redacted
+                # DEAR_* env) so multi-rank logs stay machine-separable
+                dump = fl.dump()
+                logger.warning(
+                    "guard: flight ring at rollback (%d records): %s",
+                    len(dump["records"]), json.dumps(dump),
+                )
+                if tr.enabled:
+                    tr.count("guard.flight_dumps")
+                    tr.event("guard.flight_dump",
+                             records=len(dump["records"]))
             restored, at_step = self._restore(cause=error)
             self._last_check_t = None  # restore time must not skew timing
+            self._prev_step_t = None   # ditto for the flight cadence
             if tr.enabled:
                 # counted only after the restore actually happened — the
                 # give-up/restore-failure paths above must not inflate the

@@ -3,23 +3,33 @@ reference reduces to rank-0 ``print`` + log-scraping regexes (SURVEY.md §5:
 ``log()`` helpers, dear/imagenet_benchmark.py:139-142; results recovered by
 ``extract_log`` pattern-matching, benchmarks.py:119-128).
 
-One record per call, one JSON object per line, flushed eagerly so a crashed
-run keeps everything logged up to the failure. Rank-0-only by default (the
-in-step metrics are already cross-replica reduced). Values are coerced to
-host scalars lazily — pass device arrays freely, but note each write then
-costs a device sync; under async dispatch prefer logging every N steps.
+`MetricsLogger` is a thin shim over the ONE JSONL backend in the repo —
+`observability.export.JsonlWriter` (also behind the tracer's
+`JsonlExporter` and the run-health stream), so every ``.jsonl`` the
+framework emits shares the line format and json-safety rules and parses
+back with `read_metrics`. What the shim adds is the training-metrics
+record shape: a wall-clock ``time`` (seconds since logger creation), an
+optional ``step``, device-array -> host-scalar coercion, and rank-0-only
+gating (the in-step metrics are already cross-replica reduced).
+
+One record per call, one JSON object per line, flushed eagerly so a
+crashed run keeps everything logged up to the failure. Values are coerced
+to host scalars lazily — pass device arrays freely, but note each write
+then costs a device sync; under async dispatch prefer logging every N
+steps.
 """
 
 from __future__ import annotations
 
 import json
-import os
 import time
 import warnings
-from typing import IO, Optional
+from typing import Optional
 
 import jax
 import numpy as np
+
+from dear_pytorch_tpu.observability.export import JsonlWriter
 
 
 class MetricsLogger:
@@ -36,23 +46,11 @@ class MetricsLogger:
     def __init__(self, path: str, *, all_ranks: bool = False,
                  append: bool = False):
         self._active = all_ranks or jax.process_index() == 0
-        self._f: Optional[IO[str]] = None
+        self._w: Optional[JsonlWriter] = None
         self.path = path
         if self._active:
-            d = os.path.dirname(os.path.abspath(path))
-            os.makedirs(d, exist_ok=True)
-            self._f = open(path, "a" if append else "w")
+            self._w = JsonlWriter(path, append=append)
         self._t0 = time.time()
-
-    @staticmethod
-    def _json_safe(v):
-        # NaN/Inf are not standard JSON (json.dumps would emit bare NaN
-        # tokens that strict parsers reject); stringify them, recursively
-        if isinstance(v, float) and not np.isfinite(v):
-            return repr(v)
-        if isinstance(v, list):
-            return [MetricsLogger._json_safe(x) for x in v]
-        return v
 
     @staticmethod
     def _scalar(v):
@@ -60,8 +58,8 @@ class MetricsLogger:
             return v
         arr = np.asarray(jax.device_get(v))
         if arr.size == 1:
-            return MetricsLogger._json_safe(arr.reshape(()).item())
-        return MetricsLogger._json_safe(arr.tolist())
+            return JsonlWriter.json_safe(arr.reshape(()).item())
+        return JsonlWriter.json_safe(arr.tolist())
 
     def log(self, step: Optional[int] = None, **values) -> None:
         if not self._active:
@@ -71,13 +69,13 @@ class MetricsLogger:
             rec["step"] = int(step)
         for k, v in values.items():
             rec[k] = self._scalar(v)
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        self._w.write(rec)
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        if self._w is not None:
+            self._w.close()
+            self._w = None
+            self._active = False
 
     def __enter__(self):
         return self

@@ -1,0 +1,122 @@
+"""Bench-regression gate: fail CI on a throughput regression.
+
+Compares a fresh `bench.py` contract JSON against a pinned baseline and
+exits nonzero when any shared metric regressed by more than the
+tolerance — turning the BENCH_r*.json round history from a human-read
+artifact into an automated check:
+
+  python bench.py > /tmp/fresh.json
+  python scripts/bench_gate.py --baseline BENCH_r05.json \
+      --run /tmp/fresh.json --tolerance 0.05
+
+Both files may be either the raw contract line (``{"metric", "value",
+"extra_metrics": [...]}``) or the driver's round record (``{"parsed":
+{...}}``). Metrics are throughput numbers (higher is better); entries
+that errored carry no value and are skipped on the run side only if the
+baseline also lacks them — a metric the baseline HAS but the fresh run
+lost counts as a failure (``missing``), because a benchmark that silently
+stopped reporting is a harness regression, not parity.
+
+``--metrics a,b`` restricts the comparison; ``--allow-missing`` downgrades
+lost metrics to a warning (for gating a deliberately partial run).
+
+Prints one JSON verdict line (the `observability.anomaly.compare_bench`
+shape). Exit codes: 0 ok · 2 regression/missing · 3 unusable input.
+
+Pure host-side Python (no jax): tier-1 safe, driven by
+tests/test_run_health.py on synthetic fixtures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        text = f.read().strip()
+    # a well-formed file (pretty-printed BENCH_r*.json, or a bare
+    # contract line) parses whole
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            return doc
+    except json.JSONDecodeError:
+        pass
+    # otherwise tolerate a captured stdout file holding the contract line
+    # amid other output: the contract is ONE JSON object per line, so
+    # take the last parseable one
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+            if isinstance(doc, dict):
+                return doc
+        except json.JSONDecodeError:
+            continue
+    raise ValueError(f"{path}: no JSON object found")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail on bench throughput regressions vs a baseline")
+    ap.add_argument("--baseline", required=True,
+                    help="pinned bench JSON (contract line or BENCH_r*.json)")
+    ap.add_argument("--run", required=True,
+                    help="fresh bench JSON to gate")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed relative regression (default 5%%)")
+    ap.add_argument("--metrics", default=None,
+                    help="comma list restricting which metrics to compare")
+    ap.add_argument("--allow-missing", action="store_true",
+                    help="metrics the run lost vs the baseline only warn")
+    args = ap.parse_args(argv)
+
+    # stdlib-only import path: anomaly.py never touches jax
+    from dear_pytorch_tpu.observability import anomaly as A
+
+    try:
+        baseline, run = _load(args.baseline), _load(args.run)
+        if args.metrics:
+            keep = {m.strip() for m in args.metrics.split(",") if m.strip()}
+
+            def restrict(doc):
+                flat = A.bench_metrics(doc)
+                return {"extra_metrics": [
+                    {"metric": k, "value": v}
+                    for k, v in flat.items() if k in keep]}
+
+            baseline, run = restrict(baseline), restrict(run)
+        verdict = A.compare_bench(baseline, run, tolerance=args.tolerance)
+    except (OSError, ValueError) as exc:
+        print(json.dumps({"ok": False,
+                          "error": f"{type(exc).__name__}: {exc}"}))
+        return 3
+    if args.allow_missing and verdict["missing"] \
+            and not verdict["regressions"]:
+        verdict["ok"] = True
+    print(json.dumps(verdict))
+    if not verdict["ok"]:
+        lines = [f"  {r['metric']}: {r['run']:g} vs baseline "
+                 f"{r['baseline']:g} ({(r['ratio'] - 1) * 100:+.1f}%)"
+                 for r in verdict["regressions"]]
+        lines += [f"  {m}: missing from the run"
+                  for m in verdict["missing"]]
+        sys.stderr.write("bench_gate: REGRESSION beyond "
+                         f"{args.tolerance:.0%} tolerance:\n"
+                         + "\n".join(lines) + "\n")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,22 +1,25 @@
-"""Micro-benchmark of the telemetry hot path: disabled vs enabled tracer.
+"""Micro-benchmark of the telemetry hot path: disabled vs enabled gates.
 
-The tracer's contract (docs/OBSERVABILITY.md) is that a DISABLED tracer
-costs an instrumented call site one `get_tracer()` module lookup plus one
+The contract (docs/OBSERVABILITY.md) is that a DISABLED tracer costs an
+instrumented call site one `get_tracer()` module lookup plus one
 ``.enabled`` attribute read — so instrumenting the training step is free
-when telemetry is off. This script measures exactly that gate, the way
-`parallel/dear.py`'s ``step()`` executes it, and compares against the
-enabled path (counter add + span) and against an UNinstrumented baseline
-loop.
+when telemetry is off. The flight recorder (`observability.flight`) makes
+the SAME promise for its per-step `get_recorder()` gate. This script
+measures both gates, the way `parallel/dear.py`'s ``step()`` and
+`utils/guard.py`'s step path execute them, and compares against the
+enabled paths (counter add + span; ring record) and an UNinstrumented
+baseline loop.
 
 Pure host-side Python — no jax, no devices — so it runs anywhere in
 milliseconds (tier-1 safe; tests/test_observability.py drives `main` with
 small iteration counts). Prints one JSON line:
 
   {"disabled_ns_per_call": ..., "enabled_ns_per_call": ...,
+   "flight_disabled_ns_per_call": ..., "flight_enabled_ns_per_call": ...,
    "baseline_ns_per_call": ..., "disabled_overhead_ns": ...,
    "budget_ns": 1000.0, "ok": true}
 
-``ok`` asserts the disabled gate costs under ``--budget-ns`` (default
+``ok`` asserts BOTH disabled gates cost under ``--budget-ns`` (default
 1 µs — three orders of magnitude below a ~1 ms device step, i.e. the
 "< 1% of step time, unmeasurable" acceptance bar with huge margin).
 
@@ -58,12 +61,18 @@ def main(argv=None) -> int:
     # stdlib-only at module level.
     import importlib.util
 
-    spec = importlib.util.spec_from_file_location(
-        "_telemetry_tracer",
-        os.path.join(REPO, "dear_pytorch_tpu", "observability", "tracer.py"),
-    )
-    T = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(T)
+    def load_standalone(name: str, filename: str):
+        spec = importlib.util.spec_from_file_location(
+            name,
+            os.path.join(REPO, "dear_pytorch_tpu", "observability",
+                         filename),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    T = load_standalone("_telemetry_tracer", "tracer.py")
+    FL = load_standalone("_telemetry_flight", "flight.py")
 
     def baseline():
         # the uninstrumented call-site shape: one function call
@@ -85,18 +94,38 @@ def main(argv=None) -> int:
             with tr.span("dear.step"):
                 pass
 
+    # flight recorder gates, the way utils/guard.py's step path runs them
+    FL.set_recorder(FL.NullFlightRecorder())
+
+    def flight_disabled_gate():
+        fl = FL.get_recorder()
+        if fl.enabled:  # pragma: no cover - disabled branch
+            fl.record(0)
+
+    live_fl = FL.FlightRecorder(capacity=64, tracer=T.NullTracer())
+
+    def flight_enabled_site():
+        fl = live_fl
+        if fl.enabled:
+            fl.record(0, step_time_s=1e-3)
+
     baseline_ns = _bench(baseline, args.iters)
     disabled_ns = _bench(disabled_gate, args.iters)
     enabled_ns = _bench(enabled_site, max(args.iters // 10, 1))
+    fl_disabled_ns = _bench(flight_disabled_gate, args.iters)
+    fl_enabled_ns = _bench(flight_enabled_site, max(args.iters // 10, 1))
     overhead_ns = max(disabled_ns - baseline_ns, 0.0)
 
     out = {
         "baseline_ns_per_call": round(baseline_ns, 1),
         "disabled_ns_per_call": round(disabled_ns, 1),
         "enabled_ns_per_call": round(enabled_ns, 1),
+        "flight_disabled_ns_per_call": round(fl_disabled_ns, 1),
+        "flight_enabled_ns_per_call": round(fl_enabled_ns, 1),
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
-        "ok": disabled_ns <= args.budget_ns,
+        "ok": (disabled_ns <= args.budget_ns
+               and fl_disabled_ns <= args.budget_ns),
     }
     print(json.dumps(out))
     return 0 if out["ok"] else 1

@@ -8,6 +8,15 @@ only early-return from: `jax.distributed` bootstrap through `dear.init()`,
 devices live in different processes (reference equivalence: the
 mpirun-driven common/comm_core/tests/test_comm.py invariants).
 
+``DEAR_MP_MODE=health`` runs the run-health ladder (flight recorder +
+cluster metric aggregation + anomaly detection + streaming exporters over
+a REAL 2-process cluster, host-level only): one rank is artificially
+slowed mid-run and every rank must agree — through the digest exchange
+riding the guard's health-check cadence — on WHICH rank is the straggler;
+the slow rank must raise ``health.step_time_spike``; a watchdog kick must
+ship the flight ring (with redacted env context); the prom/stream
+exporters must have been fed on the check cadence.
+
 ``DEAR_MP_MODE=resilience`` runs the coordinated-recovery ladder instead
 (`resilience.cluster` through a real 2-process `GuardedTrainer`): each
 rank trains an independent replica (local mesh, per-host checkpoint
@@ -200,8 +209,142 @@ def _resilience_main() -> None:
     print(f"MP_RESILIENCE_OK rank={pid}/{n}", flush=True)
 
 
+def _health_main() -> None:
+    """Continuous run-health over a REAL 2-process cluster (ISSUE-4
+    acceptance): rank 1 is artificially slowed from mid-run; the digest
+    exchange riding the guard's health-check cadence must produce a
+    merged snapshot naming rank 1 as the straggler (on rank 0 — and,
+    since the merge is a pure function of the gathered views, identically
+    everywhere); the slow rank's anomaly monitor must raise
+    ``health.step_time_spike``; watchdog forensics must carry the
+    flight ring with redacted env; the prom/stream exporters must have
+    been fed. All coordination is HOST-level (the coordination-service KV
+    store), so this runs where cross-process XLA CPU computations
+    don't exist."""
+    import time
+
+    import dear_pytorch_tpu as dear
+    from dear_pytorch_tpu.observability import export as EX
+    from dear_pytorch_tpu.observability import flight as FL
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.resilience import StepWatchdog
+    from dear_pytorch_tpu.utils import read_metrics
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    os.environ["DEAR_CKPT_SHARED"] = "0"  # per-host checkpoint storage
+    dear.init()
+    n = int(os.environ["JAX_NUM_PROCESSES"])
+    pid = jax.process_index()
+    assert jax.process_count() == n
+    workdir = os.path.join(os.environ["DEAR_MP_WORKDIR"], f"rank{pid}")
+
+    # the acceptance scenario runs through the env grammar end to end:
+    # the launcher set DEAR_TELEMETRY=1 and DEAR_FLIGHT=16, so the
+    # tracer/ring resolve themselves; the streaming sinks are rank-local
+    # paths, attached through the exporter protocol
+    prom_path = os.path.join(workdir, "dear.prom")
+    stream_path = os.path.join(workdir, "health.jsonl")
+    tracer = T.get_tracer()
+    assert tracer.enabled, "DEAR_TELEMETRY must be set for health mode"
+    tracer.add_exporter(EX.PromFileExporter(prom_path))
+    tracer.add_exporter(EX.HealthStreamExporter(stream_path))
+    assert FL.get_recorder().enabled and FL.get_recorder().capacity == 16
+
+    # replica training is process-local: collectives over a 1-device mesh
+    mesh = jax.sharding.Mesh(np.asarray(jax.local_devices()), ("dp",))
+
+    def loss_fn(p, b):
+        x, y = b
+        pred = jnp.tanh(x @ p["w1"]) @ p["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    tparams = {
+        "w1": jax.random.normal(k, (8, 16)) * 0.3,
+        "w2": jax.random.normal(jax.random.fold_in(k, 1), (16, 4)) * 0.3,
+    }
+    ts = build_train_step(
+        loss_fn, tparams, mesh=mesh, mode="dear", threshold_mb=0.0001,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9), donate=False,
+    )
+    bk = jax.random.PRNGKey(7)
+
+    def batch_at(i):
+        kk = jax.random.fold_in(bk, i)
+        return (jax.random.normal(kk, (8, 8)),
+                jax.random.normal(jax.random.fold_in(kk, 1), (8, 4)))
+
+    dog = StepWatchdog(deadline_s=300, name="health-watchdog").start()
+    # check_every=3, not 2: rank 0 waits for the slow rank inside every
+    # health exchange, and that wait lands in rank 0's OWN flight-ring
+    # step gaps — at check_every=2 half of rank 0's ring would be
+    # exchange waits and its p50 would chase the straggler's
+    guard = GuardedTrainer(
+        ts, os.path.join(workdir, "ckpts"), tparams,
+        check_every=3, checkpoint_every=1000, watchdog=dog,
+    )
+    assert guard._coordinated, "2-process guard must auto-coordinate"
+    assert guard._aggregator is not None and guard._anomaly is not None
+    assert guard._flight.enabled
+
+    state = ts.init(tparams)
+    # the slowdown must be unmistakable against container-scheduler noise
+    # (an early ~0.2s hiccup inflates the warmup EWMA): 0.5s against
+    # ~5ms steps, with DEAR_HEALTH_Z=3 from the launcher
+    steps, slow_from, slow_s = 18, 8, 0.5
+    for i in range(steps):
+        if pid == 1 and i >= slow_from:
+            time.sleep(slow_s)  # the artificially slowed rank
+        state, m = guard.step(state, batch_at(i))
+        assert not m.get("rolled_back"), m
+
+    # 1) the merged rank-0 snapshot names the straggler (identical on
+    #    every rank: the merge is a pure function of the gathered views)
+    merged = guard.merged_health
+    assert merged is not None and merged["world"] == n, merged
+    assert merged["straggler_rank"] == 1, merged
+    assert merged["straggler_skew"] >= merged["skew_threshold"], merged
+    assert merged["counters"].get("cluster.health_checks", 0) > 0, merged
+    # the fleet's step-time quantiles rode along in the per-rank digests
+    assert merged["per_rank"][1]["st"]["p50_s"] >= slow_s * 0.8, merged
+
+    # 2) the slow rank's anomaly monitor fired on the step-time jump
+    if pid == 1:
+        assert tracer.counters().get("health.step_time_spike", 0) >= 1, \
+            tracer.counters()
+
+    # 3) watchdog forensics ship the flight ring + redacted env (the
+    #    "hung rank" dump path, triggered via the immediate-kick API)
+    report = dog.kick("health probe")
+    dog.stop()
+    assert report.flight, "kick report must carry the flight ring"
+    assert report.flight[-1]["step"] == guard.steps_seen
+    assert any("step_time_s" in r for r in report.flight)
+    assert report.env.get("DEAR_MP_FAKE_TOKEN") == "[redacted]", report.env
+
+    # 4) streaming exporters were fed on the check cadence
+    prom = open(prom_path).read()
+    assert "dear_cluster_health_checks" in prom, prom[:500]
+    assert "dear_step_time_p50_seconds" in prom
+    assert "DEAR_MP_FAKE_TOKEN=[redacted]" in prom
+    if pid == 0:
+        assert "dear_cluster_straggler_rank 1" in prom, prom[:800]
+    if pid == 1:
+        assert "dear_health_step_time_spike" in prom
+    stream = read_metrics(stream_path)
+    assert stream and all(r["kind"] == "health" for r in stream)
+    assert stream[-1]["counters"].get("cluster.health_checks", 0) > 0
+
+    print(f"MP_HEALTH_OK rank={pid}/{n}", flush=True)
+
+
 def main() -> None:
-    if os.environ.get("DEAR_MP_MODE", "").strip() == "resilience":
+    mode = os.environ.get("DEAR_MP_MODE", "").strip()
+    if mode == "health":
+        return _health_main()
+    if mode == "resilience":
         return _resilience_main()
     import dear_pytorch_tpu as dear
     from dear_pytorch_tpu.comm import backend

@@ -177,3 +177,36 @@ def test_coordinated_recovery_cluster(tmp_path):
                    "DEAR_MP_WORKDIR": str(tmp_path)},
         expect="MP_RESILIENCE_OK",
     )
+
+
+@pytest.mark.timeout(600, method="signal")
+def test_run_health_cluster(tmp_path):
+    """The continuous run-health ladder (mp_worker health mode) over a
+    real 2-process cluster: with telemetry enabled and one rank
+    artificially slowed mid-run, the digest exchange riding the guard's
+    health-check cadence produces a rank-0 merged snapshot naming the
+    straggler rank; the slow rank raises ``health.step_time_spike``; a
+    watchdog-triggered dump carries the last-N flight-ring records (with
+    the DEAR_* env redacted); and the prom/stream exporters were fed on
+    the check cadence (ISSUE-4 acceptance). Host-level only, like the
+    recovery ladder above — runs wherever `jax.distributed` bootstraps."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "mp_worker.py")
+    _run_direct(
+        repo, worker, 2, 1,
+        extra_env={"DEAR_MP_MODE": "health",
+                   "DEAR_MP_WORKDIR": str(tmp_path),
+                   "DEAR_TELEMETRY": "1",
+                   "DEAR_FLIGHT": "16",
+                   "DEAR_HEALTH_WARMUP": "2",
+                   # container-noise margin: the worker's 0.5s slowdown
+                   # against ~5ms steps is >10 sigma even with one noisy
+                   # warmup interval; z=3 keeps detection robust
+                   "DEAR_HEALTH_Z": "3",
+                   # predicted skew is ~2x (slow rank p50 0.5s vs fleet
+                   # median ~0.25s); 1.35 keeps the verdict stable when
+                   # container contention inflates the fast rank too
+                   "DEAR_STRAGGLER_SKEW": "1.35",
+                   "DEAR_MP_FAKE_TOKEN": "hunter2-must-not-leak"},
+        expect="MP_HEALTH_OK",
+    )

@@ -415,6 +415,30 @@ def test_runner_emits_telemetry_line(capsys, tmp_path):
     assert len(tel) == 1 and json.loads(tel[0]["telemetry"]) == snap
 
 
+def test_run_timed_respects_health_warmup_env(monkeypatch):
+    from dear_pytorch_tpu.benchmarks import runner
+    from dear_pytorch_tpu.observability import anomaly as AN
+
+    T.configure()
+    built = []
+    real = AN.AnomalyMonitor.from_env.__func__
+
+    def spy(cls, **kw):
+        m = real(cls, **kw)
+        built.append(m)
+        return m
+
+    monkeypatch.setattr(AN.AnomalyMonitor, "from_env", classmethod(spy))
+    kwargs = dict(batch_size=1, num_warmup_batches=0,
+                  num_batches_per_iter=1, num_iters=1)
+    monkeypatch.delenv("DEAR_HEALTH_WARMUP", raising=False)
+    runner.run_timed(lambda: None, **kwargs)
+    assert built[-1].warmup == 2  # benchmark default: few iters, arm early
+    monkeypatch.setenv("DEAR_HEALTH_WARMUP", "7")
+    runner.run_timed(lambda: None, **kwargs)
+    assert built[-1].warmup == 7  # the documented env knob wins
+
+
 # ---------------------------------------------------------------------------
 # overhead contract
 # ---------------------------------------------------------------------------
@@ -435,6 +459,130 @@ def test_overhead_script_fast_and_green(capsys):
     rc = mod.main(["--iters", "2000"])
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0 and out["ok"] is True
-    # the acceptance bar: the disabled gate is far below 1% of any real
-    # step (~1 ms step -> 10 us budget; the gate must sit under 1 us)
+    # the acceptance bar: the disabled gates are far below 1% of any real
+    # step (~1 ms step -> 10 us budget; each gate must sit under 1 us —
+    # generous for this container: measured ~100-300 ns)
     assert out["disabled_ns_per_call"] < 1000.0
+    assert out["flight_disabled_ns_per_call"] < 1000.0
+    # the enabled flight record stays production-cheap too (micro-seconds)
+    assert out["flight_enabled_ns_per_call"] < 100_000.0
+
+
+# ---------------------------------------------------------------------------
+# docs <-> code counter audit
+# ---------------------------------------------------------------------------
+
+
+def _doc_counter_names():
+    """Counter names from docs/OBSERVABILITY.md — ONLY the cells of table
+    columns whose header contains 'counter' (the events columns share
+    prefixes and must not be swept in)."""
+    import os
+    import re
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
+    lines = open(path).read().splitlines()
+    names = set()
+    i = 0
+    while i < len(lines):
+        if not lines[i].lstrip().startswith("|"):
+            i += 1
+            continue
+        table = []
+        while i < len(lines) and lines[i].lstrip().startswith("|"):
+            table.append([c.strip() for c in
+                          lines[i].strip().strip("|").split("|")])
+            i += 1
+        header = table[0]
+        cols = [j for j, h in enumerate(header)
+                if "counter" in h.lower()]
+        for row in table[2:]:            # skip header + |---| separator
+            for j in cols:
+                if j < len(row):
+                    names |= set(re.findall(r"`([^`]+)`", row[j]))
+    token = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_<>]+)+$")
+    return {n for n in names if token.fullmatch(n)}
+
+
+def _code_counter_names():
+    """Counter names actually emitted: every ``.count("...")`` literal in
+    the package, f-string templates normalized to wildcard patterns, and
+    the anomaly monitor's ``health.<kind>`` family expanded from its
+    `_raise` call sites."""
+    import glob
+    import os
+    import re
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dear_pytorch_tpu")
+    literals, patterns = set(), set()
+    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
+        src = open(path).read()
+        for f_flag, name in re.findall(
+                r"\.count\(\s*(f?)\"([^\"]+)\"", src):
+            if "." not in name:
+                continue  # docstring toy examples ('steps', 'rs_bytes')
+            if f_flag:
+                patterns.add(re.sub(r"\{[^}]+\}", "*", name))
+            else:
+                literals.add(name)
+        if path.endswith("anomaly.py"):
+            kinds = set(re.findall(r"_raise\(\s*\n?\s*\"(\w+)\"", src))
+            literals |= {f"health.{k}" for k in kinds}
+            patterns.discard("health.*")
+    return literals, patterns
+
+
+def test_counter_docs_in_sync():
+    """docs/OBSERVABILITY.md's counter tables are load-bearing: every
+    counter the code emits must be documented, and every documented
+    counter must exist in code — in both directions, so the tables can't
+    rot (the `retry.attempts` incident: a counter documented before it
+    was wired)."""
+    import fnmatch
+    import re
+
+    code_literals, code_patterns = _code_counter_names()
+    assert code_literals, "code scanner found no counters — scanner rot?"
+    # prose in the counter cells may backtick non-counter dotted tokens
+    # (file names like reports.json); only tokens in a subsystem namespace
+    # the code actually emits are held to the audit
+    prefixes = {n.split(".", 1)[0]
+                for n in code_literals | code_patterns}
+    doc = {n for n in _doc_counter_names()
+           if n.split(".", 1)[0] in prefixes}
+    assert doc, "doc parser found no counter tables — parser rot?"
+    doc_literals = {n for n in doc if "<" not in n}
+    # '<leg>'-style segments normalize to one '*' wildcard
+    doc_patterns = {re.sub(r"<[^>]*>", "*", n) for n in doc if "<" in n}
+
+    def matches_any(name, pats):
+        return any(fnmatch.fnmatchcase(name, p) for p in pats)
+
+    undocumented = {
+        n for n in code_literals
+        if n not in doc_literals and not matches_any(n, doc_patterns)}
+    assert not undocumented, (
+        f"counters emitted in code but missing from docs/OBSERVABILITY.md "
+        f"counter tables: {sorted(undocumented)}")
+    undocumented_pats = {
+        p for p in code_patterns
+        if p not in doc_patterns and not any(
+            fnmatch.fnmatchcase(d, p) for d in doc_literals)}
+    assert not undocumented_pats, (
+        f"templated counters in code with no doc entry: "
+        f"{sorted(undocumented_pats)}")
+    stale = {
+        n for n in doc_literals
+        if n not in code_literals and not matches_any(n, code_patterns)}
+    assert not stale, (
+        f"counters documented in docs/OBSERVABILITY.md but never emitted "
+        f"in code: {sorted(stale)}")
+    stale_pats = {
+        p for p in doc_patterns
+        if p not in code_patterns and not any(
+            fnmatch.fnmatchcase(c, p) for c in code_literals)}
+    assert not stale_pats, (
+        f"doc counter patterns matching no code counter: "
+        f"{sorted(stale_pats)}")

@@ -1,0 +1,94 @@
+"""CLI coverage for ``python -m dear_pytorch_tpu.observability.report`` —
+exit codes, JSON output shape, and the world-size override. The real run
+goes through a subprocess (the CLI forces its own emulated CPU world
+BEFORE backend init, which an in-process call could never exercise once
+the test session's 8-device world is live); argument errors are cheap and
+stay in-process."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    # the CLI owns platform/world selection; a leaked test-session world
+    # must not override the --world flag under test
+    for k in ("DEAR_NUM_CPU_DEVICES", "XLA_FLAGS"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.timeout(240, method="signal")
+def test_report_cli_json_shape_and_world_override(tmp_path):
+    out_json = str(tmp_path / "overlap.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dear_pytorch_tpu.observability.report",
+         "--world", "2", "--layers", "1", "--width", "32", "--batch", "8",
+         "--steps", "2", "--modes", "dear", "--no-hlo",
+         "--json", out_json],
+        env=_clean_env(), capture_output=True, text=True, timeout=220,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "overlap audit: mode=dear" in proc.stdout
+    assert "== telemetry (enabled=True) ==" in proc.stdout
+    doc = json.load(open(out_json))
+    # top-level shape
+    assert set(doc) >= {"world", "alpha", "beta", "compute_time_s",
+                        "modes", "telemetry"}
+    assert doc["world"] == 2          # the --world override took effect
+    assert doc["alpha"] >= 0 and doc["beta"] >= 0
+    # per-mode report shape (OverlapReport.to_dict)
+    rep = doc["modes"]["dear"]
+    assert rep["mode"] == "dear" and rep["world"] == 2
+    assert {"comm_time_s", "measured_step_s", "overlap_efficiency",
+            "legs", "num_buckets"} <= set(rep)
+    assert len(rep["legs"]) == 2 * rep["num_buckets"]  # RS + AG per bucket
+    for leg in rep["legs"]:
+        assert leg["leg"] in ("reduce_scatter", "all_gather")
+        assert leg["payload_bytes"] > 0
+    # the telemetry block is the instrumented truth: steps actually ran
+    assert doc["telemetry"]["enabled"] is True
+    assert doc["telemetry"]["counters"]["dear.steps"] > 0
+    assert json.loads(json.dumps(doc)) == doc  # JSON-safe end to end
+
+
+def test_report_cli_rejects_bad_args(capsys):
+    from dear_pytorch_tpu.observability import report as R
+
+    with pytest.raises(SystemExit) as e:
+        R.main(["--bogus-flag"])
+    assert e.value.code == 2          # argparse usage error
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as e:
+        R.main(["--world", "not-a-number"])
+    assert e.value.code == 2
+    capsys.readouterr()
+
+
+def test_report_renders_without_measurement():
+    """render_text must not crash on a report with no measured step (the
+    honest-absence path: exposure split absent, never guessed)."""
+    import jax.numpy as jnp
+
+    from dear_pytorch_tpu.observability import overlap as OV
+    from dear_pytorch_tpu.observability import report as R
+    from dear_pytorch_tpu.ops import fusion as F
+
+    class _StubTS:
+        plan = F.plan_by_nearby_layers({"w": jnp.zeros((64,))},
+                                       world=4, k=1)
+
+        def lower(self, state, batch):
+            raise RuntimeError("no backend")
+
+    rep = OV.audit_train_step(_StubTS(), None, None, alpha=1e-3, beta=1e-6,
+                              mode="dear", include_hlo=False)
+    text = R.render_text(rep)
+    assert "n/a" in text and "overlap efficiency n/a" in text

@@ -1,0 +1,758 @@
+"""Run-health layer tests: flight-recorder ring semantics, cluster digest
+aggregation + straggler detection, streaming exporters (prom/stream) and
+the shared JSONL writer, online anomaly detectors, redaction, the guard's
+wiring of all four, and the bench-regression gate fixtures."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dear_pytorch_tpu.observability import aggregate as AG
+from dear_pytorch_tpu.observability import anomaly as AN
+from dear_pytorch_tpu.observability import export as EX
+from dear_pytorch_tpu.observability import flight as FL
+from dear_pytorch_tpu.observability import redaction as RD
+from dear_pytorch_tpu.observability import tracer as T
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate_globals():
+    """Tests leave the process-global tracer/recorder as they found
+    them."""
+    old_tr, old_fl, old_auto = T._tracer, FL._recorder, FL._auto_follow
+    yield
+    T.set_tracer(old_tr)
+    FL.set_recorder(old_fl)
+    FL._auto_follow = old_auto
+
+
+def _live_tracer():
+    tr = T.Tracer([T.MemoryExporter()])
+    T.set_tracer(tr)
+    return tr
+
+
+# ---------------------------------------------------------------------------
+# redaction
+# ---------------------------------------------------------------------------
+
+
+def test_redact_env_masks_secret_keys(monkeypatch):
+    monkeypatch.setenv("DEAR_FAULTS", "nan@6:r1")
+    monkeypatch.setenv("DEAR_API_TOKEN", "hunter2")
+    monkeypatch.setenv("DEAR_GCS_SECRET_KEY", "sssh")
+    monkeypatch.setenv("NOT_DEAR", "invisible")
+    env = RD.redact_env()
+    assert env["DEAR_FAULTS"] == "nan@6:r1"       # replay context survives
+    assert env["DEAR_API_TOKEN"] == RD.REDACTED
+    assert env["DEAR_GCS_SECRET_KEY"] == RD.REDACTED
+    assert "NOT_DEAR" not in env
+    # arbitrary mappings via prefix=""
+    got = RD.redact_env({"password": "x", "plain": "y"}, prefix="")
+    assert got == {"password": RD.REDACTED, "plain": "y"}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_wraps_and_keeps_newest():
+    tr = _live_tracer()
+    fl = FL.FlightRecorder(capacity=4, tracer=tr)
+    for i in range(7):
+        tr.count("dear.steps")
+        fl.record(i, step_time_s=0.01 * (i + 1), loss=float(i))
+    recs = fl.records()
+    assert [r["step"] for r in recs] == [3, 4, 5, 6]
+    assert fl.recorded == 7 and fl.head()["step"] == 6
+    # counter DELTAS, not totals: exactly one step between records
+    assert recs[-1]["counters_delta"] == {"dear.steps": 1}
+    stats = fl.step_time_stats()
+    assert stats["n"] == 4 and stats["max_s"] == pytest.approx(0.07)
+    assert stats["p50_s"] <= stats["p90_s"] <= stats["max_s"]
+
+
+def test_flight_records_live_spans_and_plan_epoch():
+    tr = _live_tracer()
+    tr.count("dear.plan_builds")
+    fl = FL.FlightRecorder(capacity=4, tracer=tr)
+    with tr.span("dear.step"):
+        fl.record(1)
+    rec = fl.head()
+    assert rec["live_spans"] == "dear.step"
+    assert rec["plan_epoch"] == 1
+
+
+def test_flight_nonfinite_loss_stays_strict_json():
+    fl = FL.FlightRecorder(capacity=4, tracer=T.NullTracer())
+    fl.record(1, loss=float("nan"))
+    dumped = json.dumps(fl.dump(env=False))
+    json.loads(dumped)  # no bare NaN tokens
+    assert '"nan"' in dumped
+
+
+def test_flight_dump_redacts_env(monkeypatch):
+    monkeypatch.setenv("DEAR_FAKE_TOKEN", "leakme")
+    fl = FL.FlightRecorder(capacity=4, tracer=T.NullTracer())
+    fl.record(1)
+    dump = fl.dump()
+    assert dump["env"]["DEAR_FAKE_TOKEN"] == RD.REDACTED
+    assert dump["records"][0]["step"] == 1
+
+
+def test_flight_env_resolution(monkeypatch):
+    monkeypatch.setattr(FL, "_recorder", None)
+    monkeypatch.setenv(FL.FLIGHT_ENV, "0")
+    assert not FL.get_recorder().enabled          # forced off
+    monkeypatch.setattr(FL, "_recorder", None)
+    monkeypatch.setenv(FL.FLIGHT_ENV, "128")
+    fl = FL.get_recorder()                        # forced on, sized
+    assert fl.enabled and fl.capacity == 128
+    # unset: follows the tracer
+    monkeypatch.setattr(FL, "_recorder", None)
+    monkeypatch.delenv(FL.FLIGHT_ENV, raising=False)
+    T.set_tracer(T.NullTracer())
+    assert not FL.get_recorder().enabled
+    monkeypatch.setattr(FL, "_recorder", None)
+    _live_tracer()
+    assert FL.get_recorder().enabled
+
+
+def test_flight_follows_programmatic_tracer_reconfig(monkeypatch):
+    # DEAR_FLIGHT unset: the first resolution follows the tracer — and
+    # KEEPS following it, so enabling telemetry in code after some
+    # instrumented path already touched the ring still brings it up
+    monkeypatch.delenv(FL.FLIGHT_ENV, raising=False)
+    monkeypatch.setattr(FL, "_recorder", None)
+    T.set_tracer(T.NullTracer())
+    assert not FL.get_recorder().enabled      # cached as disabled
+    _live_tracer()
+    assert FL.get_recorder().enabled          # ring came up with telemetry
+    T.set_tracer(T.NullTracer())
+    assert not FL.get_recorder().enabled      # and down again
+    # an explicit DEAR_FLIGHT pins the ring regardless of the tracer
+    monkeypatch.setenv(FL.FLIGHT_ENV, "8")
+    monkeypatch.setattr(FL, "_recorder", None)
+    assert FL.get_recorder().enabled
+    _live_tracer()
+    T.set_tracer(T.NullTracer())
+    assert FL.get_recorder().enabled
+
+
+def test_watchdog_report_tolerates_malformed_flight_env(monkeypatch):
+    # the watchdog must never crash while reporting a crash: a typo'd
+    # DEAR_FLIGHT raises on FIRST recorder resolution, which can happen
+    # inside the daemon's _make_report (e.g. bench.py arms the watchdog
+    # before anything else touches the ring)
+    from dear_pytorch_tpu.resilience import StepWatchdog
+
+    monkeypatch.setattr(FL, "_recorder", None)
+    monkeypatch.setenv(FL.FLIGHT_ENV, "16k")
+    dog = StepWatchdog(deadline_s=60, name="t-dog", dump_stacks=False)
+    report = dog._make_report(1.0, {"step": 3})
+    assert report.flight == [] and report.name == "t-dog"
+
+
+def test_watchdog_report_defaults_are_immutable():
+    from dear_pytorch_tpu.resilience.watchdog import WatchdogReport
+
+    r = WatchdogReport(name="a", waited_s=1.0, deadline_s=2.0,
+                       beat_info={}, live_spans=[])
+    assert r.flight == () and dict(r.env) == {}
+    # NamedTuple defaults are class-level shared instances: they must not
+    # be mutable, or one report's edits would leak into every later one
+    with pytest.raises(TypeError):
+        r.env["x"] = "y"
+
+
+def test_flight_env_rejects_malformed_values(monkeypatch):
+    monkeypatch.setattr(FL, "_recorder", None)
+    monkeypatch.setenv(FL.FLIGHT_ENV, "16k")
+    with pytest.raises(ValueError, match="DEAR_FLIGHT"):
+        FL.get_recorder()
+    monkeypatch.setattr(FL, "_recorder", None)
+    monkeypatch.setenv(FL.FLIGHT_ENV, "-5")
+    with pytest.raises(ValueError):
+        FL.get_recorder()
+    monkeypatch.setattr(FL, "_recorder", None)
+    monkeypatch.setenv(FL.FLIGHT_ENV, "true")
+    assert FL.get_recorder().enabled  # keyword truthies still fine
+
+
+def test_rank_placeholder_paths(tmp_path):
+    prom = EX.PromFileExporter(str(tmp_path / "d.{rank}.prom"))
+    prom.export({"counters": {"x.y": 1}})
+    assert os.path.exists(tmp_path / "d.0.prom")  # single process: rank 0
+    stream = EX.HealthStreamExporter(str(tmp_path / "h.{rank}.jsonl"))
+    stream.export({"counters": {}})
+    stream.close()
+    assert os.path.exists(tmp_path / "h.0.jsonl")
+    stream.export({"counters": {}})  # post-close export is a no-op
+
+
+class _ExplodingSink:
+    def span(self, rec):
+        pass
+
+    def event(self, rec):
+        pass
+
+    def export(self, snapshot, gauges=None):
+        raise OSError("disk full")
+
+    def close(self):
+        pass
+
+
+def test_guard_survives_sink_failures(tmp_path, mesh, caplog):
+    import logging
+
+    tr = T.Tracer([T.MemoryExporter(), _ExplodingSink()])
+    T.set_tracer(tr)
+    FL.set_recorder(FL.NullFlightRecorder())
+    ts, guard, params = _tiny_trainer(tmp_path, mesh)
+    state = ts.init(params)
+    with caplog.at_level(logging.WARNING, logger="dear_pytorch_tpu"):
+        for _ in range(6):  # 3 check intervals, all with a raising sink
+            state, m = guard.step(state, jnp.ones((8, 8)))
+    assert "loss" in m  # training survived every failed export
+    assert tr.counters()["health.export_errors"] == 3
+    warned = [r for r in caplog.records
+              if "telemetry export via" in r.getMessage()]
+    assert len(warned) == 1  # logged once per sink, not per interval
+
+
+def test_write_streams_isolates_failing_sink(tmp_path):
+    """One dead sink must not starve the healthy ones."""
+    stream = str(tmp_path / "h.jsonl")
+    tr = T.Tracer([_ExplodingSink(), EX.HealthStreamExporter(stream)])
+    T.set_tracer(tr)
+    tr.count("dear.steps", 3)
+    assert EX.write_streams(tracer=tr) == 1   # the healthy sink wrote
+    assert EX.write_streams(tracer=tr) == 1
+    recs = [json.loads(ln) for ln in open(stream)]
+    assert len(recs) == 2
+    assert tr.counters()["health.export_errors"] == 2
+
+
+def test_jsonl_writer_coerces_numpy_and_jax_scalars(tmp_path):
+    """Span/event attrs are routinely numpy/jax scalars; the shared
+    writer must coerce them (the old MetricsLogger path did)."""
+    import numpy as np
+
+    from dear_pytorch_tpu.utils import read_metrics
+
+    path = str(tmp_path / "t.jsonl")
+    tr = T.Tracer([T.JsonlExporter(path)])
+    tr.event("x", val=np.float32(1.5), n=np.int64(7),
+             arr=np.arange(2.0), dev=jnp.float32(2.5))
+    with tr.span("s", b=np.bool_(True)):
+        pass
+    tr.close()
+    recs = read_metrics(path)
+    assert recs[0]["val"] == 1.5 and recs[0]["n"] == 7
+    assert recs[0]["arr"] == [0.0, 1.0] and recs[0]["dev"] == 2.5
+    assert recs[1]["b"] is True
+
+
+def test_null_recorder_is_free():
+    fl = FL.NullFlightRecorder()
+    fl.record(1, step_time_s=0.1)
+    assert fl.records() == [] and fl.head() is None
+    assert fl.step_time_stats() == {} and fl.dump()["records"] == []
+
+
+def test_flight_thread_safety():
+    fl = FL.FlightRecorder(capacity=8, tracer=T.NullTracer())
+    stop = threading.Event()
+    seen = []
+
+    def reader():
+        while not stop.is_set():
+            seen.append(len(fl.records()))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for i in range(500):
+        fl.record(i)
+    stop.set()
+    t.join()
+    assert fl.recorded == 500 and len(fl.records()) == 8
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_local_digest_compact_and_prefix_filtered():
+    tr = _live_tracer()
+    tr.count("guard.rollbacks", 2)
+    tr.count("dear.steps", 10)
+    tr.count("dear.reduce_scatter_bytes", 1e9)  # not a digest prefix
+    fl = FL.FlightRecorder(capacity=8, tracer=tr)
+    fl.record(5, step_time_s=0.02, loss=0.5)
+    d = AG.local_digest(rank=3, recorder=fl, tracer=tr)
+    assert d["rank"] == 3
+    assert d["ctr"]["guard.rollbacks"] == 2
+    assert d["ctr"]["dear.steps"] == 10
+    assert "dear.reduce_scatter_bytes" not in d["ctr"]
+    assert d["head"]["step"] == 5 and d["st"]["p50_s"] == 0.02
+    # the allgather transport gives each rank a fixed 2 KB slot
+    assert len(json.dumps(d, separators=(",", ":"))) < 1900
+
+
+def test_oversize_digest_trims_under_slot_budget():
+    # a pathological counter explosion must trim, not strand the exchange
+    digest = {
+        "rank": 0,
+        "ctr": {f"health.counter_with_a_long_name_{i:03d}": 123456.789
+                for i in range(200)},
+        "st": {"p50_s": 0.1, "p90_s": 0.2, "n": 100},
+        "head": {"step": 5, "step_time_s": 0.1, "loss": 1.0, "t_s": 12.0},
+    }
+    fitted = AG._fit_digest(digest)
+    assert AG._size(fitted) <= AG.MAX_DIGEST_BYTES
+    assert fitted["rank"] == 0
+    assert fitted["ctr"]  # trimmed, not emptied
+
+
+def test_merge_digests_straggler_and_counters():
+    fast = {"rank": 0, "ctr": {"dear.steps": 10}, "st": {"p50_s": 0.01}}
+    slow = {"rank": 1, "ctr": {"dear.steps": 10, "guard.rollbacks": 1},
+            "st": {"p50_s": 0.05}}
+    m = AG.merge_digests([fast, slow], skew_threshold=1.5)
+    assert m["world"] == 2
+    assert m["counters"] == {"dear.steps": 20, "guard.rollbacks": 1}
+    assert m["straggler_rank"] == 1
+    assert m["straggler_skew"] == pytest.approx(0.05 / 0.03, rel=1e-3)
+    assert m["step_time"]["slowest_rank"] == 1
+    # balanced fleet: no straggler named
+    m2 = AG.merge_digests(
+        [fast, {"rank": 1, "ctr": {}, "st": {"p50_s": 0.011}}],
+        skew_threshold=1.5)
+    assert m2["straggler_rank"] is None
+    assert json.loads(json.dumps(m)) is not None  # JSON-safe
+
+
+def test_metric_aggregator_over_local_transport():
+    """N thread-ranks over one LocalTransport behave like N processes —
+    the same harness the cluster consensus tests use."""
+    from dear_pytorch_tpu.resilience import cluster as CL
+
+    tr = _live_tracer()
+    transport = CL.LocalTransport(num_processes=2)
+    merged: dict = {}
+
+    def rank(i):
+        co = CL.ClusterCoordinator(
+            namespace="agg", process_index=i, process_count=2,
+            timeout_s=10, transport=transport, instance=0)
+        agg = AG.MetricAggregator(co, skew_threshold=1.5)
+        digest = {"rank": i, "ctr": {"dear.steps": 5},
+                  "st": {"p50_s": 0.01 if i == 0 else 0.04}}
+        merged[i] = agg.exchange(digest)
+
+    threads = [threading.Thread(target=rank, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert merged[0] == merged[1]             # identical on every rank
+    assert merged[0]["straggler_rank"] == 1
+    assert merged[0]["counters"]["dear.steps"] == 10
+    counters = tr.counters()
+    assert counters["cluster.metric_exchanges"] == 2
+    assert counters["cluster.straggler_detected"] == 2
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_writer_rotation(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    w = EX.JsonlWriter(path, max_bytes=200, backups=2)
+    for i in range(50):
+        w.write({"i": i, "pad": "x" * 40})
+    w.close()
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".1")
+    assert os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")  # bounded
+    # every surviving line is intact JSON
+    for p in (path, path + ".1", path + ".2"):
+        for line in open(p):
+            json.loads(line)
+
+
+def test_prom_exporter_format_and_redaction(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEAR_FAKE_TOKEN", "leakme")
+    monkeypatch.setenv("DEAR_FAULTS", "nan@6")
+    path = str(tmp_path / "dear.prom")
+    ex = EX.PromFileExporter(path)
+    ex.export({"counters": {"guard.rollbacks": 3, "dear.steps": 10}},
+              {"step_time_p50_seconds": 0.012, "skip_me": None})
+    text = open(path).read()
+    assert "# TYPE dear_guard_rollbacks counter" in text
+    assert "dear_guard_rollbacks 3" in text
+    assert "dear_dear_steps 10" in text
+    assert "# TYPE dear_step_time_p50_seconds gauge" in text
+    assert "dear_step_time_p50_seconds 0.012" in text
+    assert "# env DEAR_FAULTS=nan@6" in text
+    assert "leakme" not in text and "DEAR_FAKE_TOKEN=[redacted]" in text
+    # atomic rewrite: a second export fully replaces the file
+    ex.export({"counters": {"guard.rollbacks": 4}}, None)
+    text = open(path).read()
+    assert "dear_guard_rollbacks 4" in text and "dear_dear_steps" not in text
+
+
+def test_health_stream_roundtrip(tmp_path):
+    from dear_pytorch_tpu.utils import read_metrics
+
+    path = str(tmp_path / "h.jsonl")
+    ex = EX.HealthStreamExporter(path)
+    ex.export({"counters": {"dear.steps": 2}}, {"g": 1.5})
+    ex.export({"counters": {"dear.steps": 4}}, None)
+    ex.close()
+    recs = read_metrics(path)
+    assert [r["kind"] for r in recs] == ["health", "health"]
+    assert recs[0]["gauges"] == {"g": 1.5}
+    assert recs[1]["counters"] == {"dear.steps": 4}
+
+
+def test_write_streams_feeds_attached_exporters(tmp_path):
+    prom = str(tmp_path / "p.prom")
+    stream = str(tmp_path / "h.jsonl")
+    tr = T.Tracer([T.MemoryExporter(), EX.PromFileExporter(prom),
+                   EX.HealthStreamExporter(stream)])
+    T.set_tracer(tr)
+    tr.count("dear.steps", 7)
+    assert EX.write_streams() == 2
+    assert "dear_dear_steps 7" in open(prom).read()
+    assert json.loads(open(stream).readline())["counters"]["dear.steps"] == 7
+    # disabled tracer: zero writes
+    assert EX.write_streams(tracer=T.NullTracer()) == 0
+
+
+def test_telemetry_env_grammar_prom_stream(tmp_path):
+    T.set_tracer(None)
+    tr = T.configure_from_env(
+        f"prom:{tmp_path}/d.prom,stream:{tmp_path}/h.jsonl")
+    assert isinstance(tr, T.Tracer)
+    tr.count("x.y", 1)
+    assert EX.write_streams(tracer=tr) == 2
+    tr.close()
+    assert os.path.exists(tmp_path / "d.prom")
+    assert os.path.exists(tmp_path / "h.jsonl")
+    T.set_tracer(None)
+    with pytest.raises(ValueError):
+        T.configure_from_env("prom:")  # path required
+
+
+# ---------------------------------------------------------------------------
+# anomaly detectors
+# ---------------------------------------------------------------------------
+
+
+def test_step_time_spike_detector():
+    tr = _live_tracer()
+    hits = []
+    am = AN.AnomalyMonitor(warmup=3, z_threshold=4.0, tracer=tr,
+                           on_anomaly=lambda k, d: hits.append(k))
+    for _ in range(6):
+        assert am.observe(step=1, step_time_s=0.010) == []
+    found = am.observe(step=7, step_time_s=0.200)
+    assert found == ["step_time_spike"]
+    assert hits == ["step_time_spike"]
+    c = tr.counters()
+    assert c["health.step_time_spike"] == 1 and c["health.anomalies"] == 1
+    # steady noise below threshold never fires
+    assert am.observe(step=8, step_time_s=0.011) == []
+
+
+def test_loss_spike_and_plateau():
+    tr = _live_tracer()
+    am = AN.AnomalyMonitor(warmup=3, plateau_window=4, plateau_rel=1e-3,
+                           tracer=tr)
+    for i in range(5):
+        am.observe(step=i, loss=1.0 - 0.1 * i)
+    assert am.observe(step=6, loss=50.0) == ["loss_spike"]
+    assert am.observe(step=7, loss=float("nan")) == ["loss_spike"]
+    # plateau: flat window fires ONCE, re-arms when the loss moves
+    am2 = AN.AnomalyMonitor(warmup=100, plateau_window=4, plateau_rel=1e-3)
+    fired = []
+    for i in range(8):
+        fired += am2.observe(step=i, loss=0.5)
+    assert fired == ["loss_plateau"]
+    am2.observe(step=9, loss=0.4)       # movement re-arms
+    fired2 = []
+    for i in range(10, 16):
+        fired2 += am2.observe(step=i, loss=0.4)
+    assert fired2 == ["loss_plateau"]
+    assert am2.anomalies[-1]["kind"] == "loss_plateau"
+
+
+def test_input_stall_and_mfu_drop():
+    am = AN.AnomalyMonitor(tracer=T.NullTracer(), mfu_drop_frac=0.25)
+    assert am.observe(counters={"pipeline.stall_timeouts": 0}) == []
+    assert am.observe(counters={"pipeline.stall_timeouts": 2}) == \
+        ["input_stall"]
+    assert am.observe(counters={"pipeline.stall_timeouts": 2}) == []
+    assert am.observe(mfu=0.40) == []
+    assert am.observe(mfu=0.38) == []       # within window
+    assert am.observe(mfu=0.20) == ["mfu_drop"]
+
+
+def test_pipeline_stall_counters():
+    """A starved numpy-free pipeline path: drive Pipeline._fetch through
+    a stub `_next` that always times out and assert the stall counters
+    the anomaly monitor watches."""
+    from dear_pytorch_tpu.runtime import pipeline as P
+
+    tr = _live_tracer()
+
+    class Starved(P.NumpyPipeline):
+        def _next(self, timeout_ms=0):
+            raise TimeoutError("no batch")
+
+        _next_counted = P.Pipeline._next_counted
+        _fetch = P.Pipeline._fetch
+
+    pipe = Starved(P.mnist_spec(2))
+    with pytest.raises(TimeoutError):
+        pipe._fetch(30)
+    c = tr.counters()
+    assert c["pipeline.stalls"] == 1
+    assert c["pipeline.stall_timeouts"] == 3  # every retried attempt
+
+
+def test_anomaly_env_knobs(monkeypatch):
+    monkeypatch.setenv("DEAR_HEALTH_Z", "7")
+    monkeypatch.setenv("DEAR_HEALTH_WARMUP", "3")
+    am = AN.AnomalyMonitor.from_env()
+    assert am.z_threshold == 7.0 and am.warmup == 3
+    monkeypatch.setenv("DEAR_HEALTH", "0")
+    assert not AN.AnomalyMonitor.enabled_by_env()
+    monkeypatch.delenv("DEAR_HEALTH")
+    assert AN.AnomalyMonitor.enabled_by_env()
+
+
+# ---------------------------------------------------------------------------
+# guard + watchdog wiring
+# ---------------------------------------------------------------------------
+
+
+def _tiny_trainer(tmp_path, mesh, **kw):
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    params = {"w": jnp.ones((8, 4)) * 0.1}
+
+    def loss(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    ts = build_train_step(
+        loss, params, mesh=mesh, mode="dear", nearby_layers=1,
+        optimizer=fused_sgd(lr=0.05), donate=False,
+    )
+    return ts, GuardedTrainer(ts, str(tmp_path / "ckpt"), params,
+                              check_every=2, checkpoint_every=4, **kw), \
+        params
+
+
+def test_guard_feeds_flight_and_health(tmp_path, mesh):
+    tr = _live_tracer()
+    fl = FL.FlightRecorder(capacity=16, tracer=tr)
+    FL.set_recorder(fl)
+    ts, guard, params = _tiny_trainer(tmp_path, mesh)
+    assert guard._anomaly is not None          # telemetry on -> monitor on
+    state = ts.init(params)
+    batch = jnp.ones((8, 8))
+    for _ in range(6):
+        state, m = guard.step(state, batch)
+    recs = fl.records()
+    assert [r["step"] for r in recs] == [1, 2, 3, 4, 5, 6]
+    # checked steps carry the fetched loss; unchecked ones don't
+    assert "loss" in recs[1] and "loss" not in recs[0]
+    assert recs[1]["checked"] == 1
+    assert any("step_time_s" in r for r in recs[1:])
+
+
+def test_guard_rollback_dumps_flight(tmp_path, mesh, caplog):
+    import logging
+
+    from dear_pytorch_tpu.resilience import Fault, FaultInjector
+
+    tr = _live_tracer()
+    FL.set_recorder(FL.FlightRecorder(capacity=8, tracer=tr))
+    ts, guard, params = _tiny_trainer(
+        tmp_path, mesh, injector=FaultInjector([Fault(kind="nan", step=6)]))
+    state = ts.init(params)
+    batch = jnp.ones((8, 8))
+    with caplog.at_level(logging.WARNING, logger="dear_pytorch_tpu"):
+        for _ in range(7):
+            state, m = guard.step(state, batch)
+    dumps = [r for r in caplog.records
+             if "flight ring at rollback" in r.getMessage()]
+    assert len(dumps) == 1
+    payload = json.loads(dumps[0].getMessage().split("records): ", 1)[1])
+    assert payload["records"] and payload["records"][-1]["step"] == 6
+    assert "env" in payload
+    c = tr.counters()
+    assert c["guard.flight_dumps"] == 1 and c["guard.rollbacks"] == 1
+
+
+def test_guard_streams_on_check_cadence(tmp_path, mesh):
+    prom = str(tmp_path / "d.prom")
+    tr = T.Tracer([T.MemoryExporter(), EX.PromFileExporter(prom)])
+    T.set_tracer(tr)
+    FL.set_recorder(FL.FlightRecorder(capacity=8, tracer=tr))
+    ts, guard, params = _tiny_trainer(tmp_path, mesh)
+    state = ts.init(params)
+    for _ in range(4):
+        state, _ = guard.step(state, jnp.ones((8, 8)))
+    text = open(prom).read()
+    assert "dear_dear_steps" in text
+    assert "dear_step_time_p50_seconds" in text
+
+
+def test_watchdog_kick_ships_flight_ring(monkeypatch, capfd):
+    from dear_pytorch_tpu.resilience import StepWatchdog
+
+    monkeypatch.setenv("DEAR_FAKE_TOKEN", "leakme")
+    tr = _live_tracer()
+    fl = FL.FlightRecorder(capacity=4, tracer=tr)
+    FL.set_recorder(fl)
+    for i in range(6):
+        fl.record(i, step_time_s=0.01)
+    dog = StepWatchdog(deadline_s=60, name="t-dog")
+    report = dog.kick("unit probe", step=6)
+    assert [r["step"] for r in report.flight] == [2, 3, 4, 5]
+    assert report.env["DEAR_FAKE_TOKEN"] == RD.REDACTED
+    err = capfd.readouterr().err
+    assert "flight ring (4 records)" in err and "leakme" not in err
+
+
+def test_anomaly_kick_escalation(tmp_path, mesh, monkeypatch):
+    from dear_pytorch_tpu.resilience import StepWatchdog
+
+    monkeypatch.setenv("DEAR_HEALTH_KICK", "1")
+    _live_tracer()
+    FL.set_recorder(FL.NullFlightRecorder())
+    dog = StepWatchdog(deadline_s=60, name="esc-dog", dump_stacks=False)
+    ts, guard, params = _tiny_trainer(tmp_path, mesh, watchdog=dog)
+    guard._on_anomaly("step_time_spike", {"step_time_s": 9.0})
+    assert dog.kicked == 1
+    assert dog.last_report.beat_info["step_time_s"] == 9.0
+
+
+# ---------------------------------------------------------------------------
+# bench gate
+# ---------------------------------------------------------------------------
+
+
+def _gate():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(REPO, "scripts", "bench_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_doc(resnet=2300.0, bert=1200.0, gpt=60000.0):
+    return {
+        "metric": "resnet50_bs64_train_img_sec_per_chip", "value": resnet,
+        "unit": "img/s", "mfu": 0.28,
+        "extra_metrics": [
+            {"metric": "bert_base_sen_sec_per_chip", "value": bert},
+            {"metric": "gpt2_s1024_tok_sec_per_chip", "value": gpt},
+        ],
+    }
+
+
+def test_compare_bench_shapes():
+    v = AN.compare_bench(_bench_doc(), _bench_doc(resnet=2310.0))
+    assert v["ok"] and len(v["parity"]) == 3
+    v = AN.compare_bench(_bench_doc(), _bench_doc(bert=1100.0))
+    assert not v["ok"]
+    assert [r["metric"] for r in v["regressions"]] == [
+        "bert_base_sen_sec_per_chip"]
+    v = AN.compare_bench(_bench_doc(), _bench_doc(gpt=80000.0))
+    assert v["ok"] and len(v["improvements"]) == 1
+    # driver-record shape + errored entry on the run side
+    run = {"parsed": {"metric": "resnet50_bs64_train_img_sec_per_chip",
+                      "value": 2290.0,
+                      "extra_metrics": [
+                          {"metric": "bert_base_sen_sec_per_chip",
+                           "error": "wedged"},
+                          {"metric": "gpt2_s1024_tok_sec_per_chip",
+                           "value": 60000.0}]}}
+    v = AN.compare_bench(_bench_doc(), run)
+    assert not v["ok"] and v["missing"] == ["bert_base_sen_sec_per_chip"]
+
+
+def test_bench_gate_cli_regression_and_parity(tmp_path, capsys):
+    gate = _gate()
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps(_bench_doc()))
+    # >5% regression on the primary metric -> nonzero
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_bench_doc(resnet=2300.0 * 0.93)))
+    assert gate.main(["--baseline", str(base), "--run", str(bad)]) == 2
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert not verdict["ok"] and verdict["regressions"][0]["ratio"] < 0.95
+    # parity -> zero
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_bench_doc(resnet=2295.0)))
+    assert gate.main(["--baseline", str(base), "--run", str(ok)]) == 0
+    # improvement -> zero
+    fast = tmp_path / "fast.json"
+    fast.write_text(json.dumps(_bench_doc(resnet=2600.0)))
+    assert gate.main(["--baseline", str(base), "--run", str(fast)]) == 0
+
+
+def test_bench_gate_cli_missing_metrics_and_flags(tmp_path, capsys):
+    gate = _gate()
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps(_bench_doc()))
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps({
+        "metric": "resnet50_bs64_train_img_sec_per_chip", "value": 2300.0}))
+    assert gate.main(["--baseline", str(base), "--run", str(partial)]) == 2
+    capsys.readouterr()
+    # --allow-missing downgrades lost metrics (no regression otherwise)
+    assert gate.main(["--baseline", str(base), "--run", str(partial),
+                     "--allow-missing"]) == 0
+    # --metrics restricts the comparison
+    assert gate.main(["--baseline", str(base), "--run", str(partial),
+                     "--metrics", "resnet50_bs64_train_img_sec_per_chip"]
+                     ) == 0
+    capsys.readouterr()
+    # unusable input -> 3
+    empty = tmp_path / "empty.json"
+    empty.write_text("no json here\n")
+    assert gate.main(["--baseline", str(base), "--run", str(empty)]) == 3
+    capsys.readouterr()
+
+
+def test_bench_gate_reads_contract_line_amid_output(tmp_path, capsys):
+    gate = _gate()
+    base = tmp_path / "b.json"
+    base.write_text(json.dumps(_bench_doc()))
+    # a captured stdout file: warmup logs + the contract line
+    run = tmp_path / "run.log"
+    run.write_text("Running warmup...\nIter #0: 100 img/s\n"
+                   + json.dumps(_bench_doc(resnet=2400.0)) + "\n")
+    assert gate.main(["--baseline", str(base), "--run", str(run)]) == 0
+    capsys.readouterr()
