@@ -44,7 +44,29 @@ from typing import Callable, Optional
 __all__ = [
     "FLIGHT_ENV", "FlightRecorder", "NullFlightRecorder",
     "get_recorder", "set_recorder", "configure", "disable",
+    "set_epoch_provider",
 ]
+
+# Membership-epoch stamping: the resilience layer (which imports
+# observability, never the reverse) registers a zero-arg callable here;
+# every subsequent flight row carries its value as ``mem_epoch``, so a
+# forensic dump shows WHICH membership the failing steps ran under
+# (`resilience.membership.ElasticCluster` registers `current_epoch`).
+_epoch_provider: Optional[Callable[[], Optional[int]]] = None
+
+
+def set_epoch_provider(fn: Optional[Callable[[], Optional[int]]]) -> None:
+    global _epoch_provider
+    _epoch_provider = fn
+
+
+def _membership_epoch() -> Optional[int]:
+    if _epoch_provider is None:
+        return None
+    try:
+        return _epoch_provider()
+    except Exception:  # forensics must never crash the step path
+        return None
 
 #: falsy ('0'/'false'/'no'/'off') -> disabled; '1'/'true'/'yes'/'on' ->
 #: enabled at the default capacity; an integer >= 2 -> enabled with that
@@ -118,6 +140,9 @@ class FlightRecorder:
             rec["loss"] = loss if math.isfinite(loss) else repr(loss)
         if plan_epoch is not None:
             rec["plan_epoch"] = int(plan_epoch)
+        mem_epoch = _membership_epoch()
+        if mem_epoch is not None:
+            rec["mem_epoch"] = int(mem_epoch)
         if delta:
             rec["counters_delta"] = delta
         if spans:

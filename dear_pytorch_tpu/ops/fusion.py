@@ -88,6 +88,12 @@ class FusionPlan:
     buckets: tuple[Bucket, ...]
     world: int
     treedef: Any = dataclasses.field(compare=False)
+    #: membership epoch this plan was (re)built under (elastic runs bump it
+    #: on every reconfiguration via `rescale_plan`, so plan-fingerprinted
+    #: checkpoint restores can tell a pre-shrink plan from a post-shrink
+    #: one even when the surviving world size coincides). 0 = the initial
+    #: membership — fingerprints of epoch-0 plans are unchanged.
+    epoch: int = 0
 
     @property
     def num_buckets(self) -> int:
@@ -296,6 +302,27 @@ def make_plan(
     if nearby_layers is not None:
         return plan_by_nearby_layers(params, world, nearby_layers)
     return plan_by_threshold(params, world, threshold_mb)
+
+
+def rescale_plan(plan: FusionPlan, world: int,
+                 *, epoch: Optional[int] = None) -> FusionPlan:
+    """Rebuild ``plan`` for a NEW replica count (elastic membership change:
+    a host is lost or readmitted and the data-parallel world shrinks or
+    grows). The leaf specs and bucket grouping are preserved exactly — only
+    the per-bucket padding and shard sizes are recomputed for the new
+    ``world`` — so `tuning.autotune.repack_state` can carry a live
+    `DearState` across the resize. ``epoch`` stamps the membership epoch
+    into the plan (and therefore into `utils.checkpoint.plan_fingerprint`),
+    keeping plan-fingerprinted restores coherent across reconfigurations.
+    """
+    if world == plan.world and (epoch is None or epoch == plan.epoch):
+        return plan
+    rebuilt = _build_plan(
+        plan.leaves, [list(b.leaf_ids) for b in plan.buckets], world,
+        plan.treedef,
+    )
+    return dataclasses.replace(
+        rebuilt, epoch=plan.epoch if epoch is None else int(epoch))
 
 
 def _build_plan(specs, groups, world, treedef) -> FusionPlan:

@@ -31,9 +31,16 @@ from dear_pytorch_tpu.resilience.cluster import (  # noqa: F401
     ClusterCoordinator,
     ClusterError,
     DesyncError,
+    FileTransport,
     HealthVerdict,
     LocalTransport,
     PeerTimeout,
+)
+from dear_pytorch_tpu.resilience.membership import (  # noqa: F401
+    ElasticCluster,
+    ElasticVerdict,
+    EvictedError,
+    MembershipView,
 )
 from dear_pytorch_tpu.resilience.inject import (  # noqa: F401
     FAULT_ENV,

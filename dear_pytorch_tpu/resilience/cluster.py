@@ -55,8 +55,8 @@ logger = logging.getLogger("dear_pytorch_tpu")
 __all__ = [
     "ClusterError", "PeerTimeout", "DesyncError", "HealthVerdict",
     "LocalTransport", "CoordinationServiceTransport", "AllgatherTransport",
-    "ClusterCoordinator", "enabled_by_env", "CLUSTER_ENV", "TIMEOUT_ENV",
-    "TRANSPORT_ENV",
+    "FileTransport", "ClusterCoordinator", "enabled_by_env", "CLUSTER_ENV",
+    "TIMEOUT_ENV", "TRANSPORT_ENV",
 ]
 
 #: Deadline for one coordination exchange (set/gather/barrier) before a
@@ -74,9 +74,11 @@ DEFAULT_TIMEOUT_S = 120.0
 #: Default: 10x the base deadline.
 RESTORE_TIMEOUT_ENV = "DEAR_CLUSTER_RESTORE_TIMEOUT_SECS"
 
-#: Transport selection: "kv" (coordination-service store, native timeouts)
-#: or "allgather" (`comm.collectives.host_allgather` with a thread-join
-#: timeout). "kv" is the default wherever `jax.distributed` is live.
+#: Transport selection: "kv" (coordination-service store, native timeouts),
+#: "allgather" (`comm.collectives.host_allgather` with a thread-join
+#: timeout), or "file:<dir>" (shared-directory store — the only transport
+#: that survives rank relaunch, see `FileTransport`). "kv" is the default
+#: wherever `jax.distributed` is live.
 TRANSPORT_ENV = "DEAR_CLUSTER_TRANSPORT"
 
 #: Kill switch: DEAR_CLUSTER=0 restores the legacy multi-host policy
@@ -92,6 +94,66 @@ def enabled_by_env() -> bool:
 
 _ALLGATHER_PAYLOAD_BYTES = 2048  # fixed-size slot per rank (allgather needs
 #                                  identical shapes on every process)
+
+
+def evaluate_health_views(ranks, views, *, step, scope="cluster"):
+    """The shared any-rank-unhealthy / desync-sentinel / preemption
+    evaluation over one gathered health exchange, with its telemetry and
+    logging. `ClusterCoordinator` (fixed world) and
+    `resilience.membership.ElasticCluster` (member-scoped) must never
+    drift on this decision rule, so both call here. Returns
+    ``(unhealthy_ranks, fingerprints, desync, any_preempted)``."""
+    unhealthy = tuple(r for r, v in zip(ranks, views) if not v["ok"])
+    fps = tuple(v["fp"] for v in views)
+    healthy_fps = {v["fp"] for v in views if v["ok"] and v["fp"]}
+    desync = len(healthy_fps) > 1
+    any_pre = any(v["pre"] for v in views)
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("cluster.health_checks")
+        if unhealthy:
+            tr.count("cluster.unhealthy_detected")
+            tr.event("cluster.unhealthy", step=step or -1,
+                     ranks=",".join(map(str, unhealthy)))
+        if desync:
+            tr.count("cluster.desync_detected")
+            tr.event("cluster.desync", step=step or -1,
+                     fingerprints=";".join(fps)[:200])
+        if any_pre:
+            tr.count("cluster.preempt_propagated")
+    if desync:
+        logger.critical(
+            "%s: DESYNC at step %s — replica fingerprints disagree: %s",
+            scope, step, list(fps))
+    elif unhealthy:
+        logger.warning(
+            "%s: rank(s) %s unhealthy at step %s — coordinated rollback",
+            scope, list(unhealthy), step)
+    return unhealthy, fps, desync, any_pre
+
+
+def newest_common_step(views, *, scope="cluster", epoch=None):
+    """The shared consensus-restore decision rule over the gathered
+    per-rank verified-step views: the newest step present in EVERY
+    opining view (None views defer), with its telemetry and logging —
+    one implementation for both coordinators."""
+    opining = [set(v) for v in views if v is not None]
+    common = set.intersection(*opining) if opining else set()
+    step = max(common) if common else None
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("cluster.consensus_restores")
+        attrs = dict(
+            step=-1 if step is None else step,
+            newest_per_rank=",".join(
+                "-" if not v else str(max(v)) for v in views))
+        if epoch is not None:
+            attrs["epoch"] = epoch
+        tr.event("cluster.consensus_restore", **attrs)
+    logger.warning(
+        "%s: consensus restore step = %s (per-rank newest: %s)",
+        scope, step, [max(v) if v else None for v in views])
+    return step
 
 
 class ClusterError(RuntimeError):
@@ -150,6 +212,16 @@ class LocalTransport:
         with self._cv:
             self._store.pop(key, None)
 
+    def decide_once(self, key: str, value: str) -> str:
+        """First-writer-wins: atomically publish ``value`` under ``key``
+        unless a value is already there; returns the winning value either
+        way (the consensus-decision primitive `ElasticCluster` anchors
+        epoch commits on)."""
+        with self._cv:
+            won = self._store.setdefault(key, value)
+            self._cv.notify_all()
+            return won
+
     def barrier(self, tag: str, timeout_s: float) -> None:
         try:
             self._barrier.wait(timeout=timeout_s)
@@ -157,6 +229,129 @@ class LocalTransport:
             raise PeerTimeout(
                 f"barrier {tag!r} broken/timed out after {timeout_s:.1f}s"
             ) from None
+
+
+class FileTransport:
+    """Shared-directory KV store: ``set`` is an atomic file write under
+    ``root`` (tmp + ``os.replace``), ``get`` polls for the file until the
+    deadline. No ``jax.distributed`` involved at all — which is exactly
+    what whole-process elasticity needs: the store outlives any single
+    rank, a relaunched rank sees every key its predecessor's peers wrote,
+    and rank death can never take the coordination substrate down with it
+    (the jax coordination service lives *inside* process 0, so host-0 loss
+    kills that transport's store — see docs/RESILIENCE.md). Works on any
+    filesystem every rank can reach: local disk for same-host process
+    clusters (`launch/supervisor.py`), NFS/GCS-fuse on a pod.
+
+    ``barrier`` needs ``index``/``num_processes`` (marker-file gather);
+    `resilience.membership.ElasticCluster` never calls it — membership
+    can't barrier on a fixed world — so elastic use may omit both.
+    """
+
+    def __init__(self, root: str, *, index: Optional[int] = None,
+                 num_processes: Optional[int] = None, poll_s: float = 0.02):
+        self.root = os.path.abspath(root)
+        self.index = index
+        self.num_processes = num_processes
+        self.poll_s = float(poll_s)
+        self._prev_barrier: Optional[str] = None
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        # keys are '/'-structured; mirror them as directories so the store
+        # stays human-debuggable (ls the tree to watch a protocol run)
+        parts = [p for p in key.split("/") if p not in ("", ".", "..")]
+        return os.path.join(self.root, *parts)
+
+    def set(self, key: str, value: str) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, path)  # readers see the whole value or no file
+
+    def get(self, key: str, timeout_s: float) -> str:
+        import time as _time
+
+        path = self._path(key)
+        deadline = _time.monotonic() + max(float(timeout_s), 0.0)
+        while True:
+            try:
+                with open(path) as f:
+                    return f.read()
+            except OSError:
+                pass
+            if _time.monotonic() >= deadline:
+                raise PeerTimeout(
+                    f"no peer published {key!r} within {timeout_s:.1f}s")
+            _time.sleep(self.poll_s)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+    def decide_once(self, key: str, value: str) -> str:
+        """First-writer-wins publish (see `LocalTransport.decide_once`).
+        Atomic via hard-link of a fully-written tmp file — ``link`` fails
+        with EEXIST when another rank won, and a reader can never observe
+        a partially written value (the tmp is complete before linking)."""
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(value)
+        try:
+            os.link(tmp, path)
+            return value
+        except FileExistsError:
+            return self.get(key, self.poll_s)
+        except OSError:
+            # filesystem without hard links (some FUSE mounts): exclusive
+            # create of the final path — racier (a concurrent reader can
+            # catch the value mid-write) but still first-writer-wins
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                with os.fdopen(fd, "w") as f:
+                    f.write(value)
+                return value
+            except FileExistsError:
+                return self.get(key, self.poll_s)
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def prune_prefix(self, prefix: str) -> None:
+        """Best-effort GC of a whole key subtree (an elastic epoch's
+        exchanges after the fleet moved past it)."""
+        import shutil
+
+        try:
+            shutil.rmtree(self._path(prefix), ignore_errors=True)
+        except OSError:
+            pass
+
+    def barrier(self, tag: str, timeout_s: float) -> None:
+        if self.index is None or self.num_processes is None:
+            raise ClusterError(
+                "FileTransport.barrier needs index/num_processes at "
+                "construction (elastic membership never barriers on a "
+                "fixed world; pass both for ClusterCoordinator use)")
+        self.set(f"{tag}/{self.index}", "b")
+        for r in range(self.num_processes):
+            self.get(f"{tag}/{r}", timeout_s)
+        # lag-1 GC: every rank is past the PREVIOUS barrier (it published
+        # this one's marker, which happens only after completing that
+        # gather), so its subtree is dead weight on the shared store —
+        # prune it now instead of accreting one marker per rank per sync
+        # for the life of the run. Concurrent prunes are idempotent.
+        if self._prev_barrier is not None and self._prev_barrier != tag:
+            self.prune_prefix(self._prev_barrier)
+        self._prev_barrier = tag
 
 
 class CoordinationServiceTransport:
@@ -360,10 +555,14 @@ class ClusterCoordinator:
                 transport = CoordinationServiceTransport()
             elif transport == "allgather":
                 transport = AllgatherTransport(self.index, self.process_count)
+            elif transport.startswith("file:"):
+                transport = FileTransport(
+                    transport[len("file:"):], index=self.index,
+                    num_processes=self.process_count)
             else:
                 raise ValueError(
                     f"{TRANSPORT_ENV}={transport!r}: valid transports are "
-                    "'kv' and 'allgather'"
+                    "'kv', 'allgather', and 'file:<dir>'"
                 )
         self._transport = transport
 
@@ -445,40 +644,13 @@ class ClusterCoordinator:
         })
         views = [json.loads(v)
                  for v in self.exchange("health", payload)]
-        unhealthy = tuple(r for r, v in enumerate(views) if not v["ok"])
-        fps = tuple(v["fp"] for v in views)
-        healthy_fps = {v["fp"] for v in views if v["ok"] and v["fp"]}
-        desync = len(healthy_fps) > 1
-        any_pre = any(v["pre"] for v in views)
-        verdict = HealthVerdict(
+        unhealthy, fps, desync, any_pre = evaluate_health_views(
+            range(len(views)), views, step=step)
+        return HealthVerdict(
             ok=not unhealthy and not desync,
             unhealthy_ranks=unhealthy, desync=desync,
             any_preempted=any_pre, fingerprints=fps,
         )
-        tr = _telemetry.get_tracer()
-        if tr.enabled:
-            tr.count("cluster.health_checks")
-            if unhealthy:
-                tr.count("cluster.unhealthy_detected")
-                tr.event("cluster.unhealthy", step=step or -1,
-                         ranks=",".join(map(str, unhealthy)))
-            if desync:
-                tr.count("cluster.desync_detected")
-                tr.event("cluster.desync", step=step or -1,
-                         fingerprints=";".join(fps)[:200])
-            if any_pre:
-                tr.count("cluster.preempt_propagated")
-        if desync:
-            logger.critical(
-                "cluster: DESYNC at step %s — replica fingerprints "
-                "disagree: %s", step, list(fps),
-            )
-        elif unhealthy:
-            logger.warning(
-                "cluster: rank(s) %s unhealthy at step %s — coordinated "
-                "rollback", list(unhealthy), step,
-            )
-        return verdict
 
     def consensus_restore_step(
         self, local_steps: Optional[Sequence[int]],
@@ -504,23 +676,7 @@ class ClusterCoordinator:
         views = [json.loads(v)
                  for v in self.exchange("restore", json.dumps(mine),
                                         timeout_s=restore_deadline)]
-        opining = [set(v) for v in views if v is not None]
-        common = set.intersection(*opining) if opining else set()
-        step = max(common) if common else None
-        tr = _telemetry.get_tracer()
-        if tr.enabled:
-            tr.count("cluster.consensus_restores")
-            tr.event(
-                "cluster.consensus_restore",
-                step=-1 if step is None else step,
-                newest_per_rank=",".join(
-                    "-" if not v else str(max(v)) for v in views),
-            )
-        logger.warning(
-            "cluster: consensus restore step = %s (per-rank newest: %s)",
-            step, [max(v) if v else None for v in views],
-        )
-        return step
+        return newest_common_step(views)
 
     @staticmethod
     def fingerprint(value) -> str:

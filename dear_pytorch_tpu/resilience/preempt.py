@@ -14,6 +14,15 @@ The handler chains to any previously-installed SIGTERM handler on exit
 (context-manager protocol restores it), and `resilience.inject`'s
 ``preempt`` fault delivers a real ``os.kill(getpid(), SIGTERM)`` so this
 path is exercised in CI, not just in production.
+
+Elastic runs route preemption through the epoch machinery: the signal
+records the membership epoch it landed under (``epoch_at_signal``), the
+flag propagates to every *current member* via the epoch-scoped health
+sync (`resilience.membership.ElasticCluster.health_check`'s
+``any_preempted``), and the cooperative emergency save is stamped with
+that epoch in its checkpoint sidecar (`utils.checkpoint`'s
+``mem_epoch``) — which is exactly the "last known epoch" a relaunched
+rank later presents to the rejoin protocol.
 """
 
 from __future__ import annotations
@@ -39,22 +48,44 @@ class PreemptionHandler:
         self._event = threading.Event()
         self.count = 0
         self._installed = False
+        #: membership epoch the (first) signal landed under — None until a
+        #: signal arrives, and on non-elastic runs
+        self.epoch_at_signal: Optional[int] = None
+        #: resolved at install() time, NEVER in the handler: a module
+        #: import inside a signal handler can block on the import lock
+        #: (or observe a half-initialized module) — the handler may only
+        #: call this pre-bound function (a weakref read)
+        self._epoch_fn = None
 
     # -- signal plumbing -----------------------------------------------------
 
     def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
         self.count += 1
         self._event.set()
+        if self.epoch_at_signal is None and self._epoch_fn is not None:
+            try:
+                self.epoch_at_signal = self._epoch_fn()
+            except Exception:
+                self.epoch_at_signal = None
         # no I/O here beyond logging: the actual save happens at the next
         # step boundary, on the training thread, where device state is
         # coherent
         logger.warning(
-            "preempt: received signal %d (count %d); emergency checkpoint "
-            "at the next step boundary", signum, self.count,
+            "preempt: received signal %d (count %d, membership epoch %s); "
+            "emergency checkpoint at the next step boundary", signum,
+            self.count, self.epoch_at_signal,
         )
 
     def install(self) -> "PreemptionHandler":
         if not self._installed:
+            try:
+                from dear_pytorch_tpu.resilience.membership import (
+                    current_epoch,
+                )
+
+                self._epoch_fn = current_epoch
+            except Exception:
+                self._epoch_fn = None
             for s in self._signals:
                 self._prev[s] = signal.signal(s, self._on_signal)
             self._installed = True

@@ -57,6 +57,8 @@ class WatchdogReport(NamedTuple):
     # leak into every later default-constructed report
     flight: Sequence = ()           # flight ring (last N step records)
     env: Mapping = MappingProxyType({})  # redacted DEAR_* env context
+    mem_epoch: Optional[int] = None  # elastic membership epoch at firing
+    #                                  time (None outside elastic runs)
 
 
 def _process_index() -> int:
@@ -183,11 +185,17 @@ class StepWatchdog:
             env = _redaction.redact_env()
         except Exception:
             env = {}
+        try:
+            from dear_pytorch_tpu.resilience import membership as _membership
+
+            mem_epoch = _membership.current_epoch()
+        except Exception:
+            mem_epoch = None
         return WatchdogReport(
             name=self.name, waited_s=waited, deadline_s=self.deadline_s,
             beat_info=info, live_spans=live,
             process_index=_process_index(), faults=_active_faults(),
-            flight=ring, env=env,
+            flight=ring, env=env, mem_epoch=mem_epoch,
         )
 
     def _dump(self, report: WatchdogReport, cause: str) -> None:
@@ -196,8 +204,10 @@ class StepWatchdog:
         multi-host hang logs can be lined up by rank and replayed."""
         if not self._dump_stacks:
             return
+        epoch = ("" if report.mem_epoch is None
+                 else f" epoch={report.mem_epoch}")
         sys.stderr.write(
-            f"\n+++ {report.name} [rank {report.process_index}] "
+            f"\n+++ {report.name} [rank {report.process_index}]{epoch} "
             f"faults={report.faults or '-'}: {cause} — thread stacks "
             "follow +++\n"
         )

@@ -259,6 +259,78 @@ class AutoTuner:
                 )
         return state
 
+    def rescale(self, view, *, mesh: Optional[jax.sharding.Mesh] = None,
+                state: Optional[D.DearState] = None):
+        """Rebuild the train step for a NEW replica count after an elastic
+        membership transition (`utils.guard.GuardedTrainer`'s
+        ``on_membership_change`` hook calls this with the committed
+        `resilience.membership.MembershipView`). The bucket grouping is
+        preserved (`F.rescale_plan`) — only the per-bucket padding/shard
+        sizes change — and the membership epoch is stamped into the plan,
+        so `utils.checkpoint.plan_fingerprint` distinguishes the rescaled
+        plan even when the world size coincides with an earlier epoch.
+
+        ``mesh`` defaults to a 1-D dp mesh over the first ``view.world``
+        global devices (single-controller CPU emulation; a real pod passes
+        the re-initialized post-shrink mesh). ``state`` is optional
+        because the guard restores from checkpoint AFTER this hook (the
+        elastic re-pack lands directly in the new plan); pass a live state
+        to carry it across the resize in-process (`repack_state`).
+
+        Sandboxed like a BO trial: the rebuild is functional — on any
+        failure the previous train step stays installed and the exception
+        propagates (counted as ``autotune.rescale_failures``), so the
+        caller can fall back to crash-for-relaunch without a half-swapped
+        plan.
+        """
+        world = int(getattr(view, "world", view))
+        epoch = int(getattr(view, "epoch", 0) or 0)
+        old_ts = self.ts
+        if world == old_ts.plan.world and epoch == old_ts.plan.epoch:
+            return state
+        tr = _telemetry.get_tracer()
+        if mesh is None:
+            devs = jax.devices()
+            if world > len(devs):
+                raise ValueError(
+                    f"rescale to world={world} needs {world} devices; "
+                    f"only {len(devs)} visible (pass an explicit mesh)")
+            mesh = jax.sharding.Mesh(
+                np.asarray(devs[:world]), (D.DP_AXIS,))
+        plan = F.rescale_plan(old_ts.plan, world, epoch=epoch)
+        kw = dict(self._build_kwargs)
+        kw["mesh"] = mesh
+        try:
+            with tr.span("autotune.rescale", world=world, epoch=epoch,
+                         buckets=plan.num_buckets):
+                new_ts = D.build_train_step(
+                    self._loss_fn, self._template, plan=plan, **kw)
+                if state is not None:
+                    state = repack_state(state, old_ts, new_ts)
+        except Exception as exc:
+            if tr.enabled:
+                tr.count("autotune.rescale_failures")
+                tr.event("autotune.rescale_failed", world=world,
+                         epoch=epoch, why=f"{type(exc).__name__}: {exc}"[:120])
+            logger.error(
+                "autotune: rescale to world=%d (epoch %d) failed (%s: %s); "
+                "previous plan still installed",
+                world, epoch, type(exc).__name__, exc)
+            raise
+        self.ts = new_ts
+        self.rebuilds += 1
+        if tr.enabled:
+            tr.count("autotune.rescales")
+            tr.event("autotune.rescaled", world=world, epoch=epoch,
+                     buckets=new_ts.plan.num_buckets)
+        if self.tuner is not None:
+            self.tuner.notify_rebuild()
+        self._log(
+            f"autotune: rescaled plan to world={world} "
+            f"(membership epoch {epoch}, {new_ts.plan.num_buckets} buckets)"
+        )
+        return state
+
     def step(self, state, batch):
         state, metrics = self.ts.step(state, batch)
         self._host_step += 1

@@ -56,8 +56,21 @@ from dear_pytorch_tpu.resilience.retry import retry_call
 logger = logging.getLogger("dear_pytorch_tpu")
 
 
+class PlanMismatchError(ValueError):
+    """The checkpoint was packed under a different fusion plan than the
+    live train step's (another threshold, world size, or membership
+    epoch). `GuardedTrainer._restore_step` catches exactly this type to
+    route into the `elastic_restore` re-pack path — a ValueError subclass
+    so pre-existing callers keep working."""
+
+
 def plan_fingerprint(plan: F.FusionPlan) -> str:
-    """Stable hash of everything that determines buffer layout."""
+    """Stable hash of everything that determines buffer layout — including
+    the membership epoch for elastically rescaled plans (`F.rescale_plan`),
+    so a post-reconfiguration restore can never silently unpack buffers
+    packed under a different membership even when the world size happens
+    to coincide. Epoch-0 (initial membership) fingerprints are unchanged
+    from pre-elastic checkpoints."""
     desc = {
         "world": plan.world,
         "leaves": [(s.name, list(s.shape), str(s.dtype)) for s in plan.leaves],
@@ -65,6 +78,9 @@ def plan_fingerprint(plan: F.FusionPlan) -> str:
             [list(b.leaf_ids), b.padded_size] for b in plan.buckets
         ],
     }
+    epoch = int(getattr(plan, "epoch", 0) or 0)
+    if epoch:
+        desc["epoch"] = epoch
     return hashlib.sha256(
         json.dumps(desc, sort_keys=True).encode()
     ).hexdigest()[:16]
@@ -76,6 +92,7 @@ def plan_desc(plan: F.FusionPlan) -> dict:
     `elastic_restore` possible on a different world size."""
     return {
         "world": plan.world,
+        "epoch": int(getattr(plan, "epoch", 0) or 0),
         "leaves": [
             {"name": s.name, "layer": s.layer, "shape": list(s.shape),
              "dtype": str(s.dtype)}
@@ -99,8 +116,14 @@ def plan_from_desc(desc: dict, treedef) -> F.FusionPlan:
         )
         for d in desc["leaves"]
     )
-    return F._build_plan(specs, [list(g) for g in desc["groups"]],
+    plan = F._build_plan(specs, [list(g) for g in desc["groups"]],
                          desc["world"], treedef)
+    epoch = int(desc.get("epoch", 0) or 0)
+    if epoch:
+        import dataclasses as _dc
+
+        plan = _dc.replace(plan, epoch=epoch)
+    return plan
 
 
 def _prod(shape) -> int:
@@ -241,6 +264,8 @@ def _get_async_checkpointer():
 def save_checkpoint(
     directory: str, state: D.DearState, plan: F.FusionPlan,
     *, asynchronous: bool = False,
+    pipeline_state: Optional[dict] = None,
+    mem_epoch: Optional[int] = None,
 ) -> str:
     """Write a checkpoint for the state's current step; returns its path.
 
@@ -249,6 +274,12 @@ def save_checkpoint(
     while training continues (the step dir appears atomically when the write
     commits). Call `wait_for_checkpoints` before reading the files or
     exiting the process.
+
+    ``pipeline_state`` (a `runtime.pipeline` ``state_dict()``) and
+    ``mem_epoch`` (the elastic membership epoch) ride in the sidecar:
+    restoring the model without restoring the input-pipeline position
+    silently replays or skips data, so the guard persists both and
+    `read_pipeline_state` / `read_mem_epoch` recover them.
     """
     import orbax.checkpoint as ocp
 
@@ -278,6 +309,10 @@ def save_checkpoint(
         # a crash mid-write leaves an orphan sidecar, never a broken restore
         meta = {"plan": plan_fingerprint(plan), "step": step,
                 "plan_desc": plan_desc(plan)}
+        if pipeline_state is not None:
+            meta["pipeline"] = pipeline_state
+        if mem_epoch is not None:
+            meta["mem_epoch"] = int(mem_epoch)
         # checksum manifest over the committed files: only the sync paths
         # have them on disk here; async saves backfill via `write_manifest`
         # after `wait_for_checkpoints` (manifest=None verifies vacuously)
@@ -342,6 +377,82 @@ def write_manifest(directory: str, step: int) -> bool:
     meta["manifest"] = _build_manifest(step_dir)
     _write_sidecar(directory, step, meta)
     return True
+
+
+def read_sidecar(directory: str, step: int) -> Optional[dict]:
+    """The sidecar metadata for a step (None when missing/unreadable)."""
+    meta_path = os.path.join(directory, f"meta_{step:010d}.json")
+    try:
+        with open(meta_path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def read_pipeline_state(directory: str, step: int) -> Optional[dict]:
+    """The input-pipeline ``state_dict()`` persisted with a checkpoint
+    (None when the save predates pipeline sidecars). Feed it to
+    `runtime.pipeline.Pipeline.load_state_dict` so a restore resumes the
+    data stream at the position the checkpoint was taken — without this,
+    every restore silently replays or skips data."""
+    meta = read_sidecar(directory, step)
+    return meta.get("pipeline") if meta else None
+
+
+def read_mem_epoch(directory: str, step: int) -> Optional[int]:
+    """The elastic membership epoch stamped into a checkpoint's sidecar
+    (None when absent) — a relaunched rank's "last known epoch" for the
+    rejoin protocol (`resilience.membership.ElasticCluster.rejoin`)."""
+    meta = read_sidecar(directory, step)
+    if meta is None or "mem_epoch" not in meta:
+        return None
+    return int(meta["mem_epoch"])
+
+
+def prune_future_steps(directory: str, *, above: int) -> list:
+    """Delete every checkpoint step STRICTLY NEWER than ``above``.
+
+    After a restore to an older-than-newest step — a consensus rollback
+    past a corrupted checkpoint, or an elastic-membership restore to the
+    newest step valid on every member — the newer step dirs belong to an
+    ABANDONED timeline: replayed training will re-reach those step numbers
+    with different parameters, so leaving the stale dirs in place would
+    (a) collide with the replayed saves and (b) let a later restore
+    resurrect dead-timeline state (a silent desync across members that
+    rolled back together). `GuardedTrainer` calls this after every
+    successful restore. Returns the pruned steps (newest first)."""
+    import shutil
+
+    from dear_pytorch_tpu.observability import tracer as _telemetry
+
+    if not _owns_directory_io():
+        return []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    stale = sorted(
+        (int(name[len("step_"):]) for name in names
+         if name.startswith("step_") and name[len("step_"):].isdigit()
+         and int(name[len("step_"):]) > above),
+        reverse=True)
+    for s in stale:
+        shutil.rmtree(os.path.join(directory, f"step_{s:010d}"),
+                      ignore_errors=True)
+        try:
+            os.remove(os.path.join(directory, f"meta_{s:010d}.json"))
+        except OSError:
+            pass
+    if stale:
+        logger.warning(
+            "checkpoint: pruned %d stale future step(s) %s after restore "
+            "to step %d (abandoned timeline)", len(stale), stale, above)
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("ckpt.future_steps_pruned", len(stale))
+            tr.event("ckpt.future_steps_prune", above=above,
+                     pruned=len(stale))
+    return stale
 
 
 def verify_checkpoint(directory: str, step: int) -> bool:
@@ -608,7 +719,7 @@ def restore_checkpoint(
         meta = json.load(f)
     live = plan_fingerprint(ts.plan)
     if meta["plan"] != live:
-        raise ValueError(
+        raise PlanMismatchError(
             f"checkpoint step {step} was packed under plan {meta['plan']} "
             f"but the train step uses plan {live}; rebuild the step with "
             "the original plan, or restore there and carry across with "
@@ -695,7 +806,11 @@ def elastic_restore(
     # after a genuine downsize (orbax warns exactly about this).
     ckptr = ocp.PyTreeCheckpointer()
     path = os.path.abspath(_ckpt_dir(directory, step))
-    item_md = ckptr.metadata(path).item_metadata
+    # orbax version drift: metadata() returns a StepMetadata with
+    # .item_metadata on newer releases and the raw tree (a dict) on the
+    # 0.5.x line this container ships — tolerate both
+    md = ckptr.metadata(path)
+    item_md = getattr(md, "item_metadata", md)
     item_tree = item_md.tree if hasattr(item_md, "tree") else item_md
     restore_args = jax.tree.map(
         lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item_tree

@@ -19,8 +19,20 @@ few donated steps. `GuardedTrainer` wraps a `TrainStep` with:
     shows up in logs with the last-good step number.
 
 This is single-program recovery (the process survives). Whole-process
-elasticity (host loss on a pod) composes on top: the same periodic
-checkpoints are what a relaunched job restores from.
+elasticity (host loss on a pod) composes on top: pass a
+`resilience.membership.ElasticCluster` as the ``coordinator`` and a
+confirmed peer loss SHRINKS the membership instead of crashing the job —
+the guard treats any ``membership_changed`` health verdict as a
+transition point: it invokes ``on_membership_change`` (where the loop
+rebuilds its train step for the new replica count, e.g.
+`tuning.autotune.AutoTuner.rescale`), reshards the input ``pipeline``
+(`runtime.pipeline.reshard`), and rolls every survivor back to the
+newest step valid on all of them. A checkpoint packed under the
+pre-change plan restores through `utils.checkpoint.elastic_restore`
+(the plan fingerprint carries the membership epoch, so the mismatch is
+detected, never silently unpacked). A relaunched rank re-enters through
+`ElasticCluster.rejoin` + `elastic_resume` (docs/RESILIENCE.md
+"Elastic membership").
 
 The resilience layer (`dear_pytorch_tpu.resilience`, docs/RESILIENCE.md)
 plugs in here:
@@ -122,6 +134,8 @@ class GuardedTrainer:
         watchdog: Optional[Any] = None,
         preemption: Optional[Any] = None,
         coordinator: Optional[Any] = None,
+        pipeline: Optional[Any] = None,
+        on_membership_change: Optional[Callable[[Any], None]] = None,
     ):
         self.ts = ts
         self.directory = directory
@@ -149,6 +163,12 @@ class GuardedTrainer:
             # already separates multiple trainers in one process
             coordinator = _cluster.ClusterCoordinator(namespace="guard")
         self._coordinator = coordinator
+        # deterministic data resume/resharding: a pipeline handed to the
+        # guard has its state_dict persisted in every checkpoint sidecar,
+        # restored on rollback, and resharded on membership changes
+        self._pipeline = pipeline
+        self.on_membership_change = on_membership_change
+        self._pending_reshard = False
         # run-health layer: flight ring (enabled alongside telemetry; see
         # the _flight property), anomaly detectors on the check cadence,
         # and — on coordinated runs — the digest aggregation that rides
@@ -207,6 +227,78 @@ class GuardedTrainer:
                 and self._coordinator.process_count > 1)
 
     @property
+    def _mem_epoch(self) -> Optional[int]:
+        """The elastic membership epoch (None outside elastic runs) —
+        stamped into every checkpoint sidecar so a relaunched rank can
+        present its last known epoch to the rejoin protocol."""
+        return getattr(self._coordinator, "epoch", None)
+
+    def _pipeline_state(self) -> Optional[dict]:
+        if self._pipeline is None:
+            return None
+        try:
+            return self._pipeline.state_dict()
+        except Exception as exc:  # a stats bug must not block the save
+            logger.error("guard: pipeline.state_dict() failed: %s", exc)
+            return None
+
+    def _restore_pipeline(self, step: int) -> None:
+        """Resume the input pipeline at the position persisted with the
+        checkpoint being restored — without this every rollback silently
+        replays (or skips) data."""
+        if self._pipeline is None:
+            return
+        pstate = ckpt.read_pipeline_state(self.directory, step)
+        if pstate is None:
+            logger.warning(
+                "guard: checkpoint step %d has no pipeline sidecar state; "
+                "the data stream position is NOT restored", step)
+            return
+        try:
+            self._pipeline.load_state_dict(pstate)
+        except Exception as exc:  # a spec change must not kill recovery
+            logger.error(
+                "guard: pipeline state restore failed (%s); continuing "
+                "with the live stream position", exc)
+
+    def _reshard_pipeline(self) -> None:
+        """Reassign this rank's data slice after a committed membership
+        transition (shard slot = position in the new member list)."""
+        self._pending_reshard = False
+        view_fn = getattr(self._coordinator, "view", None)
+        if self._pipeline is None or view_fn is None:
+            return
+        view = view_fn()
+        try:
+            self._pipeline.reshard(view.index, view.world, epoch=view.epoch)
+        except Exception as exc:
+            logger.error(
+                "guard: pipeline reshard to %d/%d (epoch %d) failed: %s",
+                view.index, view.world, view.epoch, exc)
+
+    def _restore_step(self, step: int):
+        """Restore one step into the live plan's layout; a checkpoint
+        packed under a DIFFERENT plan (pre-membership-change epoch, or a
+        different world size) re-packs through `ckpt.elastic_restore`
+        instead of failing — the fingerprint mismatch is the signal, the
+        sidecar's plan_desc is the recovery path."""
+        try:
+            return ckpt.restore_checkpoint(
+                self.directory, self.ts, step=step,
+                template=self._template_state(),
+            )
+        except ckpt.PlanMismatchError:
+            logger.warning(
+                "guard: checkpoint step %d predates the live plan "
+                "(membership epoch %s); elastic re-pack restore",
+                step, self._mem_epoch)
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                tr.event("guard.elastic_restore", step=step,
+                         epoch=self._mem_epoch or 0)
+            return ckpt.elastic_restore(self.directory, self.ts, step=step)
+
+    @property
     def _preempt_requested(self) -> bool:
         """Should this step act on a preemption? Coordinated runs act only
         once the signal has propagated through the health sync, so every
@@ -231,7 +323,9 @@ class GuardedTrainer:
         step = int(jax.device_get(state.step))
         try:
             ckpt.save_checkpoint(self.directory, state, self.ts.plan,
-                                 asynchronous=self.async_checkpoints)
+                                 asynchronous=self.async_checkpoints,
+                                 pipeline_state=self._pipeline_state(),
+                                 mem_epoch=self._mem_epoch)
         except Exception as exc:
             if not self.async_checkpoints:
                 raise
@@ -301,20 +395,46 @@ class GuardedTrainer:
                     self.directory, limit=self._coordinator.max_candidates)
             else:
                 local = None  # defer to rank 0's verification
+            epoch_before = getattr(self._coordinator, "epoch", None)
             step = self._coordinator.consensus_restore_step(local)
             if step is None:
                 raise DivergenceError(
                     "no checkpoint step is verified on every host; "
                     "nothing commonly restorable (see the chained cause)"
                 ) from cause
+            if (epoch_before is not None
+                    and getattr(self._coordinator, "epoch",
+                                epoch_before) != epoch_before):
+                # a SECOND failure during the restore exchange
+                # reconfigured the membership again (elastic clusters
+                # retry the exchange over the survivors): rebuild for the
+                # newest view BEFORE unpacking, or the restore lands in a
+                # plan built for a membership that no longer exists and
+                # later sidecars stamp an epoch the plan doesn't carry
+                logger.critical(
+                    "guard: membership moved during the restore exchange "
+                    "(epoch %s -> %s); rebuilding for the newest view",
+                    epoch_before, self._coordinator.epoch)
+                self._pending_reshard = True
+                if self.on_membership_change is not None:
+                    self.on_membership_change(self._coordinator.view())
+                    self._template = None
+                if tr.enabled:
+                    tr.count("guard.membership_changes")
+                    tr.event("guard.membership_change",
+                             epoch=self._coordinator.epoch,
+                             during="restore")
             # every rank is now committed to this step: a restore failure
             # here must propagate (crash for whole-job relaunch) — falling
             # back locally would desynchronize replicas.
-            state = ckpt.restore_checkpoint(
-                self.directory, self.ts, step=step,
-                template=self._template_state(),
-            )
+            state = self._restore_step(step)
             self._template = None
+            self._restore_pipeline(step)
+            # the consensus step may be OLDER than this rank's newest
+            # (elastic rejoin, a step corrupted elsewhere): anything newer
+            # is now an abandoned timeline — replay will re-reach those
+            # step numbers with different parameters
+            ckpt.prune_future_steps(self.directory, above=step)
             logger.warning(
                 "guard: consensus rollback to checkpoint step %d", step)
             if tr.enabled:
@@ -341,6 +461,7 @@ class GuardedTrainer:
                 template=self._template_state(),
             )
             self._template = None
+            self._restore_pipeline(step)
             logger.warning("guard: rolled back to checkpoint step %d", step)
             return state, step
         # single-host: walk newest -> oldest. Checksum verification skips
@@ -353,10 +474,7 @@ class GuardedTrainer:
         step = ckpt.latest_valid_step(self.directory)
         while step is not None:
             try:
-                state = ckpt.restore_checkpoint(
-                    self.directory, self.ts, step=step,
-                    template=self._template_state(),
-                )
+                state = self._restore_step(step)
             except Exception as exc:
                 logger.error(
                     "guard: restore of checkpoint step %d failed (%s: %s); "
@@ -374,6 +492,10 @@ class GuardedTrainer:
             # the template is only needed for structure/shardings during
             # the restore; caching it would permanently double device memory
             self._template = None
+            self._restore_pipeline(step)
+            # a corrupted/unrestorable newer step just became an abandoned
+            # timeline; sweep it so replayed saves don't collide with it
+            ckpt.prune_future_steps(self.directory, above=step)
             logger.warning("guard: rolled back to checkpoint step %d", step)
             if tr.enabled:
                 tr.count("guard.restores")
@@ -597,11 +719,18 @@ class GuardedTrainer:
                                and self._preemption.requested
                                and not self._preempt_handled),
                 )
-                if self._aggregator is not None:
+                membership_changed = bool(
+                    getattr(verdict, "membership_changed", False))
+                if (self._aggregator is not None
+                        and not membership_changed):
                     # metric aggregation rides the same cadence (and the
                     # same bounded deadline): one lockstep digest exchange
                     # per health sync. Every rank computes the identical
                     # merged snapshot; rank 0's is the exported copy.
+                    # Skipped across a membership transition: the member
+                    # set just changed under the exchange, and a freshly
+                    # admitted rank only enters the digest cadence at the
+                    # NEXT sync (after its consensus restore).
                     self.merged_health = self._aggregator.exchange()
             except _cluster.PeerTimeout:
                 # dead-peer detection: dump forensics (open spans + all
@@ -619,6 +748,35 @@ class GuardedTrainer:
                 raise
             if verdict.any_preempted:
                 self._peer_preempt = True
+            if membership_changed:
+                # a committed transition (survivor shrink or rejoin
+                # admission) is a transition point: the loop rebuilds its
+                # train step for the new replica count (the hook — e.g.
+                # AutoTuner.rescale — runs BEFORE the restore so the
+                # elastic re-pack lands in the new plan), the pipeline is
+                # resharded after the restore, and every member rolls
+                # back to the newest step valid on all of them (the
+                # verdict is never ok, so the rollback path below runs).
+                self._pending_reshard = True
+                if tr.enabled:
+                    tr.count("guard.membership_changes")
+                    tr.event(
+                        "guard.membership_change",
+                        epoch=getattr(verdict, "epoch", -1),
+                        lost=",".join(map(str, getattr(verdict, "lost", ()))),
+                        admitted=",".join(
+                            map(str, getattr(verdict, "admitted", ()))),
+                    )
+                logger.critical(
+                    "guard: membership transition at step %d — epoch %s, "
+                    "members %s (lost %s, admitted %s); coordinated "
+                    "rollback + reshard",
+                    self.steps_seen, getattr(verdict, "epoch", "?"),
+                    list(getattr(verdict, "members", ())),
+                    list(getattr(verdict, "lost", ())),
+                    list(getattr(verdict, "admitted", ())))
+                if self.on_membership_change is not None:
+                    self.on_membership_change(self._coordinator.view())
             if not verdict.ok:
                 if error is None:
                     error = self._pending_error
@@ -649,8 +807,18 @@ class GuardedTrainer:
                     tr.event("guard.flight_dump",
                              records=len(dump["records"]))
             restored, at_step = self._restore(cause=error)
+            # futures were just pruned: the restored step IS the newest
+            # durable checkpoint now
+            self._last_good_step = at_step
             self._last_check_t = None  # restore time must not skew timing
             self._prev_step_t = None   # ditto for the flight cadence
+            if self._pending_reshard:
+                # AFTER the restore: the sidecar state re-seats the stream
+                # at the checkpointed position first, then the reshard
+                # reassigns this rank's slice under the new epoch — a pure
+                # function of (seed, epoch, slot, world), so every
+                # survivor derives the identical assignment independently
+                self._reshard_pipeline()
             if tr.enabled:
                 # counted only after the restore actually happened — the
                 # give-up/restore-failure paths above must not inflate the
@@ -708,6 +876,37 @@ class GuardedTrainer:
             self._watchdog.beat(step=self.steps_seen,
                                 last_good_step=self._last_good_step)
         return new_state, metrics
+
+    def elastic_resume(self, context: Optional[dict] = None):
+        """Re-entry for a relaunched rank that was just admitted through
+        `resilience.membership.ElasticCluster.rejoin`. The surviving
+        members are, right now, inside their membership-change rollback —
+        this performs the SAME consensus-restore exchange from this side
+        (the rejoiner's locally verified steps participate in the
+        decision), re-seats the pipeline, and aligns the guard's attempt
+        cadence with the fleet via the admission ack's ``steps_seen``
+        so the next health sync lands on the same attempt everywhere.
+        Returns ``(state, step)`` — resume the training loop from there.
+        """
+        if context:
+            self.steps_seen = int(context.get("steps_seen",
+                                              self.steps_seen))
+        self._last_check_steps = self.steps_seen
+        self._last_check_t = None
+        self._prev_step_t = None
+        state, step = self._restore()
+        self._reshard_pipeline()
+        self._last_good_step = step
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.event("guard.elastic_resume", step=step,
+                     steps_seen=self.steps_seen,
+                     epoch=self._mem_epoch or 0)
+        logger.warning(
+            "guard: elastic resume at checkpoint step %d (attempt cadence "
+            "%d, membership epoch %s)", step, self.steps_seen,
+            self._mem_epoch)
+        return state, step
 
     def _emergency_save(self, state, metrics) -> Optional[int]:
         """Preemption checkpoint: synchronous, verified, at the current
@@ -771,7 +970,9 @@ class GuardedTrainer:
                 )
         try:
             ckpt.save_checkpoint(self.directory, state, self.ts.plan,
-                                 asynchronous=False)
+                                 asynchronous=False,
+                                 pipeline_state=self._pipeline_state(),
+                                 mem_epoch=self._mem_epoch)
         except Exception as exc:
             # the grace window must still end in a clean preempted exit:
             # a failed emergency save (disk full, shared-fs error) means

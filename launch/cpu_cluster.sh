@@ -5,10 +5,24 @@
 # works host-per-process on a real CPU/GPU cluster.
 #
 # Usage: ./launch/cpu_cluster.sh <nprocs> -- <command...>
+#
+# Elastic mode: ./launch/cpu_cluster.sh --elastic <supervisor args...>
+# delegates to launch/supervisor.py — ranks get the DEAR_ELASTIC_* rejoin
+# env contract (FileTransport coordination, no jax.distributed, so a dead
+# rank can be relaunched and rejoin at a later membership epoch) instead
+# of the fixed-world JAX_* contract below. Example:
+#   ./launch/cpu_cluster.sh --elastic --nprocs 3 --dir /tmp/el -- \
+#       python worker.py
 set -euo pipefail
+
+if [ "${1:-}" = "--elastic" ]; then
+    shift
+    exec "${PYTHON:-python3}" "$(dirname "$0")/supervisor.py" "$@"
+fi
 
 if [ "$#" -lt 3 ]; then
     echo "usage: $0 <nprocs> -- <command...>" >&2
+    echo "       $0 --elastic <supervisor.py args...>" >&2
     exit 2
 fi
 NPROCS=$1; shift

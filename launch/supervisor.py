@@ -1,0 +1,200 @@
+#!/usr/bin/env python3
+"""Elastic rank supervisor: launch N worker ranks, relaunch the dead ones.
+
+The resilience stack's division of labor (docs/RESILIENCE.md "Elastic
+membership"): `resilience.membership.ElasticCluster` decides WHO is in the
+fleet — survivors shrink the membership when a rank dies, and a relaunched
+rank rejoins at a later epoch — but something outside the job has to bring
+the dead rank BACK. On a real pod that is the cluster manager (k8s
+restartPolicy, GCE instance groups); this supervisor is the same contract
+for process clusters on one host, and the reference implementation of the
+**rejoin env contract** every relauncher must speak:
+
+    DEAR_ELASTIC_DIR    FileTransport root — the coordination store that
+                        outlives any single rank (never the jax
+                        coordination service, which dies with process 0)
+    DEAR_ELASTIC_RANK   the stable rank id (identity, not position)
+    DEAR_ELASTIC_WORLD  the initial world size
+    DEAR_ELASTIC_REJOIN "1" on a RELAUNCHED rank — the worker must come
+                        back through `ElasticCluster.rejoin` instead of
+                        assuming first-launch membership
+
+Policy: a rank exiting 0 is finished and never relaunched; any other exit
+(including signals — a SIGKILLed host shows up here as -9) is relaunched
+with the rejoin flag after ``relaunch_delay_s``, up to ``max_relaunches``
+per rank. Per-rank pid files under ``<dir>/supervisor/pids/<rank>`` let
+chaos harnesses (scripts/chaos_check.py --elastic) target a specific rank.
+
+Usage (also via ``launch/cpu_cluster.sh --elastic ...``)::
+
+    python launch/supervisor.py --nprocs 3 --dir /tmp/elastic \
+        [--max-relaunches 2] [--deadline 300] -- python worker.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+ELASTIC_DIR_ENV = "DEAR_ELASTIC_DIR"
+ELASTIC_RANK_ENV = "DEAR_ELASTIC_RANK"
+ELASTIC_WORLD_ENV = "DEAR_ELASTIC_WORLD"
+ELASTIC_REJOIN_ENV = "DEAR_ELASTIC_REJOIN"
+
+
+class ElasticSupervisor:
+    """Supervise one elastic process cluster on this host."""
+
+    def __init__(
+        self,
+        nprocs: int,
+        command: List[str],
+        *,
+        elastic_dir: str,
+        env: Optional[dict] = None,
+        max_relaunches: int = 2,
+        relaunch_delay_s: float = 0.5,
+        log=lambda s: print(s, file=sys.stderr, flush=True),
+    ):
+        if nprocs < 1:
+            raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+        if not command:
+            raise ValueError("empty worker command")
+        self.nprocs = int(nprocs)
+        self.command = list(command)
+        self.elastic_dir = os.path.abspath(elastic_dir)
+        self.base_env = dict(os.environ if env is None else env)
+        self.max_relaunches = int(max_relaunches)
+        self.relaunch_delay_s = float(relaunch_delay_s)
+        self._log = log
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._final_rc: Dict[int, int] = {}   # rank -> exit of its LAST run
+        self.relaunches: Dict[int, int] = {r: 0 for r in range(self.nprocs)}
+        self._pid_dir = os.path.join(self.elastic_dir, "supervisor", "pids")
+        os.makedirs(self._pid_dir, exist_ok=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _spawn(self, rank: int, *, rejoin: bool) -> None:
+        env = dict(self.base_env)
+        env[ELASTIC_DIR_ENV] = self.elastic_dir
+        env[ELASTIC_RANK_ENV] = str(rank)
+        env[ELASTIC_WORLD_ENV] = str(self.nprocs)
+        if rejoin:
+            env[ELASTIC_REJOIN_ENV] = "1"
+        else:
+            env.pop(ELASTIC_REJOIN_ENV, None)
+        proc = subprocess.Popen(self.command, env=env)
+        self._procs[rank] = proc
+        with open(os.path.join(self._pid_dir, str(rank)), "w") as f:
+            f.write(str(proc.pid))
+        self._log(
+            f"supervisor: rank {rank} {'RELAUNCHED (rejoin)' if rejoin else 'launched'} "
+            f"pid={proc.pid}")
+
+    def start(self) -> "ElasticSupervisor":
+        for rank in range(self.nprocs):
+            self._spawn(rank, rejoin=False)
+        return self
+
+    def pid(self, rank: int) -> Optional[int]:
+        proc = self._procs.get(rank)
+        return proc.pid if proc is not None else None
+
+    def poll(self) -> bool:
+        """One supervision pass: reap exits, relaunch failures. Returns
+        True while any rank is still running (or pending relaunch)."""
+        for rank, proc in list(self._procs.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            del self._procs[rank]
+            self._final_rc[rank] = rc
+            if rc == 0:
+                self._log(f"supervisor: rank {rank} finished cleanly")
+                continue
+            if self.relaunches[rank] >= self.max_relaunches:
+                self._log(
+                    f"supervisor: rank {rank} exited rc={rc}; relaunch "
+                    f"budget ({self.max_relaunches}) exhausted — giving up")
+                continue
+            self.relaunches[rank] += 1
+            self._log(
+                f"supervisor: rank {rank} exited rc={rc}; relaunching with "
+                f"{ELASTIC_REJOIN_ENV}=1 "
+                f"({self.relaunches[rank]}/{self.max_relaunches}) "
+                f"in {self.relaunch_delay_s:.1f}s")
+            time.sleep(self.relaunch_delay_s)
+            self._spawn(rank, rejoin=True)
+        return bool(self._procs)
+
+    def wait(self, deadline_s: Optional[float] = None, poll_s: float = 0.2,
+             ) -> int:
+        """Supervise until every rank has finished (rc 0 or budget
+        exhausted) or the deadline expires (everything still alive is
+        killed). Returns 0 iff every rank's FINAL run exited 0."""
+        t_end = (None if deadline_s is None
+                 else time.monotonic() + float(deadline_s))
+        while self.poll():
+            if t_end is not None and time.monotonic() >= t_end:
+                self._log(
+                    f"supervisor: deadline {deadline_s:.0f}s expired with "
+                    f"rank(s) {sorted(self._procs)} still alive — killing")
+                self.kill_all()
+                for rank, proc in list(self._procs.items()):
+                    self._final_rc[rank] = proc.wait()
+                self._procs.clear()
+                return 124
+            time.sleep(poll_s)
+        bad = {r: rc for r, rc in self._final_rc.items() if rc != 0}
+        if bad:
+            self._log(f"supervisor: failed rank exits: {bad}")
+            return 1
+        return 0
+
+    def kill_all(self, sig: int = signal.SIGKILL) -> None:
+        for proc in self._procs.values():
+            try:
+                proc.send_signal(sig)
+            except OSError:
+                pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="elastic rank supervisor (see module docstring)")
+    ap.add_argument("--nprocs", type=int, required=True)
+    ap.add_argument("--dir", required=True,
+                    help="elastic coordination dir (FileTransport root)")
+    ap.add_argument("--max-relaunches", type=int, default=2,
+                    help="relaunch budget PER RANK (default 2)")
+    ap.add_argument("--relaunch-delay", type=float, default=0.5)
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="overall wall-clock budget in seconds")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="-- worker command...")
+    args = ap.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        ap.error("missing worker command (pass it after --)")
+    sup = ElasticSupervisor(
+        args.nprocs, command, elastic_dir=args.dir,
+        max_relaunches=args.max_relaunches,
+        relaunch_delay_s=args.relaunch_delay,
+    ).start()
+    try:
+        return sup.wait(args.deadline)
+    except KeyboardInterrupt:
+        sup.kill_all(signal.SIGTERM)
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -413,6 +413,250 @@ def run_procs(nprocs: int, steps: int, checkpoint_every: int,
             "per_rank": per_rank, "failures": failures}
 
 
+def run_worker_elastic(checkpoint_every: int, workdir: str) -> dict:
+    """One rank of the ELASTIC storm (spawned by `run_elastic` under
+    `launch/supervisor.py`'s rejoin env contract). No ``jax.distributed``:
+    membership, recovery, and the final lockstep verdict all run over the
+    supervisor's `FileTransport` store, which outlives rank death. The
+    scheduled victim SIGKILLs itself mid-run; survivors shrink the
+    membership, rescale the fusion plan, reshard the pipeline, and
+    continue; the supervisor's relaunch comes back through
+    `ElasticCluster.rejoin` + `GuardedTrainer.elastic_resume`. Each final
+    rank writes a ``verdict_rank<r>.json`` the parent gate asserts on."""
+    import importlib.util
+    import json
+
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    os.environ["DEAR_CKPT_SHARED"] = "0"
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(4, scrub_env=True)
+
+    import jax
+
+    from dear_pytorch_tpu.observability import flight as FL
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.resilience import membership as M
+    from dear_pytorch_tpu.runtime import build as RB
+    from dear_pytorch_tpu.runtime import pipeline as P
+    from dear_pytorch_tpu.tuning.autotune import AutoTuner
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    import numpy as np
+
+    # the one shared elastic-worker harness (tests/mp_worker.py uses the
+    # same one): rejoin handshake + transition hook + kill/step loop
+    eh_spec = importlib.util.spec_from_file_location(
+        "dear_elastic_harness",
+        os.path.join(REPO, "tests", "elastic_harness.py"))
+    EH = importlib.util.module_from_spec(eh_spec)
+    eh_spec.loader.exec_module(EH)
+
+    cluster = M.ElasticCluster.from_env(max_candidates=256)
+    rejoining = M.ElasticCluster.rejoining_by_env()
+    rank, world0 = cluster.rank, cluster.world
+    kr, ka = os.environ["DEAR_CHAOS_ELASTIC_KILL"].split(":")
+    kill_rank, kill_at = int(kr), int(ka)
+    post_steps = int(os.environ.get("DEAR_CHAOS_ELASTIC_POST", "4"))
+    ckpt_dir = os.path.join(workdir, f"rank{rank}", "ckpts")
+    tracer = T.get_tracer()
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:cluster.world]),
+                             ("dp",))
+    tuner = AutoTuner(
+        _loss_fn, params, strategy="bo", threshold_mb=0.0008,
+        interval=10**9, mesh=mesh, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    # batch 12*world-divisible rows: _data(n=12) shards over 3 AND 2
+    spec = P.SyntheticSpec((
+        P.Field("x", (12, 12), RB.KIND_NORMAL_F32, 0.0, 1.0),
+    ))
+    pipe = P.NumpyPipeline(spec, seed=123, shard=cluster.index,
+                           num_shards=cluster.world)
+
+    guard = GuardedTrainer(
+        tuner.ts, ckpt_dir, params,
+        check_every=1, checkpoint_every=checkpoint_every, max_keep=1000,
+        max_recoveries=8, coordinator=cluster, pipeline=pipe,
+    )
+    EH.attach_elastic(guard, tuner)
+    rollback_steps = []
+    guard.on_rollback = lambda c, at: rollback_steps.append(at)
+
+    resumed_at = None
+    t_target = None
+    if rejoining:
+        state, resumed_at, _ = EH.reenter(cluster, tuner, guard, ckpt_dir)
+        t_target = guard.steps_seen + post_steps
+    else:
+        state = tuner.init(params)
+
+    # n=12 batch rows shard evenly over world 3 AND the post-shrink world 2
+    state, m = EH.run_loop(
+        cluster, guard, pipe, state,
+        lambda i: _data(jax.random.PRNGKey(100 + i), n=12), tracer,
+        rejoining=rejoining, kill=(kill_rank, kill_at),
+        post=post_steps, t_target=t_target,
+    )
+    counters = tracer.counters()
+    ring = FL.get_recorder().dump()["records"]
+    verdict = {
+        "rank": rank,
+        "rejoined": bool(rejoining),
+        "epoch": cluster.epoch,
+        "members": list(cluster.members),
+        "resumed_at": resumed_at,
+        "rollback_steps": rollback_steps,
+        "final_step": int(jax.device_get(state.step)),
+        "final_loss": float(m.get("loss", float("nan"))),
+        "steps_seen": guard.steps_seen,
+        "plan_world": guard.ts.plan.world,
+        "plan_epoch": guard.ts.plan.epoch,
+        "pipe_shard": [pipe.shard, pipe.num_shards],
+        "flight_epoch": (ring[-1].get("mem_epoch") if ring else None),
+        "sidecar_epoch": ckpt.read_mem_epoch(ckpt_dir,
+                                             guard._last_good_step or -1),
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("cluster.", "guard.", "pipeline.",
+                                      "autotune.", "ckpt."))},
+    }
+    # the lockstep verdict is itself a member-scoped collective
+    views = cluster.exchange("chaos.verdict", json.dumps(
+        [verdict["final_step"], verdict["final_loss"], verdict["epoch"]]))
+    verdict["lockstep"] = all(
+        json.loads(v) == json.loads(views[0]) for v in views)
+    with open(os.path.join(workdir, f"verdict_rank{rank}.json.tmp"),
+              "w") as f:
+        json.dump(verdict, f)
+    os.replace(os.path.join(workdir, f"verdict_rank{rank}.json.tmp"),
+               os.path.join(workdir, f"verdict_rank{rank}.json"))
+    print(f"CHAOS_EL rank={rank}/{world0} " + json.dumps(verdict),
+          flush=True)
+    return verdict
+
+
+def run_elastic(nprocs: int, checkpoint_every: int,
+                workdir: str | None) -> dict:
+    """Parent of the elastic storm: drive `launch/supervisor.py`'s
+    `ElasticSupervisor` over ``nprocs`` ranks of `run_worker_elastic`,
+    SIGKILL one rank mid-run (the victim self-kills on a deterministic
+    step), and gate on: survivors commit a smaller membership epoch and
+    continue >= N steps with zero loss of progress past the newest
+    commonly-valid checkpoint; the relaunched rank rejoins at a later
+    epoch; every member finishes in lockstep; the reconfig/rejoin
+    counters and epoch-stamped flight rows are visible in the exported
+    telemetry."""
+    import importlib.util
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_el_")
+    kill_rank, kill_at = nprocs - 1, 5
+    post_steps = 4
+    spec = importlib.util.spec_from_file_location(
+        "dear_launch_supervisor",
+        os.path.join(REPO, "launch", "supervisor.py"))
+    sup_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sup_mod)
+
+    env = dict(os.environ)
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_FLIGHT"] = "8"
+    env["DEAR_CHAOS_ELASTIC_KILL"] = f"{kill_rank}:{kill_at}"
+    env["DEAR_CHAOS_ELASTIC_POST"] = str(post_steps)
+    # a peer's post-transition XLA recompile must not read as a death
+    env.setdefault("DEAR_CLUSTER_TIMEOUT_SECS", "30")
+    sup = sup_mod.ElasticSupervisor(
+        nprocs,
+        [sys.executable, os.path.abspath(__file__), "--worker", "--elastic",
+         "--checkpoint-every", str(checkpoint_every),
+         "--workdir", workdir],
+        elastic_dir=os.path.join(workdir, "elastic"), env=env,
+        max_relaunches=1,
+    ).start()
+    rc = sup.wait(deadline_s=400)
+
+    failures: list[str] = []
+    _check(rc == 0, f"supervisor exits 0 (got {rc})", failures)
+    _check(sup.relaunches.get(kill_rank) == 1
+           and all(n == 0 for r, n in sup.relaunches.items()
+                   if r != kill_rank),
+           f"exactly the killed rank was relaunched ({sup.relaunches})",
+           failures)
+    verdicts = {}
+    for r in range(nprocs):
+        path = os.path.join(workdir, f"verdict_rank{r}.json")
+        if not os.path.exists(path):
+            failures.append(f"rank {r} wrote no verdict")
+            continue
+        with open(path) as f:
+            verdicts[r] = json.load(f)
+    summary = {"passed": False, "procs": nprocs, "workdir": workdir,
+               "verdicts": verdicts, "failures": failures}
+    if len(verdicts) != nprocs:
+        return summary
+
+    expect_restore = (kill_at - 1) - (kill_at - 1) % checkpoint_every
+    for r, v in verdicts.items():
+        _check(v["epoch"] == 2 and v["members"] == list(range(nprocs)),
+               f"rank {r} ends at epoch 2, full membership "
+               f"(epoch {v['epoch']}, members {v['members']})", failures)
+        _check(v["lockstep"], f"rank {r} finished in lockstep", failures)
+        _check(v["plan_world"] == nprocs and v["plan_epoch"] == 2,
+               f"rank {r} trains the rescaled epoch-stamped plan "
+               f"(world {v['plan_world']}, epoch {v['plan_epoch']})",
+               failures)
+        _check(v["pipe_shard"][1] == nprocs,
+               f"rank {r} pipeline resharded over the full membership",
+               failures)
+        _check(v["flight_epoch"] == 2,
+               f"rank {r} flight rows are epoch-stamped "
+               f"({v['flight_epoch']})", failures)
+        _check(v["sidecar_epoch"] == 2,
+               f"rank {r} newest checkpoint sidecar carries the epoch "
+               f"({v['sidecar_epoch']})", failures)
+        _check(v["final_step"] >= expect_restore + post_steps
+               and v["final_step"] == verdicts[0]["final_step"],
+               f"rank {r} continued past the transitions to step "
+               f"{v['final_step']}", failures)
+    survivors = [v for r, v in verdicts.items() if r != kill_rank]
+    for v in survivors:
+        c = v["counters"]
+        _check(c.get("cluster.reconfigs", 0) >= 1,
+               f"rank {v['rank']} committed a reconfiguration", failures)
+        _check(c.get("cluster.rejoins", 0) >= 1,
+               f"rank {v['rank']} admitted the relaunched rank", failures)
+        _check(c.get("guard.membership_changes", 0) >= 2,
+               f"rank {v['rank']} guard saw both transitions", failures)
+        _check(c.get("autotune.rescales", 0) >= 2,
+               f"rank {v['rank']} rescaled the plan per transition",
+               failures)
+        _check(c.get("pipeline.reshards", 0) >= 2
+               and c.get("pipeline.resumes", 0) >= 1,
+               f"rank {v['rank']} pipeline resharded + resumed", failures)
+        # zero loss of progress: every rollback landed exactly on the
+        # newest commonly-valid checkpoint, never older
+        _check(bool(v["rollback_steps"])
+               and all(s == expect_restore for s in v["rollback_steps"]),
+               f"rank {v['rank']} rollbacks landed on the newest common "
+               f"checkpoint {expect_restore} ({v['rollback_steps']})",
+               failures)
+    rv = verdicts[kill_rank]
+    _check(rv["rejoined"] and rv["resumed_at"] == expect_restore,
+           f"relaunched rank rejoined and resumed at the fleet-agreed "
+           f"step ({rv['resumed_at']})", failures)
+    summary["passed"] = not failures
+    summary["failures"] = failures
+    return summary
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="multi-fault recovery check (see module docstring)")
@@ -422,11 +666,27 @@ def main(argv=None) -> int:
     ap.add_argument("--procs", type=int, default=1,
                     help="run the storm over N coordinated processes "
                          "(launcher env contract; rank-targeted faults)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic storm: SIGKILL one rank of a 3-rank "
+                         "host-level cluster mid-run; survivors must "
+                         "commit a smaller epoch and keep training, the "
+                         "supervisor's relaunch must rejoin")
     ap.add_argument("--worker", action="store_true",
                     help=argparse.SUPPRESS)  # internal: one storm rank
     args = ap.parse_args(argv)
 
-    if args.worker:
+    if args.worker and args.elastic:
+        # one elastic rank: the verdict file is the output, the parent
+        # gate does the asserting — a clean exit just means "ran"
+        run_worker_elastic(
+            checkpoint_every=args.checkpoint_every, workdir=args.workdir)
+        return 0
+    if args.elastic:
+        summary = run_elastic(3, checkpoint_every=args.checkpoint_every,
+                              workdir=args.workdir)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "verdicts"}))
+    elif args.worker:
         summary = run_worker(steps=args.steps,
                              checkpoint_every=args.checkpoint_every,
                              workdir=args.workdir)
@@ -456,6 +716,10 @@ if __name__ == "__main__":
     if any(a == "--procs" or a.startswith("--procs=") for a in sys.argv):
         # parent of the multi-process storm: pure process supervisor, no
         # jax in this process (the workers own the devices)
+        sys.exit(main())
+    if "--elastic" in sys.argv:
+        # parent of the elastic storm: likewise jax-free — it drives
+        # launch/supervisor.py and reads the ranks' verdict files
         sys.exit(main())
     # standalone single-process: emulate the 8-device CPU world the test
     # suite uses

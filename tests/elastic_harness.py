@@ -1,0 +1,110 @@
+"""Shared elastic-worker harness.
+
+`tests/mp_worker.py` (``DEAR_MP_MODE=elastic``) and
+`scripts/chaos_check.py --worker --elastic` drive the same scenario — a
+supervised rank that may SIGKILL itself, survivors that transition
+through the guard's membership machinery, and a relaunched rank that
+re-enters through rejoin — with different models and different final
+verdicts. The protocol-shaped pieces they must agree on live here, in
+exactly one place, so a change to the rejoin handshake or the transition
+hook ordering cannot drift between the two entry points:
+
+  - `attach_elastic` — the membership-transition hook (plan rescale +
+    train-step swap) every elastic worker wires the same way;
+  - `reenter` — the relaunched rank's re-entry sequence (sidecar epoch →
+    `rejoin` → rescale → `elastic_resume`);
+  - `run_loop` — the kill/step/target loop with the idle cadence that
+    keeps the member sync polling for rejoin requests.
+
+Import: plain (`import elastic_harness`) when launched from tests/;
+`importlib` by file path from scripts/. Deliberately jax-free at module
+level — workers configure the backend env BEFORE importing anything
+heavy, and this module must not get in the way of that.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Callable, Optional, Tuple
+
+
+def attach_elastic(guard, tuner) -> Callable:
+    """Wire the guard's membership-transition hook: rescale the fusion
+    plan for the committed view (epoch-stamped) and swap the guard's
+    train step BEFORE the consensus restore, so the elastic re-pack lands
+    in the rescaled plan. Returns the hook (already attached)."""
+    def on_change(view):
+        tuner.rescale(view)
+        guard.ts = tuner.ts
+        guard._template = None
+    guard.on_membership_change = on_change
+    return on_change
+
+
+def reenter(cluster, tuner, guard, ckpt_dir: str):
+    """Relaunched-rank re-entry: present the newest sidecar's membership
+    epoch as "last known", wait for admission, rescale the plan for the
+    admitted view, and consensus-restore through `elastic_resume`.
+    Returns ``(state, resumed_at_step, last_epoch)``."""
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+
+    steps = ckpt.valid_steps(ckpt_dir)
+    last_epoch = ckpt.read_mem_epoch(ckpt_dir, steps[0]) if steps else None
+    view, context = cluster.rejoin(last_epoch)
+    tuner.rescale(view)
+    guard.ts = tuner.ts
+    state, at_step = guard.elastic_resume(context)
+    return state, at_step, last_epoch
+
+
+def run_loop(
+    cluster,
+    guard,
+    pipe,
+    state,
+    batch_at: Callable[[int], object],
+    tracer,
+    *,
+    rejoining: bool,
+    kill: Optional[Tuple[int, int]] = None,
+    post: int = 4,
+    t_target: Optional[int] = None,
+    no_kill_target: Optional[int] = None,
+    deadline_s: float = 300.0,
+    idle_s: float = 0.1,
+):
+    """The elastic training loop every worker runs after setup. The
+    scheduled victim SIGKILLs itself before attempt ``kill[1]``;
+    survivors keep stepping (transitions happen inside ``guard.step``)
+    until ``post`` lockstep steps after the relaunch's admission
+    (``cluster.rejoins`` observed); a rejoiner enters with ``t_target``
+    already set by `reenter`'s caller. With no kill scheduled the loop
+    runs to ``no_kill_target`` attempts. The idle sleep keeps the member
+    sync cadence slow enough that the leader's rejoin poll isn't racing
+    hundreds of checkpoints past the rejoiner's view. Returns
+    ``(state, metrics)``; raises `TimeoutError` if the target is never
+    reached within ``deadline_s``."""
+    kill_rank, kill_at = kill if kill is not None else (None, None)
+    deadline = time.monotonic() + deadline_s
+    m = {}
+    while True:
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"rank {cluster.rank} never reached its target "
+                f"(epoch {cluster.epoch})")
+        i = guard.steps_seen
+        if not rejoining and kill_rank == cluster.rank and i + 1 == kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)  # a lost host, abruptly
+        pipe.next()  # the guarded input stream advances once per step
+        state, m = guard.step(state, batch_at(i))
+        if kill_rank is None:
+            t_target = no_kill_target
+        elif (t_target is None
+                and tracer.counters().get("cluster.rejoins", 0) >= 1):
+            t_target = guard.steps_seen + post  # admission landed HERE
+        if t_target is not None and guard.steps_seen >= t_target:
+            return state, m
+        if t_target is None:
+            time.sleep(idle_s)

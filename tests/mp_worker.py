@@ -340,12 +340,186 @@ def _health_main() -> None:
     print(f"MP_HEALTH_OK rank={pid}/{n}", flush=True)
 
 
+def _elastic_main() -> None:
+    """Elastic membership over a REAL 3-process host-level cluster
+    (ISSUE-5 acceptance): one rank SIGKILLs itself mid-run; the survivors
+    must commit a smaller membership epoch (two-phase reconfiguration),
+    rescale the fusion plan to the new replica count
+    (`AutoTuner.rescale`, epoch-stamped), reshard the input pipeline, and
+    consensus-restore to the newest step valid on every survivor — then
+    the supervisor relaunches the dead rank with ``DEAR_ELASTIC_REJOIN=1``
+    and it must be readmitted at a later epoch barrier
+    (`ElasticCluster.rejoin` + `GuardedTrainer.elastic_resume`), after
+    which ALL members finish in lockstep.
+
+    No ``jax.distributed`` anywhere: the coordination substrate must
+    outlive rank death (the jax coordination service dies with process 0),
+    so membership runs over `FileTransport` and each rank is a
+    single-process jax world with enough EMULATED CPU devices to rescale
+    across. The replicas train a COMMON batch stream (in real data-
+    parallel training the gradient all-reduce couples the replicas, so
+    the checked loss is replicated even though each rank feeds its own
+    shard; these emulated replicas are uncoupled, so a common stream is
+    what preserves the lockstep invariant the desync sentinel checks).
+    The `runtime.pipeline` object rides along as the guarded input stream
+    whose shard assignment, sidecar persistence, and reshard-on-epoch
+    behavior are asserted directly."""
+    import json
+
+    # BEFORE any backend touch: stay single-process, emulate 4 devices
+    # (world shrinks 3 -> 2 and grows back; the mesh is rebuilt per epoch)
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    os.environ["DEAR_CKPT_SHARED"] = "0"  # every rank owns its ckpt dir
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(4, scrub_env=True)
+
+    from dear_pytorch_tpu.observability import flight as FL
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.resilience import membership as M
+    from dear_pytorch_tpu.runtime import build as B
+    from dear_pytorch_tpu.runtime import pipeline as P
+    from dear_pytorch_tpu.tuning.autotune import AutoTuner
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    import elastic_harness as EH  # tests/ is sys.path[0] (script launch)
+
+    cluster = M.ElasticCluster.from_env(max_candidates=256)
+    rejoining = M.ElasticCluster.rejoining_by_env()
+    rank, world0 = cluster.rank, int(os.environ["DEAR_ELASTIC_WORLD"])
+    workdir = os.path.join(os.environ["DEAR_MP_WORKDIR"], f"rank{rank}")
+    ckpt_dir = os.path.join(workdir, "ckpts")
+    tracer = T.get_tracer()
+    assert tracer.enabled, "DEAR_TELEMETRY must be set for elastic mode"
+    assert FL.get_recorder().enabled
+
+    kill_rank = kill_at = None
+    if os.environ.get("DEAR_MP_ELASTIC_KILL"):
+        kr, ka = os.environ["DEAR_MP_ELASTIC_KILL"].split(":")
+        kill_rank, kill_at = int(kr), int(ka)
+
+    def loss_fn(p, b):
+        x, y = b
+        pred = jnp.tanh(x @ p["w1"]) @ p["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    k = jax.random.PRNGKey(0)
+    tparams = {
+        "w1": jax.random.normal(k, (8, 16)) * 0.3,
+        "w2": jax.random.normal(jax.random.fold_in(k, 1), (16, 4)) * 0.3,
+    }
+    bk = jax.random.PRNGKey(7)
+
+    def batch_at(i):
+        kk = jax.random.fold_in(bk, i)
+        # batch 12 shards evenly over world 3 AND the post-shrink world 2
+        return (jax.random.normal(kk, (12, 8)),
+                jax.random.normal(jax.random.fold_in(kk, 1), (12, 4)))
+
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:cluster.world]),
+                             ("dp",))
+    tuner = AutoTuner(
+        loss_fn, tparams, strategy="bo", threshold_mb=0.0001,
+        interval=10**9,  # the tuner never proposes; rescale() is the point
+        mesh=mesh, optimizer=fused_sgd(lr=0.05, momentum=0.9), donate=False,
+    )
+
+    # the guarded input stream: per-member shard assignment folded into
+    # the seed, position persisted in every checkpoint sidecar
+    spec = P.SyntheticSpec((
+        P.Field("x", (12, 8), B.KIND_NORMAL_F32, 0.0, 1.0),
+        P.Field("y", (12, 4), B.KIND_NORMAL_F32, 0.0, 1.0),
+    ))
+    pipe = P.NumpyPipeline(spec, seed=123, shard=cluster.index,
+                           num_shards=cluster.world)
+
+    guard = GuardedTrainer(
+        tuner.ts, ckpt_dir, tparams,
+        check_every=1, checkpoint_every=2, max_keep=1000, max_recoveries=8,
+        coordinator=cluster, pipeline=pipe,
+    )
+    EH.attach_elastic(guard, tuner)
+    assert guard._coordinated, "elastic guard must coordinate via members"
+
+    POST = 6  # lockstep steps every member runs after the last transition
+    t_target = None
+    rollbacks = []
+    guard.on_rollback = lambda c, at: rollbacks.append(at)
+
+    if rejoining:
+        state, at_step, last_epoch = EH.reenter(cluster, tuner, guard,
+                                                ckpt_dir)
+        t_target = guard.steps_seen + POST
+        print(f"MP_ELASTIC_REJOINED rank={rank} epoch={cluster.epoch} "
+              f"resumed_step={at_step} steps_seen={guard.steps_seen}",
+              flush=True)
+        assert last_epoch == 0, last_epoch  # died before any transition
+        assert cluster.epoch == 2 and cluster.world == world0
+        assert tracer.counters().get("pipeline.resumes", 0) >= 1
+    else:
+        state = tuner.init(tparams)
+
+    state, m = EH.run_loop(
+        cluster, guard, pipe, state, batch_at, tracer,
+        rejoining=rejoining,
+        kill=None if kill_rank is None else (kill_rank, kill_at),
+        post=POST, t_target=t_target, no_kill_target=10,
+    )
+
+    counters = tracer.counters()
+    view = cluster.view()
+    if kill_rank is not None:
+        # every member ends at epoch 2 (shrink + admission), full strength
+        assert view.epoch == 2 and view.members == tuple(range(world0)), view
+        assert guard.ts.plan.world == world0 and \
+            guard.ts.plan.epoch == 2, guard.ts.plan
+        assert pipe.shard == view.index and pipe.num_shards == world0
+        assert pipe._epoch == 2
+        if rank != kill_rank:
+            # survivors transitioned through the in-loop rollback path
+            # (the rejoiner re-entered through elastic_resume instead)
+            assert rollbacks, "the transitions must have rolled back"
+            assert counters.get("cluster.reconfigs", 0) >= 1, counters
+            assert counters.get("cluster.rejoins", 0) >= 1, counters
+            assert counters.get("guard.membership_changes", 0) >= 2, counters
+            assert counters.get("autotune.rescales", 0) >= 2, counters
+            assert counters.get("pipeline.reshards", 0) >= 2, counters
+            assert counters.get("pipeline.resumes", 0) >= 1, counters
+        # the flight ring stamps rows with the membership epoch
+        ring = FL.get_recorder().dump()["records"]
+        assert ring and ring[-1]["mem_epoch"] == 2, ring[-1]
+        # ... and the newest checkpoint sidecar carries it (the relaunch
+        # contract: this is the "last known epoch" a future rejoin presents)
+        assert ckpt.read_mem_epoch(ckpt_dir, guard._last_good_step) == 2
+
+    # lockstep epilogue: every member must agree on the final loss AND
+    # final parameter step (one member-scoped exchange, member-ordered)
+    final_loss = float(m["loss"])
+    final_step = int(jax.device_get(state.step))
+    views = cluster.exchange("verdict", json.dumps(
+        {"loss": final_loss, "step": final_step,
+         "steps_seen": guard.steps_seen, "epoch": cluster.epoch}))
+    parsed = [json.loads(v) for v in views]
+    assert all(p["epoch"] == cluster.epoch for p in parsed), parsed
+    assert all(p["steps_seen"] == guard.steps_seen for p in parsed), parsed
+    assert all(p["step"] == final_step for p in parsed), parsed
+    assert all(abs(p["loss"] - final_loss) < 1e-6 for p in parsed), parsed
+    assert np.isfinite(final_loss)
+
+    print(f"MP_ELASTIC_OK rank={rank}/{world0} epoch={cluster.epoch} "
+          f"final_step={final_step}", flush=True)
+
+
 def main() -> None:
     mode = os.environ.get("DEAR_MP_MODE", "").strip()
     if mode == "health":
         return _health_main()
     if mode == "resilience":
         return _resilience_main()
+    if mode == "elastic":
+        return _elastic_main()
     import dear_pytorch_tpu as dear
     from dear_pytorch_tpu.comm import backend
     from dear_pytorch_tpu.comm import collectives as C

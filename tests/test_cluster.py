@@ -204,7 +204,7 @@ def test_enabled_by_env(monkeypatch):
 
 
 def test_unknown_transport_name_lists_valid_ones():
-    with pytest.raises(ValueError, match="'kv' and 'allgather'"):
+    with pytest.raises(ValueError, match="'kv', 'allgather', and 'file:<dir>'"):
         CL.ClusterCoordinator(process_index=0, process_count=2,
                               transport="carrier-pigeon")
 

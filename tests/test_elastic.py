@@ -1,0 +1,1051 @@
+"""Elastic membership layer (`resilience.membership`) unit tests.
+
+The membership protocol is exercised WITHOUT processes: N `ElasticCluster`
+instances on N threads share one `LocalTransport` (or a `FileTransport`
+under tmp_path where store persistence across "relaunch" matters) and
+behave like N ranks. The real 3-process SIGKILL/rejoin scenario lives in
+tests/test_multiprocess.py::test_elastic_membership and
+scripts/chaos_check.py --elastic; this file covers the protocol corners
+those can't schedule deterministically — a second failure racing a
+reconfiguration, a rejoin racing a shrink, eviction — plus the downstream
+elastic plumbing: epoch-stamped plan fingerprints, `AutoTuner.rescale`,
+pipeline state/reshard determinism, decorrelated retry jitter, and the
+guard's membership-transition path against a scripted coordinator.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.observability import tracer as T
+from dear_pytorch_tpu.ops import fusion as F
+from dear_pytorch_tpu.resilience import cluster as CL
+from dear_pytorch_tpu.resilience import membership as M
+from dear_pytorch_tpu.resilience import retry as R
+from dear_pytorch_tpu.runtime import build as RB
+from dear_pytorch_tpu.runtime import pipeline as P
+from dear_pytorch_tpu.utils import checkpoint as ckpt
+
+
+def make_members(n, transport=None, *, timeout_s=2.0, ranks=None):
+    """N ElasticClusters sharing one transport (LocalTransport default)."""
+    transport = transport or CL.LocalTransport(n)
+    ranks = list(ranks if ranks is not None else range(n))
+    return transport, [
+        M.ElasticCluster(rank=r, members=ranks, transport=transport,
+                         timeout_s=timeout_s)
+        for r in ranks
+    ]
+
+
+def run_threads(fns, *, join_s=60):
+    """Run one callable per thread; returns (results, errors) by index."""
+    results, errors = [None] * len(fns), [None] * len(fns)
+
+    def work(i):
+        try:
+            results[i] = fns[i]()
+        except BaseException as exc:  # noqa: BLE001 - asserted by callers
+            errors[i] = exc
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(len(fns))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=join_s)
+    return results, errors
+
+
+def _health_payload(ok=True, fp="", pre=False, rejoin=None):
+    return json.dumps({"ok": ok, "fp": fp, "pre": pre,
+                       "rejoin": rejoin or {}})
+
+
+# -- exchange / epochs --------------------------------------------------------
+
+
+def test_exchange_is_member_ordered():
+    _, ms = make_members(3)
+    out, errs = run_threads([
+        (lambda c=c, i=i: c.exchange("hello", f"msg{i}"))
+        for i, c in enumerate(ms)])
+    assert not any(errs)
+    assert out == [["msg0", "msg1", "msg2"]] * 3
+
+
+def test_exchange_world_one_short_circuits():
+    _, (c,) = make_members(1)
+    assert c.exchange("solo", "x") == ["x"]
+    assert c.view() == M.MembershipView(epoch=0, members=(0,), rank=0,
+                                        index=0, world=1)
+
+
+def test_missing_member_attaches_missing_ranks():
+    _, ms = make_members(3, timeout_s=0.5)
+    # rank 2 never shows up
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.exchange("t", "a")),
+        (lambda c=ms[1]: c.exchange("t", "b")),
+    ])
+    assert all(isinstance(e, CL.PeerTimeout) for e in errs)
+    assert all(e.missing_ranks == (2,) for e in errs)
+
+
+# -- reconfiguration ----------------------------------------------------------
+
+
+def test_health_check_converts_loss_into_reconfig():
+    """A member that never reaches the sync is converted into a committed
+    survivor-set epoch — the verdict every guard consumes as a
+    transition point."""
+    _, ms = make_members(3, timeout_s=0.5)
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.health_check(True, fingerprint="f", step=7)),
+        (lambda c=ms[1]: c.health_check(True, fingerprint="f", step=7)),
+    ])
+    assert not any(errs[:2])
+    for v in out[:2]:
+        assert v.reconfigured and v.membership_changed and not v.ok
+        assert v.epoch == 1 and v.members == (0, 1) and v.lost == (2,)
+    for c in ms[:2]:
+        assert c.epoch == 1 and c.members == (0, 1)
+        assert c.world == 2 and c.leader == 0
+    # the survivors are in lockstep at the new epoch, seqs reset
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.exchange("post", "p0")),
+        (lambda c=ms[1]: c.exchange("post", "p1")),
+    ])
+    assert not any(errs) and out[0] == ["p0", "p1"]
+
+
+def test_concurrent_failure_during_reconfig_widens():
+    """A member that dies BETWEEN the health exchange and its reconfig
+    proposal is absorbed by the union-widening round: the committed epoch
+    still bumps by exactly one."""
+    transport, ms = make_members(4, timeout_s=0.5)
+    # rank 2 published its health key (it was alive at the sync)...
+    transport.set(f"{ms[2]._ns}/e0/health/0/2", _health_payload())
+    # ...then died before proposing; rank 3 was already dead.
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.health_check(True, step=1)),
+        (lambda c=ms[1]: c.health_check(True, step=1)),
+    ])
+    assert not any(errs)
+    for v in out:
+        assert v.reconfigured and v.epoch == 1
+        assert v.members == (0, 1)
+        assert v.lost == (3,)  # the sync loss; 2 was absorbed mid-reconfig
+    assert ms[0].members == (0, 1) and ms[0].epoch == 1
+
+
+def test_reconfigure_rejects_self_and_non_members():
+    _, (c,) = make_members(1)
+    with pytest.raises(M.EvictedError):
+        c.reconfigure([0])
+    with pytest.raises(ValueError, match="no current member"):
+        c.reconfigure([9])
+
+
+def test_evicted_when_peers_declared_me_dead():
+    """Asymmetric failure detection: rank 1 declares 0 dead while rank 0
+    declares 2 dead. Rank 0 finds itself in a gathered proposal's union
+    and must exit for relaunch+rejoin (EvictedError); rank 1's rounds
+    widen to {0, 2} and it commits alone."""
+    _, ms = make_members(3, timeout_s=0.5)
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.reconfigure([2])),
+        (lambda c=ms[1]: c.reconfigure([0])),
+    ])
+    assert isinstance(errs[0], M.EvictedError), errs
+    assert errs[1] is None and out[1].members == (1,)
+    assert ms[1].epoch == 1 and ms[1].world == 1
+
+
+def test_sole_survivor_commits_unilaterally():
+    _, ms = make_members(2, timeout_s=0.5)
+    view = ms[0].reconfigure([1])
+    assert view == M.MembershipView(epoch=1, members=(0,), rank=0,
+                                    index=0, world=1)
+
+
+def test_decide_once_first_writer_wins(tmp_path):
+    lt = CL.LocalTransport(1)
+    assert lt.decide_once("k", "a") == "a"
+    assert lt.decide_once("k", "b") == "a"  # loser adopts the winner
+    ft = CL.FileTransport(str(tmp_path))
+    assert ft.decide_once("d/e1", "x") == "x"
+    assert ft.decide_once("d/e1", "y") == "x"
+    assert ft.get("d/e1", 0.1) == "x"  # durable, a plain key
+
+
+def test_falsely_evicted_rank_cannot_fork_the_membership():
+    """Split-brain guard: peers commit epoch 1 without the stalled rank 0
+    (decision record durably present). When rank 0 wakes, times out on
+    everyone, and reconfigures itself into sole survivorship, it must
+    discover the record and exit for relaunch+rejoin — NOT unilaterally
+    commit a parallel one-rank epoch-1 fleet."""
+    transport, ms = make_members(3, timeout_s=0.5)
+    out, errs = run_threads([
+        (lambda c=ms[1]: c.reconfigure([0])),
+        (lambda c=ms[2]: c.reconfigure([0])),
+    ])
+    assert not any(errs)
+    assert ms[1].members == (1, 2) and ms[1].epoch == 1
+    with pytest.raises(M.EvictedError, match="already decided"):
+        ms[0].reconfigure([1, 2])
+    assert ms[0].epoch == 0  # nothing committed on the evicted side
+
+
+def test_missed_commit_ack_defers_to_decided_record():
+    """The 2PC ambiguity: a survivor that missed a commit ack widens past
+    an epoch its peers already committed. Its eventual (sole-survivor)
+    view disagrees with the durable decision record — even though it IS
+    in the decided member set, re-entering an epoch whose exchange
+    cadence started without it can't be lockstep, so it must exit for
+    relaunch+rejoin rather than commit a diverged member set."""
+    transport, ms = make_members(3, timeout_s=0.5)
+    # peers decided epoch 1 as the full survivor set {0, 1, 2}
+    transport.decide_once(f"{ms[0]._ns}/decided/e1", json.dumps([0, 1, 2]))
+    with pytest.raises(M.EvictedError, match="already decided"):
+        ms[0].reconfigure([1, 2])
+    assert ms[0].epoch == 0
+
+
+def test_admission_writes_the_epoch_decision_record():
+    """Every committed epoch — shrink OR admission — must be discoverable
+    by a later partitioned rank through its decision record."""
+    transport, ms = make_members(3, timeout_s=1.0)
+    shrink, errs = run_threads([
+        (lambda c=ms[0]: c.health_check(True, step=1)),
+        (lambda c=ms[1]: c.health_check(True, step=1)),
+    ])
+    assert not any(errs) and ms[0].epoch == 1
+    # the shrink's commit left its decision record
+    assert json.loads(
+        transport.get(f"{ms[0]._ns}/decided/e1", 0.1)) == [0, 1]
+
+    relaunched = M.ElasticCluster(rank=2, members=[0, 1, 2],
+                                  transport=transport, timeout_s=1.0)
+
+    def member(c):
+        for step in range(2, 40):
+            v = c.health_check(True, step=step)
+            if v.admitted:
+                return v
+            time.sleep(0.05)
+        raise AssertionError("never admitted the rejoiner")
+
+    out, errs = run_threads([
+        (lambda c=ms[0]: member(c)),
+        (lambda c=ms[1]: member(c)),
+        (lambda: relaunched.rejoin(0, timeout_s=20)),
+    ])
+    assert not any(errs), errs
+    # ...and so did the admission's
+    assert json.loads(
+        transport.get(f"{ms[0]._ns}/decided/e2", 0.1)) == [0, 1, 2]
+
+
+# -- rejoin -------------------------------------------------------------------
+
+
+def test_rejoin_after_shrink_admits_at_epoch_barrier():
+    transport, ms = make_members(3, timeout_s=1.0)
+    shrink, errs = run_threads([
+        (lambda c=ms[0]: c.health_check(True, step=3)),
+        (lambda c=ms[1]: c.health_check(True, step=3)),
+    ])
+    assert not any(errs) and ms[0].epoch == 1
+
+    # the relaunched rank presents its last known epoch...
+    relaunched = M.ElasticCluster(rank=2, members=[0, 1, 2],
+                                  transport=transport, timeout_s=1.0)
+    rejoin_out = {}
+
+    def rejoiner():
+        view, context = relaunched.rejoin(0, timeout_s=20)
+        rejoin_out["view"], rejoin_out["context"] = view, context
+        return relaunched.exchange("post", "p2")
+
+    def member(c):
+        # ...the member cadence polls/admits within a few health syncs
+        for step in range(4, 40):
+            v = c.health_check(True, step=step)
+            if v.admitted:
+                assert v.admitted == (2,) and not v.ok
+                assert v.epoch == 2 and v.members == (0, 1, 2)
+                return c.exchange("post", f"p{c.rank}")
+            time.sleep(0.05)
+        raise AssertionError("never admitted the rejoiner")
+
+    out, errs = run_threads([
+        (lambda c=ms[0]: member(c)),
+        (lambda c=ms[1]: member(c)),
+        rejoiner,
+    ])
+    assert not any(errs), errs
+    assert rejoin_out["view"].epoch == 2
+    assert rejoin_out["view"].members == (0, 1, 2)
+    assert rejoin_out["view"].index == 2
+    # the fleet's cadence anchor rode in the admission ack
+    assert rejoin_out["context"]["steps_seen"] >= 4
+    # all three meet in lockstep at the admitted epoch (seq 0 reset)
+    assert out[0] == out[2] == ["p0", "p1", "p2"]
+
+
+def test_rejoin_racing_a_shrink_is_reconfigured_back_out():
+    """An admitted rank that dies before reaching the epoch barrier is
+    shrunk right back out: the fleet ends at epoch+2 with the original
+    survivors and an empty admitted tuple."""
+    transport, ms = make_members(2, timeout_s=0.5)
+    ns = ms[0]._ns
+    transport.set(f"{ns}/rejoin/req/7", json.dumps(
+        {"rank": 7, "last_epoch": 0, "nonce": "dead07"}))
+    # rank 7 is in initial_ranks for the members' poll to consider it
+    for c in ms:
+        c.initial_ranks = (0, 1, 7)
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.health_check(True, step=1)),
+        (lambda c=ms[1]: c.health_check(True, step=1)),
+    ])
+    assert not any(errs), errs
+    for v in out:
+        assert v.admitted == ()  # admitted, then lost before the barrier
+        assert v.epoch == 2 and v.members == (0, 1)
+        # the epoch moved INSIDE admit() (admission + eviction): the
+        # verdict must still surface a membership change, or the guard
+        # would keep a stale plan/pipeline epoch while sidecars advance
+        assert v.reconfigured and v.membership_changed and not v.ok
+    assert ms[0].members == (0, 1) and ms[0].epoch == 2
+    # the dead rank's request was CONSUMED at the admission decision: the
+    # next sync must not re-admit it (previously this thrashed forever —
+    # one barrier timeout + two spurious epochs per health check)
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.health_check(True, step=2)),
+        (lambda c=ms[1]: c.health_check(True, step=2)),
+    ])
+    assert not any(errs), errs
+    for v in out:
+        assert v.ok and not v.membership_changed and v.epoch == 2
+
+
+def test_rejoin_times_out_on_dead_fleet(tmp_path):
+    c = M.ElasticCluster(rank=1, members=[0, 1],
+                         transport=CL.FileTransport(str(tmp_path)),
+                         timeout_s=0.2)
+    with pytest.raises(CL.ClusterError, match="not admitted"):
+        c.rejoin(0, timeout_s=0.5)
+    # the stale request was withdrawn — a later fleet won't admit a ghost
+    with pytest.raises(CL.PeerTimeout):
+        c._transport.get(f"{c._ns}/rejoin/req/1", 0.1)
+
+
+# -- transports ---------------------------------------------------------------
+
+
+def test_file_transport_roundtrip(tmp_path):
+    t = CL.FileTransport(str(tmp_path))
+    t.set("a/b/c", "v1")
+    assert t.get("a/b/c", 0.1) == "v1"
+    t.set("a/b/c", "v2")  # atomic overwrite
+    assert t.get("a/b/c", 0.1) == "v2"
+    with pytest.raises(CL.PeerTimeout):
+        t.get("a/b/missing", 0.1)
+    t.delete("a/b/c")
+    with pytest.raises(CL.PeerTimeout):
+        t.get("a/b/c", 0.1)
+    t.set("sub/tree/x", "1")
+    t.set("sub/tree/y", "2")
+    t.prune_prefix("sub")
+    with pytest.raises(CL.PeerTimeout):
+        t.get("sub/tree/x", 0.1)
+
+
+def test_file_transport_barrier_contract(tmp_path):
+    t = CL.FileTransport(str(tmp_path))
+    with pytest.raises(CL.ClusterError, match="index/num_processes"):
+        t.barrier("b", 0.1)
+    t0 = CL.FileTransport(str(tmp_path), index=0, num_processes=2)
+    t1 = CL.FileTransport(str(tmp_path), index=1, num_processes=2)
+    _, errs = run_threads([lambda: t0.barrier("b", 5), lambda: t1.barrier("b", 5)])
+    assert not any(errs)
+
+
+def test_file_transport_store_survives_instance_loss(tmp_path):
+    """The property rank relaunch needs: a NEW ElasticCluster instance
+    (fresh process, same stable rank) lands in the same key space."""
+    t = CL.FileTransport(str(tmp_path))
+    first = M.ElasticCluster(rank=0, world=2, transport=t, timeout_s=0.5)
+    first._transport.set(f"{first._ns}/rejoin/req/1", "ghost")
+    del first
+    again = M.ElasticCluster(rank=0, world=2,
+                             transport=CL.FileTransport(str(tmp_path)),
+                             timeout_s=0.5)
+    assert again._transport.get(f"{again._ns}/rejoin/req/1", 0.1) == "ghost"
+
+
+def test_superseded_epoch_gc_is_deferred(tmp_path):
+    """The split-brain regression: committing a new epoch must NOT prune
+    the old epoch's keys immediately — a slow-but-alive peer may still be
+    reading them (it commits only after finishing that gather). The GC
+    runs after the first COMPLETED exchange at the new epoch."""
+    t = CL.FileTransport(str(tmp_path))
+    _, ms = make_members(3, t, timeout_s=0.5)
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.health_check(True, step=1)),
+        (lambda c=ms[1]: c.health_check(True, step=1)),
+    ])
+    assert not any(errs) and ms[0].epoch == 1
+    # the e0 health keys are still readable right after the commit
+    assert t.get(f"{ms[0]._ns}/e0/health/0/0", 0.1)
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.exchange("x", "a")),
+        (lambda c=ms[1]: c.exchange("x", "b")),
+    ])
+    assert not any(errs)
+    # ...and swept once an epoch-1 exchange completed on this rank
+    with pytest.raises(CL.PeerTimeout):
+        t.get(f"{ms[0]._ns}/e0/health/0/0", 0.1)
+
+
+def test_elastic_cluster_accepts_file_transport_string(tmp_path):
+    c = M.ElasticCluster(rank=0, world=1,
+                         transport=f"file:{tmp_path}", timeout_s=0.5)
+    assert isinstance(c._transport, CL.FileTransport)
+    with pytest.raises(ValueError, match="explicit transport"):
+        M.ElasticCluster(rank=0, world=2, transport=None)
+
+
+def test_from_env_contract(tmp_path, monkeypatch):
+    monkeypatch.setenv(M.ELASTIC_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(M.ELASTIC_RANK_ENV, "1")
+    monkeypatch.setenv(M.ELASTIC_WORLD_ENV, "3")
+    monkeypatch.delenv(M.ELASTIC_REJOIN_ENV, raising=False)
+    c = M.ElasticCluster.from_env()
+    assert (c.rank, c.world, c.epoch) == (1, 3, 0)
+    assert isinstance(c._transport, CL.FileTransport)
+    assert not M.ElasticCluster.rejoining_by_env()
+    monkeypatch.setenv(M.ELASTIC_REJOIN_ENV, "1")
+    assert M.ElasticCluster.rejoining_by_env()
+    monkeypatch.delenv(M.ELASTIC_DIR_ENV)
+    with pytest.raises(CL.ClusterError, match="supervisor contract"):
+        M.ElasticCluster.from_env()
+
+
+def test_current_epoch_tracks_live_cluster():
+    _, (c,) = make_members(1)
+    assert M.current_epoch() == 0
+    c._commit(3, [0])
+    assert M.current_epoch() == 3
+
+
+# -- member-scoped consensus restore ------------------------------------------
+
+
+def test_consensus_restore_is_member_scoped():
+    _, ms = make_members(3, timeout_s=1.0)
+    run_threads([  # shrink to {0, 1} first
+        (lambda c=ms[0]: c.health_check(True, step=1)),
+        (lambda c=ms[1]: c.health_check(True, step=1)),
+    ])
+    views = {0: [12, 8, 4], 1: [8, 4]}
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.consensus_restore_step(views[0])),
+        (lambda c=ms[1]: c.consensus_restore_step(views[1])),
+    ])
+    assert not any(errs)
+    assert out == [8, 8]  # newest step valid on every SURVIVOR
+
+
+def test_consensus_restore_survives_second_failure():
+    """A member lost DURING the restore exchange is reconfigured out and
+    the exchange retried over the survivors — a second failure cannot
+    deadlock the first one's repair."""
+    _, ms = make_members(3, timeout_s=0.5)
+    os.environ[CL.RESTORE_TIMEOUT_ENV] = "0.5"
+    try:
+        out, errs = run_threads([
+            (lambda c=ms[0]: c.consensus_restore_step([8, 4])),
+            (lambda c=ms[1]: c.consensus_restore_step([8])),
+        ])
+    finally:
+        os.environ.pop(CL.RESTORE_TIMEOUT_ENV, None)
+    assert not any(errs), errs
+    assert out == [8, 8]
+    assert ms[0].epoch == 1 and ms[0].members == (0, 1)
+
+
+# -- epoch-stamped plans + checkpoint compat ----------------------------------
+
+
+def _plan(world=4):
+    params = {"a": np.zeros((6, 4), np.float32),
+              "b": np.zeros((8,), np.float32)}
+    return F.make_plan(params, world=world, threshold_mb=0.00002)
+
+
+def test_rescale_plan_preserves_grouping_and_stamps_epoch():
+    plan = _plan(world=4)
+    out = F.rescale_plan(plan, 2, epoch=1)
+    assert out.world == 2 and out.epoch == 1
+    assert [b.leaf_ids for b in out.buckets] == \
+        [b.leaf_ids for b in plan.buckets]
+    assert all(b.padded_size % 2 == 0 for b in out.buckets)
+    # no-op fast path
+    assert F.rescale_plan(out, 2, epoch=1) is out
+
+
+def test_plan_fingerprint_separates_epochs_not_epoch_zero():
+    plan = _plan(world=4)
+    assert plan.epoch == 0
+    import dataclasses
+    stamped = dataclasses.replace(plan, epoch=3)
+    # same world+layout, different membership epoch -> different restore
+    # identity; epoch 0 keeps the pre-elastic fingerprint byte-for-byte
+    assert ckpt.plan_fingerprint(stamped) != ckpt.plan_fingerprint(plan)
+    assert ckpt.plan_fingerprint(plan) == ckpt.plan_fingerprint(
+        F.rescale_plan(stamped, 4, epoch=0))
+
+
+def test_plan_desc_roundtrips_epoch():
+    plan = F.rescale_plan(_plan(world=4), 2, epoch=5)
+    desc = ckpt.plan_desc(plan)
+    assert desc["epoch"] == 5
+    rebuilt = ckpt.plan_from_desc(desc, plan.treedef)
+    assert rebuilt.epoch == 5 and rebuilt.world == 2
+    assert ckpt.plan_fingerprint(rebuilt) == ckpt.plan_fingerprint(plan)
+
+
+def test_autotuner_rescale_carries_state_across_worlds(tmp_path, mesh):
+    """The guard's on_membership_change hook: rebuild for the shrunk
+    world with the epoch stamped, carrying live state (repack), and a
+    restore of a pre-shrink checkpoint re-packs through elastic_restore
+    instead of silently unpacking the wrong layout."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.tuning.autotune import AutoTuner
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    devs = list(mesh.devices.flat)
+    tuner = AutoTuner(
+        _loss_fn, params, strategy="bo", threshold_mb=0.0008,
+        interval=10**9, donate=False,
+        mesh=jax.sharding.Mesh(np.asarray(devs[:4]), ("dp",)),
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    assert tuner.ts.plan.world == 4 and tuner.ts.plan.epoch == 0
+    state = tuner.init(params)
+    for i in range(3):
+        state, m = tuner.step(state, _data(jax.random.PRNGKey(i), n=8))
+    ckpt.save_checkpoint(str(tmp_path), state, tuner.ts.plan)
+    pre_loss = float(m["loss"])
+
+    view = M.MembershipView(epoch=1, members=(0, 2), rank=0, index=0,
+                            world=2)
+    state = tuner.rescale(view, state=state)
+    assert tuner.ts.plan.world == 2 and tuner.ts.plan.epoch == 1
+    assert int(jax.device_get(state.step)) == 3  # carried across
+    step3_kernel = np.asarray(jax.device_get(
+        F.unpack_all(list(state.buffers), tuner.ts.plan)["out"]["kernel"]))
+    state, m = tuner.step(state, _data(jax.random.PRNGKey(9), n=8))
+    assert np.isfinite(float(m["loss"])), pre_loss
+
+    # the world-4 epoch-0 checkpoint no longer matches the live plan...
+    with pytest.raises(ValueError, match="packed under plan"):
+        ckpt.restore_checkpoint(str(tmp_path), tuner.ts, step=3,
+                                template=tuner.ts.init(params))
+    # ...and elastic_restore re-packs it into the rescaled layout,
+    # reproducing the step-3 values the repacked live state held before
+    # it advanced
+    restored = ckpt.elastic_restore(str(tmp_path), tuner.ts, step=3)
+    assert int(jax.device_get(restored.step)) == 3
+    rparams = F.unpack_all(list(restored.buffers), tuner.ts.plan)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(rparams["out"]["kernel"])),
+        step3_kernel, atol=1e-5)
+
+
+def test_autotuner_rescale_failure_keeps_previous_plan(mesh, monkeypatch):
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.tuning import autotune as AT
+
+    from tests.test_dear_numerics import _loss_fn, _mlp_params
+
+    tracer = T.Tracer([T.MemoryExporter()])
+    prev = T._tracer
+    T.set_tracer(tracer)
+    try:
+        params = _mlp_params(jax.random.PRNGKey(0))
+        tuner = AT.AutoTuner(
+            _loss_fn, params, strategy="bo", threshold_mb=0.0008,
+            interval=10**9, mesh=mesh, donate=False,
+            optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        )
+        before = tuner.ts
+        # precondition failures (not enough devices) raise up front
+        view99 = M.MembershipView(epoch=1, members=tuple(range(99)),
+                                  rank=0, index=0, world=99)
+        with pytest.raises(ValueError, match="needs 99 devices"):
+            tuner.rescale(view99)
+        # a failing REBUILD is sandboxed like a BO trial: counted, and
+        # the previous train step stays installed
+        def boom(*a, **k):
+            raise RuntimeError("compile exploded")
+
+        monkeypatch.setattr(AT.D, "build_train_step", boom)
+        view = M.MembershipView(epoch=1, members=(0, 1), rank=0, index=0,
+                                world=2)
+        with pytest.raises(RuntimeError, match="compile exploded"):
+            tuner.rescale(view)
+        assert tuner.ts is before  # sandboxed: nothing half-swapped
+        assert tuner.ts.plan.epoch == 0
+        assert tracer.counters().get("autotune.rescale_failures", 0) == 1
+    finally:
+        T.set_tracer(prev)
+
+
+def test_sidecar_mem_epoch_and_pipeline_state(tmp_path, mesh):
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, threshold_mb=0.0008, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    state = ts.init(params)
+    state, _ = ts.step(state, _data(jax.random.PRNGKey(0)))
+    pstate = {"backend": "numpy", "produced": 1}
+    ckpt.save_checkpoint(str(tmp_path), state, ts.plan,
+                         pipeline_state=pstate, mem_epoch=4)
+    assert ckpt.read_mem_epoch(str(tmp_path), 1) == 4
+    assert ckpt.read_pipeline_state(str(tmp_path), 1) == pstate
+    assert ckpt.read_sidecar(str(tmp_path), 99) is None
+    assert ckpt.read_mem_epoch(str(tmp_path), 99) is None
+
+
+def test_prune_future_steps(tmp_path, mesh):
+    """After a restore to an older step, newer checkpoints are a dead
+    timeline: replayed saves would collide with them and a later restore
+    could resurrect them (split-brain across members)."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, threshold_mb=0.0008, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    state = ts.init(params)
+    for i in range(3):
+        state, _ = ts.step(state, _data(jax.random.PRNGKey(i)))
+        ckpt.save_checkpoint(str(tmp_path), state, ts.plan)
+    assert ckpt.valid_steps(str(tmp_path)) == [3, 2, 1]
+    assert ckpt.prune_future_steps(str(tmp_path), above=1) == [3, 2]
+    assert ckpt.valid_steps(str(tmp_path)) == [1]
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "meta_0000000003.json"))
+    assert ckpt.prune_future_steps(str(tmp_path), above=1) == []
+
+
+# -- pipeline: deterministic resume + elastic resharding ----------------------
+
+
+def _spec(batch=4):
+    return P.SyntheticSpec((
+        P.Field("x", (batch, 3), RB.KIND_NORMAL_F32, 0.0, 1.0),
+        P.Field("label", (batch,), RB.KIND_UNIFORM_I32, 0, 10),
+    ))
+
+
+def test_numpy_pipeline_state_roundtrip_is_bit_exact():
+    p = P.NumpyPipeline(_spec(), seed=3)
+    for _ in range(3):
+        p.next()
+    snap = p.state_dict()
+    assert snap["exact"] and snap["produced"] == 3
+    expect = [p.next() for _ in range(2)]
+    p.load_state_dict(snap)
+    assert p.produced == 3
+    replay = [p.next() for _ in range(2)]
+    for a, b in zip(expect, replay):
+        np.testing.assert_array_equal(a["x"], b["x"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+    # a FRESH pipeline (relaunched rank) resumes the same stream
+    q = P.NumpyPipeline(_spec(), seed=3)
+    q.load_state_dict(snap)
+    replay2 = [q.next() for _ in range(2)]
+    np.testing.assert_array_equal(expect[0]["x"], replay2[0]["x"])
+
+
+def test_pipeline_state_rejects_spec_mismatch():
+    p = P.NumpyPipeline(_spec(), seed=3)
+    snap = p.state_dict()
+    q = P.NumpyPipeline(_spec(batch=8), seed=3)
+    with pytest.raises(ValueError, match="different batch spec"):
+        q.load_state_dict(snap)
+
+
+def test_native_resume_does_not_replay_the_stream():
+    """The native backend cannot seek, so a resume reseeds — but the
+    reseed must be POSITION-dependent: restoring produced=N and then
+    restarting the position-0 stream would silently replay batches
+    0..N-1 (the exact bug this PR's pipeline sidecars exist to fix)."""
+    if not P.native_available():
+        pytest.skip("native runtime library unavailable"
+                    f" ({RB.load_error()})")
+    p = P.Pipeline(_spec(), seed=3, nthreads=1)
+    first = p.next()["x"].copy()
+    for _ in range(2):
+        p.next()
+    snap = p.state_dict()
+    # the recorded position is the CONSUMED count — the async producers
+    # run ahead, but prefilled-yet-unfetched slots are not position
+    assert not snap["exact"] and snap["produced"] == 3
+    q = P.Pipeline(_spec(), seed=3, nthreads=1)
+    q.load_state_dict(snap)
+    assert q.produced == 3
+    resumed = [q.next()["x"] for _ in range(3)]
+    # none of the next batches is the original stream's batch 0
+    assert all(not np.array_equal(first, r) for r in resumed)
+    # ...and the position-seeded resume is itself deterministic: a second
+    # fresh consumer restoring the same sidecar draws the same stream
+    r = P.Pipeline(_spec(), seed=3, nthreads=1)
+    r.load_state_dict(snap)
+    np.testing.assert_array_equal(resumed[0], r.next()["x"])
+    p.close(), q.close(), r.close()
+
+
+def test_native_reshard_does_not_double_count_position():
+    """`produced` already includes the resume offset, so consecutive
+    recreates (resume -> reshard -> reshard, the shrink-then-rejoin
+    sequence) must ASSIGN the new position, not accumulate it — the old
+    `+=` doubled every pre-reshard segment, skipping data and persisting
+    a compounding-wrong position in later sidecars."""
+    if not P.native_available():
+        pytest.skip("native runtime library unavailable"
+                    f" ({RB.load_error()})")
+    p = P.Pipeline(_spec(), seed=3, nthreads=1)
+    snap = p.state_dict()
+    snap["produced"] = 100  # a long-running stream's checkpoint
+    q = P.Pipeline(_spec(), seed=3, nthreads=1)
+    q.load_state_dict(snap)
+    q.reshard(0, 2, epoch=1)
+    q.reshard(0, 3, epoch=2)
+    # nothing was consumed, so the position is exactly the restored one
+    # (the += bug compounded to >= 300 here; a producer-count-based
+    # position drifted by ~nslots per recreate)
+    assert q.produced == 100, q.produced
+    p.close(), q.close()
+
+
+def test_numpy_resume_of_native_sidecar_does_not_replay():
+    """A native-written sidecar restored on the numpy fallback (the .so
+    stopped loading on relaunch) has no PRNG state: the resume must
+    position-seed rather than silently replay from batch 0."""
+    p = P.NumpyPipeline(_spec(), seed=3)
+    first = p.next()["x"].copy()
+    snap = p.state_dict()
+    snap["backend"], snap["exact"] = "native", False
+    snap["produced"] = 3
+    del snap["rng"]
+    q = P.NumpyPipeline(_spec(), seed=3)
+    q.load_state_dict(snap)
+    assert q.produced == 3
+    assert not np.array_equal(first, q.next()["x"])
+
+
+def test_reshard_is_a_pure_function_of_assignment():
+    a = P.NumpyPipeline(_spec(), seed=11, shard=0, num_shards=3)
+    b = P.NumpyPipeline(_spec(), seed=11, shard=1, num_shards=3)
+    xa, xb = a.next()["x"], b.next()["x"]
+    assert not np.array_equal(xa, xb)  # disjoint shard streams
+    # survivors recompute the identical post-shrink assignment
+    a.reshard(0, 2, epoch=1)
+    b.reshard(0, 2, epoch=1)
+    np.testing.assert_array_equal(a.next()["x"], b.next()["x"])
+    assert a.shard == 0 and a.num_shards == 2
+    # a different slot of the same epoch draws a different stream
+    b.reshard(1, 2, epoch=1)
+    assert not np.array_equal(a.next()["x"], b.next()["x"])
+
+
+def test_default_assignment_is_byte_compatible_with_pre_elastic():
+    plain = np.random.default_rng(7)  # what the pre-elastic backend drew
+    p = P.NumpyPipeline(_spec(), seed=7)  # shard 0 of 1, epoch 0
+    np.testing.assert_array_equal(
+        p.next()["x"], plain.normal(0.0, 1.0, (4, 3)).astype(np.float32))
+
+
+def test_pipeline_counters_fire():
+    tracer = T.Tracer([T.MemoryExporter()])
+    prev = T._tracer
+    T.set_tracer(tracer)
+    try:
+        p = P.NumpyPipeline(_spec(), seed=1)
+        p.reshard(1, 4, epoch=2)
+        p.load_state_dict(p.state_dict())
+        c = tracer.counters()
+        assert c.get("pipeline.reshards") == 1
+        assert c.get("pipeline.resumes") == 1
+    finally:
+        T.set_tracer(prev)
+
+
+# -- retry: decorrelated jitter + elapsed budget ------------------------------
+
+
+def test_jitter_is_deterministic_per_label_and_decorrelated():
+    def delays_for(label):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 5:
+                raise OSError("transient")
+            return "ok"
+
+        assert R.retry_call(flaky, attempts=5, name=label,
+                            base_delay_s=0.01, max_delay_s=10.0,
+                            sleep=sleeps.append) == "ok"
+        return sleeps
+
+    a1, a2 = delays_for("siteA"), delays_for("siteA")
+    b = delays_for("siteB")
+    assert a1 == a2, "same (rank, label) must replay the same schedule"
+    assert a1 != b, "different call sites must decorrelate"
+    assert all(d >= 0.01 for d in a1)
+
+
+def test_jitter_off_restores_legacy_exponential():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise OSError("x")
+        return 1
+
+    R.retry_call(flaky, attempts=4, jitter=False, base_delay_s=0.05,
+                 backoff=2.0, max_delay_s=2.0, sleep=sleeps.append)
+    assert sleeps == [0.05, 0.1, 0.2]
+
+
+def test_elapsed_budget_caps_total_time():
+    sleeps = []
+
+    def always_fails():
+        raise OSError("down")
+
+    with pytest.raises(R.RetryError, match="budget"):
+        R.retry_call(always_fails, attempts=100, jitter=False,
+                     base_delay_s=0.2, max_delay_s=5.0,
+                     max_elapsed_s=0.3, sleep=sleeps.append)
+    # the loop stopped when the NEXT sleep would cross the budget —
+    # far short of the 100-attempt allowance
+    assert len(sleeps) <= 2
+
+
+# -- the guard's membership-transition path (scripted coordinator) ------------
+
+
+class _ElasticStub:
+    """Scripts one shrink verdict against a single-process guard: the
+    coordinated membership branches (hook order, reshard-after-restore,
+    sidecar epochs) are unit-testable without threads or processes."""
+
+    max_candidates = 16
+
+    def __init__(self):
+        self.epoch = 0
+        self.members = (0, 1, 2)
+        self.rank = 0
+        self.shrink_at = None
+        self.restore_calls = 0
+
+    @property
+    def process_count(self):
+        return len(self.members)
+
+    @property
+    def index(self):
+        return self.members.index(self.rank)
+
+    def view(self):
+        return M.MembershipView(epoch=self.epoch, members=self.members,
+                                rank=self.rank, index=self.index,
+                                world=len(self.members))
+
+    def health_check(self, ok, *, fingerprint="", step=None,
+                     preempted=False):
+        if step == self.shrink_at:
+            self.epoch += 1
+            self.members = (0, 1)
+            return M.ElasticVerdict(
+                ok=False, unhealthy_ranks=(), desync=False,
+                any_preempted=False, fingerprints=(), epoch=self.epoch,
+                members=self.members, reconfigured=True, lost=(2,))
+        return M.ElasticVerdict(
+            ok=ok, unhealthy_ranks=() if ok else (0,), desync=False,
+            any_preempted=False, fingerprints=(fingerprint,),
+            epoch=self.epoch, members=self.members)
+
+    #: when set, the FIRST consensus_restore_step call commits another
+    #: shrink mid-exchange (a second failure during the restore — the
+    #: elastic cluster retries the exchange over the survivors)
+    restore_bumps_to = None
+
+    def consensus_restore_step(self, local_steps):
+        self.restore_calls += 1
+        if self.restore_bumps_to is not None:
+            self.epoch, self.members = self.restore_bumps_to
+            self.restore_bumps_to = None
+        return max(local_steps) if local_steps else None
+
+
+def test_guard_membership_transition_order(tmp_path, mesh):
+    """On a membership_changed verdict the guard must: run the hook
+    (plan rescale) BEFORE the restore, restore the pipeline sidecar
+    state, reshard AFTER the restore, stamp later sidecars with the new
+    epoch, and count guard.membership_changes."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    tracer = T.Tracer([T.MemoryExporter()])
+    prev = T._tracer
+    T.set_tracer(tracer)
+    try:
+        params = _mlp_params(jax.random.PRNGKey(0))
+        ts = build_train_step(
+            _loss_fn, params, mesh=mesh, threshold_mb=0.0008,
+            donate=False, optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        )
+        co = _ElasticStub()
+        co.shrink_at = 6
+        pipe = P.NumpyPipeline(_spec(), seed=5, shard=0, num_shards=3)
+        events = []
+        guard = GuardedTrainer(
+            ts, str(tmp_path / "g"), params, check_every=1,
+            checkpoint_every=4, coordinator=co, pipeline=pipe,
+            on_membership_change=lambda v: events.append(("hook", v)),
+        )
+        guard.on_rollback = lambda c, at: events.append(("rollback", at))
+        state = ts.init(params)
+        for i in range(8):
+            state, m = guard.step(state, _data(jax.random.PRNGKey(i)))
+        # hook BEFORE the rollback's restore, with the committed view
+        assert [e[0] for e in events] == ["hook", "rollback"]
+        assert events[0][1].epoch == 1 and events[0][1].world == 2
+        assert events[1][1] == 4 and co.restore_calls == 1
+        # pipeline: sidecar resume first, then the epoch-1 reshard
+        assert pipe.shard == 0 and pipe.num_shards == 2
+        assert pipe._epoch == 1
+        c = tracer.counters()
+        assert c.get("guard.membership_changes") == 1
+        assert c.get("pipeline.resumes") == 1
+        assert c.get("pipeline.reshards") == 1
+        # post-transition checkpoints carry the new epoch in the sidecar.
+        # Cadence: the transition fired at attempt 6 (rollback to step 4),
+        # so attempts 7-8 advance the state to step 6, where the
+        # checkpoint_every=4 cadence (attempt 8) persists it.
+        assert ckpt.read_mem_epoch(str(tmp_path / "g"), 6) == 1
+        pstate = ckpt.read_pipeline_state(str(tmp_path / "g"), 6)
+        assert pstate["num_shards"] == 2 and pstate["epoch"] == 1
+    finally:
+        T.set_tracer(prev)
+
+
+def test_guard_second_failure_during_restore_rebuilds_again(tmp_path, mesh):
+    """A membership move committed INSIDE the consensus-restore exchange
+    (second failure mid-recovery) must re-fire the transition hook with
+    the newest view before unpacking — otherwise the restore lands in a
+    plan built for a membership that no longer exists and later sidecars
+    stamp an epoch the plan doesn't carry."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    tracer = T.Tracer([T.MemoryExporter()])
+    prev = T._tracer
+    T.set_tracer(tracer)
+    try:
+        params = _mlp_params(jax.random.PRNGKey(0))
+        ts = build_train_step(
+            _loss_fn, params, mesh=mesh, threshold_mb=0.0008,
+            donate=False, optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        )
+        co = _ElasticStub()
+        co.shrink_at = 6
+        co.restore_bumps_to = (2, (0,))  # second shrink mid-restore
+        pipe = P.NumpyPipeline(_spec(), seed=5, shard=0, num_shards=3)
+        hooks = []
+        guard = GuardedTrainer(
+            ts, str(tmp_path / "g"), params, check_every=1,
+            checkpoint_every=4, coordinator=co, pipeline=pipe,
+            on_membership_change=lambda v: hooks.append(v),
+        )
+        state = ts.init(params)
+        for i in range(8):
+            state, m = guard.step(state, _data(jax.random.PRNGKey(i)))
+        # the hook fired TWICE: the health-sync shrink, then the
+        # mid-restore one with the even-newer view
+        assert [(v.epoch, v.world) for v in hooks] == [(1, 2), (2, 1)]
+        # the pipeline landed on the FINAL view, not the intermediate one
+        assert pipe.num_shards == 1 and pipe._epoch == 2
+        assert tracer.counters().get("guard.membership_changes") == 2
+        # post-transition sidecars agree with the final epoch
+        assert ckpt.read_mem_epoch(str(tmp_path / "g"), 6) == 2
+    finally:
+        T.set_tracer(prev)
+
+
+def test_guard_elastic_resume_aligns_cadence(tmp_path, mesh):
+    """The rejoiner's re-entry: elastic_resume restores through the SAME
+    consensus exchange, re-seats the pipeline, and adopts the fleet's
+    attempt cadence from the admission ack."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, threshold_mb=0.0008, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    co = _ElasticStub()
+    guard = GuardedTrainer(
+        ts, str(tmp_path / "g"), params, check_every=1,
+        checkpoint_every=2, coordinator=co,
+        pipeline=P.NumpyPipeline(_spec(), seed=5),
+    )
+    state = ts.init(params)
+    for i in range(4):
+        state, _ = guard.step(state, _data(jax.random.PRNGKey(i)))
+    co.epoch, co.members = 2, (0, 1)  # "admitted at epoch 2"
+    state, step = guard.elastic_resume({"steps_seen": 11})
+    assert step == 4 and guard.steps_seen == 11
+    assert int(jax.device_get(state.step)) == 4
+    assert guard._last_good_step == 4
+    # the loop continues from the fleet's cadence
+    state, m = guard.step(state, _data(jax.random.PRNGKey(11)))
+    assert guard.steps_seen == 12 and np.isfinite(float(m["loss"]))

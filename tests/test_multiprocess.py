@@ -180,6 +180,64 @@ def test_coordinated_recovery_cluster(tmp_path):
 
 
 @pytest.mark.timeout(600, method="signal")
+def test_elastic_membership(tmp_path):
+    """Elastic membership end to end (mp_worker elastic mode) over a REAL
+    3-process host-level cluster driven by `launch/supervisor.py`: rank 2
+    SIGKILLs itself mid-run; the survivors must two-phase-commit a smaller
+    membership epoch, rescale the fusion plan to the reduced world
+    (epoch-stamped), reshard the data pipeline, and consensus-restore to
+    the newest step valid on every survivor; the supervisor relaunches
+    the dead rank with the rejoin env contract and it must be readmitted
+    at a later epoch barrier and finish IN LOCKSTEP with the survivors
+    (ISSUE-5 acceptance). All coordination is `FileTransport` — no
+    `jax.distributed` at all, so the coordination substrate survives rank
+    death and the whole scenario runs where cross-process XLA CPU
+    computations don't exist."""
+    import signal
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "mp_worker.py")
+    supervisor = os.path.join(repo, "launch", "supervisor.py")
+    env = _base_env(repo)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"  # membership != jax.distributed
+    env["DEAR_MP_MODE"] = "elastic"
+    env["DEAR_MP_WORKDIR"] = str(tmp_path / "work")
+    env["DEAR_MP_ELASTIC_KILL"] = "2:5"  # rank 2 dies before attempt 5
+    # the deadline must cover a PEER's post-transition XLA recompile
+    # (every epoch change rebuilds+recompiles the train step, 10-20s on a
+    # loaded container) — a legitimate compile must not read as a death
+    env["DEAR_CLUSTER_TIMEOUT_SECS"] = "40"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_FLIGHT"] = "8"
+    proc = subprocess.Popen(
+        [sys.executable, supervisor, "--nprocs", "3",
+         "--dir", str(tmp_path / "elastic"), "--deadline", "420",
+         "--", sys.executable, worker],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=480)
+    except subprocess.TimeoutExpired as e:
+        os.killpg(proc.pid, signal.SIGKILL)
+        out, _ = proc.communicate()
+        raise AssertionError(
+            f"elastic supervisor wedged:\n{(e.stdout or out or '')[-3000:]}"
+        ) from e
+    assert proc.returncode == 0, out[-5000:]
+    for pid in range(3):
+        assert f"MP_ELASTIC_OK rank={pid}/3 epoch=2" in out, out[-5000:]
+    assert "MP_ELASTIC_REJOINED rank=2 epoch=2" in out, out[-5000:]
+    # the supervisor saw the SIGKILL and relaunched exactly that rank,
+    # BEFORE the relaunched process reported its admission
+    assert "supervisor: rank 2 exited rc=-9" in out, out[-5000:]
+    assert "supervisor: rank 2 RELAUNCHED (rejoin)" in out, out[-5000:]
+    assert out.index("rank 2 exited rc=-9") < out.index(
+        "MP_ELASTIC_REJOINED rank=2")
+
+
+@pytest.mark.timeout(600, method="signal")
 def test_run_health_cluster(tmp_path):
     """The continuous run-health ladder (mp_worker health mode) over a
     real 2-process cluster: with telemetry enabled and one rank

@@ -441,6 +441,34 @@ def test_chaos_check_two_process_storm(tmp_path):
     assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]
 
 
+@pytest.mark.timeout(480, method="signal")
+def test_chaos_check_elastic_storm(tmp_path):
+    """scripts/chaos_check.py --elastic: SIGKILL one rank of a 3-rank
+    host-level cluster mid-run. The gate asserts the survivors committed
+    a smaller membership epoch and kept training with a rescaled
+    epoch-stamped fusion plan and resharded pipeline — rolling back
+    exactly to the newest commonly-valid checkpoint, zero loss of
+    progress — and that `launch/supervisor.py`'s relaunch of the dead
+    rank was readmitted at a later epoch barrier and finished in lockstep
+    (ISSUE-5 acceptance). All coordination over `FileTransport`; no
+    `jax.distributed`."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "chaos_check.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, "--elastic", "--checkpoint-every", "2",
+         "--workdir", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=440,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]
+
+
 # -- autotuner sandboxing -----------------------------------------------------
 
 
