@@ -61,6 +61,13 @@ def pytest_configure(config):
         "timeout(seconds, method='signal'|'thread'): override the "
         "per-test time limit for this test",
     )
+    config.addinivalue_line(
+        "markers",
+        "flaky(reason=..., reruns=2): quarantined load-flaky test — a "
+        "failure is rerun (fresh setup/teardown) up to `reruns` times "
+        "and only reported if every attempt fails; set "
+        "DEAR_FLAKY_RERUNS=0 to see first-attempt failures raw",
+    )
 
 
 def _settings(item):
@@ -139,6 +146,41 @@ def _guard(item):
                 timer.cancel()
 
     return armed()
+
+
+def pytest_runtest_protocol(item, nextitem):
+    """Rerun-on-failure for tests quarantined with ``@pytest.mark.flaky``
+    (vendored, same idea as pytest-rerunfailures — which cannot be
+    installed in this container). A marked test that fails any phase is
+    torn down and rerun from a fresh setup, up to ``reruns`` times; only
+    the FINAL attempt's reports are logged, so a load blip neither fails
+    CI nor inflates the dot count. ``DEAR_FLAKY_RERUNS`` overrides the
+    marker (0 disables rerunning — for hunting the flake itself)."""
+    marker = item.get_closest_marker("flaky")
+    if marker is None:
+        return None
+    env = os.environ.get("DEAR_FLAKY_RERUNS", "").strip()
+    reruns = int(env) if env.isdigit() else int(marker.kwargs.get("reruns", 2))
+    if reruns <= 0:
+        return None
+    from _pytest.runner import runtestprotocol
+
+    hook = item.ihook
+    hook.pytest_runtest_logstart(nodeid=item.nodeid, location=item.location)
+    for attempt in range(reruns + 1):
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+        if not any(r.failed for r in reports) or attempt == reruns:
+            for report in reports:
+                hook.pytest_runtest_logreport(report=report)
+            break
+        # runtestprotocol ran teardown for the failed attempt; the next
+        # loop iteration re-runs setup from scratch
+        sys.stderr.write(
+            f"\nflaky: {item.nodeid} failed attempt {attempt + 1}/"
+            f"{reruns + 1} ({marker.kwargs.get('reason', 'quarantined')}); "
+            "rerunning\n")
+    hook.pytest_runtest_logfinish(nodeid=item.nodeid, location=item.location)
+    return True
 
 
 @pytest.hookimpl(hookwrapper=True)

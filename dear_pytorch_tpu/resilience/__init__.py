@@ -21,6 +21,10 @@ This package makes every recovery path in the framework first-class,
                   health exchange (peer-aware failure + preemption
                   propagation), the cross-replica desync sentinel, and
                   bounded-timeout dead-peer detection.
+  - `scale`     — the capacity-driven supervisor policy: watched
+                  capacity-hint file -> hysteresis-gated scale-up /
+                  drain decisions (`launch/supervisor.py` executes them;
+                  `membership` commits the resulting epochs).
 
 Recovery itself stays in `utils.guard.GuardedTrainer` (rollback, checksum
 fallback, retention) and `utils.checkpoint` (manifests, pruning); this
@@ -52,6 +56,12 @@ from dear_pytorch_tpu.resilience.inject import (  # noqa: F401
     poison_pytree,
 )
 from dear_pytorch_tpu.resilience.preempt import PreemptionHandler  # noqa: F401
+from dear_pytorch_tpu.resilience.scale import (  # noqa: F401
+    CapacityHint,
+    ScaleDecision,
+    ScalePolicy,
+    read_capacity_file,
+)
 from dear_pytorch_tpu.resilience.retry import (  # noqa: F401
     RetryError,
     retry_call,

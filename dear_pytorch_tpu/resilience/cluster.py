@@ -222,6 +222,16 @@ class LocalTransport:
             self._cv.notify_all()
             return won
 
+    def list_prefix(self, prefix: str) -> List[str]:
+        """Child key names directly under ``prefix`` (non-blocking). The
+        discovery primitive scale-UP admission needs: a brand-new rank's
+        join request lands under a key the members cannot enumerate from
+        any static rank list."""
+        base = prefix.rstrip("/") + "/"
+        with self._cv:
+            return sorted({k[len(base):].split("/", 1)[0]
+                           for k in self._store if k.startswith(base)})
+
     def barrier(self, tag: str, timeout_s: float) -> None:
         try:
             self._barrier.wait(timeout=timeout_s)
@@ -334,6 +344,17 @@ class FileTransport:
             shutil.rmtree(self._path(prefix), ignore_errors=True)
         except OSError:
             pass
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        """Child key names directly under ``prefix`` (non-blocking; empty
+        when the subtree does not exist). In-flight atomic-write temp
+        files are excluded — a reader must never enumerate a key whose
+        value has not committed."""
+        try:
+            return sorted(n for n in os.listdir(self._path(prefix))
+                          if ".tmp." not in n)
+        except OSError:
+            return []
 
     def barrier(self, tag: str, timeout_s: float) -> None:
         if self.index is None or self.num_processes is None:

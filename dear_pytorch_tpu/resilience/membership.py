@@ -32,6 +32,22 @@ promised would compose on top — **whole-process elasticity**:
     an **epoch barrier** (the first exchange of the new epoch) with the
     fleet's cadence context (``steps_seen``) handed over in the admission
     ack. ``cluster.rejoins`` counts admissions.
+  - **scale-UP** — the same admission path grows the fleet past the
+    initial world: a brand-new rank (no prior death, no sidecar epoch —
+    ``last_epoch=None``) publishes the identical join request, the leader
+    discovers it via the transport's ``list_prefix`` enumeration (no
+    static rank list can know a rank that never existed), and the commit
+    is an ordinary epoch bump whose decision record carries a **signed
+    world delta** (``added``/``removed``) instead of implying "shrink".
+    ``cluster.scale_ups`` counts admissions of never-before-seen ranks.
+    Transports without ``list_prefix`` (the coordination-service KV)
+    degrade to relaunch-only admission over the initial rank set.
+  - **planned shrink (drain)** — a rank holding a spot/preemption SIGTERM
+    (`resilience.preempt`, ``DEAR_PREEMPT_GRACE_S``) announces
+    ``draining=True`` in its next `health_check`; the survivors commit
+    the shrink *at that sync* — no peer-timeout window burned against the
+    kill deadline — while the drainer itself skips the reconfiguration
+    (it is the dead set) and exits after its emergency save.
 
 Failure-detector honesty: like every timeout-based detector, this one
 cannot distinguish "dead" from "slower than the deadline". A false
@@ -135,10 +151,18 @@ class ElasticVerdict(NamedTuple):
     reconfigured: bool = False   # a shrink committed during this sync
     admitted: tuple = ()         # ranks admitted during this sync
     lost: tuple = ()             # ranks dropped during this sync
+    drained: tuple = ()          # ranks that announced a planned departure
 
     @property
     def membership_changed(self) -> bool:
         return self.reconfigured or bool(self.admitted)
+
+    @property
+    def self_draining(self) -> bool:
+        """True on the rank that announced the drain: save and exit; the
+        SURVIVORS' verdict carries the committed shrink (their membership
+        moved) instead."""
+        return bool(self.drained) and not self.membership_changed
 
 
 # Process-global "current membership epoch" for forensic stamping: the
@@ -171,6 +195,10 @@ class ElasticCluster:
     assignment.
     """
 
+    #: The guard feature-detects this before passing ``draining=`` to
+    #: `health_check` (scripted test coordinators may not accept it).
+    supports_draining = True
+
     def __init__(
         self,
         *,
@@ -181,6 +209,7 @@ class ElasticCluster:
         timeout_s: Optional[float] = None,
         namespace: str = "elastic",
         max_candidates: int = 16,
+        joining: bool = False,
     ):
         global _live_cluster
         if members is None:
@@ -189,9 +218,18 @@ class ElasticCluster:
             members = range(int(world))
         self.rank = int(rank)
         self.members: Tuple[int, ...] = tuple(sorted(int(m) for m in members))
-        self.initial_ranks: Tuple[int, ...] = self.members
         if self.rank not in self.members:
-            raise ValueError(f"rank {rank} not in members {self.members}")
+            if not joining:
+                raise ValueError(
+                    f"rank {rank} not in members {self.members} "
+                    "(a brand-new scale-up rank must pass joining=True "
+                    "and enter through rejoin())")
+            # scale-UP joiner: not a member yet — the committed member set
+            # arrives in the admission ack; until then this instance only
+            # publishes its join request (never exchanges)
+            self.members = tuple(sorted(set(self.members) | {self.rank}))
+        self.joining = bool(joining)
+        self.initial_ranks: Tuple[int, ...] = self.members
         self.epoch = 0
         if timeout_s is None:
             timeout_s = float(os.environ.get(TIMEOUT_ENV, "")
@@ -240,6 +278,10 @@ class ElasticCluster:
                     or os.environ["JAX_NUM_PROCESSES"])
         kw = dict(rank=rank, world=world,
                   transport=FileTransport(root))
+        if rank >= world:
+            # a scale-up spawn: the supervisor handed out a rank id beyond
+            # the initial world — this process can only be a joiner
+            kw["joining"] = True
         kw.update(overrides)
         return cls(**kw)
 
@@ -413,7 +455,8 @@ class ElasticCluster:
             raise ClusterError(
                 f"epoch-{target} reconfiguration did not converge "
                 f"(dead={sorted(dead_set)})")
-        decided = self._decide_epoch(target, survivors)
+        decided = self._decide_epoch(target, survivors,
+                                     delta={"removed": dead_set})
         if set(decided) != set(survivors):
             # another partition of the old membership decided this epoch
             # first (it presumed ME dead, or I missed a commit ack and
@@ -435,8 +478,8 @@ class ElasticCluster:
             target, list(survivors), sorted(dead_set))
         return self.view()
 
-    def _decide_epoch(self, target: int,
-                      members: Sequence[int]) -> Tuple[int, ...]:
+    def _decide_epoch(self, target: int, members: Sequence[int],
+                      *, delta: Optional[dict] = None) -> Tuple[int, ...]:
         """Durable first-writer-wins decision record for epoch ``target``
         (`{ns}/decided/e{target}` — OUTSIDE the per-epoch exchange
         subtrees, so epoch GC never prunes it). Returns the winning member
@@ -444,9 +487,22 @@ class ElasticCluster:
         relaunch+rejoin. One tiny record per epoch for the life of the
         store — what makes a unilateral sole-survivor commit by a
         partitioned rank discover the fleet's commit instead of forking
-        the membership."""
+        the membership.
+
+        Records are **signed world-delta commits**: alongside the member
+        set they carry ``delta={"added": [...], "removed": [...]}`` — one
+        format for survivor shrinks, drains, AND scale-up admissions, so
+        an external supervisor (or a forensic read of the store) can
+        replay the fleet's capacity history from the records alone.
+        Legacy bare-list records parse compatibly."""
         key = f"{self._ns}/decided/e{int(target)}"
-        mine = json.dumps(sorted(int(m) for m in members))
+        record = {"members": sorted(int(m) for m in members)}
+        if delta:
+            record["delta"] = {
+                "added": sorted(int(r) for r in delta.get("added", ())),
+                "removed": sorted(int(r) for r in delta.get("removed", ())),
+            }
+        mine = json.dumps(record, sort_keys=True)
         decide = getattr(self._transport, "decide_once", None)
         if decide is not None:
             won = decide(key, mine)
@@ -463,8 +519,11 @@ class ElasticCluster:
         deadline = time.monotonic() + self.timeout_s
         while True:
             try:
-                return tuple(int(m) for m in json.loads(won))
-            except ValueError:
+                doc = json.loads(won)
+                if isinstance(doc, dict):
+                    doc = doc["members"]
+                return tuple(int(m) for m in doc)
+            except (ValueError, KeyError, TypeError):
                 # a non-linking store's exclusive-create fallback can
                 # expose a mid-write value: the file exists (so get()
                 # returns immediately) but the winner's bytes are still
@@ -513,13 +572,24 @@ class ElasticCluster:
     # -- rejoin: relaunch -> request -> admission at an epoch barrier --------
 
     def _poll_rejoin_requests(self) -> Dict[str, dict]:
-        """Leader-only probe for pending rejoin requests from non-member
-        launch ranks. Only the leader pays the poll; the union across the
-        member exchange makes the admit decision identical everywhere."""
+        """Leader-only probe for pending rejoin/join requests from
+        non-member ranks. Only the leader pays the poll; the union across
+        the member exchange makes the admit decision identical everywhere.
+        With a ``list_prefix``-capable transport (FileTransport,
+        LocalTransport) the candidate set is DISCOVERED from the store, so
+        a brand-new scale-up rank — one no static rank list has ever
+        contained — is admissible; transports without enumeration degrade
+        to relaunch-only admission over the initial rank set."""
         if self.rank != self.leader:
             return {}
+        lister = getattr(self._transport, "list_prefix", None)
+        if lister is not None:
+            cands = [int(n) for n in lister(f"{self._ns}/rejoin/req")
+                     if str(n).isdigit()]
+        else:
+            cands = list(self.initial_ranks)
         reqs: Dict[str, dict] = {}
-        for r in self.initial_ranks:
+        for r in cands:
             if r in self.members:
                 continue
             try:
@@ -546,7 +616,8 @@ class ElasticCluster:
             return ()
         new_members = tuple(sorted(set(self.members) | set(cands)))
         new_epoch = self.epoch + 1
-        decided = self._decide_epoch(new_epoch, new_members)
+        decided = self._decide_epoch(new_epoch, new_members,
+                                     delta={"added": cands})
         if set(decided) != set(new_members):
             # a racing reconfiguration won this epoch number (only a stale
             # partitioned rank can race an admission — admission requires
@@ -576,10 +647,22 @@ class ElasticCluster:
             # re-evicts — an indefinite admit/evict thrash burning one
             # barrier timeout and two spurious epochs per health check
             self._transport.delete(f"{self._ns}/rejoin/req/{r}")
+        # a never-before-seen rank is a SCALE-UP, not a relaunch: record
+        # it in initial_ranks so a later relaunch of it stays admissible
+        # even on transports without list_prefix discovery
+        fresh = tuple(r for r in cands if r not in self.initial_ranks)
+        if fresh:
+            self.initial_ranks = tuple(
+                sorted(set(self.initial_ranks) | set(fresh)))
         self._commit(new_epoch, new_members)
         tr = _telemetry.get_tracer()
         if tr.enabled:
             tr.count("cluster.rejoins", len(cands))
+            if fresh:
+                tr.count("cluster.scale_ups", len(fresh))
+                tr.event("cluster.scale_up", epoch=new_epoch,
+                         ranks=",".join(map(str, fresh)),
+                         world=len(new_members))
             tr.event("cluster.admit", epoch=new_epoch,
                      admitted=",".join(map(str, cands)))
         try:
@@ -633,6 +716,11 @@ class ElasticCluster:
         tr = _telemetry.get_tracer()
         if tr.enabled:
             tr.count("cluster.rejoins")
+            if self.joining:
+                # the scale-up is counted on BOTH sides (like rejoins):
+                # a brand-new rank's own telemetry must show how it got
+                # here even when every original member has since churned
+                tr.count("cluster.scale_ups")
             tr.event("cluster.rejoin", epoch=self.epoch, rank=self.rank,
                      last_epoch=-1 if last_epoch is None else int(last_epoch))
         # the epoch barrier (seq 0 of the admitted epoch)
@@ -651,19 +739,27 @@ class ElasticCluster:
         fingerprint: str = "",
         step: Optional[int] = None,
         preempted: bool = False,
+        draining: bool = False,
     ) -> ElasticVerdict:
         """The per-check-interval member sync: any-rank-unhealthy, the
-        desync sentinel, preemption propagation — and the two membership
+        desync sentinel, preemption propagation — and the membership
         triggers. A member that never reaches the exchange is converted
         into a survivor-set reconfiguration (``reconfigured=True``, epoch
-        bumped, health data void for this sync); a pending rejoin request
-        (leader-polled, union-agreed) is admitted at an epoch barrier
-        (``admitted`` non-empty, epoch bumped). The caller must treat any
-        ``membership_changed`` verdict as a transition point: restamp the
-        plan epoch, reshard the pipeline, consensus-restore."""
+        bumped, health data void for this sync); a pending rejoin/join
+        request (leader-polled, union-agreed) is admitted at an epoch
+        barrier (``admitted`` non-empty, epoch bumped); a member
+        announcing ``draining=True`` (spot SIGTERM with a grace deadline,
+        `resilience.preempt`) triggers a **planned** shrink: the
+        survivors commit it at THIS sync instead of burning a
+        peer-timeout window against the kill, and the drainer's own
+        verdict (``self_draining``) tells it to save and exit. The caller
+        must treat any ``membership_changed`` verdict as a transition
+        point: restamp the plan epoch, reshard the pipeline,
+        consensus-restore."""
         epoch0, members0 = self.epoch, self.members
         payload = json.dumps({
             "ok": bool(ok), "fp": fingerprint, "pre": bool(preempted),
+            "drain": bool(draining),
             "rejoin": self._poll_rejoin_requests(),
         })
         try:
@@ -679,6 +775,28 @@ class ElasticCluster:
         unhealthy, fps, desync, any_pre = evaluate_health_views(
             self.members, views, step=step,
             scope=f"elastic (epoch {epoch0})")
+        drains = tuple(r for r, v in zip(members0, views)
+                       if v.get("drain"))
+        if drains and self.rank in drains:
+            # I announced the drain: the survivors commit the shrink
+            # among themselves (I am the dead set); my remaining duties
+            # are the emergency save and a clean exit for the supervisor
+            logger.warning(
+                "elastic: rank %d draining at step %s — survivors commit "
+                "the planned shrink; exiting after the emergency save",
+                self.rank, step)
+            return ElasticVerdict(
+                ok=not unhealthy and not desync,
+                unhealthy_ranks=unhealthy, desync=desync,
+                any_preempted=any_pre, fingerprints=fps,
+                epoch=self.epoch, members=self.members, drained=drains)
+        if drains:
+            # planned shrink: commit NOW — no timeout window, the 2PC
+            # runs over the survivors only (the drainer never proposes)
+            logger.warning(
+                "elastic: member(s) %s draining at step %s — committing "
+                "a planned shrink", list(drains), step)
+            self.reconfigure(drains)
         reqs: Dict[str, dict] = {}
         for v in views:
             reqs.update(v.get("rejoin") or {})
@@ -699,7 +817,8 @@ class ElasticCluster:
             unhealthy_ranks=unhealthy, desync=desync,
             any_preempted=any_pre, fingerprints=fps,
             epoch=self.epoch, members=self.members, admitted=admitted,
-            reconfigured=moved and not admitted, lost=lost)
+            reconfigured=moved and not admitted, lost=lost,
+            drained=drains)
 
     def consensus_restore_step(
         self, local_steps: Optional[Sequence[int]],

@@ -28,13 +28,23 @@ rank later presents to the rejoin protocol.
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import threading
+import time
 from typing import Optional
 
 logger = logging.getLogger("dear_pytorch_tpu")
 
-__all__ = ["PreemptionHandler"]
+__all__ = ["PreemptionHandler", "GRACE_ENV"]
+
+#: Known SIGTERM-to-SIGKILL grace window in seconds (spot/preemptible
+#: platforms publish one — e.g. 30s on GCE spot, 120s on TPU maintenance).
+#: When set, the handler stamps a wall-clock **deadline** at signal time;
+#: `remaining()` is the budget the emergency save and the planned-shrink
+#: announcement (`resilience.membership` ``draining=True``) must fit in —
+#: the loop budgets against it instead of racing the kill blind.
+GRACE_ENV = "DEAR_PREEMPT_GRACE_S"
 
 
 class PreemptionHandler:
@@ -42,7 +52,8 @@ class PreemptionHandler:
     `restore`). Thread-safe to poll from any thread; signals are only
     *delivered* to the main thread, which is where `install` must run."""
 
-    def __init__(self, signals=(signal.SIGTERM,)):
+    def __init__(self, signals=(signal.SIGTERM,),
+                 grace_s: Optional[float] = None):
         self._signals = tuple(signals)
         self._prev: dict = {}
         self._event = threading.Event()
@@ -56,11 +67,25 @@ class PreemptionHandler:
         #: (or observe a half-initialized module) — the handler may only
         #: call this pre-bound function (a weakref read)
         self._epoch_fn = None
+        #: the platform's SIGTERM->SIGKILL grace window: explicit arg wins,
+        #: else DEAR_PREEMPT_GRACE_S, else unknown (None). Resolved HERE —
+        #: not in the handler — so the signal path stays allocation-free.
+        if grace_s is None:
+            raw = os.environ.get(GRACE_ENV, "").strip()
+            grace_s = float(raw) if raw else None
+        self.grace_s = grace_s
+        #: monotonic deadline stamped by the (first) signal; None until it
+        #: arrives or when no grace window is configured
+        self.deadline_monotonic: Optional[float] = None
 
     # -- signal plumbing -----------------------------------------------------
 
     def _on_signal(self, signum, frame) -> None:  # noqa: ARG002
         self.count += 1
+        if self.deadline_monotonic is None and self.grace_s is not None:
+            # stamp BEFORE setting the flag: a poller that sees
+            # `requested` must be able to read a coherent deadline
+            self.deadline_monotonic = time.monotonic() + self.grace_s
         self._event.set()
         if self.epoch_at_signal is None and self._epoch_fn is not None:
             try:
@@ -71,9 +96,10 @@ class PreemptionHandler:
         # step boundary, on the training thread, where device state is
         # coherent
         logger.warning(
-            "preempt: received signal %d (count %d, membership epoch %s); "
-            "emergency checkpoint at the next step boundary", signum,
-            self.count, self.epoch_at_signal,
+            "preempt: received signal %d (count %d, membership epoch %s, "
+            "grace %s); emergency checkpoint at the next step boundary",
+            signum, self.count, self.epoch_at_signal,
+            "unknown" if self.grace_s is None else f"{self.grace_s:.0f}s",
         )
 
     def install(self) -> "PreemptionHandler":
@@ -110,10 +136,21 @@ class PreemptionHandler:
     def requested(self) -> bool:
         return self._event.is_set()
 
+    def remaining(self) -> Optional[float]:
+        """Seconds left in the platform's grace window (never negative);
+        None when no signal has arrived or no `DEAR_PREEMPT_GRACE_S` /
+        ``grace_s`` budget is configured. The emergency-save path logs it
+        and a drain announcement can size its sync wait against it."""
+        if self.deadline_monotonic is None:
+            return None
+        return max(self.deadline_monotonic - time.monotonic(), 0.0)
+
     def clear(self) -> None:
         """Acknowledge a handled preemption (tests; multi-phase loops that
-        checkpoint and keep going until the platform actually kills them)."""
+        checkpoint and keep going until the platform actually kills them).
+        The grace deadline re-arms with the next signal."""
         self._event.clear()
+        self.deadline_monotonic = None
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._event.wait(timeout)

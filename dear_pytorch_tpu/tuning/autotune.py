@@ -144,6 +144,7 @@ class AutoTuner:
         layer_times: Optional[Sequence[float]] = None,
         log: Callable[[str], None] = lambda s: None,
         clock=None,
+        tuner_seed: int = 0,
         **build_kwargs: Any,
     ):
         if strategy not in ("bo", "wait_time"):
@@ -164,7 +165,7 @@ class AutoTuner:
             kw = {} if clock is None else {"clock": clock}
             self.tuner: Optional[Tuner] = Tuner(
                 x=threshold_mb, bound=bound, max_num_steps=max_trials,
-                interval=interval, log=log, **kw,
+                interval=interval, log=log, seed=tuner_seed, **kw,
             )
             self.ts = D.build_train_step(
                 loss_fn, params_template, threshold_mb=threshold_mb,

@@ -113,9 +113,14 @@ class Tuner:
     def __init__(self, x: float = 25.0, bound: tuple[float, float] = (1.0, 256.0),
                  max_num_steps: int = 10, interval: int = 5,
                  log: Callable[[str], None] = print,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 seed: int = 0):
         self._current = float(x)
-        self._opt = BayesianOptimizer(bound)
+        # the trial RNG is PINNED per tuner (EI tie-break jitter + the
+        # cold-start draw): two runs of the same seed propose identical
+        # trial sequences, so tests assert on plan-rebuild behavior
+        # without loss-trajectory flake
+        self._opt = BayesianOptimizer(bound, seed=seed)
         self._max = max_num_steps
         if interval < 4:
             # the first 3 durations of each window are discarded, so a

@@ -51,7 +51,7 @@ import jax
 
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.parallel import dear as D
-from dear_pytorch_tpu.resilience.retry import retry_call
+from dear_pytorch_tpu.resilience.retry import RetryError, retry_call
 
 logger = logging.getLogger("dear_pytorch_tpu")
 
@@ -842,3 +842,352 @@ def _as_sequence(tree):
     if isinstance(tree, dict):
         return [tree[k] for k in sorted(tree, key=lambda s: int(s))]
     return list(tree)
+
+
+# ---------------------------------------------------------------------------
+# Durable remote tier: async checkpoint streaming to an object store
+# ---------------------------------------------------------------------------
+
+#: Remote key layout (under the store's root/prefix):
+#:   steps/<step:010d>/files/<relpath>   the step dir payload
+#:   steps/<step:010d>/sidecar.json      the local sidecar metadata
+#:   steps/<step:010d>/MANIFEST.json     written LAST — the commit marker
+#: A remote step EXISTS iff its manifest does (object stores have no
+#: rename; the last-written manifest is the atomic commit point).
+_REMOTE_STEPS = "steps"
+_REMOTE_MANIFEST = "MANIFEST.json"
+_REMOTE_SIDECAR = "sidecar.json"
+
+
+def _remote_step_key(step: int) -> str:
+    return f"{_REMOTE_STEPS}/{int(step):010d}"
+
+
+def remote_steps(store) -> list[int]:
+    """Committed remote steps, newest first — a step counts only once its
+    ``MANIFEST.json`` landed (it is uploaded last, so a crash mid-upload
+    leaves an invisible partial, never a restorable-looking torn step)."""
+    out = set()
+    for key in store.list(_REMOTE_STEPS):
+        parts = key.split("/")
+        if (len(parts) >= 3 and parts[-1] == _REMOTE_MANIFEST
+                and parts[1].isdigit()):
+            out.add(int(parts[1]))
+    return sorted(out, reverse=True)
+
+
+class CheckpointStreamer:
+    """Background uploader: stream committed step dirs to an object store.
+
+    The durable-tier half of the multi-tier retention contract
+    (docs/RESILIENCE.md "Autoscaling"):
+
+      - **every-step local** — the checkpoint directory keeps what the
+        guard's ``max_keep`` retention decides; nothing here touches it.
+      - **every-Nth remote** — `enqueue` uploads steps on the
+        ``upload_every`` cadence (upload bandwidth is the scarce resource
+        on a training host; N spreads it).
+      - **last-K pinned** — remote retention always keeps the newest
+        ``pin_last`` uploads; older uploads survive only on the
+        ``keep_every`` archive cadence (0 = prune them), bounding remote
+        spend for the life of the service.
+
+    Uploads run on ONE daemon thread off the training path: `enqueue` is
+    a queue put, the worker waits for the step to commit locally (async
+    saves land late), verifies the checksum manifest, uploads files →
+    sidecar → manifest (commit marker last), all under
+    `resilience.retry` backoff. **An exhausted retry never raises into
+    training**: it counts ``ckpt.upload_errors``, logs the fallback to
+    local-only retention for that step, and the worker moves on — a dead
+    bucket degrades durability, not the run. ``ckpt.uploads`` counts
+    committed uploads.
+
+    A fully-lost fleet (or a scale-from-zero cold start) restores from
+    the remote tier alone via `restore_from_object_store` — zero loss of
+    progress past the newest uploaded step.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        store,
+        *,
+        upload_every: int = 1,
+        pin_last: int = 2,
+        keep_every: int = 0,
+        attempts: int = 4,
+        base_delay_s: float = 0.1,
+        max_delay_s: float = 2.0,
+        commit_wait_s: float = 60.0,
+    ):
+        import queue
+        import threading
+
+        self.directory = directory
+        self._store = store
+        self.upload_every = max(int(upload_every), 1)
+        self.pin_last = max(int(pin_last), 1)
+        self.keep_every = max(int(keep_every), 0)
+        self._attempts = max(int(attempts), 1)
+        self._base_delay_s = float(base_delay_s)
+        self._max_delay_s = float(max_delay_s)
+        self._commit_wait_s = float(commit_wait_s)
+        self.uploaded: list[int] = []
+        self.failed: list[int] = []
+        self._q: "queue.Queue" = queue.Queue()
+        self._pending = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dear-ckpt-streamer")
+        self._thread.start()
+
+    # -- producer side (the training loop) -----------------------------------
+
+    def enqueue(self, step: int, *, force: bool = False) -> bool:
+        """Queue one committed (or committing) step for upload; returns
+        False when the step is off the remote cadence (``force=True``
+        bypasses the cadence — emergency saves must reach the durable
+        tier no matter where they land) or the streamer is closed. Never
+        blocks the training loop."""
+        step = int(step)
+        if self._closed or (not force and step % self.upload_every != 0):
+            return False
+        with self._cv:
+            self._pending += 1
+        self._q.put(step)
+        return True
+
+    def flush(self, timeout_s: Optional[float] = None) -> bool:
+        """Wait for every enqueued upload to finish (committed or given
+        up); True when the queue drained within the timeout."""
+        with self._cv:
+            return self._cv.wait_for(lambda: self._pending == 0,
+                                     timeout=timeout_s)
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Drain and stop the worker (call at training end; `flush` first
+        if the last upload must be durable)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.flush(timeout_s)
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "CheckpointStreamer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker side ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                self._upload(item)
+            except Exception:  # the worker must outlive any one upload
+                logger.exception(
+                    "checkpoint: unexpected streamer failure at step %s "
+                    "(local-only retention for it)", item)
+                self.failed.append(int(item))
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._cv.notify_all()
+
+    def _wait_local_commit(self, step: int) -> Optional[dict]:
+        """Block (bounded) until the step is committed AND verified
+        locally — an async save's dir appears only on commit, and an
+        unverifiable step must never become the durable tier's truth."""
+        import time
+
+        deadline = time.monotonic() + self._commit_wait_s
+        while True:
+            meta = read_sidecar(self.directory, step)
+            if (meta is not None
+                    and os.path.isdir(_ckpt_dir(self.directory, step))
+                    and verify_checkpoint(self.directory, step)):
+                return meta
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.1)
+
+    def _upload(self, step: int) -> None:
+        from dear_pytorch_tpu.observability import tracer as _telemetry
+
+        tr = _telemetry.get_tracer()
+        meta = self._wait_local_commit(step)
+        if meta is None:
+            logger.error(
+                "checkpoint: step %d never committed/verified locally "
+                "within %.0fs; not uploaded", step, self._commit_wait_s)
+            if tr.enabled:
+                tr.count("ckpt.upload_errors")
+                tr.event("ckpt.upload_error", step=step,
+                         why="local_commit_timeout")
+            self.failed.append(step)
+            return
+        step_dir = _ckpt_dir(self.directory, step)
+        # the sidecar manifest was just re-verified by _wait_local_commit
+        # — reuse it instead of sha256-hashing the whole step dir a
+        # second time (manifest-less sidecars — async saves before their
+        # finalize backfill — hash here once)
+        files = meta.get("manifest") or _build_manifest(step_dir)
+        base = _remote_step_key(step)
+
+        def _put():
+            for rel in sorted(files):
+                self._store.put_file(f"{base}/files/{rel}",
+                                     os.path.join(step_dir, rel))
+            self._store.put_bytes(f"{base}/{_REMOTE_SIDECAR}",
+                                  json.dumps(meta).encode())
+            # the commit marker goes LAST: a reader that sees it can
+            # trust every byte above it is fully written
+            self._store.put_bytes(
+                f"{base}/{_REMOTE_MANIFEST}",
+                json.dumps({"step": step, "files": files}).encode())
+
+        try:
+            retry_call(_put, name="ckpt.upload", attempts=self._attempts,
+                       base_delay_s=self._base_delay_s,
+                       max_delay_s=self._max_delay_s,
+                       retry_on=(OSError, KeyError))
+        except RetryError as exc:
+            # the durable tier is best-effort from the run's point of
+            # view: training continues on local-only retention and the
+            # next cadence step tries the store again
+            logger.error(
+                "checkpoint: upload of step %d exhausted its retry "
+                "budget (%s); falling back to LOCAL-ONLY retention for "
+                "it", step, exc)
+            if tr.enabled:
+                tr.count("ckpt.upload_errors")
+                tr.event("ckpt.upload_error", step=step, why="retry_exhausted")
+            self.failed.append(step)
+            return
+        self.uploaded.append(step)
+        logger.info("checkpoint: step %d uploaded to the remote tier", step)
+        if tr.enabled:
+            tr.count("ckpt.uploads")
+            tr.event("ckpt.upload", step=step, files=len(files))
+        self._prune_remote(step)
+
+    def _prune_remote(self, uploaded_step: int) -> None:
+        """Remote retention: newest ``pin_last`` uploads are pinned;
+        older ones survive only on the ``keep_every`` archive cadence.
+        Remote steps NUMERICALLY NEWER than the one just uploaded are an
+        abandoned timeline (uploads are chronological on the one worker
+        thread, so a smaller step number after a larger one proves a
+        consensus rollback happened in between) — they are pruned
+        unconditionally, mirroring `prune_future_steps` locally; leaving
+        them would hand a cold start dead-timeline state newer than
+        anything the live fleet holds."""
+        try:
+            steps = remote_steps(self._store)
+        except Exception:
+            return  # a listing error must not fail the upload that ran
+        stale = [s for s in steps if s > uploaded_step]
+        if stale:
+            logger.warning(
+                "checkpoint: pruning %d abandoned-timeline remote step(s) "
+                "%s after upload of step %d (post-rollback)", len(stale),
+                stale, uploaded_step)
+        live = [s for s in steps if s <= uploaded_step]
+        for s in stale + live[self.pin_last:]:
+            if (s <= uploaded_step and self.keep_every
+                    and s % self.keep_every == 0):
+                continue
+            try:
+                self._store.delete_prefix(_remote_step_key(s))
+            except Exception:
+                pass  # retention is best-effort; retried next upload
+
+
+def restore_from_object_store(store, directory: str,
+                              *, step: Optional[int] = None,
+                              ) -> Optional[int]:
+    """Cold-start restore: materialize the newest (or given) remote step
+    into ``directory`` so the ordinary local restore path
+    (`restore_checkpoint` / `elastic_restore` + sidecar reads) works on a
+    machine that has NEVER trained — a scale-from-zero start or a
+    fully-lost fleet. Every downloaded file is **re-hashed against the
+    remote manifest** (a bit-flip in the bucket or on the wire must not
+    become a poisoned restore); a corrupted remote step is walked past to
+    the next older one, exactly like the local corruption-fallback walk.
+    Returns the restored step (None when nothing restorable is remote).
+    Counts ``ckpt.remote_restores``."""
+    import shutil
+
+    from dear_pytorch_tpu.observability import tracer as _telemetry
+
+    tr = _telemetry.get_tracer()
+    candidates = remote_steps(store)
+    if step is not None:
+        candidates = [s for s in candidates if s == int(step)]
+    os.makedirs(directory, exist_ok=True)
+    for s in candidates:
+        base = _remote_step_key(s)
+        try:
+            manifest = json.loads(
+                store.get_bytes(f"{base}/{_REMOTE_MANIFEST}"))
+            meta = json.loads(store.get_bytes(f"{base}/{_REMOTE_SIDECAR}"))
+        except (KeyError, ValueError) as exc:
+            logger.error(
+                "checkpoint: remote step %d unreadable (%s); walking to "
+                "the previous upload", s, exc)
+            continue
+        if not manifest.get("files"):
+            # a manifest listing no files is not a checkpoint (torn or
+            # rewritten remote object): corrupt, walk past it
+            logger.error(
+                "checkpoint: remote step %d manifest lists no files; "
+                "walking to the previous upload", s)
+            if tr.enabled:
+                tr.event("ckpt.remote_corrupt", step=s, file="<manifest>")
+            continue
+        step_dir = _ckpt_dir(directory, s)
+        tmp = step_dir + _LOCAL_TMP_MARK  # swept by prune_orphaned_tmp
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp, exist_ok=True)
+        ok = True
+        for rel, ent in sorted(manifest.get("files", {}).items()):
+            dest = os.path.join(tmp, rel)
+            try:
+                store.get_file(f"{base}/files/{rel}", dest)
+            except KeyError:
+                ok = False
+            else:
+                ok = (os.path.getsize(dest) == ent["bytes"]
+                      and _file_digest(dest) == ent["sha256"])
+            if not ok:
+                logger.error(
+                    "checkpoint: remote step %d failed sha256 reverify on "
+                    "%s; walking to the previous upload", s, rel)
+                if tr.enabled:
+                    tr.event("ckpt.remote_corrupt", step=s, file=rel)
+                break
+        if not ok:
+            shutil.rmtree(tmp, ignore_errors=True)
+            continue
+        if os.path.isdir(step_dir):
+            shutil.rmtree(step_dir)
+        os.rename(tmp, step_dir)  # the local step dir appears atomically
+        if not meta.get("manifest"):
+            # an async save's sidecar may predate its manifest backfill;
+            # the remote manifest IS the verified truth now
+            meta["manifest"] = manifest.get("files", {})
+        _write_sidecar(directory, s, meta)
+        logger.warning(
+            "checkpoint: cold-start restored step %d from the remote "
+            "tier into %s", s, directory)
+        if tr.enabled:
+            tr.count("ckpt.remote_restores")
+            tr.event("ckpt.remote_restore", step=s)
+        return s
+    return None

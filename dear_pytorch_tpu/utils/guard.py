@@ -136,6 +136,7 @@ class GuardedTrainer:
         coordinator: Optional[Any] = None,
         pipeline: Optional[Any] = None,
         on_membership_change: Optional[Callable[[Any], None]] = None,
+        streamer: Optional[Any] = None,
     ):
         self.ts = ts
         self.directory = directory
@@ -168,6 +169,11 @@ class GuardedTrainer:
         # restored on rollback, and resharded on membership changes
         self._pipeline = pipeline
         self.on_membership_change = on_membership_change
+        # durable remote tier: a `ckpt.CheckpointStreamer` handed to the
+        # guard gets every committed save enqueued (emergency saves are
+        # additionally flushed inside the preemption grace budget). The
+        # caller owns the streamer's lifecycle; `finalize` only flushes.
+        self._streamer = streamer
         self._pending_reshard = False
         # run-health layer: flight ring (enabled alongside telemetry; see
         # the _flight property), anomaly detectors on the check cadence,
@@ -299,6 +305,17 @@ class GuardedTrainer:
             return ckpt.elastic_restore(self.directory, self.ts, step=step)
 
     @property
+    def _drain_on_preempt(self) -> bool:
+        """Should a SIGTERM become a single-rank planned shrink instead of
+        a fleet-wide preemption? Only coordinators that speak the drain
+        protocol (`ElasticCluster.supports_draining`) can; the env knob
+        keeps the full-fleet propagate semantics selectable."""
+        if not getattr(self._coordinator, "supports_draining", False):
+            return False
+        return os.environ.get("DEAR_PREEMPT_DRAIN", "").strip().lower() \
+            not in ("0", "false", "no", "off")
+
+    @property
     def _preempt_requested(self) -> bool:
         """Should this step act on a preemption? Coordinated runs act only
         once the signal has propagated through the health sync, so every
@@ -356,6 +373,11 @@ class GuardedTrainer:
             skip_tmp_step=(self._last_good_step
                            if self.async_checkpoints else None)
         )
+        if self._streamer is not None:
+            # remote tier: the streamer's worker waits for the local
+            # commit itself (async saves land late), so this is a queue
+            # put — nothing on the step path
+            self._streamer.enqueue(step)
         return True
 
     def _prune(self, skip_tmp_step: Optional[int] = None) -> None:
@@ -712,17 +734,26 @@ class GuardedTrainer:
             if healthy and metrics is not None:
                 fp = _cluster.ClusterCoordinator.fingerprint(
                     jax.device_get(metrics["loss"]))
+            pre_req = (self._preemption is not None
+                       and self._preemption.requested
+                       and not self._preempt_handled)
+            # elastic runs turn a SIGTERM into a single-rank PLANNED
+            # shrink (spot semantics: each reclaimed rank gets its own
+            # signal) instead of propagating full-fleet preemption;
+            # DEAR_PREEMPT_DRAIN=0 restores propagate-and-save-everywhere
+            drain = pre_req and self._drain_on_preempt
+            sync_kwargs = dict(
+                ok=local_ok, fingerprint=fp, step=self.steps_seen,
+                preempted=pre_req and not drain)
+            if drain:
+                sync_kwargs["draining"] = True
             try:
-                verdict = self._coordinator.health_check(
-                    ok=local_ok, fingerprint=fp, step=self.steps_seen,
-                    preempted=(self._preemption is not None
-                               and self._preemption.requested
-                               and not self._preempt_handled),
-                )
+                verdict = self._coordinator.health_check(**sync_kwargs)
                 membership_changed = bool(
                     getattr(verdict, "membership_changed", False))
                 if (self._aggregator is not None
-                        and not membership_changed):
+                        and not membership_changed
+                        and not getattr(verdict, "self_draining", False)):
                     # metric aggregation rides the same cadence (and the
                     # same bounded deadline): one lockstep digest exchange
                     # per health sync. Every rank computes the identical
@@ -730,7 +761,12 @@ class GuardedTrainer:
                     # Skipped across a membership transition: the member
                     # set just changed under the exchange, and a freshly
                     # admitted rank only enters the digest cadence at the
-                    # NEXT sync (after its consensus restore).
+                    # NEXT sync (after its consensus restore). Skipped by
+                    # a DRAINING rank too — the survivors are inside
+                    # their shrink rollback and will never join this
+                    # exchange; entering it would hang the drainer's
+                    # whole grace window and turn the clean drain into a
+                    # dirty crash (observed).
                     self.merged_health = self._aggregator.exchange()
             except _cluster.PeerTimeout:
                 # dead-peer detection: dump forensics (open spans + all
@@ -748,6 +784,18 @@ class GuardedTrainer:
                 raise
             if verdict.any_preempted:
                 self._peer_preempt = True
+            if getattr(verdict, "self_draining", False):
+                # the fleet acknowledged my drain announcement and is
+                # committing the planned shrink without me: emergency-save
+                # and exit inside the grace budget
+                self._peer_preempt = True
+                rem = (self._preemption.remaining()
+                       if self._preemption is not None else None)
+                logger.warning(
+                    "guard: drain acknowledged at step %d — planned shrink "
+                    "committed by the survivors (grace remaining: %s)",
+                    self.steps_seen,
+                    "unknown" if rem is None else f"{rem:.1f}s")
             if membership_changed:
                 # a committed transition (survivor shrink or rejoin
                 # admission) is a transition point: the loop rebuilds its
@@ -908,11 +956,38 @@ class GuardedTrainer:
             self._mem_epoch)
         return state, step
 
+    def _stream_emergency(self, step: int) -> None:
+        """Push an emergency save to the remote tier INSIDE the grace
+        budget: enqueue, then flush bounded by what remains of the
+        platform's SIGTERM->SIGKILL window (`DEAR_PREEMPT_GRACE_S`) — an
+        upload that can't finish in time must not stall the clean exit."""
+        if self._streamer is None:
+            return
+        rem = (self._preemption.remaining()
+               if self._preemption is not None else None)
+        budget = 10.0 if rem is None else max(min(rem - 1.0, 10.0), 0.5)
+        # force=True: the emergency save is the resume point no matter
+        # where it lands relative to the every-Nth upload cadence
+        self._streamer.enqueue(step, force=True)
+        if not self._streamer.flush(budget):
+            logger.error(
+                "guard: emergency upload of step %d did not finish inside "
+                "the %.1fs grace budget; the remote tier keeps the "
+                "previous upload", step, budget)
+
     def _emergency_save(self, state, metrics) -> Optional[int]:
         """Preemption checkpoint: synchronous, verified, at the current
         step — the grace window is short, so no async handoff. Returns the
-        persisted step (None when the state could not be verified)."""
+        persisted step (None when the state could not be verified). With
+        a known grace window (`DEAR_PREEMPT_GRACE_S`) the remaining
+        budget is logged and bounds the remote-tier flush."""
         tr = _telemetry.get_tracer()
+        rem = (self._preemption.remaining()
+               if self._preemption is not None else None)
+        if rem is not None:
+            logger.warning(
+                "guard: emergency save starting with %.1fs of the "
+                "preemption grace window remaining", rem)
         try:
             healthy = self._check(metrics)
         except Exception as exc:
@@ -938,6 +1013,7 @@ class GuardedTrainer:
                     # landing on a boundary must not vanish from telemetry
                     tr.count("guard.preempt_saves")
                     tr.event("guard.preempt_save", step=step)
+                self._stream_emergency(step)
                 return step
             # the newest async save may still be an UNCOMMITTED enqueue:
             # make it durable before claiming it as the resume point
@@ -958,6 +1034,7 @@ class GuardedTrainer:
                 if tr.enabled:
                     tr.count("guard.preempt_saves")
                     tr.event("guard.preempt_save", step=step)
+                self._stream_emergency(step)
                 return step
         else:
             try:
@@ -995,6 +1072,7 @@ class GuardedTrainer:
         if tr.enabled:
             tr.count("guard.preempt_saves")
             tr.event("guard.preempt_save", step=step)
+        self._stream_emergency(step)
         return step
 
     def finalize(self) -> None:
@@ -1007,6 +1085,10 @@ class GuardedTrainer:
         ckpt.wait_for_checkpoints()
         if self.async_checkpoints and self._last_good_step is not None:
             ckpt.write_manifest(self.directory, self._last_good_step)
+        if self._streamer is not None and not self._streamer.flush(30.0):
+            logger.error(
+                "guard: remote-tier uploads still pending at finalize; "
+                "the newest local checkpoint may not be durable remotely")
 
     def __enter__(self):
         return self

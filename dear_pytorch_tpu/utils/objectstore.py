@@ -1,0 +1,111 @@
+"""Object-store-shaped durable tier for checkpoint streaming.
+
+The resilience stack's checkpoints are only as durable as the disk they
+land on: per-host storage (``DEAR_CKPT_SHARED=0``) dies with the host,
+and even shared NFS dies with the filesystem. The continuous-training
+service (docs/RESILIENCE.md "Autoscaling") adds a **remote tier**: a
+background uploader (`utils.checkpoint.CheckpointStreamer`) streams
+committed step dirs to an object store, so a fully-lost fleet — or a
+scale-from-zero cold start — restores from the remote tier alone with
+zero loss past the newest uploaded step.
+
+This module defines the store *shape* and its local-directory reference
+implementation. The interface is deliberately the narrow waist every
+real object store offers (GCS/S3 semantics, no rename, no append):
+
+    put_bytes(key, data)     atomic whole-object write
+    get_bytes(key) -> bytes  whole-object read (KeyError when absent)
+    put_file(key, path)      upload one local file
+    get_file(key, dest)      download one object to a local path
+    list(prefix) -> [key]    every key under a prefix
+    delete_prefix(prefix)    best-effort recursive delete
+    exists(key) -> bool
+
+A production deployment implements the same seven methods over its
+bucket client; everything above the waist (manifest commit protocol,
+retry, sha256 reverify, retention) lives in `utils.checkpoint` and is
+backend-agnostic.
+
+`LocalObjectStore` maps keys to files under a root directory with
+tmp-then-``os.replace`` atomicity — a reader can never observe a torn
+object, which is what lets ``MANIFEST.json`` act as the per-step commit
+marker (a remote step exists iff its manifest does).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+
+__all__ = ["LocalObjectStore"]
+
+
+class LocalObjectStore:
+    """Local-directory object store (the GCS/S3 stand-in).
+
+    Keys are '/'-separated and mirror onto a directory tree so the store
+    stays human-debuggable (``ls`` the root to watch an upload land).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        parts = [p for p in key.split("/") if p not in ("", ".", "..")]
+        return os.path.join(self.root, *parts)
+
+    # -- the seven-method waist ----------------------------------------------
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # readers see the whole object or none
+
+    def get_bytes(self, key: str) -> bytes:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except OSError:
+            raise KeyError(key) from None
+
+    def put_file(self, key: str, path: str) -> None:
+        dest = self._path(key)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        tmp = f"{dest}.tmp.{os.getpid()}"
+        shutil.copyfile(path, tmp)
+        os.replace(tmp, dest)
+
+    def get_file(self, key: str, dest: str) -> None:
+        src = self._path(key)
+        if not os.path.isfile(src):
+            raise KeyError(key)
+        os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+        tmp = f"{dest}.tmp.{os.getpid()}"
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, dest)
+
+    def list(self, prefix: str) -> List[str]:
+        """Every committed key under ``prefix`` (in-flight tmp files
+        excluded), as full keys relative to the store root."""
+        base = self._path(prefix)
+        if not os.path.isdir(base):
+            return []
+        out = []
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in files:
+                if ".tmp." in fn:
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), self.root)
+                out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    def delete_prefix(self, prefix: str) -> None:
+        shutil.rmtree(self._path(prefix), ignore_errors=True)
+
+    def exists(self, key: str) -> bool:
+        return os.path.isfile(self._path(key))

@@ -1,34 +1,55 @@
 #!/usr/bin/env python3
-"""Elastic rank supervisor: launch N worker ranks, relaunch the dead ones.
+"""Elastic rank supervisor: launch N worker ranks, relaunch the dead ones,
+and — with a `ScalePolicy` — ride external capacity up and down.
 
 The resilience stack's division of labor (docs/RESILIENCE.md "Elastic
-membership"): `resilience.membership.ElasticCluster` decides WHO is in the
-fleet — survivors shrink the membership when a rank dies, and a relaunched
-rank rejoins at a later epoch — but something outside the job has to bring
-the dead rank BACK. On a real pod that is the cluster manager (k8s
-restartPolicy, GCE instance groups); this supervisor is the same contract
-for process clusters on one host, and the reference implementation of the
-**rejoin env contract** every relauncher must speak:
+membership" / "Autoscaling"): `resilience.membership.ElasticCluster`
+decides WHO is in the fleet — survivors shrink the membership when a rank
+dies, a relaunched rank rejoins at a later epoch, and a brand-new rank is
+admitted through the same barrier (scale-UP) — but something outside the
+job has to bring ranks up and down. On a real pod that is the cluster
+manager (k8s restartPolicy, GCE instance groups, a spot-pool API); this
+supervisor is the same contract for process clusters on one host, and the
+reference implementation of the **rejoin env contract** every relauncher
+must speak:
 
     DEAR_ELASTIC_DIR    FileTransport root — the coordination store that
                         outlives any single rank (never the jax
                         coordination service, which dies with process 0)
     DEAR_ELASTIC_RANK   the stable rank id (identity, not position)
-    DEAR_ELASTIC_WORLD  the initial world size
-    DEAR_ELASTIC_REJOIN "1" on a RELAUNCHED rank — the worker must come
-                        back through `ElasticCluster.rejoin` instead of
-                        assuming first-launch membership
+    DEAR_ELASTIC_WORLD  the initial world size (a scale-up rank's id is
+                        >= this — `ElasticCluster.from_env` joins)
+    DEAR_ELASTIC_REJOIN "1" on a RELAUNCHED or SCALE-UP rank — the worker
+                        must come back through `ElasticCluster.rejoin`
+                        instead of assuming first-launch membership
 
-Policy: a rank exiting 0 is finished and never relaunched; any other exit
-(including signals — a SIGKILLed host shows up here as -9) is relaunched
-with the rejoin flag after ``relaunch_delay_s``, up to ``max_relaunches``
-per rank. Per-rank pid files under ``<dir>/supervisor/pids/<rank>`` let
-chaos harnesses (scripts/chaos_check.py --elastic) target a specific rank.
+Policy: a rank exiting 0 is finished and never relaunched (unless it was
+being **drained** — then the scale policy may backfill it while capacity
+still wants the larger world); any other exit (including signals — a
+SIGKILLed host shows up here as -9) is relaunched with the rejoin flag
+after ``relaunch_delay_s``, within the per-rank **sliding-window budget**:
+at most ``max_relaunches`` relaunches per rank inside the trailing
+``relaunch_window_s`` seconds. With no window the budget degrades to the
+legacy per-rank lifetime cap — but a long-running continuous-training
+service exhausts any lifetime cap by design, so production runs should
+always set the window. Per-rank pid files under
+``<dir>/supervisor/pids/<rank>`` let chaos harnesses
+(scripts/chaos_check.py --elastic/--autoscale) target a specific rank.
+
+With ``--capacity-file`` the supervisor drives a
+`dear_pytorch_tpu.resilience.scale.ScalePolicy` each poll: a
+``target_world`` above the live world spawns new ranks (fresh ids beyond
+the initial world, admitted as scale-UP epochs), below it — or an explicit
+``drain`` list — SIGTERMs victims so `resilience.preempt`'s grace window
+(``DEAR_PREEMPT_GRACE_S``) turns the exit into an emergency save plus a
+*planned* membership shrink.
 
 Usage (also via ``launch/cpu_cluster.sh --elastic ...``)::
 
     python launch/supervisor.py --nprocs 3 --dir /tmp/elastic \
-        [--max-relaunches 2] [--deadline 300] -- python worker.py
+        [--max-relaunches 2] [--relaunch-window 600] \
+        [--capacity-file /tmp/capacity.json] [--deadline 300] \
+        -- python worker.py
 """
 
 from __future__ import annotations
@@ -47,6 +68,18 @@ ELASTIC_WORLD_ENV = "DEAR_ELASTIC_WORLD"
 ELASTIC_REJOIN_ENV = "DEAR_ELASTIC_REJOIN"
 
 
+def _import_scale():
+    """The policy lives in the package (`resilience.scale`) so its
+    counters are audited with everything else; the supervisor is runnable
+    from anywhere, so bootstrap the repo root onto sys.path first."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from dear_pytorch_tpu.resilience import scale
+
+    return scale
+
+
 class ElasticSupervisor:
     """Supervise one elastic process cluster on this host."""
 
@@ -58,7 +91,9 @@ class ElasticSupervisor:
         elastic_dir: str,
         env: Optional[dict] = None,
         max_relaunches: int = 2,
+        relaunch_window_s: Optional[float] = None,
         relaunch_delay_s: float = 0.5,
+        policy=None,
         log=lambda s: print(s, file=sys.stderr, flush=True),
     ):
         if nprocs < 1:
@@ -70,11 +105,20 @@ class ElasticSupervisor:
         self.elastic_dir = os.path.abspath(elastic_dir)
         self.base_env = dict(os.environ if env is None else env)
         self.max_relaunches = int(max_relaunches)
+        self.relaunch_window_s = (
+            None if relaunch_window_s is None else float(relaunch_window_s))
         self.relaunch_delay_s = float(relaunch_delay_s)
+        self.policy = policy
         self._log = log
         self._procs: Dict[int, subprocess.Popen] = {}
         self._final_rc: Dict[int, int] = {}   # rank -> exit of its LAST run
         self.relaunches: Dict[int, int] = {r: 0 for r in range(self.nprocs)}
+        self._relaunch_times: Dict[int, List[float]] = {}
+        self._draining: set = set()      # ranks SIGTERMed by the policy
+        self._backfill: List[int] = []   # drained ranks eligible to respawn
+        self._finished: set = set()      # ranks that completed cleanly
+        self._ever_ranks: set = set(range(self.nprocs))
+        self.events: List[tuple] = []    # (what, rank) policy/churn audit
         self._pid_dir = os.path.join(self.elastic_dir, "supervisor", "pids")
         os.makedirs(self._pid_dir, exist_ok=True)
 
@@ -91,10 +135,13 @@ class ElasticSupervisor:
             env.pop(ELASTIC_REJOIN_ENV, None)
         proc = subprocess.Popen(self.command, env=env)
         self._procs[rank] = proc
+        self._ever_ranks.add(rank)
+        self.relaunches.setdefault(rank, 0)
         with open(os.path.join(self._pid_dir, str(rank)), "w") as f:
             f.write(str(proc.pid))
         self._log(
-            f"supervisor: rank {rank} {'RELAUNCHED (rejoin)' if rejoin else 'launched'} "
+            f"supervisor: rank {rank} "
+            f"{'RELAUNCHED (rejoin)' if rejoin else 'launched'} "
             f"pid={proc.pid}")
 
     def start(self) -> "ElasticSupervisor":
@@ -106,31 +153,136 @@ class ElasticSupervisor:
         proc = self._procs.get(rank)
         return proc.pid if proc is not None else None
 
+    # -- relaunch budget -----------------------------------------------------
+
+    def _budget_ok(self, rank: int) -> bool:
+        """Per-rank sliding-window relaunch budget: at most
+        ``max_relaunches`` within the trailing ``relaunch_window_s``. With
+        no window, the legacy lifetime cap (which a long-running service
+        exhausts by design — prefer the window)."""
+        if self.relaunch_window_s is None:
+            return self.relaunches.get(rank, 0) < self.max_relaunches
+        now = time.monotonic()
+        times = [t for t in self._relaunch_times.get(rank, [])
+                 if now - t < self.relaunch_window_s]
+        self._relaunch_times[rank] = times
+        return len(times) < self.max_relaunches
+
+    def _relaunch(self, rank: int) -> None:
+        self.relaunches[rank] = self.relaunches.get(rank, 0) + 1
+        self._relaunch_times.setdefault(rank, []).append(time.monotonic())
+        self.events.append(("relaunch", rank))
+        time.sleep(self.relaunch_delay_s)
+        self._spawn(rank, rejoin=True)
+
+    # -- policy actions ------------------------------------------------------
+
+    def drain(self, rank: int) -> bool:
+        """Planned removal: SIGTERM so the worker's `PreemptionHandler`
+        turns the exit into an emergency save + a planned membership
+        shrink inside the grace window. A clean exit of a draining rank
+        is recorded for backfill, not treated as 'finished'."""
+        proc = self._procs.get(rank)
+        if proc is None:
+            return False
+        self._draining.add(rank)
+        self.events.append(("drain", rank))
+        self._log(f"supervisor: draining rank {rank} (SIGTERM, planned "
+                  "shrink inside the preemption grace window)")
+        try:
+            proc.send_signal(signal.SIGTERM)
+        except OSError:
+            return False
+        return True
+
+    def scale_up(self, count: int) -> List[int]:
+        """Spawn ``count`` additional ranks: drained ranks are backfilled
+        first (stable ids, bounded rank space), then fresh ids beyond
+        every rank ever used — admitted by the fleet as scale-UP epochs."""
+        spawned = []
+        for _ in range(max(int(count), 0)):
+            if self._backfill:
+                rank = self._backfill.pop(0)
+            else:
+                rank = max(self._ever_ranks) + 1
+            self.events.append(("scale_up", rank))
+            self._spawn(rank, rejoin=True)
+            spawned.append(rank)
+        return spawned
+
+    def _policy_tick(self) -> None:
+        if self.policy is None or not self._procs or self._finished:
+            # the policy scales a LIVE service: a fully-exited fleet is
+            # finished, not under-capacity — and the moment ANY rank
+            # completes cleanly (not drained) the job is wrapping up, so
+            # the policy stands down rather than "backfilling" completed
+            # work (observed: the fleet's staggered lockstep exits left a
+            # live<target window that spawned ghost ranks which then
+            # waited out their whole rejoin timeout against a dead fleet)
+            return
+        live = tuple(sorted(self._procs))
+        decision = self.policy.decide(
+            live_world=len(live), live_ranks=live,
+            draining=tuple(sorted(self._draining & set(live))))
+        if decision is None:
+            return
+        if decision.kind == "scale_up":
+            self.scale_up(decision.count)
+        else:  # "drain" / "scale_down"
+            for rank in decision.ranks:
+                self.drain(rank)
+
+    # -- the supervision loop ------------------------------------------------
+
     def poll(self) -> bool:
-        """One supervision pass: reap exits, relaunch failures. Returns
-        True while any rank is still running (or pending relaunch)."""
+        """One supervision pass: reap exits, relaunch failures, run the
+        scale policy. Returns True while any rank is still running (or
+        pending relaunch)."""
         for rank, proc in list(self._procs.items()):
             rc = proc.poll()
             if rc is None:
                 continue
             del self._procs[rank]
             self._final_rc[rank] = rc
+            if rank in self._draining:
+                self._draining.discard(rank)
+                if rc == 0:
+                    self._log(f"supervisor: rank {rank} drained cleanly; "
+                              "eligible for backfill")
+                    self.events.append(("drained", rank))
+                else:
+                    # a dirty drain (crash inside the grace window) is
+                    # still a DRAIN: the policy asked for this rank's
+                    # removal, so relaunching it would override the
+                    # capacity decision and burn its relaunch budget —
+                    # it stays out until the policy backfills it
+                    self._log(f"supervisor: draining rank {rank} exited "
+                              f"rc={rc} (dirty drain; not relaunched — "
+                              "eligible for backfill)")
+                    self.events.append(("drained_dirty", rank))
+                    self._final_rc[rank] = 0  # a requested removal is
+                    #                           not a job failure
+                self._backfill.append(rank)
+                continue
             if rc == 0:
                 self._log(f"supervisor: rank {rank} finished cleanly")
+                self._finished.add(rank)
                 continue
-            if self.relaunches[rank] >= self.max_relaunches:
+            if not self._budget_ok(rank):
+                window = ("lifetime" if self.relaunch_window_s is None
+                          else f"{self.relaunch_window_s:.0f}s window")
                 self._log(
                     f"supervisor: rank {rank} exited rc={rc}; relaunch "
-                    f"budget ({self.max_relaunches}) exhausted — giving up")
+                    f"budget ({self.max_relaunches} per {window}) "
+                    "exhausted — giving up")
                 continue
-            self.relaunches[rank] += 1
             self._log(
                 f"supervisor: rank {rank} exited rc={rc}; relaunching with "
                 f"{ELASTIC_REJOIN_ENV}=1 "
-                f"({self.relaunches[rank]}/{self.max_relaunches}) "
-                f"in {self.relaunch_delay_s:.1f}s")
-            time.sleep(self.relaunch_delay_s)
-            self._spawn(rank, rejoin=True)
+                f"({self.relaunches.get(rank, 0) + 1}/{self.max_relaunches})"
+                f" in {self.relaunch_delay_s:.1f}s")
+            self._relaunch(rank)
+        self._policy_tick()
         return bool(self._procs)
 
     def wait(self, deadline_s: Optional[float] = None, poll_s: float = 0.2,
@@ -171,9 +323,23 @@ def main(argv=None) -> int:
     ap.add_argument("--nprocs", type=int, required=True)
     ap.add_argument("--dir", required=True,
                     help="elastic coordination dir (FileTransport root)")
-    ap.add_argument("--max-relaunches", type=int, default=2,
-                    help="relaunch budget PER RANK (default 2)")
+    ap.add_argument("--relaunch-budget", "--max-relaunches",
+                    dest="relaunch_budget", type=int, default=2,
+                    help="relaunch budget PER RANK (default 2) — within "
+                         "--relaunch-window when set, else lifetime "
+                         "(--max-relaunches is the legacy alias)")
+    ap.add_argument("--relaunch-window", type=float, default=None,
+                    metavar="SECS",
+                    help="sliding window for the per-rank budget; unset = "
+                         "legacy lifetime cap (a long-running service "
+                         "should always set this)")
     ap.add_argument("--relaunch-delay", type=float, default=0.5)
+    ap.add_argument("--capacity-file", default=None,
+                    help="watched capacity-hint JSON (spot-pool stand-in); "
+                         "enables the ScalePolicy loop "
+                         "(DEAR_CAPACITY_FILE also works)")
+    ap.add_argument("--max-world", type=int, default=None,
+                    help="ScalePolicy ceiling on the fleet size")
     ap.add_argument("--deadline", type=float, default=None,
                     help="overall wall-clock budget in seconds")
     ap.add_argument("command", nargs=argparse.REMAINDER,
@@ -184,10 +350,17 @@ def main(argv=None) -> int:
         command = command[1:]
     if not command:
         ap.error("missing worker command (pass it after --)")
+    policy = None
+    capacity = args.capacity_file or os.environ.get("DEAR_CAPACITY_FILE")
+    if capacity:
+        policy = _import_scale().ScalePolicy(
+            capacity_file=capacity, max_world=args.max_world)
     sup = ElasticSupervisor(
         args.nprocs, command, elastic_dir=args.dir,
-        max_relaunches=args.max_relaunches,
+        max_relaunches=args.relaunch_budget,
+        relaunch_window_s=args.relaunch_window,
         relaunch_delay_s=args.relaunch_delay,
+        policy=policy,
     ).start()
     try:
         return sup.wait(args.deadline)

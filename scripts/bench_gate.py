@@ -1,4 +1,5 @@
-"""Bench-regression gate: fail CI on a throughput regression.
+"""Bench-regression gate: fail CI on a throughput regression or a broken
+service-level objective.
 
 Compares a fresh `bench.py` contract JSON against a pinned baseline and
 exits nonzero when any shared metric regressed by more than the
@@ -8,6 +9,15 @@ artifact into an automated check:
   python bench.py > /tmp/fresh.json
   python scripts/bench_gate.py --baseline BENCH_r05.json \
       --run /tmp/fresh.json --tolerance 0.05
+
+``--slo METRIC=MIN`` (repeatable) additionally enforces an **absolute
+floor** on a run metric — the continuous-training service contract
+("N steps/hour despite churn", scripts/chaos_check.py --autoscale) is a
+floor, not a ratio, so it gates independently of any baseline; with only
+``--slo`` flags the baseline may be omitted entirely:
+
+  python scripts/bench_gate.py --run /tmp/autoscale.json \
+      --slo steps_per_hour=120
 
 Both files may be either the raw contract line (``{"metric", "value",
 "extra_metrics": [...]}``) or the driver's round record (``{"parsed":
@@ -68,9 +78,11 @@ def _load(path: str) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="fail on bench throughput regressions vs a baseline")
-    ap.add_argument("--baseline", required=True,
-                    help="pinned bench JSON (contract line or BENCH_r*.json)")
+        description="fail on bench throughput regressions vs a baseline "
+                    "and/or broken absolute SLO floors")
+    ap.add_argument("--baseline", default=None,
+                    help="pinned bench JSON (contract line or BENCH_r*.json);"
+                         " optional when gating only --slo floors")
     ap.add_argument("--run", required=True,
                     help="fresh bench JSON to gate")
     ap.add_argument("--tolerance", type=float, default=0.05,
@@ -79,24 +91,51 @@ def main(argv=None) -> int:
                     help="comma list restricting which metrics to compare")
     ap.add_argument("--allow-missing", action="store_true",
                     help="metrics the run lost vs the baseline only warn")
+    ap.add_argument("--slo", action="append", default=[],
+                    metavar="METRIC=MIN",
+                    help="absolute floor for a run metric (repeatable); "
+                         "a missing metric fails the gate — a service "
+                         "that stopped reporting its SLO is down, not "
+                         "quiet")
     args = ap.parse_args(argv)
 
     # stdlib-only import path: anomaly.py never touches jax
     from dear_pytorch_tpu.observability import anomaly as A
 
+    slos = {}
+    for spec in args.slo:
+        name, _, floor = spec.partition("=")
+        try:
+            slos[name.strip()] = float(floor)
+        except ValueError:
+            print(json.dumps({"ok": False,
+                              "error": f"bad --slo {spec!r} (METRIC=MIN)"}))
+            return 3
+    if args.baseline is None and not slos:
+        ap.error("pass --baseline, --slo, or both")
+
     try:
-        baseline, run = _load(args.baseline), _load(args.run)
-        if args.metrics:
-            keep = {m.strip() for m in args.metrics.split(",") if m.strip()}
+        run = _load(args.run)
+        run_metrics = A.bench_metrics(run)
+        if args.baseline is not None:
+            baseline = _load(args.baseline)
+            if args.metrics:
+                keep = {m.strip() for m in args.metrics.split(",")
+                        if m.strip()}
 
-            def restrict(doc):
-                flat = A.bench_metrics(doc)
-                return {"extra_metrics": [
-                    {"metric": k, "value": v}
-                    for k, v in flat.items() if k in keep]}
+                def restrict(doc):
+                    flat = A.bench_metrics(doc)
+                    return {"extra_metrics": [
+                        {"metric": k, "value": v}
+                        for k, v in flat.items() if k in keep]}
 
-            baseline, run = restrict(baseline), restrict(run)
-        verdict = A.compare_bench(baseline, run, tolerance=args.tolerance)
+                baseline, run = restrict(baseline), restrict(run)
+            verdict = A.compare_bench(baseline, run,
+                                      tolerance=args.tolerance)
+        else:
+            verdict = {"ok": True, "tolerance": args.tolerance,
+                       "regressions": [], "improvements": [], "parity": [],
+                       "missing": [], "new": []}
     except (OSError, ValueError) as exc:
         print(json.dumps({"ok": False,
                           "error": f"{type(exc).__name__}: {exc}"}))
@@ -104,6 +143,16 @@ def main(argv=None) -> int:
     if args.allow_missing and verdict["missing"] \
             and not verdict["regressions"]:
         verdict["ok"] = True
+    # absolute SLO floors gate on the RUN alone. NOT-above-floor (rather
+    # than below-floor) so a NaN metric FAILS: a service reporting NaN
+    # for its SLO is broken, not healthy.
+    verdict["slo_violations"] = []
+    for name, floor in sorted(slos.items()):
+        value = run_metrics.get(name)
+        if value is None or not (value >= floor):
+            verdict["slo_violations"].append(
+                {"metric": name, "floor": floor, "run": value})
+            verdict["ok"] = False
     print(json.dumps(verdict))
     if not verdict["ok"]:
         lines = [f"  {r['metric']}: {r['run']:g} vs baseline "
@@ -111,8 +160,11 @@ def main(argv=None) -> int:
                  for r in verdict["regressions"]]
         lines += [f"  {m}: missing from the run"
                   for m in verdict["missing"]]
-        sys.stderr.write("bench_gate: REGRESSION beyond "
-                         f"{args.tolerance:.0%} tolerance:\n"
+        lines += [f"  {v['metric']}: "
+                  + ("missing" if v["run"] is None else f"{v['run']:g}")
+                  + f" below SLO floor {v['floor']:g}"
+                  for v in verdict["slo_violations"]]
+        sys.stderr.write("bench_gate: REGRESSION/SLO failure:\n"
                          + "\n".join(lines) + "\n")
         return 2
     return 0

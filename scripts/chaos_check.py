@@ -657,6 +657,516 @@ def run_elastic(nprocs: int, checkpoint_every: int,
     return summary
 
 
+def _load_harness():
+    import importlib.util
+
+    eh_spec = importlib.util.spec_from_file_location(
+        "dear_elastic_harness",
+        os.path.join(REPO, "tests", "elastic_harness.py"))
+    EH = importlib.util.module_from_spec(eh_spec)
+    eh_spec.loader.exec_module(EH)
+    return EH
+
+
+def _newest_remote_store(remote_root: str, *, skip_rank=None):
+    """The replica store holding the newest committed upload (states are
+    replica-identical across ranks, so any store hydrates any rank)."""
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    best, best_step = None, -1
+    try:
+        names = sorted(os.listdir(remote_root))
+    except OSError:
+        return None, None
+    for name in names:
+        if skip_rank is not None and name == f"rank{skip_rank}":
+            continue
+        store = LocalObjectStore(os.path.join(remote_root, name))
+        steps = ckpt.remote_steps(store)
+        if steps and steps[0] > best_step:
+            best, best_step = store, steps[0]
+    return best, (best_step if best is not None else None)
+
+
+def run_worker_autoscale(checkpoint_every: int, workdir: str) -> dict:
+    """One rank of the AUTOSCALE storm (spawned — possibly mid-run, as a
+    scale-up or backfill — by `launch/supervisor.py` under the rejoin env
+    contract). Mirrors `run_worker_elastic` plus the continuous-training
+    service pieces: a `PreemptionHandler` with the spot grace window (a
+    policy drain SIGTERM becomes an emergency save + planned shrink), a
+    `CheckpointStreamer` uploading every committed checkpoint to this
+    rank's object store, and — for a scale-from-zero spawn with no local
+    checkpoints — hydration from a fleet replica's remote tier before the
+    consensus restore. The loop runs until membership epoch
+    ``DEAR_CHAOS_AUTO_EPOCHS`` commits, plus a lockstep runout."""
+    import json
+
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    os.environ["DEAR_CKPT_SHARED"] = "0"
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(4, scrub_env=True)
+
+    import jax
+    import numpy as np
+
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.resilience import PreemptionHandler
+    from dear_pytorch_tpu.resilience import membership as M
+    from dear_pytorch_tpu.runtime import build as RB
+    from dear_pytorch_tpu.runtime import pipeline as P
+    from dear_pytorch_tpu.tuning.autotune import AutoTuner
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    EH = _load_harness()
+    cluster = M.ElasticCluster.from_env(max_candidates=256)
+    rejoining = M.ElasticCluster.rejoining_by_env()
+    rank = cluster.rank
+    kr, ke, kx = os.environ["DEAR_CHAOS_AUTO_KILL"].split(":")
+    kill = (int(kr), int(ke), int(kx))
+    target_epoch = int(os.environ.get("DEAR_CHAOS_AUTO_EPOCHS", "5"))
+    post = int(os.environ.get("DEAR_CHAOS_AUTO_POST", "3"))
+    remote_root = os.environ["DEAR_CHAOS_REMOTE"]
+    ckpt_dir = os.path.join(workdir, f"rank{rank}", "ckpts")
+    tracer = T.get_tracer()
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:min(cluster.world, 3)]), ("dp",))
+    tuner = AutoTuner(
+        _loss_fn, params, strategy="bo", threshold_mb=0.0008,
+        interval=10**9, mesh=mesh, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    # batch rows divide every world this storm visits (2 and 3)
+    spec = P.SyntheticSpec((
+        P.Field("x", (12, 12), RB.KIND_NORMAL_F32, 0.0, 1.0),
+    ))
+    pipe = P.NumpyPipeline(spec, seed=123, shard=cluster.index,
+                           num_shards=cluster.world)
+    store = LocalObjectStore(os.path.join(remote_root, f"rank{rank}"))
+    streamer = ckpt.CheckpointStreamer(
+        ckpt_dir, store, upload_every=1, pin_last=4)
+    pre = PreemptionHandler().install()
+    guard = GuardedTrainer(
+        tuner.ts, ckpt_dir, params,
+        check_every=1, checkpoint_every=checkpoint_every, max_keep=1000,
+        max_recoveries=8, coordinator=cluster, pipeline=pipe,
+        preemption=pre, streamer=streamer,
+    )
+    EH.attach_elastic(guard, tuner)
+    rollback_steps = []
+    guard.on_rollback = lambda c, at: rollback_steps.append(at)
+
+    resumed_at = last_epoch = None
+    if rejoining:
+        hydrate, _ = _newest_remote_store(remote_root, skip_rank=rank)
+        state, resumed_at, last_epoch = EH.reenter(
+            cluster, tuner, guard, ckpt_dir, hydrate_store=hydrate)
+    else:
+        state = tuner.init(params)
+
+    state, m = EH.run_autoscale_loop(
+        cluster, guard, pipe, state,
+        lambda i: _data(jax.random.PRNGKey(100 + i), n=12),
+        rejoining=rejoining, target_epoch=target_epoch, post=post,
+        kill=kill)
+    drained = bool(m.get("preempted"))
+    streamer.flush(20.0)
+    streamer.close()
+    counters = tracer.counters()
+    verdict = {
+        "rank": rank,
+        "pid": os.getpid(),
+        "rejoined": bool(rejoining),
+        "scale_up_join": bool(cluster.joining),
+        "drained": drained,
+        "grace_remaining": pre.remaining(),
+        "epoch": cluster.epoch,
+        "members": list(cluster.members),
+        "resumed_at": resumed_at,
+        "rollback_steps": rollback_steps,
+        "final_step": int(jax.device_get(state.step)),
+        "final_loss": float(m.get("loss", float("nan"))),
+        "steps_seen": guard.steps_seen,
+        "plan_world": guard.ts.plan.world,
+        "plan_epoch": guard.ts.plan.epoch,
+        "pipe_shard": [pipe.shard, pipe.num_shards],
+        "uploaded": sorted(streamer.uploaded),
+        "upload_failed": sorted(streamer.failed),
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("cluster.", "guard.", "pipeline.",
+                                      "autotune.", "ckpt."))},
+    }
+    if not drained:
+        # the lockstep verdict is itself a member-scoped collective; a
+        # drained rank exits OUTSIDE the lockstep and skips it
+        views = cluster.exchange("chaos.verdict", json.dumps(
+            [verdict["final_step"], round(verdict["final_loss"], 9),
+             verdict["epoch"]]))
+        verdict["lockstep"] = all(
+            json.loads(v) == json.loads(views[0]) for v in views)
+    path = os.path.join(workdir, f"verdict_rank{rank}.{os.getpid()}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(verdict, f)
+    os.replace(path + ".tmp", path)
+    print(f"CHAOS_AUTO rank={rank} " + json.dumps(verdict), flush=True)
+    return verdict
+
+
+def run_cold_start(workdir: str) -> dict:
+    """Scale-from-zero restore gate: on a machine with NO local
+    checkpoints, restore from the remote tier alone (sha256-reverified
+    download), land exactly on the newest uploaded step, and train one
+    live step on the restored state."""
+    import json
+
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(4, scrub_env=True)
+
+    import jax
+    import numpy as np
+
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.resilience.membership import MembershipView
+    from dear_pytorch_tpu.tuning.autotune import AutoTuner
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+
+    failures: list[str] = []
+    remote_root = os.environ["DEAR_CHAOS_REMOTE"]
+    store, newest = _newest_remote_store(remote_root)
+    _check(store is not None, "a remote tier with uploads exists", failures)
+    local = os.path.join(workdir, "cold", "ckpts")
+    step = ckpt.restore_from_object_store(store, local)
+    _check(step == newest,
+           f"cold start restored the NEWEST uploaded step ({newest}); "
+           f"got {step}", failures)
+    _check(step is not None and ckpt.verify_checkpoint(local, step),
+           "downloaded checkpoint passes local checksum verification",
+           failures)
+    meta = ckpt.read_sidecar(local, step) or {}
+    desc = meta.get("plan_desc") or {}
+    world = int(desc.get("world", 1))
+    epoch = int(desc.get("epoch", 0))
+    _check(ckpt.read_pipeline_state(local, step) is not None,
+           "the remote sidecar carries the pipeline position", failures)
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:world]), ("dp",))
+    tuner = AutoTuner(
+        _loss_fn, params, strategy="bo", threshold_mb=0.0008,
+        interval=10**9, mesh=mesh, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    if epoch:
+        tuner.rescale(MembershipView(
+            epoch=epoch, members=tuple(range(world)), rank=0, index=0,
+            world=world))
+    try:
+        state = ckpt.restore_checkpoint(local, tuner.ts, step=step,
+                                        template=tuner.init(params))
+    except ckpt.PlanMismatchError:
+        state = ckpt.elastic_restore(local, tuner.ts, step=step)
+    _check(int(jax.device_get(state.step)) == step,
+           "restored state sits exactly at the uploaded step "
+           "(zero loss of progress past the remote tier)", failures)
+    state, m = tuner.step(state, _data(jax.random.PRNGKey(999), n=12))
+    _check(np.isfinite(float(m["loss"])),
+           "cold-started state trains a live step", failures)
+    counters = T.get_tracer().counters()
+    verdict = {
+        "passed": not failures,
+        "restored_step": step,
+        "newest_uploaded": newest,
+        "plan_world": world,
+        "plan_epoch": epoch,
+        "remote_restores": counters.get("ckpt.remote_restores", 0),
+        "failures": failures,
+    }
+    path = os.path.join(workdir, "cold_verdict.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(verdict, f)
+    os.replace(path + ".tmp", path)
+    print("CHAOS_COLD " + json.dumps(verdict), flush=True)
+    return verdict
+
+
+def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
+    """Parent of the autoscale storm — the continuous-training-service
+    acceptance gate. A 2-rank supervised fleet:
+
+      1. streams checkpoints to its per-rank object stores, then receives
+         a capacity-UP hint (watched capacity file -> `ScalePolicy`) —
+         the supervisor spawns a brand-new rank 2 and the fleet commits a
+         scale-UP epoch (e1, signed +[2] in the decision record);
+      2. rank 1 is SIGKILLed (abrupt loss -> e2 shrink), relaunched by
+         the sliding-window budget, and readmitted (e3);
+      3. the capacity file drains rank 0 (spot-style SIGTERM): planned
+         shrink inside the preemption grace window (e4), then the policy
+         backfills it while capacity still wants world 3 (e5);
+      4. the fleet finishes in lockstep at epoch 5; the gate then
+         machine-checks the steps-per-hour SLO through
+         `scripts/bench_gate.py --slo`, asserts zero loss of progress
+         past the newest uploaded checkpoint, and spawns a scale-from-
+         zero cold-start worker that restores from the remote tier alone.
+
+    The parent stays jax-free: it watches the durable decision records
+    (`{ns}/decided/e*` — the signed world-delta commits) to sequence its
+    phases, exactly as an external operator would."""
+    import importlib.util
+    import subprocess
+    import tempfile
+    import time
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_auto_")
+    elastic_dir = os.path.join(workdir, "elastic")
+    remote_root = os.path.join(workdir, "remote")
+    os.makedirs(remote_root, exist_ok=True)
+    capacity = os.path.join(workdir, "capacity.json")
+
+    def write_capacity(doc):
+        with open(capacity + ".tmp", "w") as f:
+            json.dump(doc, f)
+        os.replace(capacity + ".tmp", capacity)
+
+    write_capacity({"target_world": 2})
+
+    spec = importlib.util.spec_from_file_location(
+        "dear_launch_supervisor",
+        os.path.join(REPO, "launch", "supervisor.py"))
+    sup_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sup_mod)
+    from dear_pytorch_tpu.resilience.scale import ScalePolicy
+
+    kill_rank, drain_rank, target_epoch, post = 1, 0, 5, 3
+    env = dict(os.environ)
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_FLIGHT"] = "8"
+    env["DEAR_CHAOS_AUTO_KILL"] = f"{kill_rank}:1:2"  # after the scale-up
+    env["DEAR_CHAOS_AUTO_EPOCHS"] = str(target_epoch)
+    env["DEAR_CHAOS_AUTO_POST"] = str(post)
+    env["DEAR_CHAOS_REMOTE"] = remote_root
+    env["DEAR_PREEMPT_GRACE_S"] = "30"
+    # a peer's post-transition XLA recompile must not read as a death
+    env.setdefault("DEAR_CLUSTER_TIMEOUT_SECS", "30")
+    policy = ScalePolicy(capacity_file=capacity, hysteresis_s=0.5,
+                         max_world=3)
+    sup = sup_mod.ElasticSupervisor(
+        2,
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--autoscale", "--checkpoint-every", str(checkpoint_every),
+         "--workdir", workdir],
+        elastic_dir=elastic_dir, env=env,
+        max_relaunches=2, relaunch_window_s=120.0, policy=policy,
+    ).start()
+
+    decided_dir = os.path.join(elastic_dir, "dearel", "elastic", "decided")
+
+    def decided(n):
+        try:
+            with open(os.path.join(decided_dir, f"e{n}")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    t0 = time.monotonic()
+    deadline = t0 + 420.0
+    phase, rc = 0, None
+    while True:
+        alive = sup.poll()
+        now = time.monotonic()
+        if not alive:
+            break
+        if now >= deadline:
+            sup.kill_all()
+            rc = 124
+            break
+        if phase == 0 and _newest_remote_store(remote_root)[0] is not None:
+            # the fleet is streaming checkpoints: capacity-UP hint
+            write_capacity({"target_world": 3})
+            phase = 1
+        elif phase == 1 and decided(3) is not None:
+            # scale-up (e1), SIGKILL shrink (e2), and rejoin (e3) all
+            # committed: now the spot-style drain of rank 0
+            write_capacity({"target_world": 3, "drain": [drain_rank]})
+            phase = 2
+        time.sleep(0.1)
+    elapsed_s = time.monotonic() - t0
+    if rc is None:
+        bad = {r: c for r, c in sup._final_rc.items() if c != 0}
+        rc = 1 if bad else 0
+
+    failures: list[str] = []
+    _check(rc == 0, f"supervisor fleet exits clean (got rc={rc})", failures)
+    _check(sup.relaunches.get(kill_rank) == 1,
+           f"the SIGKILLed rank was relaunched once within its window "
+           f"budget ({sup.relaunches})", failures)
+    kinds = [d.kind for d in policy.decisions]
+    _check(kinds.count("scale_up") >= 2 and "drain" in kinds,
+           f"policy decided capacity-up, drain, and backfill ({kinds})",
+           failures)
+    _check(("drained", drain_rank) in sup.events,
+           f"rank {drain_rank} drained CLEANLY on SIGTERM "
+           f"(events {sup.events})", failures)
+
+    # the signed world-delta decision records tell the capacity story
+    expect_delta = {
+        1: {"added": [2], "removed": []},
+        2: {"added": [], "removed": [kill_rank]},
+        3: {"added": [kill_rank], "removed": []},
+        4: {"added": [], "removed": [drain_rank]},
+        5: {"added": [drain_rank], "removed": []},
+    }
+    for e, want in expect_delta.items():
+        rec = decided(e)
+        _check(isinstance(rec, dict) and rec.get("delta") == want,
+               f"decision record e{e} carries the signed delta {want} "
+               f"(got {rec})", failures)
+    rec5 = decided(5)
+    _check(isinstance(rec5, dict) and rec5.get("members") == [0, 1, 2],
+           f"epoch-5 record commits the full world ({rec5})", failures)
+
+    # newest verdict per rank (churned ranks write one per life)
+    lives: dict[int, list] = {}
+    for name in sorted(os.listdir(workdir)):
+        if not (name.startswith("verdict_rank") and name.endswith(".json")):
+            continue
+        with open(os.path.join(workdir, name)) as f:
+            v = json.load(f)
+        lives.setdefault(int(v["rank"]), []).append(
+            (os.path.getmtime(os.path.join(workdir, name)), v))
+    finals = {r: sorted(vs)[-1][1] for r, vs in lives.items()}
+    summary = {"passed": False, "workdir": workdir, "rc": rc,
+               "elapsed_s": round(elapsed_s, 1),
+               "policy_decisions": kinds, "finals": finals,
+               "failures": failures}
+    if sorted(finals) != [0, 1, 2]:
+        failures.append(f"expected final verdicts from ranks 0-2, got "
+                        f"{sorted(finals)}")
+        return summary
+
+    for r, v in sorted(finals.items()):
+        _check(v["epoch"] == target_epoch
+               and v["members"] == [0, 1, 2],
+               f"rank {r} ends at epoch {target_epoch}, full membership "
+               f"(epoch {v['epoch']}, members {v['members']})", failures)
+        _check(v.get("lockstep"), f"rank {r} finished in lockstep",
+               failures)
+        _check(v["plan_world"] == 3 and v["plan_epoch"] == target_epoch,
+               f"rank {r} trains the rescaled epoch-stamped plan "
+               f"(world {v['plan_world']}, epoch {v['plan_epoch']})",
+               failures)
+        _check(v["pipe_shard"][1] == 3,
+               f"rank {r} pipeline resharded over the full membership",
+               failures)
+        _check(bool(v["uploaded"]) and not v["upload_failed"],
+               f"rank {r} streamed checkpoints to its remote tier "
+               f"({v['uploaded']}, failed {v['upload_failed']})", failures)
+    # the scale-up admission is visible in the first-life counters of the
+    # original members, and as cluster.scale_ups on at least one of them
+    merged: dict = {}
+    for vs in lives.values():
+        for _t, v in vs:
+            for k, n in v.get("counters", {}).items():
+                merged[k] = merged.get(k, 0) + n
+    _check(merged.get("cluster.scale_ups", 0) >= 1,
+           f"a scale-UP admission was counted (cluster.scale_ups="
+           f"{merged.get('cluster.scale_ups', 0)})", failures)
+    _check(merged.get("cluster.reconfigs", 0) >= 2,
+           "both shrinks (SIGKILL + planned drain) committed", failures)
+    _check(merged.get("cluster.rejoins", 0) >= 3,
+           "scale-up, relaunch, and backfill admissions all counted",
+           failures)
+    _check(merged.get("ckpt.uploads", 0) >= 3,
+           f"checkpoint streaming uploaded throughout "
+           f"(ckpt.uploads={merged.get('ckpt.uploads', 0)})", failures)
+    fresh_life = [v for vs in lives.values() for _t, v in vs
+                  if v.get("scale_up_join")]
+    _check(bool(fresh_life),
+           "the brand-new rank hydrated from the remote tier and joined "
+           "with no sidecar epoch", failures)
+    drained_life = [v for vs in lives.values() for _t, v in vs
+                    if v.get("drained")]
+    _check(len(drained_life) == 1
+           and drained_life[0]["rank"] == drain_rank
+           and (drained_life[0]["grace_remaining"] or 0) > 0,
+           "exactly the drained rank exited via the planned-shrink path "
+           "inside its grace window", failures)
+
+    # zero loss of progress past the newest uploaded checkpoint
+    _, newest_uploaded = _newest_remote_store(remote_root)
+    final_step = finals[0]["final_step"]
+    _check(newest_uploaded is not None
+           and final_step >= newest_uploaded,
+           f"final step {final_step} >= newest uploaded checkpoint "
+           f"{newest_uploaded} (zero loss past the remote tier)", failures)
+
+    # the machine-checked service contract: steps/hour despite churn,
+    # through the bench gate's absolute SLO floor
+    slo_floor = float(os.environ.get("DEAR_CHAOS_SLO_STEPS_PER_HOUR", "50"))
+    steps_per_hour = final_step * 3600.0 / max(elapsed_s, 1e-9)
+    run_json = os.path.join(workdir, "autoscale_contract.json")
+    with open(run_json, "w") as f:
+        json.dump({"metric": "steps_per_hour",
+                   "value": round(steps_per_hour, 2),
+                   "extra_metrics": [
+                       {"metric": "final_step", "value": final_step},
+                       {"metric": "ckpt_uploads",
+                        "value": merged.get("ckpt.uploads", 0)},
+                   ]}, f)
+    gate_spec = importlib.util.spec_from_file_location(
+        "dear_bench_gate", os.path.join(REPO, "scripts", "bench_gate.py"))
+    gate = importlib.util.module_from_spec(gate_spec)
+    gate_spec.loader.exec_module(gate)
+    gate_rc = gate.main(["--run", run_json,
+                         "--slo", f"steps_per_hour={slo_floor}"])
+    _check(gate_rc == 0,
+           f"bench_gate --slo holds the steps/hour contract "
+           f"({steps_per_hour:.0f}/h vs floor {slo_floor:.0f}/h)", failures)
+
+    # scale-from-zero: a machine with NO local state restores from the
+    # remote tier alone
+    cold = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--cold-start", "--workdir", workdir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120,
+    )
+    _check(cold.returncode == 0,
+           f"cold-start worker exits 0: {cold.stdout[-1500:]}", failures)
+    cold_verdict = {}
+    try:
+        with open(os.path.join(workdir, "cold_verdict.json")) as f:
+            cold_verdict = json.load(f)
+    except (OSError, ValueError):
+        failures.append("cold-start worker wrote no verdict")
+    _check(bool(cold_verdict.get("passed")),
+           f"cold-start restore from the remote tier alone "
+           f"({cold_verdict.get('failures')})", failures)
+
+    summary.update({
+        "passed": not failures,
+        "steps_per_hour": round(steps_per_hour, 2),
+        "newest_uploaded": newest_uploaded,
+        "cold": cold_verdict,
+        "merged_counters": {k: v for k, v in sorted(merged.items())
+                            if k.startswith(("cluster.", "ckpt."))},
+        "failures": failures,
+    })
+    return summary
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="multi-fault recovery check (see module docstring)")
@@ -671,17 +1181,37 @@ def main(argv=None) -> int:
                          "host-level cluster mid-run; survivors must "
                          "commit a smaller epoch and keep training, the "
                          "supervisor's relaunch must rejoin")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="autoscaling service storm: capacity-up scale to "
+                         "3 ranks, SIGKILL shrink + relaunch, spot-drain "
+                         "planned shrink + backfill, steps/hour SLO gate, "
+                         "and a cold start from the remote checkpoint tier")
+    ap.add_argument("--cold-start", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: scale-from-zero leg
     ap.add_argument("--worker", action="store_true",
                     help=argparse.SUPPRESS)  # internal: one storm rank
     args = ap.parse_args(argv)
 
+    if args.worker and args.cold_start:
+        summary = run_cold_start(workdir=args.workdir)
+        return 0 if summary["passed"] else 1
+    if args.worker and args.autoscale:
+        # one autoscale rank: the verdict file is the output
+        run_worker_autoscale(
+            checkpoint_every=args.checkpoint_every, workdir=args.workdir)
+        return 0
     if args.worker and args.elastic:
         # one elastic rank: the verdict file is the output, the parent
         # gate does the asserting — a clean exit just means "ran"
         run_worker_elastic(
             checkpoint_every=args.checkpoint_every, workdir=args.workdir)
         return 0
-    if args.elastic:
+    if args.autoscale:
+        summary = run_autoscale(checkpoint_every=args.checkpoint_every,
+                                workdir=args.workdir)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "finals"}))
+    elif args.elastic:
         summary = run_elastic(3, checkpoint_every=args.checkpoint_every,
                               workdir=args.workdir)
         print(json.dumps({k: v for k, v in summary.items()
@@ -717,9 +1247,10 @@ if __name__ == "__main__":
         # parent of the multi-process storm: pure process supervisor, no
         # jax in this process (the workers own the devices)
         sys.exit(main())
-    if "--elastic" in sys.argv:
-        # parent of the elastic storm: likewise jax-free — it drives
-        # launch/supervisor.py and reads the ranks' verdict files
+    if "--elastic" in sys.argv or "--autoscale" in sys.argv:
+        # parent of the elastic/autoscale storms: likewise jax-free — it
+        # drives launch/supervisor.py (+ the ScalePolicy / capacity file)
+        # and reads the ranks' verdict files and decision records
         sys.exit(main())
     # standalone single-process: emulate the 8-device CPU world the test
     # suite uses

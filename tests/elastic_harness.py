@@ -43,14 +43,34 @@ def attach_elastic(guard, tuner) -> Callable:
     return on_change
 
 
-def reenter(cluster, tuner, guard, ckpt_dir: str):
+def reenter(cluster, tuner, guard, ckpt_dir: str, hydrate_store=None):
     """Relaunched-rank re-entry: present the newest sidecar's membership
     epoch as "last known", wait for admission, rescale the plan for the
     admitted view, and consensus-restore through `elastic_resume`.
-    Returns ``(state, resumed_at_step, last_epoch)``."""
+    Returns ``(state, resumed_at_step, last_epoch)``.
+
+    A **scale-from-zero** rank (brand-new scale-up spawn, or a host whose
+    disk was lost with it) has no local checkpoints to contribute to the
+    consensus restore; with ``hydrate_store`` (an object store holding a
+    fleet replica's uploads) it first materializes the newest uploaded
+    step locally (`restore_from_object_store`, sha256-reverified), so its
+    consensus view intersects the survivors' at that step. A rank that
+    was down a LONG time hydrates too — its local newest is far behind
+    the fleet, and since the consensus restores the newest step valid on
+    EVERY member, rejoining with the stale view alone would drag every
+    survivor back to it (observed: a drained rank's backfill rolled a
+    200-step fleet back to step 18). Hydration caps the fleet's loss at
+    the upload lag instead of the rejoiner's downtime."""
     from dear_pytorch_tpu.utils import checkpoint as ckpt
 
     steps = ckpt.valid_steps(ckpt_dir)
+    if hydrate_store is not None:
+        remote = ckpt.remote_steps(hydrate_store)
+        if remote and (not steps or remote[0] > steps[0]):
+            hydrated = ckpt.restore_from_object_store(
+                hydrate_store, ckpt_dir, step=remote[0])
+            if hydrated is not None:
+                steps = ckpt.valid_steps(ckpt_dir)
     last_epoch = ckpt.read_mem_epoch(ckpt_dir, steps[0]) if steps else None
     view, context = cluster.rejoin(last_epoch)
     tuner.rescale(view)
@@ -104,6 +124,62 @@ def run_loop(
         elif (t_target is None
                 and tracer.counters().get("cluster.rejoins", 0) >= 1):
             t_target = guard.steps_seen + post  # admission landed HERE
+        if t_target is not None and guard.steps_seen >= t_target:
+            return state, m
+        if t_target is None:
+            time.sleep(idle_s)
+
+
+def run_autoscale_loop(
+    cluster,
+    guard,
+    pipe,
+    state,
+    batch_at: Callable[[int], object],
+    *,
+    rejoining: bool,
+    target_epoch: int,
+    post: int = 3,
+    kill: Optional[Tuple[int, int, int]] = None,
+    deadline_s: float = 300.0,
+    idle_s: float = 0.1,
+):
+    """The autoscaling worker loop (`scripts/chaos_check.py --autoscale`).
+
+    Differences from `run_loop`: termination is **epoch-driven** —
+    membership epochs commit inside the lockstep health sync, so every
+    member observes ``cluster.epoch >= target_epoch`` at the SAME attempt
+    and the ``post``-step runout stays lockstep without any counter
+    heuristics (a rejoiner admitted at the target epoch anchors on the
+    admission ack's cadence instead). ``kill`` is
+    ``(rank, after_epoch, extra_steps)``: the victim SIGKILLs itself
+    ``extra_steps`` attempts after it first observes ``after_epoch``. A
+    ``preempted`` metric (the supervisor's SIGTERM drain → planned
+    shrink → emergency save) exits the loop cleanly — the policy
+    backfills the rank, which re-enters through `reenter`."""
+    kill_rank, kill_epoch, kill_extra = kill if kill else (None, None, 0)
+    kill_at = None
+    deadline = time.monotonic() + deadline_s
+    t_target = (guard.steps_seen + post
+                if rejoining and cluster.epoch >= target_epoch else None)
+    m = {}
+    while True:
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"rank {cluster.rank} never reached epoch {target_epoch} "
+                f"(at epoch {cluster.epoch})")
+        i = guard.steps_seen
+        if not rejoining and kill_rank == cluster.rank:
+            if kill_at is None and cluster.epoch >= kill_epoch:
+                kill_at = i + 1 + kill_extra
+            if kill_at is not None and i + 1 == kill_at:
+                os.kill(os.getpid(), signal.SIGKILL)  # abrupt host loss
+        pipe.next()  # the guarded input stream advances once per step
+        state, m = guard.step(state, batch_at(i))
+        if m.get("preempted"):
+            return state, m  # drained: clean exit inside the grace window
+        if t_target is None and cluster.epoch >= target_epoch:
+            t_target = guard.steps_seen + post
         if t_target is not None and guard.steps_seen >= t_target:
             return state, m
         if t_target is None:

@@ -15,6 +15,8 @@ guard's membership-transition path against a scripted coordinator.
 
 import json
 import os
+import signal
+import sys
 import threading
 import time
 
@@ -27,9 +29,12 @@ from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.resilience import cluster as CL
 from dear_pytorch_tpu.resilience import membership as M
 from dear_pytorch_tpu.resilience import retry as R
+from dear_pytorch_tpu.resilience import scale as SC
+from dear_pytorch_tpu.resilience.preempt import PreemptionHandler
 from dear_pytorch_tpu.runtime import build as RB
 from dear_pytorch_tpu.runtime import pipeline as P
 from dear_pytorch_tpu.utils import checkpoint as ckpt
+from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
 
 
 def make_members(n, transport=None, *, timeout_s=2.0, ranks=None):
@@ -226,9 +231,10 @@ def test_admission_writes_the_epoch_decision_record():
         (lambda c=ms[1]: c.health_check(True, step=1)),
     ])
     assert not any(errs) and ms[0].epoch == 1
-    # the shrink's commit left its decision record
-    assert json.loads(
-        transport.get(f"{ms[0]._ns}/decided/e1", 0.1)) == [0, 1]
+    # the shrink's commit left its SIGNED world-delta decision record
+    rec = json.loads(transport.get(f"{ms[0]._ns}/decided/e1", 0.1))
+    assert rec["members"] == [0, 1]
+    assert rec["delta"] == {"added": [], "removed": [2]}
 
     relaunched = M.ElasticCluster(rank=2, members=[0, 1, 2],
                                   transport=transport, timeout_s=1.0)
@@ -247,9 +253,10 @@ def test_admission_writes_the_epoch_decision_record():
         (lambda: relaunched.rejoin(0, timeout_s=20)),
     ])
     assert not any(errs), errs
-    # ...and so did the admission's
-    assert json.loads(
-        transport.get(f"{ms[0]._ns}/decided/e2", 0.1)) == [0, 1, 2]
+    # ...and so did the admission's — signed with the ADDED side
+    rec = json.loads(transport.get(f"{ms[0]._ns}/decided/e2", 0.1))
+    assert rec["members"] == [0, 1, 2]
+    assert rec["delta"] == {"added": [2], "removed": []}
 
 
 # -- rejoin -------------------------------------------------------------------
@@ -333,6 +340,147 @@ def test_rejoin_racing_a_shrink_is_reconfigured_back_out():
     assert not any(errs), errs
     for v in out:
         assert v.ok and not v.membership_changed and v.epoch == 2
+
+
+def test_fresh_rank_requires_joining_flag():
+    with pytest.raises(ValueError, match="joining=True"):
+        M.ElasticCluster(rank=5, members=[0, 1],
+                         transport=CL.LocalTransport(1))
+
+
+def test_transport_list_prefix(tmp_path):
+    lt = CL.LocalTransport(1)
+    lt.set("a/b/1", "x")
+    lt.set("a/b/2/deep", "y")
+    lt.set("a/other", "z")
+    assert lt.list_prefix("a/b") == ["1", "2"]
+    ft = CL.FileTransport(str(tmp_path))
+    ft.set("a/b/1", "x")
+    assert ft.list_prefix("a/b") == ["1"]
+    assert ft.list_prefix("never/written") == []
+
+
+def test_scale_up_admits_a_brand_new_rank():
+    """Scale-UP: a rank that never existed (no prior death, no sidecar
+    epoch — ``last_epoch=None``) publishes the ordinary join request, is
+    DISCOVERED via the transport's list_prefix enumeration (no static
+    rank list contains it), admitted at the epoch barrier, and counted
+    as ``cluster.scale_ups`` on the members that grew the world."""
+    tracer = T.Tracer([T.MemoryExporter()])
+    prev = T._tracer
+    T.set_tracer(tracer)
+    try:
+        transport, ms = make_members(2, timeout_s=1.0)
+        fresh = M.ElasticCluster(rank=5, members=[0, 1],
+                                 transport=transport, timeout_s=1.0,
+                                 joining=True)
+        out = {}
+
+        def joiner():
+            view, context = fresh.rejoin(None, timeout_s=20)
+            out["view"], out["context"] = view, context
+            return fresh.exchange("post", "p5")
+
+        def member(c):
+            for step in range(1, 40):
+                v = c.health_check(True, step=step)
+                if v.admitted:
+                    assert v.admitted == (5,) and not v.ok
+                    assert v.epoch == 1 and v.members == (0, 1, 5)
+                    return c.exchange("post", f"p{c.rank}")
+                time.sleep(0.05)
+            raise AssertionError("never admitted the scale-up joiner")
+
+        res, errs = run_threads([
+            (lambda c=ms[0]: member(c)),
+            (lambda c=ms[1]: member(c)),
+            joiner,
+        ])
+        assert not any(errs), errs
+        assert out["view"].epoch == 1 and out["view"].world == 3
+        assert out["view"].index == 2  # the new shard slot
+        assert res[0] == res[2] == ["p0", "p1", "p5"]
+        # signed world-delta record: +[5]
+        rec = json.loads(transport.get(f"{ms[0]._ns}/decided/e1", 0.1))
+        assert rec["delta"] == {"added": [5], "removed": []}
+        # a later DEATH of the scaled-up rank stays admissible even on
+        # transports without enumeration: it joined initial_ranks
+        assert 5 in ms[0].initial_ranks and 5 in ms[1].initial_ranks
+        assert tracer.counters().get("cluster.scale_ups", 0) >= 1
+    finally:
+        T.set_tracer(prev)
+
+
+def test_scale_up_racing_a_shrink():
+    """A join request pending while a member dies: the sync converts the
+    death into a shrink epoch FIRST, then the next sync admits the
+    joiner — two clean epochs, and the joiner lands in the post-shrink
+    membership (never the dead rank's ghost world)."""
+    transport, ms = make_members(3, timeout_s=0.5)
+    fresh = M.ElasticCluster(rank=7, members=[0, 1, 2],
+                             transport=transport, timeout_s=0.5,
+                             joining=True)
+    # rank 2 never syncs (dead); rank 7 wants in
+    admitted_verdicts = []
+
+    def joiner():
+        return fresh.rejoin(None, timeout_s=30)
+
+    def member(c):
+        for step in range(1, 60):
+            v = c.health_check(True, step=step)
+            if v.admitted:
+                admitted_verdicts.append(v)
+                return v
+            time.sleep(0.05)
+        raise AssertionError("never admitted the joiner")
+
+    out, errs = run_threads([
+        (lambda c=ms[0]: member(c)),
+        (lambda c=ms[1]: member(c)),
+        joiner,
+    ])
+    assert not any(errs), errs
+    view, _context = out[2]
+    assert view.members == (0, 1, 7) and view.epoch == 2
+    assert ms[0].members == (0, 1, 7) and ms[0].epoch == 2
+    # epoch ledger: e1 = the shrink, e2 = the admission
+    rec1 = json.loads(transport.get(f"{ms[0]._ns}/decided/e1", 0.1))
+    rec2 = json.loads(transport.get(f"{ms[0]._ns}/decided/e2", 0.1))
+    assert rec1["delta"] == {"added": [], "removed": [2]}
+    assert rec2["delta"] == {"added": [7], "removed": []}
+
+
+def test_drain_commits_planned_shrink_without_timeout():
+    """A member announcing ``draining=True`` (spot SIGTERM with a grace
+    deadline) triggers the shrink at THAT sync: survivors commit epoch+1
+    immediately — no peer-timeout window burned against the kill — and
+    the drainer's verdict (`self_draining`) tells it to save and exit."""
+    _, ms = make_members(3, timeout_s=5.0)
+    t0 = time.monotonic()
+    out, errs = run_threads([
+        (lambda c=ms[0]: c.health_check(True, step=1)),
+        (lambda c=ms[1]: c.health_check(True, step=1)),
+        (lambda c=ms[2]: c.health_check(True, step=1, draining=True)),
+    ])
+    elapsed = time.monotonic() - t0
+    assert not any(errs), errs
+    drainer = out[2]
+    assert drainer.self_draining and drainer.drained == (2,)
+    assert drainer.epoch == 0  # its membership is frozen; it only exits
+    for v in out[:2]:
+        assert v.reconfigured and v.membership_changed and not v.ok
+        assert v.lost == (2,) and v.drained == (2,)
+        assert v.epoch == 1 and v.members == (0, 1)
+        assert not v.self_draining
+    # planned means FAST: nothing waited out the 5s exchange deadline
+    assert elapsed < 4.0, elapsed
+    # survivors continue in lockstep at the committed epoch
+    post, errs = run_threads([
+        (lambda c=ms[0]: c.exchange("post", "a")),
+        (lambda c=ms[1]: c.exchange("post", "b")),
+    ])
+    assert not any(errs) and post[0] == ["a", "b"]
 
 
 def test_rejoin_times_out_on_dead_fleet(tmp_path):
@@ -766,6 +914,28 @@ def test_numpy_resume_of_native_sidecar_does_not_replay():
     assert not np.array_equal(first, q.next()["x"])
 
 
+def test_reshard_to_larger_world_is_deterministic():
+    """Scale-UP reshard: growing the shard count is the same pure
+    function of (seed, epoch, slot, world) — survivors recompute their
+    new slice and a brand-new joiner derives ITS slice with no
+    coordination, all streams disjoint."""
+    a = P.NumpyPipeline(_spec(), seed=11, shard=0, num_shards=2)
+    b = P.NumpyPipeline(_spec(), seed=11, shard=1, num_shards=2)
+    a.next(), b.next()
+    a.reshard(0, 3, epoch=1)
+    b.reshard(1, 3, epoch=1)
+    c = P.NumpyPipeline(_spec(), seed=11)   # the scale-up joiner
+    c.reshard(2, 3, epoch=1)
+    xa, xb, xc = a.next()["x"], b.next()["x"], c.next()["x"]
+    assert not np.array_equal(xa, xb)
+    assert not np.array_equal(xa, xc)
+    assert not np.array_equal(xb, xc)
+    # any rank recomputing slot 2 draws exactly the joiner's stream
+    d = P.NumpyPipeline(_spec(), seed=11)
+    d.reshard(2, 3, epoch=1)
+    np.testing.assert_array_equal(xc, d.next()["x"])
+
+
 def test_reshard_is_a_pure_function_of_assignment():
     a = P.NumpyPipeline(_spec(), seed=11, shard=0, num_shards=3)
     b = P.NumpyPipeline(_spec(), seed=11, shard=1, num_shards=3)
@@ -1049,3 +1219,483 @@ def test_guard_elastic_resume_aligns_cadence(tmp_path, mesh):
     # the loop continues from the fleet's cadence
     state, m = guard.step(state, _data(jax.random.PRNGKey(11)))
     assert guard.steps_seen == 12 and np.isfinite(float(m["loss"]))
+
+
+# -- object store + durable checkpoint streaming ------------------------------
+
+
+def test_local_object_store_roundtrip(tmp_path):
+    st = LocalObjectStore(str(tmp_path / "store"))
+    st.put_bytes("a/b/obj", b"hello")
+    assert st.get_bytes("a/b/obj") == b"hello"
+    st.put_bytes("a/b/obj", b"hello2")  # atomic overwrite
+    assert st.get_bytes("a/b/obj") == b"hello2"
+    with pytest.raises(KeyError):
+        st.get_bytes("a/missing")
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"\x00\x01\x02")
+    st.put_file("files/payload", str(src))
+    dest = tmp_path / "out" / "payload.bin"
+    st.get_file("files/payload", str(dest))
+    assert dest.read_bytes() == b"\x00\x01\x02"
+    assert st.exists("files/payload") and not st.exists("files/nope")
+    assert st.list("a") == ["a/b/obj"]
+    st.delete_prefix("a")
+    assert st.list("a") == []
+
+
+def _saved_run(tmp_path, mesh, n=3):
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, threshold_mb=0.0008, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    state = ts.init(params)
+    for i in range(n):
+        state, _ = ts.step(state, _data(jax.random.PRNGKey(i)))
+        ckpt.save_checkpoint(str(tmp_path), state, ts.plan,
+                             pipeline_state={"backend": "numpy",
+                                             "produced": i + 1},
+                             mem_epoch=0)
+    return ts, params, state
+
+
+def test_checkpoint_streamer_uploads_and_cold_restores(tmp_path, mesh):
+    """The durable tier end to end: committed steps stream to the object
+    store (manifest last), remote retention pins the newest K, and a
+    machine with NO local checkpoints restores the newest upload —
+    sha256-reverified — through the ordinary local restore path."""
+    tracer = T.Tracer([T.MemoryExporter()])
+    prev = T._tracer
+    T.set_tracer(tracer)
+    try:
+        local = tmp_path / "ckpts"
+        ts, params, _state = _saved_run(local, mesh)
+        store = LocalObjectStore(str(tmp_path / "remote"))
+        with ckpt.CheckpointStreamer(str(local), store,
+                                     pin_last=2) as streamer:
+            for s in (1, 2, 3):
+                assert streamer.enqueue(s)
+            assert streamer.flush(30.0)
+        assert streamer.uploaded == [1, 2, 3] and not streamer.failed
+        # last-K pinned retention: step 1 rotated out remotely
+        assert ckpt.remote_steps(store) == [3, 2]
+        c = tracer.counters()
+        assert c.get("ckpt.uploads") == 3
+        assert "ckpt.upload_errors" not in c
+
+        cold = tmp_path / "cold"
+        restored = ckpt.restore_from_object_store(store, str(cold))
+        assert restored == 3
+        assert ckpt.verify_checkpoint(str(cold), 3)
+        assert ckpt.read_pipeline_state(str(cold), 3)["produced"] == 3
+        assert ckpt.read_mem_epoch(str(cold), 3) == 0
+        state = ckpt.restore_checkpoint(str(cold), ts, step=3,
+                                        template=ts.init(params))
+        assert int(jax.device_get(state.step)) == 3
+        assert tracer.counters().get("ckpt.remote_restores") == 1
+    finally:
+        T.set_tracer(prev)
+
+
+def test_streamer_upload_every_and_archive_cadence(tmp_path, mesh):
+    local = tmp_path / "ckpts"
+    _saved_run(local, mesh, n=4)
+    store = LocalObjectStore(str(tmp_path / "remote"))
+    with ckpt.CheckpointStreamer(str(local), store, upload_every=2,
+                                 pin_last=2, keep_every=4) as streamer:
+        assert not streamer.enqueue(1)   # off the every-Nth cadence
+        assert streamer.enqueue(2)
+        # an EMERGENCY save must reach the durable tier no matter where
+        # it lands relative to the cadence (uploads stay chronological:
+        # the emergency step is always the newest at signal time)
+        assert streamer.enqueue(3, force=True)
+        assert streamer.enqueue(4)
+        assert streamer.flush(30.0)
+    # pin_last=2 keeps the newest two uploads BY STEP (4, 3); step 2
+    # survives only on the keep_every archive cadence (2 % 4 != 0)
+    assert ckpt.remote_steps(store) == [4, 3]
+
+
+class _FailingStore:
+    """Object store whose writes always fail (dead bucket)."""
+
+    def __init__(self):
+        self.attempts = 0
+
+    def put_file(self, key, path):
+        self.attempts += 1
+        raise OSError("bucket is down")
+
+    def put_bytes(self, key, data):
+        raise OSError("bucket is down")
+
+    def list(self, prefix):
+        return []
+
+    def delete_prefix(self, prefix):
+        pass
+
+
+def test_streamer_retry_exhaustion_falls_back_to_local_only(tmp_path, mesh):
+    """Upload-retry exhaustion must degrade durability, not the run: the
+    worker counts ``ckpt.upload_errors``, records the step as failed, and
+    keeps accepting later steps — while the LOCAL checkpoints stay fully
+    restorable (local-only retention)."""
+    tracer = T.Tracer([T.MemoryExporter()])
+    prev = T._tracer
+    T.set_tracer(tracer)
+    try:
+        local = tmp_path / "ckpts"
+        ts, params, _state = _saved_run(local, mesh)
+        store = _FailingStore()
+        with ckpt.CheckpointStreamer(str(local), store, attempts=3,
+                                     base_delay_s=0.01,
+                                     max_delay_s=0.02) as streamer:
+            assert streamer.enqueue(2)
+            assert streamer.flush(30.0)
+            assert streamer.failed == [2] and not streamer.uploaded
+            assert store.attempts == 3  # every retry actually hit the store
+            # the streamer survives and keeps trying later steps
+            assert streamer.enqueue(3)
+            assert streamer.flush(30.0)
+            assert streamer.failed == [2, 3]
+        c = tracer.counters()
+        assert c.get("ckpt.upload_errors") == 2
+        assert c.get("retry.giveups", 0) >= 2
+        # local-only retention: the run's own restore path is untouched
+        state = ckpt.restore_checkpoint(str(local), ts, step=3,
+                                        template=ts.init(params))
+        assert int(jax.device_get(state.step)) == 3
+    finally:
+        T.set_tracer(prev)
+
+
+def test_remote_restore_walks_past_corruption(tmp_path, mesh):
+    """sha256 reverify on download: a bit-flipped remote object must not
+    become a poisoned restore — the walk degrades to the previous
+    upload, exactly like the local corruption-fallback walk."""
+    local = tmp_path / "ckpts"
+    _saved_run(local, mesh)
+    root = tmp_path / "remote"
+    store = LocalObjectStore(str(root))
+    with ckpt.CheckpointStreamer(str(local), store, pin_last=3) as s:
+        for n in (2, 3):
+            s.enqueue(n)
+        assert s.flush(30.0)
+    # flip bytes in the newest upload's largest payload file
+    files = [k for k in store.list(ckpt._remote_step_key(3))
+             if "/files/" in k]
+    victim = max(files, key=lambda k: len(store.get_bytes(k)))
+    blob = bytearray(store.get_bytes(victim))
+    blob[len(blob) // 2] ^= 0xFF
+    store.put_bytes(victim, bytes(blob))
+    cold = tmp_path / "cold"
+    assert ckpt.restore_from_object_store(store, str(cold)) == 2
+    assert ckpt.verify_checkpoint(str(cold), 2)
+    # a manifest that parses but lists NO files is torn, not empty:
+    # walked past like any corruption (previously crashed the restore)
+    store.put_bytes(f"{ckpt._remote_step_key(3)}/MANIFEST.json",
+                    json.dumps({"step": 3, "files": {}}).encode())
+    cold2 = tmp_path / "cold2"
+    assert ckpt.restore_from_object_store(store, str(cold2)) == 2
+
+
+# -- preemption grace window --------------------------------------------------
+
+
+def test_preempt_grace_window_budget(monkeypatch):
+    monkeypatch.setenv("DEAR_PREEMPT_GRACE_S", "30")
+    with PreemptionHandler() as pre:
+        assert pre.grace_s == 30.0
+        assert pre.remaining() is None  # no signal yet: no deadline
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert pre.requested
+        rem = pre.remaining()
+        assert rem is not None and 0 < rem <= 30.0
+        pre.clear()
+        assert pre.remaining() is None  # re-arms with the next signal
+    monkeypatch.delenv("DEAR_PREEMPT_GRACE_S")
+    with PreemptionHandler() as pre:
+        assert pre.grace_s is None
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert pre.requested and pre.remaining() is None
+
+
+# -- the capacity-driven scale policy -----------------------------------------
+
+
+def _cap_writer(path):
+    def write(doc):
+        with open(str(path) + ".tmp", "w") as f:
+            json.dump(doc, f)
+        os.replace(str(path) + ".tmp", str(path))
+    return write
+
+
+def test_scale_policy_hysteresis_and_decisions(tmp_path):
+    cap = tmp_path / "capacity.json"
+    write = _cap_writer(cap)
+    clk = {"t": 0.0}
+    pol = SC.ScalePolicy(capacity_file=str(cap), hysteresis_s=1.0,
+                         max_world=4, clock=lambda: clk["t"])
+    # no file yet: no opinion
+    assert pol.decide(live_world=2, live_ranks=(0, 1)) is None
+    write({"target_world": 3})
+    # hysteresis leg 1: the hint must hold for hysteresis_s
+    assert pol.decide(live_world=2, live_ranks=(0, 1)) is None
+    clk["t"] = 0.5
+    assert pol.decide(live_world=2, live_ranks=(0, 1)) is None
+    clk["t"] = 1.1
+    d = pol.decide(live_world=2, live_ranks=(0, 1))
+    assert d is not None and d.kind == "scale_up" and d.count == 1
+    # a flapping hint cannot thrash: the down-hint must also hold
+    write({"target_world": 2})
+    clk["t"] = 1.2
+    assert pol.decide(live_world=3, live_ranks=(0, 1, 2)) is None
+    clk["t"] = 2.5
+    d = pol.decide(live_world=3, live_ranks=(0, 1, 2))
+    assert d is not None and d.kind == "scale_down" and d.ranks == (2,)
+    assert [x.kind for x in pol.decisions] == ["scale_up", "scale_down"]
+
+
+def test_scale_policy_explicit_drain_is_immediate(tmp_path):
+    """A spot reclaim is a deadline, not a preference: explicit drain
+    requests bypass the hysteresis dwell and are acted on once."""
+    cap = tmp_path / "capacity.json"
+    write = _cap_writer(cap)
+    clk = {"t": 0.0}
+    pol = SC.ScalePolicy(capacity_file=str(cap), hysteresis_s=100.0,
+                         clock=lambda: clk["t"])
+    write({"target_world": 3, "drain": [1]})
+    d = pol.decide(live_world=3, live_ranks=(0, 1, 2))
+    assert d is not None and d.kind == "drain" and d.ranks == (1,)
+    # acted on exactly once — the next tick does not re-drain
+    assert pol.decide(live_world=3, live_ranks=(0, 1, 2),
+                      draining=(1,)) is None
+    # ...and the STALE file must not re-drain the backfilled rank either
+    assert pol.decide(live_world=3, live_ranks=(0, 1, 2)) is None
+    # but the latch is EDGE-triggered on the hint: once the pool removes
+    # the rank from the list and later re-requests it, it is honored
+    # again (a permanent latch would ignore a second legitimate reclaim
+    # for the policy's whole lifetime)
+    write({"target_world": 3})
+    assert pol.decide(live_world=3, live_ranks=(0, 1, 2)) is None
+    write({"target_world": 3, "drain": [1]})
+    d = pol.decide(live_world=3, live_ranks=(0, 1, 2))
+    assert d is not None and d.kind == "drain" and d.ranks == (1,)
+
+
+def test_scale_policy_waits_out_draining_rank_then_backfills(tmp_path):
+    """While a drained rank is still exiting it COUNTS toward capacity:
+    the replacement is backfilled after the clean drain, not pre-spawned
+    next to it (which would mint a spurious extra rank)."""
+    cap = tmp_path / "capacity.json"
+    write = _cap_writer(cap)
+    clk = {"t": 0.0}
+    pol = SC.ScalePolicy(capacity_file=str(cap), hysteresis_s=0.1,
+                         clock=lambda: clk["t"])
+    write({"target_world": 3, "drain": [0]})
+    d = pol.decide(live_world=3, live_ranks=(0, 1, 2))
+    assert d.kind == "drain"
+    clk["t"] = 1.0
+    # rank 0 still draining: live 3 == target 3, hold
+    assert pol.decide(live_world=3, live_ranks=(0, 1, 2),
+                      draining=(0,)) is None
+    clk["t"] = 2.0
+    # rank 0 exited: backfill
+    d = pol.decide(live_world=2, live_ranks=(1, 2))
+    assert d is not None and d.kind == "scale_up" and d.count == 1
+
+
+def test_scale_policy_anomaly_vetoes_scale_up(tmp_path):
+    cap = tmp_path / "capacity.json"
+    _cap_writer(cap)({"target_world": 3})
+    clk = {"t": 0.0}
+    pol = SC.ScalePolicy(capacity_file=str(cap), hysteresis_s=0.1,
+                         anomaly_veto_s=5.0, clock=lambda: clk["t"])
+    pol.decide(live_world=2, live_ranks=(0, 1))  # records the hint
+    clk["t"] = 1.0
+    pol.note_anomaly("step_time_spike", {})
+    assert pol.decide(live_world=2, live_ranks=(0, 1)) is None  # vetoed
+    clk["t"] = 7.0  # the fleet has been quiet past the veto window
+    d = pol.decide(live_world=2, live_ranks=(0, 1))
+    assert d is not None and d.kind == "scale_up"
+
+
+# -- the supervisor's sliding-window relaunch budget --------------------------
+
+
+def _supervisor_module():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), "..", "launch",
+                        "supervisor.py")
+    spec = importlib.util.spec_from_file_location("dear_sup_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_supervisor_sliding_window_budget(tmp_path):
+    """The long-running-service budget: a rank crashing repeatedly gets
+    at most ``max_relaunches`` relaunches per trailing window — and the
+    budget REFILLS as the window slides (a lifetime cap, which any
+    continuous service exhausts by design, is only the no-window
+    fallback)."""
+    sup_mod = _supervisor_module()
+    sup = sup_mod.ElasticSupervisor(
+        1, [sys.executable, "-c", "import sys; sys.exit(3)"],
+        elastic_dir=str(tmp_path), max_relaunches=1,
+        relaunch_window_s=60.0, relaunch_delay_s=0.01,
+        log=lambda s: None,
+    ).start()
+    rc = sup.wait(deadline_s=60)
+    assert rc == 1                       # the rank never came up healthy
+    assert sup.relaunches[0] == 1        # budget spent, gave up
+    # the window slides: pruning old timestamps refills the budget
+    sup2 = sup_mod.ElasticSupervisor(
+        1, ["true"], elastic_dir=str(tmp_path / "w2"), max_relaunches=1,
+        relaunch_window_s=0.05, log=lambda s: None)
+    sup2._relaunch_times[0] = [time.monotonic()]
+    assert not sup2._budget_ok(0)
+    time.sleep(0.08)
+    assert sup2._budget_ok(0)
+    # legacy alias semantics: no window -> lifetime cap
+    sup3 = sup_mod.ElasticSupervisor(
+        1, ["true"], elastic_dir=str(tmp_path / "w3"), max_relaunches=1,
+        log=lambda s: None)
+    sup3.relaunches[0] = 1
+    assert not sup3._budget_ok(0)
+
+
+def test_supervisor_dirty_drain_is_not_relaunched(tmp_path):
+    """A draining rank that crashes inside its grace window is STILL a
+    drain: the policy asked for its removal, so relaunching it would
+    override the capacity decision and burn its relaunch budget — it
+    goes to the backfill pool instead (and a requested removal is not a
+    job failure)."""
+    sup_mod = _supervisor_module()
+    sup = sup_mod.ElasticSupervisor(
+        1, [sys.executable, "-c",
+            "import signal,sys,time;"
+            "signal.signal(signal.SIGTERM, lambda *a: sys.exit(5));"
+            "time.sleep(30)"],
+        elastic_dir=str(tmp_path), max_relaunches=2,
+        relaunch_delay_s=0.01, log=lambda s: None,
+    ).start()
+    deadline = time.monotonic() + 20
+    while sup.pid(0) is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    time.sleep(0.3)  # let the handler install
+    assert sup.drain(0)
+    assert sup.wait(deadline_s=20, poll_s=0.05) == 0  # removal != failure
+    assert ("drained_dirty", 0) in sup.events
+    assert sup._backfill == [0]
+    assert sup.relaunches[0] == 0  # the budget was never touched
+
+
+def test_supervisor_policy_stands_down_on_clean_completion(tmp_path):
+    """Ghost-rank regression: a fleet finishing its job exits in
+    lockstep, but the EXITS are staggered at the OS level — the policy
+    must not read the shrinking live count as lost capacity and spawn
+    replacement ranks that then wait out a rejoin timeout against a dead
+    fleet (observed). The first clean (non-drained) completion stands
+    the policy down."""
+    sup_mod = _supervisor_module()
+    cap = tmp_path / "capacity.json"
+    _cap_writer(cap)({"target_world": 3})
+    pol = SC.ScalePolicy(capacity_file=str(cap), hysteresis_s=0.0)
+    # ranks exit cleanly but STAGGERED (rank 1 lives 0.6s longer)
+    sup = sup_mod.ElasticSupervisor(
+        2, [sys.executable, "-c",
+            "import os,time;"
+            "time.sleep(0.6*int(os.environ['DEAR_ELASTIC_RANK']))"],
+        elastic_dir=str(tmp_path / "el"), policy=pol,
+        log=lambda s: None,
+    ).start()
+    assert sup.wait(deadline_s=30, poll_s=0.05) == 0
+    ghosts = [e for e in sup.events if e[0] == "scale_up"]
+    assert not ghosts, f"policy spawned ghost ranks: {ghosts}"
+    assert sorted(sup._final_rc) == [0, 1]
+
+
+# -- the guard's drain-on-preempt path (scripted coordinator) -----------------
+
+
+class _DrainStub(_ElasticStub):
+    """Scripted elastic coordinator that speaks the drain protocol."""
+
+    supports_draining = True
+
+    def __init__(self):
+        super().__init__()
+        self.saw_draining = []
+
+    def health_check(self, ok, *, fingerprint="", step=None,
+                     preempted=False, draining=False):
+        self.saw_draining.append(bool(draining))
+        if draining:
+            # the survivors commit the shrink; my verdict says save+exit
+            return M.ElasticVerdict(
+                ok=True, unhealthy_ranks=(), desync=False,
+                any_preempted=False, fingerprints=(fingerprint,),
+                epoch=self.epoch, members=self.members,
+                drained=(self.rank,))
+        return super().health_check(ok, fingerprint=fingerprint,
+                                    step=step, preempted=preempted)
+
+
+def test_guard_drain_on_preempt(tmp_path, mesh, monkeypatch):
+    """A SIGTERM under an elastic coordinator becomes a DRAIN
+    announcement (not fleet-wide preemption): the guard passes
+    ``draining=True`` into the health sync, and a `self_draining`
+    verdict produces the emergency save + ``preempted`` exit WITHOUT a
+    rollback."""
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+    monkeypatch.setenv("DEAR_PREEMPT_GRACE_S", "25")
+    params = _mlp_params(jax.random.PRNGKey(0))
+    ts = build_train_step(
+        _loss_fn, params, mesh=mesh, threshold_mb=0.0008, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    co = _DrainStub()
+    rollbacks = []
+    with PreemptionHandler() as pre:
+        guard = GuardedTrainer(
+            ts, str(tmp_path / "g"), params, check_every=1,
+            checkpoint_every=100, coordinator=co, preemption=pre,
+        )
+        guard.on_rollback = lambda c, at: rollbacks.append(at)
+        state = ts.init(params)
+        state, m = guard.step(state, _data(jax.random.PRNGKey(0)))
+        assert co.saw_draining == [False]
+        os.kill(os.getpid(), signal.SIGTERM)
+        state, m = guard.step(state, _data(jax.random.PRNGKey(1)))
+    assert co.saw_draining == [False, True]
+    assert m.get("preempted") and not rollbacks
+    # the emergency save landed at the drained step
+    assert m.get("preempt_checkpoint_step") == 2
+    assert ckpt.latest_valid_step(str(tmp_path / "g")) == 2
+    # DEAR_PREEMPT_DRAIN=0 restores full-fleet preemption propagation
+    monkeypatch.setenv("DEAR_PREEMPT_DRAIN", "0")
+    co2 = _DrainStub()
+    with PreemptionHandler() as pre2:
+        guard2 = GuardedTrainer(
+            ts, str(tmp_path / "g2"), params, check_every=1,
+            checkpoint_every=100, coordinator=co2, preemption=pre2,
+        )
+        state = ts.init(params)
+        os.kill(os.getpid(), signal.SIGTERM)
+        state, m = guard2.step(state, _data(jax.random.PRNGKey(0)))
+    assert co2.saw_draining == [False]  # propagate path, not drain

@@ -24,6 +24,17 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+# Quarantine (tracking: ISSUE 7 satellite; flaky since at least r04): this
+# module and test_ring_attention.py fail intermittently ONLY under heavy
+# host load — 8-way CPU-device emulation plus a parallel compile storm can
+# time out XLA's own scheduler or wedge a collective long enough to trip
+# the per-test timeout, wobbling tier-1 dot counts from run to run. The
+# `flaky` marker makes the root conftest rerun a failure (fresh setup) up
+# to twice before reporting it, so a load blip no longer flips CI while a
+# genuine schedule regression (deterministic) still fails all three runs.
+pytestmark = pytest.mark.flaky(reason="load-flaky: XLA CPU scheduling "
+                               "under oversubscription", reruns=2)
+
 from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
 from dear_pytorch_tpu.parallel import build_train_step
 from dear_pytorch_tpu.utils import hlo

@@ -7,6 +7,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# Quarantine (tracking: ISSUE 7 satellite; see test_overlap.py for the
+# full note): load-flaky region — reruns-on-failure via the root
+# conftest's `flaky` marker so tier-1 dot counts stop wobbling under load.
+pytestmark = pytest.mark.flaky(reason="load-flaky: XLA CPU scheduling "
+                               "under oversubscription", reruns=2)
+
 from dear_pytorch_tpu.comm.backend import DP_AXIS
 from dear_pytorch_tpu.parallel.ring_attention import (
     full_attention,

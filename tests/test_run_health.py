@@ -746,6 +746,48 @@ def test_bench_gate_cli_missing_metrics_and_flags(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_bench_gate_slo_floor(tmp_path, capsys):
+    """`--slo METRIC=MIN` gates an ABSOLUTE service-contract floor —
+    independently of any baseline (which becomes optional): the
+    continuous-training service's steps-per-hour promise is a floor, not
+    a ratio (scripts/chaos_check.py --autoscale drives this)."""
+    gate = _gate()
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps({"metric": "steps_per_hour", "value": 900.0}))
+    # floor held -> 0, no baseline needed
+    assert gate.main(["--run", str(run),
+                      "--slo", "steps_per_hour=500"]) == 0
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["ok"] and verdict["slo_violations"] == []
+    # floor broken -> 2
+    assert gate.main(["--run", str(run),
+                      "--slo", "steps_per_hour=1000"]) == 2
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["slo_violations"][0]["run"] == 900.0
+    # a metric the run stopped reporting is DOWN, not quiet -> 2
+    assert gate.main(["--run", str(run),
+                      "--slo", "p99_latency_ms=50"]) == 2
+    capsys.readouterr()
+    # NaN is DOWN too (not-above-floor, never below-floor comparison)
+    nan_run = tmp_path / "nan.json"
+    nan_run.write_text('{"metric": "steps_per_hour", "value": NaN}')
+    assert gate.main(["--run", str(nan_run),
+                      "--slo", "steps_per_hour=1"]) == 2
+    capsys.readouterr()
+    # malformed --slo -> 3; neither baseline nor slo -> argparse error
+    assert gate.main(["--run", str(run), "--slo", "nonsense"]) == 3
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        gate.main(["--run", str(run)])
+    # SLO composes with a baseline comparison: parity but broken floor
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"metric": "steps_per_hour",
+                                "value": 905.0}))
+    assert gate.main(["--baseline", str(base), "--run", str(run),
+                      "--slo", "steps_per_hour=1000"]) == 2
+    capsys.readouterr()
+
+
 def test_bench_gate_reads_contract_line_amid_output(tmp_path, capsys):
     gate = _gate()
     base = tmp_path / "b.json"
