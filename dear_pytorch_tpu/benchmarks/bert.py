@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["ring", "ring_flash", "ulysses"],
                    help="sequence-parallel attention scheme (default: "
                         "ring, or ring_flash with --flash-attention)")
+    p.add_argument("--ring-projections", action="store_true", default=False,
+                   help="route the QKV/MLP projections through the ring "
+                        "collective-matmul (ops/collective_matmul.py "
+                        "projection_impl hook; requires --mode dear-fused "
+                        "on a pure dp mesh, hidden %% world == 0)")
     p.add_argument("--dropout0", action="store_true", default=False,
                    help="zero every dropout prob (the modern pretraining "
                         "default; the r5 on-chip A/B reads +29%% BERT / "
@@ -84,6 +89,16 @@ def main(argv=None) -> runner.BenchResult:
         from dear_pytorch_tpu.ops import make_flash_attention_impl
 
         attention_impl = make_flash_attention_impl()
+    projection_impl = None
+    if args.ring_projections:
+        if args.mode != "dear-fused" or sp > 1:
+            raise SystemExit("--ring-projections requires --mode dear-fused "
+                             "on a pure dp mesh (no --sp-degree)")
+        from dear_pytorch_tpu.ops.collective_matmul import (
+            make_ring_projection_impl,
+        )
+
+        projection_impl = make_ring_projection_impl(DP_AXIS)
     cfg_over = model.config
     # impls with no attention-prob-dropout path: dropout>0 would silently
     # measure their dense/ring FALLBACK instead of the requested kernel
@@ -108,9 +123,11 @@ def main(argv=None) -> runner.BenchResult:
                 cfg_over, attention_probs_dropout_prob=0.0
             )
     if sp == 1 and (cfg_over is not model.config
-                    or attention_impl is not None):
+                    or attention_impl is not None
+                    or projection_impl is not None):
         model = models.BertForPreTraining(
-            cfg_over, attention_impl=attention_impl
+            cfg_over, attention_impl=attention_impl,
+            projection_impl=projection_impl,
         )
     cfg = cfg_over  # == model.config whenever the model was (re)built
 

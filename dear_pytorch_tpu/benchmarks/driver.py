@@ -51,6 +51,10 @@ METHOD_ARGS: dict[str, list[str]] = {
     "dear-notf": ["--mode", "dear", "--threshold", "0",
                   "--nearby-layers", "1"],
     "dear-bo": ["--mode", "dear", "--autotune", "bo"],
+    # Pallas fused computation-collective kernels (ring RS+update epilogue,
+    # ring all-gather; ops/collective_matmul.py) — A/B against 'dear' with
+    # identical bucketing, gated by scripts/bench_gate.py --ab-methods
+    "dear-fused": ["--mode", "dear-fused", "--threshold", "25"],
     "allreduce": ["--mode", "allreduce", "--threshold", "25"],
     "rsag": ["--mode", "rsag", "--threshold", "25"],
     "rb": ["--mode", "rb", "--threshold", "25"],

@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "MoE (experts replicated under the dp schedules "
                         "here; shard them over an 'ep' axis via "
                         "parallel.tp + EP_RULES)")
+    p.add_argument("--ring-projections", action="store_true", default=False,
+                   help="route the QKV/MLP projections through the ring "
+                        "collective-matmul (ops/collective_matmul.py "
+                        "projection_impl hook; requires --mode dear-fused "
+                        "on a pure dp mesh, hidden %% world == 0)")
     p.add_argument("--dropout0", action="store_true", default=False,
                    help="zero every dropout prob (the modern pretraining "
                         "default and the r5 headline config: attention "
@@ -117,8 +122,20 @@ def main(argv=None) -> runner.BenchResult:
         cfg = dataclasses.replace(cfg, attention_probs_dropout_prob=0.0)
     if args.flash_attention and sp == 1:
         attention_impl = flash_causal_attention_impl()
-    if sp == 1 and (cfg is not model.config or attention_impl is not None):
-        model = models.GptLmHeadModel(cfg, attention_impl=attention_impl)
+    projection_impl = None
+    if args.ring_projections:
+        if args.mode != "dear-fused" or sp > 1:
+            raise SystemExit("--ring-projections requires --mode dear-fused "
+                             "on a pure dp mesh (no --sp-degree)")
+        from dear_pytorch_tpu.ops.collective_matmul import (
+            make_ring_projection_impl,
+        )
+
+        projection_impl = make_ring_projection_impl(DP_AXIS)
+    if sp == 1 and (cfg is not model.config or attention_impl is not None
+                    or projection_impl is not None):
+        model = models.GptLmHeadModel(cfg, attention_impl=attention_impl,
+                                      projection_impl=projection_impl)
 
     global_bs = args.batch_size * world
     batch = data.synthetic_gpt_batch(

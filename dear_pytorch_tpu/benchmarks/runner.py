@@ -263,11 +263,15 @@ def add_common_args(parser) -> None:
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=5)
     parser.add_argument("--mode", type=str, default="dear",
-                        choices=["dear", "allreduce", "rsag", "rb",
-                                 "bytescheduler", "fsdp"],
+                        choices=["dear", "dear-fused", "allreduce", "rsag",
+                                 "rb", "bytescheduler", "fsdp"],
                         help="communication schedule (replaces the "
                              "reference's per-directory baselines; 'fsdp' "
-                             "= ZeRO-3 re-gather-in-backward)")
+                             "= ZeRO-3 re-gather-in-backward; 'dear-fused' "
+                             "= dear with Pallas ring kernels fusing the "
+                             "reduce-scatter into the optimizer epilogue "
+                             "and the all-gather into a remote-copy ring, "
+                             "ops/collective_matmul.py)")
     parser.add_argument("--partition", type=float, default=4.0,
                         help="bytescheduler partition size in MB "
                              "(reference bytescheduler --partition, "

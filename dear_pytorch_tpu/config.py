@@ -32,7 +32,8 @@ class DearConfig:
     """Every train-step knob in one place (defaults = the reference's)."""
 
     # schedule (replaces the reference's one-directory-per-method layout)
-    mode: str = "dear"    # dear | allreduce | rsag | rb | bytescheduler | fsdp
+    mode: str = "dear"    # dear | dear-fused | allreduce | rsag | rb |
+    #                       bytescheduler | fsdp
     exclude_parts: tuple = ()               # ('reducescatter'|'allgather')*
     partition_mb: float = 4.0               # bytescheduler chunk size (MB)
 
@@ -83,8 +84,8 @@ class DearConfig:
     accum_steps: int = 1                    # gradient accumulation microbatches
 
     def __post_init__(self):
-        if self.mode not in ("dear", "allreduce", "rsag", "rb",
-                             "bytescheduler", "fsdp"):
+        if self.mode not in ("dear", "dear-fused", "allreduce", "rsag",
+                             "rb", "bytescheduler", "fsdp"):
             raise ValueError(f"bad mode {self.mode!r}")
         if self.autotune not in (None, "bo", "wait_time"):
             raise ValueError(f"bad autotune {self.autotune!r}")

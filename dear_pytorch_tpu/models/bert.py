@@ -57,6 +57,51 @@ BERT_LARGE = BertConfig(
 )
 
 
+class ProjDense(nn.Module):
+    """Dense / DenseGeneral twin with an injectable matmul impl — the
+    projection-path analog of the ``attention_impl`` hook.
+
+    Creates the SAME params as the flax module it replaces (``kernel`` of
+    shape ``(in,) + features``, ``bias`` of shape ``features``; same
+    names, same init, fp32 param dtype), so fusion plans, checkpoints,
+    and the TP rule regexes are unchanged. The impl receives the matmul
+    FLATTENED to 2-D — ``impl(x2d [M, in], kernel2d [in, out_flat],
+    bias1d [out_flat], dtype) -> y2d`` — which is the contract
+    `ops.collective_matmul.make_ring_projection_impl` implements (the
+    ring collective-matmul that starts on the local weight shard while
+    remote shards stream in). ``impl`` must apply the dtype promotion
+    itself (the ring impl mirrors flax's ``promote_dtype``).
+
+    Only instantiated when a hook is active; with ``projection_impl=None``
+    the models keep their original ``nn.Dense`` / ``nn.DenseGeneral``
+    modules so default-path numerics cannot drift.
+    """
+
+    features: Any            # int or tuple (e.g. (heads, head_dim))
+    impl: Callable
+    dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        feats = (self.features if isinstance(self.features, tuple)
+                 else (self.features,))
+        in_dim = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init, (in_dim,) + feats)
+        bias = self.param("bias", nn.initializers.zeros, feats)
+        out_flat = 1
+        for f in feats:
+            out_flat *= f
+        lead = x.shape[:-1]
+        y = self.impl(
+            x.reshape(-1, in_dim),
+            kernel.reshape(in_dim, out_flat),
+            bias.reshape(out_flat),
+            self.dtype,
+        )
+        return y.reshape(lead + feats)
+
+
 def dot_product_attention(q, k, v, mask, *, dropout_rng=None,
                           dropout_rate=0.0, dtype=jnp.float32):
     """Default attention core: one softmax(QK^T)V per layer, batched over
@@ -76,15 +121,23 @@ def dot_product_attention(q, k, v, mask, *, dropout_rng=None,
 class BertSelfAttention(nn.Module):
     config: BertConfig
     attention_impl: Optional[Callable] = None
+    #: QKV projection hook (`ProjDense` contract) — the fused
+    #: collective-matmul path (`ops.collective_matmul`); None = nn.DenseGeneral
+    projection_impl: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x, mask, train: bool = True):
         cfg = self.config
         h, nh = cfg.hidden_size, cfg.num_attention_heads
         d = h // nh
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (nh, d), dtype=cfg.dtype, name=name,
-            kernel_init=nn.initializers.normal(cfg.initializer_range))
+        kinit = nn.initializers.normal(cfg.initializer_range)
+        if self.projection_impl is not None:
+            dense = lambda name: ProjDense(  # noqa: E731
+                (nh, d), impl=self.projection_impl, dtype=cfg.dtype,
+                kernel_init=kinit, name=name)
+        else:
+            dense = lambda name: nn.DenseGeneral(  # noqa: E731
+                (nh, d), dtype=cfg.dtype, name=name, kernel_init=kinit)
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
         dropout_rng = None
         if train and cfg.attention_probs_dropout_prob > 0.0:
@@ -102,19 +155,26 @@ class BertSelfAttention(nn.Module):
 class BertLayer(nn.Module):
     config: BertConfig
     attention_impl: Optional[Callable] = None
+    projection_impl: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x, mask, train: bool = True):
         cfg = self.config
         attn = BertSelfAttention(cfg, attention_impl=self.attention_impl,
+                                 projection_impl=self.projection_impl,
                                  name="attention")(x, mask, train)
         attn = nn.Dropout(cfg.hidden_dropout_prob,
                           deterministic=not train)(attn)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="attention_ln")(x + attn)
-        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
-                     kernel_init=nn.initializers.normal(cfg.initializer_range),
-                     name="intermediate")(x)
+        kinit = nn.initializers.normal(cfg.initializer_range)
+        if self.projection_impl is not None:
+            y = ProjDense(cfg.intermediate_size, impl=self.projection_impl,
+                          dtype=cfg.dtype, kernel_init=kinit,
+                          name="intermediate")(x)
+        else:
+            y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                         kernel_init=kinit, name="intermediate")(x)
         y = nn.gelu(y, approximate=True)
         y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                      kernel_init=nn.initializers.normal(cfg.initializer_range),
@@ -135,6 +195,9 @@ class BertForPreTraining(nn.Module):
 
     config: BertConfig
     attention_impl: Optional[Callable] = None
+    #: QKV + MLP-intermediate projection hook (see `ProjDense`) — wires
+    #: the ring collective-matmul into the transformer hot path
+    projection_impl: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
@@ -173,6 +236,7 @@ class BertForPreTraining(nn.Module):
 
         for i in range(cfg.num_hidden_layers):
             x = BertLayer(cfg, attention_impl=self.attention_impl,
+                          projection_impl=self.projection_impl,
                           name=f"layer_{i}")(x, mask, train)
 
         # --- MLM head: transform + tied decoder + bias -----------------------

@@ -147,6 +147,9 @@ def flash_causal_attention_impl():
 class GptBlock(nn.Module):
     config: GptConfig
     attention_impl: Optional[Callable] = None
+    #: QKV + MLP-up projection hook (models/bert.py `ProjDense` contract)
+    #: — the ring collective-matmul path (`ops.collective_matmul`)
+    projection_impl: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False):
@@ -157,8 +160,15 @@ class GptBlock(nn.Module):
 
         y = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_1")(x)
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (nh, d), dtype=cfg.dtype, kernel_init=init, name=name)
+        if self.projection_impl is not None:
+            from dear_pytorch_tpu.models.bert import ProjDense
+
+            dense = lambda name: ProjDense(  # noqa: E731
+                (nh, d), impl=self.projection_impl, dtype=cfg.dtype,
+                kernel_init=init, name=name)
+        else:
+            dense = lambda name: nn.DenseGeneral(  # noqa: E731
+                (nh, d), dtype=cfg.dtype, kernel_init=init, name=name)
         q, k, v = dense("query")(y), dense("key")(y), dense("value")(y)
         dropout_rng = None
         if train and cfg.attention_probs_dropout_prob > 0.0:
@@ -199,6 +209,15 @@ class GptBlock(nn.Module):
                 capacity_factor=cf,
                 dtype=cfg.dtype, name="moe",
             )(y.reshape(B_ * S_, H_)).reshape(B_, S_, H_)
+        elif self.projection_impl is not None:
+            from dear_pytorch_tpu.models.bert import ProjDense
+
+            y = ProjDense(cfg.intermediate_size,
+                          impl=self.projection_impl, dtype=cfg.dtype,
+                          kernel_init=init, name="mlp_in")(y)
+            y = nn.gelu(y, approximate=True)
+            y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, kernel_init=init,
+                         name="mlp_out")(y)
         else:
             y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                          kernel_init=init, name="mlp_in")(y)
@@ -263,6 +282,8 @@ class GptLmHeadModel(nn.Module):
 
     config: GptConfig
     attention_impl: Optional[Callable] = None
+    #: QKV + MLP-up projection hook (see models/bert.py `ProjDense`)
+    projection_impl: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True, position_offset=0,
@@ -289,6 +310,7 @@ class GptLmHeadModel(nn.Module):
             block_cls = nn.remat(GptBlock, static_argnums=(2, 3))
         for i in range(cfg.num_hidden_layers):
             x = block_cls(cfg, attention_impl=self.attention_impl,
+                          projection_impl=self.projection_impl,
                           name=f"h_{i}")(x, train, decode)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_f")(x)

@@ -30,6 +30,11 @@ from dear_pytorch_tpu.ops import fusion as F
 #: collective legs per schedule mode (mirrors parallel/dear.py's device_step)
 MODE_LEGS = {
     "dear": ("reduce_scatter", "all_gather"),
+    # dear-fused moves the same legs, executed by Pallas ring kernels
+    # (ops/collective_matmul.py) instead of XLA collectives — identical
+    # payload/wire accounting, so the auditor's exposed-vs-hidden split is
+    # directly comparable against 'dear'
+    "dear-fused": ("reduce_scatter", "all_gather"),
     "fsdp": ("reduce_scatter", "all_gather"),
     "rsag": ("reduce_scatter", "all_gather"),
     "bytescheduler": ("reduce_scatter", "all_gather"),
@@ -150,7 +155,8 @@ def plan_comm_accounting(
     for b in plan.buckets:
         for leg in MODE_LEGS[mode]:
             itemsize = (gather_itemsize if leg == "all_gather"
-                        and mode in ("dear", "fsdp") else comm_itemsize)
+                        and mode in ("dear", "dear-fused", "fsdp")
+                        else comm_itemsize)
             payload = b.padded_size * itemsize
             rows.append(BucketCommRow(
                 bucket=b.index,

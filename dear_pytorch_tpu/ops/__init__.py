@@ -1,6 +1,12 @@
 """Core array-level operations: tensor fusion, fused updates, compression,
-Pallas attention kernels."""
+Pallas attention kernels, and fused computation-collective ring kernels."""
 
+from dear_pytorch_tpu.ops.collective_matmul import (  # noqa: F401
+    allgather_matmul,
+    fused_reduce_scatter_update,
+    make_ring_projection_impl,
+    ring_all_gather,
+)
 from dear_pytorch_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     make_flash_attention_impl,

@@ -55,6 +55,24 @@ Baseline schedules (same builder, ``mode=``):
                 has no analog inside one jitted step; the dear mode's
                 gather-next-step pipelining is the XLA-native way to
                 get that effect.)
+  'dear-fused'— the dear schedule with BOTH collective legs executed by
+                Pallas ring kernels (`ops/collective_matmul.py`) instead
+                of XLA collectives: the per-bucket all-gather is a ring of
+                async remote copies, and the per-bucket reduce-scatter is
+                FUSED with the optimizer-update epilogue — each ring step
+                RDMAs the partial-sum tile to the neighbor, accumulates
+                the incoming tile in fp32, and the final step applies the
+                traced `ShardOptimizer.update` to the owned shard inside
+                the same kernel (sub-XLA, tile-granular overlap; FLUX /
+                T3 ported to TPU). Numerics match 'dear' at dtype
+                tolerance (ring reduction order differs from
+                psum_scatter; the gather leg and the update math are
+                exact). Constraints: a single dp axis spanning the whole
+                mesh, elementwise optimizers only (no LAMB), no
+                clip_norm. The models' QKV/MLP projections can
+                additionally route through the ring collective-matmul via
+                their ``projection_impl`` hook (see
+                `ops.collective_matmul.make_ring_projection_impl`).
   'fsdp'      — ZeRO-3 beyond the reference (which stops at ZeRO-1 via
                 ZeroRedundancyOptimizer, pytorch-ddp/imagenet_benchmark.py:
                 10,67-68): the loss is differentiated with respect to the
@@ -85,6 +103,7 @@ from dear_pytorch_tpu.comm import collectives as C
 from dear_pytorch_tpu.comm.backend import DP_AXIS
 from dear_pytorch_tpu.observability import counters as _tel_counters
 from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.ops import collective_matmul as CM
 from dear_pytorch_tpu.ops import compression as Z
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.ops.fused_sgd import (
@@ -93,7 +112,8 @@ from dear_pytorch_tpu.ops.fused_sgd import (
     fused_sgd,
 )
 
-MODES = ("dear", "allreduce", "rsag", "rb", "bytescheduler", "fsdp")
+MODES = ("dear", "dear-fused", "allreduce", "rsag", "rb", "bytescheduler",
+         "fsdp")
 #: Ablation switches (reference `exclude_parts`, dear/dear_dopt.py:75-76,
 #: dear/batch.sh:18-43). Time-breakdown instruments — numerics are garbage
 #: when a phase is excluded, exactly as in the reference.
@@ -318,8 +338,35 @@ def build_train_step(
             f"plan was built for world={plan.world} but mesh axis "
             f"{axis_name!r} has size {world}"
         )
-    sharded = mode in ("dear", "fsdp")
+    sharded = mode in ("dear", "dear-fused", "fsdp")
+    fused = mode == "dear-fused"
     excl = frozenset(exclude_parts)
+    if fused:
+        if len(axes) != 1:
+            raise ValueError(
+                "dear-fused rings address devices by LOGICAL mesh id and "
+                "currently support a single data-parallel axis; got "
+                f"{axes}"
+            )
+        if mesh.size != world:
+            raise ValueError(
+                "dear-fused rings require the reduction axis to span the "
+                f"whole mesh (axis size {world} vs mesh size {mesh.size}): "
+                "the kernels' remote-copy device ids are the axis indices"
+            )
+        if clip_norm is not None:
+            raise ValueError(
+                "dear-fused applies the optimizer inside the per-bucket "
+                "reduce-scatter kernel; the cross-bucket global-norm clip "
+                "needs every bucket's reduced gradient first — use "
+                "mode='dear' with clip_norm"
+            )
+        if isinstance(optimizer, LayerwiseShardOptimizer):
+            raise ValueError(
+                "dear-fused cannot fuse LayerwiseShardOptimizer (LAMB) "
+                "into the epilogue kernel: trust ratios need cross-shard "
+                "psums — use mode='dear'"
+            )
     if gather_dtype is not None and not sharded:
         raise ValueError("gather_dtype applies to the sharded ('dear'/'fsdp') "
                          "schedules only")
@@ -384,6 +431,13 @@ def build_train_step(
                         axis=0,
                     )
                     for b, s in zip(plan.buckets, state.buffers)
+                ]
+            elif fused:
+                # Pallas ring all-gather: chunk t+1 streams over the ICI
+                # while chunk t lands (bit-identical to lax.all_gather)
+                full_bufs = [
+                    CM.ring_all_gather(cast_shard(s), axis_name)
+                    for s in state.buffers
                 ]
             else:
                 full_bufs = [
@@ -543,6 +597,10 @@ def build_train_step(
             gbuf = None if mode == "fsdp" else grad_bufs[g]
             if mode == "fsdp":
                 grad = grads[g].astype(state.buffers[g].dtype) / mean_world
+            elif fused:
+                # the reduce-scatter happens INSIDE the fused update kernel
+                # (ring RS + optimizer epilogue); carry the raw comm buffer
+                grad = gbuf
             elif sharded:
                 if "reducescatter" in excl:  # ablation: local slice, no comm
                     gshard = lax.dynamic_slice_in_dim(
@@ -671,7 +729,15 @@ def build_train_step(
         )
         new_buffers, new_opt = [], []
         for g, grad in enumerate(bucket_grads):
-            if layerwise:
+            if fused:
+                # one Pallas kernel: ring reduce-scatter of the bucket's
+                # comm buffer + the optimizer update on the owned shard in
+                # the final ring step (the fused epilogue)
+                new_p, new_o = CM.fused_reduce_scatter_update(
+                    grad, state.buffers[g], state.opt_state[g], optimizer,
+                    axis_name, mean_world=mean_world, **step_kw,
+                )
+            elif layerwise:
                 # per-parameter segment metadata for exact cross-shard
                 # reductions (LAMB trust ratios): this device's slice of the
                 # bucket's element->parameter map, plus the psum completing
@@ -872,6 +938,12 @@ def build_train_step(
         tr.count("dear.steps")
         for leg, nbytes in _leg_bytes.items():
             tr.count(f"dear.{leg}_bytes", nbytes)
+        if fused:
+            # per-step Pallas ring-kernel launch accounting (one fused
+            # RS+update and one ring all-gather per bucket per step) — the
+            # overlap auditor joins these with the static leg bytes above
+            tr.count("kernel.fused_rs_launches", plan.num_buckets)
+            tr.count("kernel.ring_ag_launches", plan.num_buckets)
         with tr.span("dear.step", mode=mode):
             return _jitted(state, batch)(state, batch)
 

@@ -200,6 +200,21 @@ def _pair_branch(owner, idx, causal):
                      jnp.where(owner < idx, jnp.int32(0), jnp.int32(2)))
 
 
+def _seq_branch_index(causal):
+    """Branch-index fn for the SEQUENTIAL layout, or None when the
+    schedule is static (non-causal: every pair is branch 0). Returning
+    None matters beyond taste: the scan scaffolds skip `lax.axis_index`
+    entirely for a static schedule. With a traced-but-DEAD axis_index,
+    the custom_vjp boundary keeps the dead `partition-id` chain alive
+    through to XLA, whose SPMD partitioner rejects the instruction
+    ("PartitionId ... is ambiguous") — the deterministic
+    ring_flash matches_full[False] / padding_mask container failures
+    (pre-existing at PR 7's HEAD, root-caused here)."""
+    if not causal:
+        return None
+    return lambda owner, idx: _pair_branch(owner, idx, True)
+
+
 # Shared ring-of-flash-kernels scaffold. A "variant" is just a branch set
 # for lax.switch plus the (owner, idx) -> branch index map; the sequential
 # and zigzag layouts share EVERYTHING else (the online-softmax LSE combine,
@@ -210,17 +225,21 @@ def _pair_branch(owner, idx, causal):
 def _ring_fwd_scan(q, k, v, mask, axis_name, branch_index_fn, branches):
     """Forward ring: fold per-step (o, lse) block contributions into
         out = Σ_b o_b · exp(lse_b − m*) / Σ_b exp(lse_b − m*)
-    Returns (out, global_lse)."""
+    Returns (out, global_lse). ``branch_index_fn=None`` = static schedule
+    (always branch 0, no axis_index emitted — see `_seq_branch_index`)."""
     world = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
+    idx = None if branch_index_fn is None else lax.axis_index(axis_name)
     bh, sq, d = q.shape
     perm = _ring_perm(world)
 
     def step(carry, s):
         kb, vb, mb, m, den, num = carry
-        owner = (idx - s) % world
-        o_b, lse_b = lax.switch(branch_index_fn(owner, idx), branches,
-                                (q, kb, vb, mb))
+        if branch_index_fn is None:
+            o_b, lse_b = branches[0]((q, kb, vb, mb))
+        else:
+            owner = (idx - s) % world
+            o_b, lse_b = lax.switch(branch_index_fn(owner, idx), branches,
+                                    (q, kb, vb, mb))
         lse_b = jnp.maximum(lse_b, _NEG_BIG)     # fully-masked rows finite
         m_new = jnp.maximum(m, lse_b)
         w = jnp.exp(lse_b - m_new)
@@ -246,16 +265,20 @@ def _ring_fwd_scan(q, k, v, mask, axis_name, branch_index_fn, branches):
 def _ring_bwd_scan(q, k, v, mask, axis_name, branch_index_fn, branches):
     """Backward ring: per-step (dq, dk, dv) block contributions; dK/dV
     accumulators rotate WITH their K/V blocks and arrive home after
-    ``world`` steps. Returns fp32 (dq, dk, dv)."""
+    ``world`` steps. Returns fp32 (dq, dk, dv). ``branch_index_fn=None``
+    = static schedule (always branch 0, no axis_index emitted)."""
     world = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
+    idx = None if branch_index_fn is None else lax.axis_index(axis_name)
     perm = _ring_perm(world)
 
     def step(carry, s):
         kb, vb, mb, dkb, dvb, dq = carry
-        owner = (idx - s) % world
-        dq_c, dk_c, dv_c = lax.switch(branch_index_fn(owner, idx),
-                                      branches, (q, kb, vb, mb))
+        if branch_index_fn is None:
+            dq_c, dk_c, dv_c = branches[0]((q, kb, vb, mb))
+        else:
+            owner = (idx - s) % world
+            dq_c, dk_c, dv_c = lax.switch(branch_index_fn(owner, idx),
+                                          branches, (q, kb, vb, mb))
         dq = dq + dq_c
         dkb = dkb + dk_c
         dvb = dvb + dv_c
@@ -315,7 +338,7 @@ def _ring_flash_fwd_pass(q, k, v, mask, axis_name, scale, causal):
     heads = q.shape[0] // mask.shape[0]  # mask stays [B, S]
     return _ring_fwd_scan(
         q, k, v, mask, axis_name,
-        lambda owner, idx: _pair_branch(owner, idx, causal),
+        _seq_branch_index(causal),
         _seq_fwd_branches(q, mask, scale, heads),
     )
 
@@ -353,7 +376,7 @@ def _ring_flash_bwd(axis_name, scale, causal, res, do):
 
     dq, dk, dv = _ring_bwd_scan(
         q, k, v, mask, axis_name,
-        lambda owner, idx: _pair_branch(owner, idx, causal),
+        _seq_branch_index(causal),
         [make_branch(False), make_branch(True), skip_b],
     )
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),

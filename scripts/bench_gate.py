@@ -19,6 +19,19 @@ floor, not a ratio, so it gates independently of any baseline; with only
   python scripts/bench_gate.py --run /tmp/autoscale.json \
       --slo steps_per_hour=120
 
+``--ab-methods CANDIDATE:BASE`` gates one driver-sweep METHOD against
+another inside a single `benchmarks/driver.py` ``reports.json`` — the
+one-command A/B the fused-kernel mode ships with: run the sweep with
+``--methods dear,dear-fused`` and gate the candidate's throughput at
+``>= (1 - tolerance) x`` the base's on every (model, nworkers) cell both
+methods produced; a cell the base has but the candidate lost fails
+(same silently-stopped-reporting rule as metrics):
+
+  python -m dear_pytorch_tpu.benchmarks.driver --logdir logs \
+      --tasks bert_base:8 --methods dear,dear-fused --emulate 8
+  python scripts/bench_gate.py --run logs/reports.json \
+      --ab-methods dear-fused:dear --tolerance 0.05
+
 Both files may be either the raw contract line (``{"metric", "value",
 "extra_metrics": [...]}``) or the driver's round record (``{"parsed":
 {...}}``). Metrics are throughput numbers (higher is better); entries
@@ -76,6 +89,46 @@ def _load(path: str) -> dict:
     raise ValueError(f"{path}: no JSON object found")
 
 
+def compare_driver_methods(report: dict, candidate: str, base: str,
+                           tolerance: float) -> dict:
+    """A/B two methods of a `benchmarks/driver.py` reports.json.
+
+    Shape: ``report[model][method][nworkers] = [mean, ci] | None``. Every
+    (model, nworkers) cell where the BASE has a scraped result is gated:
+    candidate missing/failed counts as ``missing`` (a method that stopped
+    producing results is a harness regression, not parity); present cells
+    must satisfy ``candidate >= (1 - tolerance) * base``."""
+    rows, missing = [], []
+    for model in sorted(report):
+        methods = report[model]
+        if model == "telemetry" or not isinstance(methods, dict):
+            continue
+        c_cells = methods.get(candidate)
+        b_cells = methods.get(base)
+        if not isinstance(b_cells, dict):
+            continue
+        for nw in sorted(b_cells):
+            bv = b_cells[nw]
+            if not bv:
+                continue  # base itself failed: nothing to gate against
+            cv = (c_cells or {}).get(nw)
+            if not cv:
+                missing.append(f"{model}[{nw}]")
+                continue
+            ratio = cv[0] / bv[0] if bv[0] else float("inf")
+            rows.append({
+                "model": model, "nworkers": nw,
+                "candidate": cv[0], "base": bv[0],
+                "ratio": round(ratio, 4),
+                "ok": bool(ratio >= 1.0 - tolerance),
+            })
+    return {
+        "candidate": candidate, "base": base, "tolerance": tolerance,
+        "cells": rows, "missing": missing,
+        "ok": bool(rows) and all(r["ok"] for r in rows) and not missing,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fail on bench throughput regressions vs a baseline "
@@ -97,7 +150,57 @@ def main(argv=None) -> int:
                          "a missing metric fails the gate — a service "
                          "that stopped reporting its SLO is down, not "
                          "quiet")
+    ap.add_argument("--ab-methods", default=None, metavar="CANDIDATE:BASE",
+                    help="gate one driver-sweep method against another "
+                         "inside --run (a benchmarks/driver.py "
+                         "reports.json): candidate >= (1-tolerance) x "
+                         "base per (model, nworkers) cell")
     args = ap.parse_args(argv)
+
+    if args.ab_methods:
+        # a standalone gate over a driver reports.json — the other gates
+        # read contract-shaped metric files, so combining would silently
+        # gate nothing; refuse loudly instead
+        if args.baseline is not None or args.slo:
+            print(json.dumps({"ok": False,
+                              "error": "--ab-methods gates a driver "
+                                       "reports.json on its own; run "
+                                       "--baseline/--slo gates as a "
+                                       "separate invocation"}))
+            return 3
+        cand, sep, base = args.ab_methods.partition(":")
+        if not sep or not cand.strip() or not base.strip():
+            print(json.dumps({"ok": False,
+                              "error": f"bad --ab-methods "
+                                       f"{args.ab_methods!r} "
+                                       "(CANDIDATE:BASE)"}))
+            return 3
+        try:
+            report = _load(args.run)
+        except (OSError, ValueError) as exc:
+            print(json.dumps({"ok": False,
+                              "error": f"{type(exc).__name__}: {exc}"}))
+            return 3
+        verdict = compare_driver_methods(report, cand.strip(),
+                                         base.strip(), args.tolerance)
+        if args.allow_missing and verdict["missing"] \
+                and verdict["cells"] and all(
+                    r["ok"] for r in verdict["cells"]):
+            verdict["ok"] = True
+        print(json.dumps(verdict))
+        if not verdict["ok"]:
+            lines = [f"  {r['model']}[{r['nworkers']}]: "
+                     f"{r['candidate']:g} vs {r['base']:g} "
+                     f"({(r['ratio'] - 1) * 100:+.1f}%)"
+                     for r in verdict["cells"] if not r["ok"]]
+            lines += [f"  {m}: missing from the candidate method"
+                      for m in verdict["missing"]]
+            if not verdict["cells"] and not verdict["missing"]:
+                lines = ["  no comparable (model, nworkers) cells found"]
+            sys.stderr.write(f"bench_gate: A/B {cand} vs {base} failed:\n"
+                             + "\n".join(lines) + "\n")
+            return 2
+        return 0
 
     # stdlib-only import path: anomaly.py never touches jax
     from dear_pytorch_tpu.observability import anomaly as A

@@ -109,11 +109,29 @@ def main(argv=None) -> int:
         if fl.enabled:
             fl.record(0, step_time_s=1e-3)
 
+    # kernel-telemetry gates, the way ops/collective_matmul.py's ring-
+    # kernel builders (`_count_build`) and parallel/dear.py's dear-fused
+    # per-step launch accounting execute them: count + event under one
+    # enabled check. Same disabled-cost contract as the step gates.
+    def kernel_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("kernel.fused_rs_builds")
+            tr.event("kernel.fused_rs_build")
+
+    def kernel_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("kernel.fused_rs_builds")
+            tr.event("kernel.fused_rs_build", elements=1024, world=8)
+
     baseline_ns = _bench(baseline, args.iters)
     disabled_ns = _bench(disabled_gate, args.iters)
     enabled_ns = _bench(enabled_site, max(args.iters // 10, 1))
     fl_disabled_ns = _bench(flight_disabled_gate, args.iters)
     fl_enabled_ns = _bench(flight_enabled_site, max(args.iters // 10, 1))
+    k_disabled_ns = _bench(kernel_disabled_gate, args.iters)
+    k_enabled_ns = _bench(kernel_enabled_site, max(args.iters // 10, 1))
     overhead_ns = max(disabled_ns - baseline_ns, 0.0)
 
     out = {
@@ -122,10 +140,13 @@ def main(argv=None) -> int:
         "enabled_ns_per_call": round(enabled_ns, 1),
         "flight_disabled_ns_per_call": round(fl_disabled_ns, 1),
         "flight_enabled_ns_per_call": round(fl_enabled_ns, 1),
+        "kernel_disabled_ns_per_call": round(k_disabled_ns, 1),
+        "kernel_enabled_ns_per_call": round(k_enabled_ns, 1),
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
         "ok": (disabled_ns <= args.budget_ns
-               and fl_disabled_ns <= args.budget_ns),
+               and fl_disabled_ns <= args.budget_ns
+               and k_disabled_ns <= args.budget_ns),
     }
     print(json.dumps(out))
     return 0 if out["ok"] else 1

@@ -36,7 +36,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-MODES = ("dear", "allreduce", "fsdp")
+MODES = ("dear", "dear-fused", "allreduce", "fsdp")
 
 
 def hlo_overlap_metric(mode: str) -> dict:
@@ -131,6 +131,33 @@ def main(argv=None) -> int:
         > ar["mean_independent_compute_frac"]
     )
     summary["claim_dear_overlappability_above_allreduce"] = bool(ok)
+
+    # dear-fused A/B: its ring transport lives INSIDE the Pallas kernels
+    # (sub-XLA — invisible to XLA's scheduler, which is the point), so the
+    # structural metric only sees whatever collectives the lowering leaves
+    # in the program (on the CPU interpret lowering, the RDMA emulation).
+    # The gated claim is computability — the mode compiles at world=8 and
+    # the metric evaluates — plus the per-mode numbers for the A/B; the
+    # exposed-vs-hidden TIME comparison is the auditor's job:
+    #   python -m dear_pytorch_tpu.observability.report \
+    #       --modes dear,dear-fused
+    fused = summary["hlo_world8"].get("dear-fused", {})
+    fused_ok = isinstance(
+        fused.get("mean_independent_compute_frac"), float)
+    summary["dear_fused_vs_dear"] = {
+        "note": ("ring transport is sub-XLA (in-kernel remote copies); "
+                 "HLO fractions compare only scheduler-visible structure"),
+        "dear_mean_independent_compute_frac":
+            dear.get("mean_independent_compute_frac"),
+        "dear_fused_mean_independent_compute_frac":
+            fused.get("mean_independent_compute_frac"),
+        "dear_collectives": {
+            k: v["count"] for k, v in dear.get("collectives", {}).items()},
+        "dear_fused_collectives": {
+            k: v["count"] for k, v in fused.get("collectives", {}).items()},
+    }
+    summary["claim_dear_fused_compiles_and_scores"] = bool(fused_ok)
+    ok = ok and fused_ok
     os.makedirs(args.out, exist_ok=True)
     with open(os.path.join(args.out, "summary.json"), "w") as f:
         json.dump(summary, f, indent=1)

@@ -192,3 +192,28 @@ def test_dropout0_and_remat_flags_shape_the_config():
     assert g.dropout0 and g.remat
     b = bert_cli.build_parser().parse_args(["--dropout0"])
     assert b.dropout0
+
+
+def test_bert_dear_fused_ring_projections_cli(mesh, capsys):
+    """--mode dear-fused end-to-end through the BERT CLI, with the QKV/MLP
+    projections routed through the ring collective-matmul
+    (--ring-projections): the scrape-able contract line still appears."""
+    res = bert_bench.main(
+        ["--model", "bert_base", "--num-hidden-layers", "1",
+         "--sentence-len", "16", "--batch-size", "2",
+         "--mode", "dear-fused", "--ring-projections", "--dropout0"]
+        + TINY
+    )
+    out = capsys.readouterr().out
+    assert re.search(r"Total sen/sec on 8 \w+\(s\): ", out), out
+    assert "Schedule: dear-fused" in out
+    assert res.unit == "sen"
+
+
+def test_ring_projections_flag_requires_dear_fused(mesh):
+    with pytest.raises(SystemExit, match="ring-projections"):
+        bert_bench.main(
+            ["--model", "bert_base", "--num-hidden-layers", "1",
+             "--sentence-len", "16", "--batch-size", "2",
+             "--ring-projections"] + TINY
+        )

@@ -1,0 +1,553 @@
+"""Fused computation-collective Pallas kernels (ops/collective_matmul.py)
+and the ``mode="dear-fused"`` schedule.
+
+Every kernel runs under Pallas interpret mode on the 8-device emulated CPU
+mesh — the exact ring schedule, async-remote-copy slot protocol, and
+traced optimizer epilogue that would run on chip. The contract asserted
+here: the fused schedule agrees with the unfused 'dear' schedule at
+dtype-appropriate tolerance (the ring reduction order differs from
+psum_scatter; the gather leg is bitwise, the update math is traced from
+the same `ShardOptimizer`).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.comm import collectives as C
+from dear_pytorch_tpu.comm.backend import DP_AXIS
+from dear_pytorch_tpu.ops import collective_matmul as CM
+from dear_pytorch_tpu.ops.fused_sgd import fused_adamw, fused_sgd
+from dear_pytorch_tpu.ops.schedules import warmup_cosine
+from dear_pytorch_tpu.parallel import build_train_step
+
+# fp32 ring sums differ from psum_scatter only in association order
+FP32_TOL = dict(rtol=2e-5, atol=2e-6)
+BF16_TOL = dict(rtol=2e-2, atol=2e-2)
+
+
+def _spmd(fn, mesh, n_in, n_out=1):
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.P(DP_AXIS),) * n_in,
+        out_specs=(jax.P(DP_AXIS),) * n_out if n_out > 1 else jax.P(DP_AXIS),
+        check_vma=False,
+    ))
+
+
+# ---------------------------------------------------------------------------
+# ring all-gather
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [8, 24, 129])  # incl. a non-128-multiple
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_ring_all_gather_matches_lax(mesh, world, n, dtype):
+    """Pure data movement: bitwise equal to lax.all_gather (tiled)."""
+    shards = jax.random.normal(
+        jax.random.PRNGKey(0), (world, n), jnp.float32).astype(dtype)
+
+    def fn(s):
+        return CM.ring_all_gather(s[0], DP_AXIS)[None]
+
+    got = np.asarray(_spmd(fn, mesh, 1)(shards))
+    want = np.tile(np.asarray(shards).reshape(-1), (world, 1))
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# fused reduce-scatter + optimizer epilogue
+# ---------------------------------------------------------------------------
+
+
+def _unfused_reference(gstack, p0, opt_state0, opt, world, dtype,
+                       step=None):
+    """What 'dear' computes: psum_scatter-equivalent reduction + the plain
+    ShardOptimizer.update per shard, on the host in fp64-free numpy."""
+    gsum = np.asarray(gstack, np.float32).sum(0)
+    ss = p0.shape[0] // world
+    new_p, new_states = [], []
+    for i in range(world):
+        sl = slice(i * ss, (i + 1) * ss)
+        grad = jnp.asarray(gsum[sl]).astype(dtype) / world
+        state_i = jax.tree.map(
+            lambda l: l[sl] if getattr(l, "ndim", 0) == 1 else l, opt_state0)
+        kw = {"step": step} if step is not None else {}
+        p_i, s_i = opt.update(grad, state_i, jnp.asarray(p0[sl], dtype), **kw)
+        new_p.append(np.asarray(p_i, np.float32))
+        new_states.append(s_i)
+    return np.concatenate(new_p), new_states
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, FP32_TOL),
+                                       (jnp.bfloat16, BF16_TOL)])
+@pytest.mark.parametrize("optname", ["sgd", "sgd_momentum", "adamw"])
+@pytest.mark.parametrize("ss", [16, 37])  # incl. a non-divisible-by-8 shard
+def test_fused_rs_update_matches_unfused(mesh, world, dtype, tol, optname,
+                                         ss):
+    opt = {
+        "sgd": fused_sgd(lr=0.05),
+        "sgd_momentum": fused_sgd(lr=0.05, momentum=0.9, weight_decay=1e-4),
+        "adamw": fused_adamw(lr=1e-3),
+    }[optname]
+    padded = world * ss
+    gstack = jax.random.normal(jax.random.PRNGKey(1), (world, padded),
+                               jnp.float32).astype(dtype)
+    p0 = jax.random.normal(jax.random.PRNGKey(2), (padded,),
+                           jnp.float32).astype(dtype)
+    opt_state0 = opt.init(p0)
+
+    def fn(g, p, *state_leaves):
+        leaves = [l[0] for l in state_leaves]
+        state = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(opt_state0), leaves)
+        new_p, new_s = CM.fused_reduce_scatter_update(
+            g[0], p[0], state, opt, DP_AXIS, mean_world=world)
+        outs = [new_p[None]] + [
+            jnp.broadcast_to(l, (1,) + jnp.shape(l))
+            for l in jax.tree_util.tree_flatten(new_s)[0]]
+        return tuple(outs)
+
+    # shard vector state leaves; replicate scalars by stacking per device
+    def stage(leaf):
+        if getattr(leaf, "ndim", 0) == 1:
+            return jnp.reshape(leaf, (world, ss))
+        return jnp.broadcast_to(jnp.asarray(leaf)[None], (world,))
+
+    state_stacked = [stage(l) for l in jax.tree_util.tree_flatten(
+        opt_state0)[0]]
+    p_stacked = p0.reshape(world, ss)
+    n_in = 2 + len(state_stacked)
+    outs = _spmd(fn, mesh, n_in, n_out=1 + len(state_stacked))(
+        gstack, p_stacked, *state_stacked)
+
+    want_p, want_states = _unfused_reference(
+        gstack, np.asarray(p0, np.float32), opt_state0, opt, world, dtype)
+    got_p = np.asarray(outs[0], np.float32).reshape(-1)
+    np.testing.assert_allclose(got_p, want_p, **tol)
+
+    # state agreement (momentum / adam moments / counters / flags)
+    got_leaves = [np.asarray(o) for o in outs[1:]]
+    want_leaf_rows = [jax.tree_util.tree_flatten(s)[0]
+                      for s in want_states]
+    for j, got in enumerate(got_leaves):
+        for i in range(world):
+            want = np.asarray(want_leaf_rows[i][j], np.float32)
+            np.testing.assert_allclose(
+                np.asarray(got[i], np.float32), want, **tol)
+
+
+def test_fused_rs_update_lr_schedule_needs_step(mesh, world):
+    """needs_step optimizers receive the replicated step scalar inside the
+    kernel (SMEM), and the schedule evaluates identically."""
+    opt = fused_sgd(lr=warmup_cosine(0.1, warmup_steps=2, total_steps=10))
+    assert opt.needs_step
+    ss = 16
+    padded = world * ss
+    gstack = jax.random.normal(jax.random.PRNGKey(3), (world, padded))
+    p0 = jax.random.normal(jax.random.PRNGKey(4), (padded,))
+    step = jnp.asarray(5, jnp.int32)
+
+    def fn(g, p):
+        new_p, _ = CM.fused_reduce_scatter_update(
+            g[0], p[0], opt.init(p[0]), opt, DP_AXIS,
+            mean_world=world, step=step)
+        return new_p[None]
+
+    got = np.asarray(_spmd(fn, mesh, 2)(
+        gstack, p0.reshape(world, ss))).reshape(-1)
+    want, _ = _unfused_reference(
+        gstack, np.asarray(p0), opt.init(p0), opt, world, jnp.float32,
+        step=step)
+    np.testing.assert_allclose(got, want, **FP32_TOL)
+
+
+def test_fused_rs_update_rejects_layerwise_state(mesh, world):
+    """A state leaf that is neither shard-shaped nor scalar is unfusable
+    and must raise with the reason (not silently mis-update)."""
+    opt = fused_sgd(lr=0.1)
+    bad_state = (jnp.zeros((4, 4)),)
+
+    def fn(g, p):
+        new_p, _ = CM.fused_reduce_scatter_update(
+            g[0], p[0], bad_state, opt, DP_AXIS, mean_world=world)
+        return new_p[None]
+
+    with pytest.raises(ValueError, match="cannot .*fused|can only fuse"):
+        _spmd(fn, mesh, 2)(jnp.zeros((world, world * 8)),
+                           jnp.zeros((world, 8)))
+
+
+# ---------------------------------------------------------------------------
+# ring collective-matmul (all-gather fused into the matmul)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, FP32_TOL),
+                                       (jnp.bfloat16, BF16_TOL)])
+def test_allgather_matmul_matches_dense(mesh, world, dtype, tol):
+    m, k, n = 16, 8 * world, 24
+    x = jax.random.normal(jax.random.PRNGKey(5), (m, k),
+                          jnp.float32).astype(dtype)
+    w = jax.random.normal(jax.random.PRNGKey(6), (k, n),
+                          jnp.float32).astype(dtype)
+    want = np.asarray(x.astype(jnp.float32) @ w.astype(jnp.float32))
+
+    def fn(xs, ws):
+        y = CM.allgather_matmul(xs[0], ws[0], DP_AXIS)
+        return y[None]
+
+    xs = jnp.broadcast_to(x[None], (world,) + x.shape)  # replicated acts
+    ws = w.reshape(world, k // world, n)                # row shards
+    got = np.asarray(_spmd(fn, mesh, 2)(xs, ws), np.float32)
+    for i in range(world):
+        np.testing.assert_allclose(got[i], want, **tol)
+
+
+def test_allgather_matmul_gradients_match_dense(mesh, world):
+    """custom VJP: dx (shards re-streamed) and the ring-reduced dw_shard
+    equal the dense matmul's gradients."""
+    m, k, n = 8, 8 * world, 16
+    kc = k // world
+    x = jax.random.normal(jax.random.PRNGKey(7), (m, k))
+    w = jax.random.normal(jax.random.PRNGKey(8), (k, n))
+    co = jax.random.normal(jax.random.PRNGKey(9), (m, n))
+
+    def dense_loss(x_, w_):
+        return jnp.sum((x_ @ w_) * co)
+
+    want_dx, want_dw = jax.grad(dense_loss, argnums=(0, 1))(x, w)
+
+    def fn(xs, ws, cs):
+        def loss(x_, w_shard):
+            return jnp.sum(CM.allgather_matmul(x_, w_shard, DP_AXIS)
+                           * cs[0])
+        dx, dws = jax.grad(loss, argnums=(0, 1))(xs[0], ws[0])
+        return dx[None], dws[None]
+
+    xs = jnp.broadcast_to(x[None], (world,) + x.shape)
+    cs = jnp.broadcast_to(co[None], (world,) + co.shape)
+    ws = w.reshape(world, kc, n)
+    dx, dws = _spmd(fn, mesh, 3, n_out=2)(xs, ws, cs)
+    # every device sees the same x, so each device's dx is the full dense dx
+    for i in range(world):
+        np.testing.assert_allclose(np.asarray(dx[i]), np.asarray(want_dx),
+                                   rtol=1e-4, atol=1e-5)
+    # dw_shard arrives cross-device reduced: with x replicated the dense dw
+    # equals world * (per-device contribution)?? No — the ring sums the SAME
+    # contribution from every device, so dw_shard = world * local x^T dy ...
+    # The dense reference for REPLICATED x/dy: each device's local grad is
+    # the full dense dw; the ring-reduced shard is world * dense rows.
+    got_dw = np.concatenate([np.asarray(dws[i]) for i in range(world)])
+    np.testing.assert_allclose(got_dw, world * np.asarray(want_dw),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ring_projection_impl_matches_dense(mesh, world):
+    """The models' projection hook: slice-shard + ring matmul + bias ==
+    the plain dense projection."""
+    impl = CM.make_ring_projection_impl(DP_AXIS)
+    m, k, n = 8, 8 * world, 12
+    x = jax.random.normal(jax.random.PRNGKey(10), (m, k))
+    w = jax.random.normal(jax.random.PRNGKey(11), (k, n))
+    b = jax.random.normal(jax.random.PRNGKey(12), (n,))
+    want = np.asarray(x @ w + b[None])
+
+    def fn(xs, ws, bs):
+        return impl(xs[0], ws[0], bs[0], jnp.float32)[None]
+
+    xs = jnp.broadcast_to(x[None], (world,) + x.shape)
+    ws = jnp.broadcast_to(w[None], (world,) + w.shape)  # replicated full W
+    bs = jnp.broadcast_to(b[None], (world,) + b.shape)
+    got = np.asarray(_spmd(fn, mesh, 3)(xs, ws, bs))
+    for i in range(world):
+        np.testing.assert_allclose(got[i], want, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# mode="dear-fused": end-to-end agreement with mode="dear"
+# ---------------------------------------------------------------------------
+
+
+def _mlp(width, n_layers=3, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), n_layers)
+    params = {
+        f"l{i}": {"w": (jax.random.normal(ks[i], (width, width)) * 0.1
+                        ).astype(dtype),
+                  "b": jnp.zeros((width,), dtype)}
+        for i in range(n_layers)
+    }
+
+    def loss(p, b):
+        x, y = b
+        for i in range(n_layers):
+            x = jnp.tanh(x @ p[f"l{i}"]["w"] + p[f"l{i}"]["b"])
+        return jnp.mean((x - y).astype(jnp.float32) ** 2)
+
+    return params, loss
+
+
+def _run_mode(mode, params, loss, mesh, batch, opt, steps=4, **kw):
+    ts = build_train_step(loss, params, mesh=mesh, mode=mode,
+                          optimizer=opt, donate=False, **kw)
+    state = ts.init(params)
+    metrics = None
+    for _ in range(steps):
+        state, metrics = ts.step(state, batch)
+    return (jax.tree.map(np.asarray, ts.gather_params(state)),
+            float(metrics["loss"]), ts)
+
+
+@pytest.mark.parametrize("buckets_kw", [dict(nearby_layers=1),
+                                        dict(threshold_mb=25.0)])
+@pytest.mark.parametrize("optname", ["sgd_momentum", "adamw"])
+def test_dear_fused_matches_dear_e2e(mesh, buckets_kw, optname):
+    """The acceptance gate: multi-step training under dear-fused tracks
+    dear at fp32 tolerance across bucket counts (multi- and single-bucket
+    plans) and both fused optimizers."""
+    opt = (fused_sgd(lr=0.05, momentum=0.9) if optname == "sgd_momentum"
+           else fused_adamw(lr=1e-3))
+    params, loss = _mlp(64)
+    batch = (jax.random.normal(jax.random.PRNGKey(20), (32, 64)),
+             jax.random.normal(jax.random.PRNGKey(21), (32, 64)))
+    p_dear, l_dear, ts = _run_mode("dear", params, loss, mesh, batch, opt,
+                                   **buckets_kw)
+    p_fused, l_fused, ts_f = _run_mode("dear-fused", params, loss, mesh,
+                                       batch, opt, **buckets_kw)
+    assert ts_f.plan.num_buckets == ts.plan.num_buckets
+    assert l_fused == pytest.approx(l_dear, rel=1e-5)
+    for a, b in zip(jax.tree.leaves(p_dear), jax.tree.leaves(p_fused)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_dear_fused_matches_dear_bf16_params(mesh):
+    """bf16 params / fp32 in-kernel accumulation: tracks dear's bf16 wire
+    at bf16 tolerance (the ring accumulates in fp32, never worse)."""
+    params, loss = _mlp(64, dtype=jnp.bfloat16)
+    batch = (jax.random.normal(jax.random.PRNGKey(22), (32, 64),
+                               jnp.bfloat16),
+             jax.random.normal(jax.random.PRNGKey(23), (32, 64),
+                               jnp.bfloat16))
+    opt = fused_sgd(lr=0.05, momentum=0.9)
+    p_dear, l_dear, _ = _run_mode("dear", params, loss, mesh, batch, opt,
+                                  nearby_layers=1)
+    p_fused, l_fused, _ = _run_mode("dear-fused", params, loss, mesh,
+                                    batch, opt, nearby_layers=1)
+    assert l_fused == pytest.approx(l_dear, rel=2e-2)
+    for a, b in zip(jax.tree.leaves(p_dear), jax.tree.leaves(p_fused)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), **BF16_TOL)
+
+
+def test_dear_fused_non_divisible_bucket_padding(mesh, world):
+    """Bucket sizes that do not divide by world exercise the padded tail
+    through the ring (the pad rides the last shard exactly as in dear)."""
+    params = {"a": {"w": jax.random.normal(jax.random.PRNGKey(1),
+                                           (13, 5))},
+              "b": {"w": jax.random.normal(jax.random.PRNGKey(2), (9,))}}
+
+    def loss(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["a"]["w"] + p["b"]["w"][None, :5] - y) ** 2)
+
+    batch = (jax.random.normal(jax.random.PRNGKey(3), (16, 13)),
+             jax.random.normal(jax.random.PRNGKey(4), (16, 5)))
+    opt = fused_sgd(lr=0.05, momentum=0.9)
+    p_dear, _, ts = _run_mode("dear", params, loss, mesh, batch, opt,
+                              nearby_layers=1)
+    assert any(b.pad for b in ts.plan.buckets)  # the case under test
+    p_fused, _, _ = _run_mode("dear-fused", params, loss, mesh, batch, opt,
+                              nearby_layers=1)
+    for a, b in zip(jax.tree.leaves(p_dear), jax.tree.leaves(p_fused)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_dear_fused_gather_dtype_and_comm_dtype(mesh):
+    """comm_dtype=bf16 wire + gather_dtype=bf16 compose with the rings the
+    same way they compose with the XLA collectives."""
+    params, loss = _mlp(64)
+    batch = (jax.random.normal(jax.random.PRNGKey(24), (32, 64)),
+             jax.random.normal(jax.random.PRNGKey(25), (32, 64)))
+    opt = fused_sgd(lr=0.05, momentum=0.9)
+    kw = dict(nearby_layers=1, comm_dtype=jnp.bfloat16,
+              gather_dtype=jnp.bfloat16)
+    p_dear, _, _ = _run_mode("dear", params, loss, mesh, batch, opt, **kw)
+    p_fused, _, _ = _run_mode("dear-fused", params, loss, mesh, batch, opt,
+                              **kw)
+    for a, b in zip(jax.tree.leaves(p_dear), jax.tree.leaves(p_fused)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), **BF16_TOL)
+
+
+def test_dear_fused_rejects_unsupported_configs(mesh):
+    params, loss = _mlp(64)
+    with pytest.raises(ValueError, match="clip_norm"):
+        build_train_step(loss, params, mesh=mesh, mode="dear-fused",
+                         clip_norm=1.0)
+    from dear_pytorch_tpu.ops.fused_sgd import fused_lamb
+
+    with pytest.raises(ValueError, match="Layerwise|LAMB"):
+        build_train_step(loss, params, mesh=mesh, mode="dear-fused",
+                         optimizer=fused_lamb(lr=1e-3))
+
+
+def test_dear_fused_counters_flow_to_tracer(mesh):
+    """kernel.* counters reach the tracer: builds at trace time, launches
+    per step (what the overlap auditor joins with the static leg bytes)."""
+    from dear_pytorch_tpu.observability import tracer as T
+
+    old = T.get_tracer()
+    T.set_tracer(T.Tracer([T.MemoryExporter()]))
+    try:
+        params, loss = _mlp(64)
+        batch = (jax.random.normal(jax.random.PRNGKey(26), (32, 64)),
+                 jax.random.normal(jax.random.PRNGKey(27), (32, 64)))
+        ts = build_train_step(loss, params, mesh=mesh, mode="dear-fused",
+                              nearby_layers=1,
+                              optimizer=fused_sgd(lr=0.05), donate=False)
+        state = ts.init(params)
+        state, _ = ts.step(state, batch)
+        state, _ = ts.step(state, batch)
+        counts = T.get_tracer().counters()
+        nb = ts.plan.num_buckets
+        assert counts["kernel.ring_ag_builds"] >= nb
+        assert counts["kernel.fused_rs_builds"] >= nb
+        assert counts["kernel.fused_rs_launches"] == 2 * nb
+        assert counts["kernel.ring_ag_launches"] == 2 * nb
+        assert counts["dear.reduce_scatter_bytes"] > 0
+        assert counts["dear.all_gather_bytes"] > 0
+    finally:
+        T.set_tracer(old)
+
+
+# ---------------------------------------------------------------------------
+# transformer paths: BERT and GPT end-to-end under dear-fused
+# ---------------------------------------------------------------------------
+
+
+def _tiny_bert():
+    from dear_pytorch_tpu import models
+    from dear_pytorch_tpu.models.bert import BertConfig, BertForPreTraining
+    from dear_pytorch_tpu.models.data import synthetic_bert_batch
+
+    cfg = BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    batch = synthetic_bert_batch(jax.random.PRNGKey(0), 16, seq_len=16,
+                                 vocab_size=64)
+
+    def build(projection_impl=None):
+        model = BertForPreTraining(cfg, projection_impl=projection_impl)
+        params = BertForPreTraining(cfg).init(
+            {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
+            train=False)["params"]
+
+        def loss(p, b):
+            logits, nsp = model.apply(
+                {"params": p}, b["input_ids"], b["token_type_ids"],
+                b["attention_mask"], train=False)
+            return models.bert_pretraining_loss(
+                logits, nsp, b["masked_lm_labels"],
+                b["next_sentence_labels"])
+
+        return params, loss
+
+    return build, batch
+
+
+def _tiny_gpt():
+    from dear_pytorch_tpu.models.data import synthetic_gpt_batch
+    from dear_pytorch_tpu.models.gpt import (
+        GptConfig,
+        GptLmHeadModel,
+        gpt_lm_loss,
+    )
+
+    cfg = GptConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, embd_dropout_prob=0.0,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    batch = synthetic_gpt_batch(jax.random.PRNGKey(0), 16, seq_len=16,
+                                vocab_size=64)
+
+    def build(projection_impl=None):
+        model = GptLmHeadModel(cfg, projection_impl=projection_impl)
+        params = GptLmHeadModel(cfg).init(
+            {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
+            train=False)["params"]
+
+        def loss(p, b):
+            logits = model.apply({"params": p}, b["input_ids"],
+                                 train=False)
+            return gpt_lm_loss(logits, b["input_ids"], vocab_size=64)
+
+        return params, loss
+
+    return build, batch
+
+
+@pytest.mark.parametrize("family", ["bert", "gpt"])
+def test_transformer_dear_fused_matches_dear(mesh, family):
+    """BERT and GPT train end-to-end under dear-fused on the emulated
+    mesh, matching dear (the issue's acceptance criterion)."""
+    build, batch = (_tiny_bert if family == "bert" else _tiny_gpt)()
+    params, loss = build()
+    opt = fused_sgd(lr=0.01, momentum=0.9)
+    p_dear, l_dear, _ = _run_mode("dear", params, loss, mesh, batch, opt,
+                                  steps=3, threshold_mb=0.05)
+    p_fused, l_fused, _ = _run_mode("dear-fused", params, loss, mesh,
+                                    batch, opt, steps=3, threshold_mb=0.05)
+    assert l_fused == pytest.approx(l_dear, rel=1e-4)
+    for a, b in zip(jax.tree.leaves(p_dear), jax.tree.leaves(p_fused)):
+        np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6)
+
+
+@pytest.mark.parametrize("family", ["bert", "gpt"])
+def test_transformer_ring_projections_match_dense(mesh, family):
+    """The QKV/MLP projection paths route through the ring
+    collective-matmul (projection_impl hook) and still track the dense
+    model under dear-fused — the (b) fusion exercised in the real model
+    graph, gradients included."""
+    build, batch = (_tiny_bert if family == "bert" else _tiny_gpt)()
+    params, loss_dense = build()
+    _, loss_ring = build(
+        projection_impl=CM.make_ring_projection_impl(DP_AXIS))
+    opt = fused_sgd(lr=0.01, momentum=0.9)
+    # one step, default (single-bucket) plan: the CM kernels dominate the
+    # cost here and bucketing / multi-step coverage lives in the other
+    # e2e tests — this one pins the in-model fwd+bwd CM path
+    p_ref, l_ref, _ = _run_mode("dear-fused", params, loss_dense, mesh,
+                                batch, opt, steps=1)
+    p_ring, l_ring, _ = _run_mode("dear-fused", params, loss_ring, mesh,
+                                  batch, opt, steps=1)
+    assert l_ring == pytest.approx(l_ref, rel=1e-4)
+    for a, b in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_ring)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_dear_fused_multi_step_scan(mesh):
+    """The scanned multi-step protocol (one lax.scan program) composes
+    with the ring kernels."""
+    params, loss = _mlp(64)
+    batch = (jax.random.normal(jax.random.PRNGKey(30), (32, 64)),
+             jax.random.normal(jax.random.PRNGKey(31), (32, 64)))
+    ts = build_train_step(loss, params, mesh=mesh, mode="dear-fused",
+                          nearby_layers=1, optimizer=fused_sgd(lr=0.05),
+                          donate=False)
+    state = ts.init(params)
+    state2, m = ts.multi_step(3)(state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+    # equals three single steps at tolerance (same program content)
+    state1 = ts.init(params)
+    for _ in range(3):
+        state1, m1 = ts.step(state1, batch)
+    np.testing.assert_allclose(float(m["loss"]), float(m1["loss"]),
+                               rtol=1e-6)

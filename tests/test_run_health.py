@@ -798,3 +798,75 @@ def test_bench_gate_reads_contract_line_amid_output(tmp_path, capsys):
                    + json.dumps(_bench_doc(resnet=2400.0)) + "\n")
     assert gate.main(["--baseline", str(base), "--run", str(run)]) == 0
     capsys.readouterr()
+
+
+def _driver_report(fused=0.98, missing_cell=False, failed_cell=False):
+    """Synthetic benchmarks/driver.py reports.json: two models swept over
+    'dear' and 'dear-fused'. ``fused`` scales the candidate's throughput
+    relative to the base."""
+    rep = {
+        "bert_base": {"dear": {"8": [100.0, 1.0]},
+                      "dear-fused": {"8": [100.0 * fused, 1.0]}},
+        "gpt2": {"dear": {"8": [500.0, 2.0]},
+                 "dear-fused": {"8": [500.0 * fused, 2.0]}},
+        "telemetry": {"cells_run": 4},
+    }
+    if missing_cell:
+        del rep["gpt2"]["dear-fused"]
+    if failed_cell:
+        rep["gpt2"]["dear-fused"]["8"] = None
+    return rep
+
+
+def test_bench_gate_ab_methods(tmp_path, capsys):
+    """--ab-methods gates a driver sweep's dear-fused cells against dear
+    (the fused-kernel one-command A/B)."""
+    gate = _gate()
+    run = tmp_path / "reports.json"
+    # within tolerance -> green
+    run.write_text(json.dumps(_driver_report(fused=0.98)))
+    assert gate.main(["--run", str(run),
+                      "--ab-methods", "dear-fused:dear"]) == 0
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["ok"] and len(verdict["cells"]) == 2
+    # >tolerance regression -> exit 2, the offending cell named
+    run.write_text(json.dumps(_driver_report(fused=0.90)))
+    assert gate.main(["--run", str(run),
+                      "--ab-methods", "dear-fused:dear"]) == 2
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert not verdict["ok"]
+    assert all(not c["ok"] for c in verdict["cells"])
+    # a loose tolerance admits the same run
+    assert gate.main(["--run", str(run), "--tolerance", "0.2",
+                      "--ab-methods", "dear-fused:dear"]) == 0
+    capsys.readouterr()
+
+
+def test_bench_gate_ab_methods_missing_cells(tmp_path, capsys):
+    """A cell the base produced but the candidate lost fails (a method
+    that silently stopped reporting is a harness regression), unless
+    --allow-missing downgrades it."""
+    gate = _gate()
+    run = tmp_path / "reports.json"
+    run.write_text(json.dumps(_driver_report(missing_cell=True)))
+    assert gate.main(["--run", str(run),
+                      "--ab-methods", "dear-fused:dear"]) == 2
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["missing"] == ["gpt2[8]"]
+    assert gate.main(["--run", str(run), "--allow-missing",
+                      "--ab-methods", "dear-fused:dear"]) == 0
+    capsys.readouterr()
+    # a FAILED candidate cell (scrape returned nothing) is missing too
+    run.write_text(json.dumps(_driver_report(failed_cell=True)))
+    assert gate.main(["--run", str(run),
+                      "--ab-methods", "dear-fused:dear"]) == 2
+    capsys.readouterr()
+    # malformed spec -> unusable-input exit code
+    assert gate.main(["--run", str(run), "--ab-methods", "nope"]) == 3
+    capsys.readouterr()
+    # --ab-methods reads a driver reports.json, the other gates read
+    # contract metric files: combining would silently gate nothing, so
+    # the tool refuses loudly instead
+    assert gate.main(["--run", str(run), "--ab-methods", "dear-fused:dear",
+                      "--slo", "steps_per_hour=1"]) == 3
+    capsys.readouterr()
