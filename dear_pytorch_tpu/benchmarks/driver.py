@@ -26,6 +26,8 @@ switch does it):
   mgwfbp      analytic MG-WFBP bucket sizing           (mgwfbp/)
   eftopk      compressed allreduce, 1% density         (wfbp sparse path)
   bytescheduler  partitioned priority allreduce, 4 MB  (bytescheduler/)
+  autotune    unified plan-space search: fusion x compression x wire
+              dtype x mode x remat, converged pre-timing (docs/TUNING.md)
 
 On machines without multiple accelerators pass ``--emulate N`` to run each
 cell on N virtual CPU devices (the reference could only sweep nworkers on a
@@ -51,6 +53,13 @@ METHOD_ARGS: dict[str, list[str]] = {
     "dear-notf": ["--mode", "dear", "--threshold", "0",
                   "--nearby-layers", "1"],
     "dear-bo": ["--mode", "dear", "--autotune", "bo"],
+    # the unified plan-space autotuner (docs/TUNING.md): fusion threshold x
+    # compressor x wire dtypes x mode x remat, tune-then-measure — the
+    # search converges during the pre-timing phase and the timed region
+    # runs the CONVERGED config. Gate it against any hand-picked row with
+    # scripts/bench_gate.py --ab-methods autotune:dear. Restrict the
+    # searched axes per-cell via DEAR_TUNE_* env vars.
+    "autotune": ["--mode", "dear", "--autotune", "plan"],
     # Pallas fused computation-collective kernels (ring RS+update epilogue,
     # ring all-gather; ops/collective_matmul.py) — A/B against 'dear' with
     # identical bucketing, gated by scripts/bench_gate.py --ab-methods

@@ -226,6 +226,7 @@ def main(argv=None) -> runner.BenchResult:
     step_fn, timed_kwargs = runner.make_step_source(
         args, scan_steps, ts, stepper, holder, next_batch
     )
+    runner.run_pretune(args, stepper, holder, next_batch)
     # sequences per CHIP per step: with sp, each sequence spans sp chips
     timed_kwargs["batch_size"] = timed_kwargs["batch_size"] / sp
 
@@ -236,7 +237,7 @@ def main(argv=None) -> runner.BenchResult:
     metrics_log = runner.metrics_from_args(args)
     # with --mfu, one AOT cost analysis BEFORE timing: the run-health
     # monitor watches live per-iteration MFU, log_mfu reuses the flops
-    flops = (runner.step_flops(ts, holder["state"], batch)
+    flops = (runner.step_flops(getattr(stepper, "ts", ts), holder["state"], batch)
              if args.mfu else None)
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)

@@ -314,10 +314,30 @@ def add_common_args(parser) -> None:
                              "buckets per the INFOCOM'19 model (reference "
                              "wfbp/dopt.py:380-486)")
     parser.add_argument("--autotune", type=str, default=None,
-                        choices=["bo", "wait_time"],
+                        choices=["bo", "wait_time", "plan"],
                         help="runtime fusion tuning: Bayesian optimization "
-                             "over the threshold (reference dopt_rsag_bo) "
-                             "or wait-time split flags (dopt_rsag_wt)")
+                             "over the threshold (reference dopt_rsag_bo), "
+                             "wait-time split flags (dopt_rsag_wt), or "
+                             "'plan' — the unified plan-space search over "
+                             "fusion x compression x wire dtypes x mode x "
+                             "remat (docs/TUNING.md; restrict axes via "
+                             "DEAR_TUNE_* env)")
+    parser.add_argument("--tune-steps", type=int, default=None,
+                        help="drive the autotuner for this many steps "
+                             "BEFORE the timed protocol (tune-then-"
+                             "measure: the timed region runs the CONVERGED "
+                             "config). Default: the tuner's full trial "
+                             "budget for --autotune plan, 0 for bo/"
+                             "wait_time (their legacy tune-while-measuring "
+                             "behavior)")
+    parser.add_argument("--remat-policy", type=str, default=None,
+                        choices=["none", "full"],
+                        help="rematerialize the whole forward during "
+                             "backward at the TRAIN-STEP level "
+                             "(jax.checkpoint around the loss; also a "
+                             "plan-space autotuner axis). Distinct from "
+                             "the GPT bench's model-level --remat, which "
+                             "checkpoints per block")
     parser.add_argument("--accum-steps", type=int, default=1,
                         help="gradient accumulation: split each per-device "
                              "batch into this many scanned microbatches; "
@@ -533,13 +553,18 @@ def config_from_args(args, *, fp16_comm: bool = True,
 
     from dear_pytorch_tpu.config import DearConfig
 
-    use_compression = args.compressor != "none" and args.mode == "allreduce"
+    use_compression = (args.compressor != "none"
+                       and args.mode in ("allreduce", "dear", "dear-fused"))
     if args.compressor != "none" and not use_compression:
-        # DeAR proper accepts-and-ignores the compression surface
-        # (reference dear/dear_dopt.py:381-398 warning)
+        # the baseline schedules accept-and-ignore the compression surface
+        # (reference dear/dear_dopt.py:381-398 warning). 'dear-fused' is
+        # deliberately NOT filtered here: the compressor flows to
+        # build_train_step, which rejects the combination loudly at
+        # plan-build time — a warned-and-dropped flag would report
+        # dense-schedule timings for a run the user asked to compress.
         warnings.warn(
-            f"--compressor is ignored by the {args.mode!r} schedule "
-            "(reference behavior); use --mode allreduce."
+            f"--compressor is ignored by the {args.mode!r} schedule; "
+            "use --mode allreduce or --mode dear."
         )
     if args.density < 1.0 and args.compressor == "none":
         warnings.warn(
@@ -568,6 +593,7 @@ def config_from_args(args, *, fp16_comm: bool = True,
             "lr_schedule": getattr(args, "lr_schedule", None),
             "warmup_steps": getattr(args, "warmup_steps", 0),
             "total_steps": getattr(args, "total_steps", None),
+            "remat": getattr(args, "remat_policy", None),
         }.items() if v},
         # fsdp communicates both legs in gather_dtype (RS = gather transpose)
         comm_dtype=(jnp.bfloat16
@@ -642,6 +668,50 @@ def make_step_source(args, scan_steps: int, ts, stepper, holder,
         steps_per_call=scan_steps,
     )
     return step_fn, kwargs
+
+
+def run_pretune(args, stepper, holder, next_batch) -> int:
+    """Tune-then-measure: drive the autotuner to convergence BEFORE the
+    warmup/timed protocol, so the timed region measures the CONVERGED
+    configuration (what a deployed run would sustain) instead of mixing
+    trial plans into the throughput number. Returns the steps spent.
+
+    ``--tune-steps`` overrides the budget; by default only the 'plan'
+    strategy pre-tunes (bo/wait_time keep their legacy tune-while-
+    measuring behavior unless --tune-steps is set explicitly).
+    """
+    if not getattr(args, "autotune", None):
+        return 0
+    tuner = getattr(stepper, "tuner", None)
+    n = getattr(args, "tune_steps", None)
+    if n is None:
+        if args.autotune != "plan":
+            return 0
+        n = getattr(tuner, "budget_steps", 0) if tuner is not None else 0
+    n = int(n)
+    if n <= 0:
+        return 0
+    log(f"Pre-tuning: up to {n} steps "
+        "(tune-then-measure; the timed region runs the converged config)")
+    for _ in range(n):
+        holder["state"], holder["metrics"] = stepper.step(
+            holder["state"], next_batch()
+        )
+        if tuner is not None and getattr(tuner, "finished", False):
+            break
+    planner = getattr(stepper, "planner", None)
+    if planner is not None:
+        if planner.finished:
+            log(f"Converged plan config: {planner.current.describe()}")
+        else:
+            # the loop ran out of --tune-steps mid-search: say so — the
+            # timed region will keep mixing tuner trials into the number
+            log(f"Plan tuner NOT converged after {n} pre-tune steps; "
+                f"current trial config: {planner.current.describe()} "
+                "(timed region may include further trials)")
+        snap = planner.summary()
+        log("TUNE_SUMMARY " + json.dumps(snap))
+    return n
 
 
 def build_stepper(cfg, loss_fn, params, mesh, *, model_state=None,

@@ -42,8 +42,8 @@ class DearConfig:
     nearby_layers: Optional[int] = None
     flags: Optional[Sequence[int]] = None
 
-    # auto-tuning
-    autotune: Optional[str] = None          # None | 'bo' | 'wait_time'
+    # auto-tuning ('plan' = the unified plan-space search, docs/TUNING.md)
+    autotune: Optional[str] = None          # None | 'bo' | 'wait_time' | 'plan'
     bo_bound: tuple = (1.0, 256.0)          # dopt_rsag_bo.py:101
     bo_trials: int = 10                     # tuner.py:9
     bo_interval: int = 5                    # tuner.py:34
@@ -78,6 +78,9 @@ class DearConfig:
     gather_dtype: Any = None                # pre-gather cast (dear/fsdp)
     compute_bf16: bool = False
 
+    # rematerialization (None | 'full'; a plan-space autotuner axis)
+    remat: Optional[str] = None
+
     # misc
     rng_seed: Optional[int] = None
     donate: bool = True
@@ -87,8 +90,10 @@ class DearConfig:
         if self.mode not in ("dear", "dear-fused", "allreduce", "rsag",
                              "rb", "bytescheduler", "fsdp"):
             raise ValueError(f"bad mode {self.mode!r}")
-        if self.autotune not in (None, "bo", "wait_time"):
+        if self.autotune not in (None, "bo", "wait_time", "plan"):
             raise ValueError(f"bad autotune {self.autotune!r}")
+        if self.remat not in (None, "none", "full"):
+            raise ValueError(f"bad remat {self.remat!r}")
         if not 0.0 < self.density <= 1.0:
             raise ValueError(f"density must be in (0, 1], got {self.density}")
 
@@ -152,7 +157,7 @@ class DearConfig:
         if name == "bo_bound":
             lo, hi = raw.split(",")
             return (float(lo), float(hi))
-        if name in ("autotune", "compressor", "mode"):
+        if name in ("autotune", "compressor", "mode", "remat"):
             return None if raw.lower() in ("none", "") else raw
         return raw
 
@@ -209,6 +214,7 @@ class DearConfig:
             partition_mb=self.partition_mb,
             accum_steps=self.accum_steps,
             clip_norm=self.clip_norm,
+            remat=None if self.remat in (None, "none") else self.remat,
         )
 
     def describe(self) -> str:

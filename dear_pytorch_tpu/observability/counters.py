@@ -25,6 +25,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import numpy as np
+
 from dear_pytorch_tpu.ops import fusion as F
 
 #: collective legs per schedule mode (mirrors parallel/dear.py's device_step)
@@ -136,21 +138,40 @@ def plan_comm_accounting(
     mode: str = "dear",
     comm_itemsize: int = 4,
     gather_itemsize: Optional[int] = None,
+    compressor: Optional[str] = None,
+    density: float = 1.0,
 ) -> CommAccounting:
     """Static communication accounting for ``plan`` under ``mode``.
 
     ``comm_itemsize`` is the gradient-leg dtype size in bytes
     (``comm_dtype`` — 2 for bf16); ``gather_itemsize`` the parameter
     all-gather leg's (``gather_dtype``, 'dear'/'fsdp' only; defaults to
-    ``comm_itemsize``). At ``world=1`` every wire estimate is 0 — the
-    collectives are local copies, which is also what the compiled program
-    contains.
+    ``comm_itemsize``). ``compressor``/``density`` scale the GRADIENT
+    leg's bytes by `ops.compression.wire_ratio` (the parameter all-gather
+    stays dense): the payload shrinks to the compressed wire format, and
+    the wire estimate becomes gather-shaped — compressed reductions
+    all-gather every peer's payload ((world-1) x payload per device)
+    instead of moving 1/world ring chunks. At ``world=1`` every wire
+    estimate is 0 — the collectives are local copies, which is also what
+    the compiled program contains.
     """
     if mode not in MODE_LEGS:
         raise ValueError(f"mode must be one of {sorted(MODE_LEGS)}, "
                          f"got {mode!r}")
     gather_itemsize = (comm_itemsize if gather_itemsize is None
                       else gather_itemsize)
+    compressed = compressor not in (None, "none")
+    if compressed:
+        from dear_pytorch_tpu.ops import compression as Z
+
+        # the compressed path casts the bucket back to the BUFFER dtype
+        # before compressing (parallel/dear.py: gin = gbuf.astype(pdtype))
+        # — its payload values never travel in comm_dtype, so price them
+        # at the buffer itemsize or the wire bytes under-count whenever a
+        # caller combines compressor with a narrower comm_dtype
+        comp_itemsize = (np.dtype(plan.leaves[0].dtype).itemsize
+                         if plan.leaves else 4)
+
     rows = []
     for b in plan.buckets:
         for leg in MODE_LEGS[mode]:
@@ -158,6 +179,12 @@ def plan_comm_accounting(
                         and mode in ("dear", "dear-fused", "fsdp")
                         else comm_itemsize)
             payload = b.padded_size * itemsize
+            wire = payload * _wire_factor(leg, plan.world)
+            if compressed and leg in ("reduce_scatter", "all_reduce"):
+                ratio = Z.wire_ratio(
+                    compressor, b.padded_size, density, comp_itemsize)
+                payload = int(round(b.padded_size * comp_itemsize * ratio))
+                wire = float(payload * max(plan.world - 1, 0))
             rows.append(BucketCommRow(
                 bucket=b.index,
                 leg=leg,
@@ -165,7 +192,7 @@ def plan_comm_accounting(
                 elements=b.size,
                 padded_elements=b.padded_size,
                 payload_bytes=payload,
-                wire_bytes=payload * _wire_factor(leg, plan.world),
+                wire_bytes=wire,
             ))
     return CommAccounting(mode=mode, world=plan.world,
                           num_buckets=plan.num_buckets, rows=tuple(rows))

@@ -210,6 +210,7 @@ def build_train_step(
     accum_steps: int = 1,
     gather_dtype=None,
     clip_norm: Optional[float] = None,
+    remat: Optional[str] = None,
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -240,14 +241,26 @@ def build_train_step(
         key as its last positional argument (folded from seed, step counter,
         and device index) — use for dropout. Without it, stochastic layers
         need a key closed over by ``loss_fn`` (constant across steps).
-      compressor / density / gtopk: gradient compression for the 'allreduce'
+      compressor / density / gtopk: gradient compression on the 'allreduce'
         (WFBP-family) schedule — the reference applies compression only
-        there, and DeAR proper ignores it (dear/dear_dopt.py:381-398).
-        ``compressor`` is a name from `ops.compression.compressors`;
-        ``density`` the kept fraction for the top-k family; ``gtopk=True``
-        uses the recursive-halving gTop-k reduction (wfbp/dopt.py:50-107)
-        instead of allgather-accumulate. Sign compressors perform majority
-        vote; their "gradient" is ±1 (signSGD — scale lives in the lr).
+        there (dear/dear_dopt.py:381-398) — OR on the 'dear' schedule
+        (beyond reference): the bucket's gradient leg becomes a compressed
+        reduction (every device reconstructs the dense mean from the
+        gathered payloads and keeps its reduce-scatter slice), while the
+        parameter all-gather leg stays dense; error-feedback residuals
+        stay per-device in ``DearState.comp_state`` exactly as on the
+        allreduce path. 'dear-fused' rejects compression at build time
+        (the ring kernels exchange dense fp tiles only). ``compressor``
+        is a name from `ops.compression.compressors` ('qint8' = the
+        int8-packed wire format); ``density`` the kept fraction for the
+        top-k family; ``gtopk=True`` uses the recursive-halving gTop-k
+        reduction (wfbp/dopt.py:50-107) instead of allgather-accumulate.
+        Sign compressors perform majority vote; their "gradient" is ±1
+        (signSGD — scale lives in the lr).
+      remat: None (default) or 'full' — wrap the differentiated loss in
+        `jax.checkpoint`, trading recompute for activation memory (a
+        searched axis of the plan-space autotuner). 'fsdp' owns its own
+        policy and rejects this knob.
       momentum_correction: DGC-style momentum correction for SPARSE
         compressed training (Lin et al. 2018; reference wfbp/dopt.py:769-775
         local velocity accumulation, :946-951 post-step mask). When > 0, a
@@ -379,10 +392,37 @@ def build_train_step(
     has_model_state = model_state_template is not None
     comp = Z.get_compressor(compressor)
     compressed = comp.name != "none"
-    if compressed and mode != "allreduce":
+    if compressed and mode == "dear-fused":
+        # plan-build-time guard, mirroring the dear-fused constraints
+        # above: rejecting here (loudly) beats a silent dense fallback
+        # that would report compressed-trial timings for a schedule that
+        # never compressed anything
         raise ValueError(
-            "gradient compression is an 'allreduce'-schedule (WFBP-family) "
-            "feature; the DeAR schedule ignores it (reference parity)"
+            "gradient compression cannot ride mode='dear-fused': the "
+            "Pallas ring kernels execute the reduce-scatter leg (fused "
+            "with the optimizer epilogue) on dense fp tiles and cannot "
+            "exchange sparse/sign/int8-packed payloads — use mode='dear' "
+            "(compressed decoupled schedule) or mode='allreduce'"
+        )
+    if compressed and mode not in ("allreduce", "dear"):
+        raise ValueError(
+            "gradient compression is supported on the 'allreduce' "
+            "(WFBP-family, reference parity) and 'dear' (decoupled "
+            f"RS+AG) schedules; got mode={mode!r}"
+        )
+    if compressed and exclude_parts:
+        raise ValueError(
+            "exclude_parts ablations assume dense collectives; the "
+            "compressed gradient leg has no reduce-scatter to exclude"
+        )
+    if remat not in (None, "none", "full"):
+        raise ValueError(
+            f"remat must be None, 'none' or 'full', got {remat!r}")
+    remat = None if remat in (None, "none") else remat
+    if remat is not None and mode == "fsdp":
+        raise ValueError(
+            "'fsdp' owns its rematerialization policy (the re-gather-in-"
+            "backward checkpoint); remat applies to the other schedules"
         )
     if compressed and mean_axes != axes:
         raise ValueError(
@@ -523,7 +563,11 @@ def build_train_step(
             diff_fn = jax.checkpoint(shard_loss, policy=_fsdp_policy)
             w0 = tuple(state.buffers)
         else:
-            diff_fn = canonical_loss
+            # remat='full': recompute the forward during backward instead
+            # of saving activations — a memory/recompute trade the plan-
+            # space autotuner searches as a categorical axis
+            diff_fn = (jax.checkpoint(canonical_loss) if remat == "full"
+                       else canonical_loss)
             w0 = params
 
         vg = jax.value_and_grad(diff_fn, has_aux=True)
@@ -601,14 +645,6 @@ def build_train_step(
                 # the reduce-scatter happens INSIDE the fused update kernel
                 # (ring RS + optimizer epilogue); carry the raw comm buffer
                 grad = gbuf
-            elif sharded:
-                if "reducescatter" in excl:  # ablation: local slice, no comm
-                    gshard = lax.dynamic_slice_in_dim(
-                        gbuf, idx * b.shard_size, b.shard_size
-                    )
-                else:
-                    gshard = C.reduce_scatter(gbuf, axis_name)
-                grad = gshard.astype(state.buffers[g].dtype) / mean_world
             elif compressed:
                 pdtype = state.buffers[g].dtype
                 centry = state.comp_state[g]
@@ -659,6 +695,10 @@ def build_train_step(
                         new_res = new_res.at[sent_idx].add(
                             rejected.astype(new_res.dtype)
                         )
+                elif comp.name in Z.QUANT:
+                    grad = Z.int8_allreduce(
+                        payload, b.padded_size, pdtype, axis_name
+                    )
                 else:
                     grad = Z.sparse_allreduce(
                         payload, b.padded_size, pdtype, axis_name
@@ -671,6 +711,23 @@ def build_train_step(
                     vel = vel.at[payload["indices"]].set(0.0)
                     new_centry = {"res": new_centry, "vel": vel[None, :]}
                 new_comp.append(new_centry)
+                if sharded:
+                    # 'dear': every device just reconstructed the same
+                    # dense mean; keep this device's reduce-scatter slice
+                    # (the update below runs on shards, and the dense
+                    # all-gather of the UPDATED params next step is the
+                    # unchanged AG leg)
+                    grad = lax.dynamic_slice_in_dim(
+                        grad, idx * b.shard_size, b.shard_size
+                    )
+            elif sharded:
+                if "reducescatter" in excl:  # ablation: local slice, no comm
+                    gshard = lax.dynamic_slice_in_dim(
+                        gbuf, idx * b.shard_size, b.shard_size
+                    )
+                else:
+                    gshard = C.reduce_scatter(gbuf, axis_name)
+                grad = gshard.astype(state.buffers[g].dtype) / mean_world
             elif mode == "allreduce":
                 grad = C.all_reduce(gbuf, axis_name).astype(
                     state.buffers[g].dtype
@@ -885,6 +942,8 @@ def build_train_step(
                        if comm_dtype is not None else _leaf_itemsize),
         gather_itemsize=(jnp.dtype(gather_dtype).itemsize
                          if gather_dtype is not None else None),
+        compressor=comp.name if compressed else None,
+        density=density,
     )
     _leg_bytes = {
         leg: _acct.leg_bytes_per_step(leg)

@@ -1,17 +1,28 @@
-"""Runtime auto-tuning of the tensor-fusion size.
+"""Runtime auto-tuning of the communication plan.
 
-Two strategies, mirroring the reference's (SURVEY.md §2.4):
+Three strategies:
   - Bayesian optimization over the fusion threshold
     (`bo.Tuner`; reference dear/tuner.py + dopt_rsag_bo.py)
   - wait-time heuristic deriving bucket-split flags from layer timing
     (`wait_time`; reference dear/dopt_rsag_wt.py)
+  - the unified plan-space search (`planspace.PlanTuner`; beyond
+    reference): fusion threshold x compressor x comm/gather wire dtype x
+    mode (dear / dear-fused) x remat in ONE mixed bandit/BO search, with
+    the overlap auditor's α-β cost model pruning dominated configurations
+    analytically (docs/TUNING.md)
 
-`autotune.AutoTuner` drives either against a live training loop,
+`autotune.AutoTuner` drives any of them against a live training loop,
 re-bucketing (and re-jitting) when a new plan is adopted.
 """
 
 from dear_pytorch_tpu.tuning.autotune import AutoTuner  # noqa: F401
 from dear_pytorch_tpu.tuning.bo import BayesianOptimizer, Tuner  # noqa: F401
+from dear_pytorch_tpu.tuning.planspace import (  # noqa: F401
+    CostModel,
+    PlanConfig,
+    PlanSpace,
+    PlanTuner,
+)
 from dear_pytorch_tpu.tuning.mgwfbp import (  # noqa: F401
     mgwfbp_layer_groups,
     plan_mgwfbp,

@@ -29,6 +29,7 @@ import math
 from typing import Any, Callable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
@@ -78,24 +79,112 @@ def _repack_bucket_states(old_states, old_plan, new_plan):
                 )
         else:
             for nbi in range(len(new_plan.buckets)):
+                # the same array object lands in every bucket here — safe
+                # only because `repack_state` deep-copies every leaf at
+                # its boundary before the state meets a donating step
+                # (see the copy note there)
                 new_flat_per_bucket[nbi].append(per_bucket_flat[0][li])
     return tuple(
         jax.tree.unflatten(treedef, flat) for flat in new_flat_per_bucket
     )
 
 
+def _repack_comp_state(old_comp, fresh_comp, old_plan, new_plan):
+    """Carry per-bucket compressor error-feedback state across a plan
+    change. Each stateful leaf is a global ``(world, padded)`` array (one
+    residual row per device); rows are unpacked to parameter granularity
+    under the old plan and repacked under the new one. Across a WORLD
+    change (elastic rescale) the rows cannot map 1:1, so the unsent mass
+    is redistributed mass-preservingly: every new row carries the mean of
+    the old rows, keeping the residuals' total contribution to the mean
+    gradient (``sum(rows)/world``) exactly invariant. Every stateful
+    compressor here keeps an ADDITIVE residual in gradient units, so the
+    carry is valid even when the compressor axis changes between plans
+    (a plan-tuner trial switching eftopk -> qint8 keeps the unsent mass);
+    a STRUCTURAL mismatch (stateless compressor, momentum-correction
+    velocity appearing/disappearing) resets to the fresh zeros instead of
+    guessing. Callers pass HOST (numpy) state — see `repack_state`'s
+    staging note."""
+    old_entries = list(old_comp)
+    fresh_entries = list(fresh_comp)
+    if not old_entries or not fresh_entries:
+        return tuple(fresh_entries)
+    old_leaves = [jax.tree.leaves(e) for e in old_entries]
+    fresh_leaves = [jax.tree.leaves(e) for e in fresh_entries]
+    n_leaf = len(fresh_leaves[0])
+    if len(old_leaves[0]) != n_leaf:
+        logger.warning(
+            "autotune: compressor state structure changed across plans "
+            "(%d vs %d leaves per bucket); error-feedback residuals reset",
+            len(old_leaves[0]), n_leaf)
+        return tuple(fresh_entries)
+    if n_leaf == 0:          # stateless compressor: nothing to carry
+        return tuple(fresh_entries)
+    if any(getattr(old_leaves[bi][li], "shape", None)
+           != (old_plan.world, old_plan.buckets[bi].padded_size)
+           for bi in range(len(old_plan.buckets))
+           for li in range(n_leaf)):
+        logger.warning(
+            "autotune: compressor state leaves are not (world, padded) "
+            "shaped; error-feedback residuals reset")
+        return tuple(fresh_entries)
+
+    out_leaves = [[] for _ in new_plan.buckets]
+    for li in range(n_leaf):
+        per_bucket = [jnp.asarray(old_leaves[bi][li])
+                      for bi in range(len(old_plan.buckets))]
+        new_rows = [[] for _ in new_plan.buckets]
+        for r in range(old_plan.world):
+            pieces = {}
+            for bi in range(len(old_plan.buckets)):
+                pieces.update(
+                    F.unpack_bucket(per_bucket[bi][r], old_plan, bi))
+            leaves_list = [pieces[i] for i in range(len(old_plan.leaves))]
+            for nbi in range(new_plan.num_buckets):
+                new_rows[nbi].append(
+                    F.pack_bucket(leaves_list, new_plan, nbi))
+        for nbi in range(new_plan.num_buckets):
+            stacked = jnp.stack(new_rows[nbi])      # (old_world, padded)
+            if new_plan.world != old_plan.world:
+                mean = jnp.mean(stacked, axis=0, keepdims=True)
+                stacked = jnp.broadcast_to(
+                    mean, (new_plan.world, stacked.shape[1]))
+            out_leaves[nbi].append(stacked)
+    treedef = jax.tree.structure(fresh_entries[0])
+    return tuple(jax.tree.unflatten(treedef, leaves)
+                 for leaves in out_leaves)
+
+
 def repack_state(
     state: D.DearState, old_ts: D.TrainStep, new_ts: D.TrainStep
 ) -> D.DearState:
-    """Carry a `DearState` across a plan change (buffers + optimizer state +
-    step + model state; compressor residuals reset, as the reference resets
-    its buffers on regeneration)."""
+    """Carry a `DearState` across a plan change: buffers, optimizer state,
+    step, model state, AND compressor error-feedback residuals
+    (`_repack_comp_state` — the reference reset its buffers on
+    regeneration, which silently dropped the unsent gradient mass; here
+    the residual algebra survives re-bucketing, checkpoint re-packs, and
+    elastic world changes)."""
+    # Stage the source state to HOST numpy first. Two reasons: (1) eager
+    # unpack/pack on live SHARDED arrays compiles per-op SPMD programs
+    # whose cross-device rendezvous can stall for minutes under CPU
+    # oversubscription (observed: a repack's gather wedged a tuner trial
+    # past the driver timeout at BERT scale) — host staging makes every
+    # intermediate single-device; (2) no intermediate can alias a live
+    # donated device buffer (see the copy note at the bottom).
+    state = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x))
+        if hasattr(x, "sharding") else x,
+        state,
+    )
     params = F.unpack_all(list(state.buffers), old_ts.plan)
     fresh = new_ts.init(params, *(
         (state.model_state,) if state.model_state != () else ()
     ))
     new_opt = _repack_bucket_states(
         list(state.opt_state), old_ts.plan, new_ts.plan
+    )
+    new_comp = _repack_comp_state(
+        state.comp_state, fresh.comp_state, old_ts.plan, new_ts.plan
     )
     # install repacked values with the fresh state's shardings — matched by
     # LEAF ORDER, not structure: a checkpoint-restored state's containers
@@ -114,19 +203,69 @@ def repack_state(
         [jax.device_put(v, ref.sharding)
          for v, ref in zip(new_flat, fresh_flat)],
     )
+    # compressor state installs on the fresh shardings by leaf order too
+    # (same dict-image tolerance as opt_state above)
+    comp_flat = jax.tree_util.tree_leaves(new_comp)
+    fresh_comp_flat, fresh_comp_def = jax.tree_util.tree_flatten(
+        fresh.comp_state)
+    if len(comp_flat) == len(fresh_comp_flat):
+        new_comp = jax.tree_util.tree_unflatten(
+            fresh_comp_def,
+            [jax.device_put(v, ref.sharding)
+             for v, ref in zip(comp_flat, fresh_comp_flat)],
+        )
+    else:
+        new_comp = fresh.comp_state
     step = jax.device_put(state.step, fresh.step.sharding)
-    return D.DearState(fresh.buffers, new_opt, step, fresh.model_state,
-                       fresh.comp_state)
+    out = D.DearState(fresh.buffers, new_opt, step, fresh.model_state,
+                      new_comp)
+    # Deep-copy EVERY leaf before handing the state to a donating train
+    # step. The repack pipeline is built from eager slices/reshapes/
+    # device_puts of the live state, and those can alias their sources —
+    # `device_put` onto an identical sharding returns the same underlying
+    # buffer (the carried ``step`` scalar), identity-shaped unpack/pack
+    # round trips short-circuit, and XLA:CPU eager slicing can hand back
+    # buffer VIEWS into the parent allocation. Donation then frees memory
+    # that other live arrays (or a parent allocation) still own —
+    # observed as "Attempt to donate the same buffer twice" and heap
+    # corruption ("double free or corruption") on the very next jitted
+    # step. `jnp.copy` materializes compact private buffers with the
+    # same shardings; rebuilds are rare (tuner trials, elastic
+    # transitions), so one state-size copy is noise.
+    return jax.tree.map(jnp.copy, out)
 
 
 class AutoTuner:
-    """Training-loop driver with runtime fusion tuning.
+    """Training-loop driver with runtime plan tuning.
 
     strategy='bo': Bayesian optimization over the MB threshold
       (reference dopt_rsag_bo.py; bound (1, 256) MB, 10 trials).
     strategy='wait_time': start with one all-layers bucket
       (num_nearby_layers=-1, dopt_rsag_wt.py) and after ``warmup_steps``
       switch to flags derived from per-layer backward times.
+    strategy='plan': the unified plan-space search
+      (`tuning.planspace.PlanTuner`) over fusion threshold x compressor x
+      comm/gather wire dtype x mode (dear / dear-fused) x remat, with the
+      overlap auditor's α-β cost model pruning analytically-dominated
+      configurations before they burn live trial steps. The searched axes
+      are lifted OUT of the static build kwargs into the starting
+      `PlanConfig`; every trial rides `_rebuild` + `repack_state` exactly
+      like a threshold trial. Trial sandboxing is snapshot-based: the
+      pre-trial train step AND a device copy of the state are held for
+      the trial's measurement window, so a diverging trial (int8 wire
+      overflow, pathological compression) reverts plan *and parameters*
+      in-place — `mark_infeasible` fires, the loop continues on the last
+      good config, and the `utils.guard.GuardedTrainer` wrapping this
+      never sees a non-finite loss (zero ``guard.rollbacks`` attributed
+      to the user's run). Costs one extra state copy while a trial is
+      live (searching only; dropped once the tuner finishes).
+
+    ``alpha_beta``: (α, β) seconds/bytes interconnect fit for the cost
+    model; when None it is measured once at construction via
+    `observability.overlap.fit_interconnect` if ``DEAR_TUNE_FIT=1``,
+    otherwise analytic pruning is disabled (trials still run). ``space``
+    defaults to `planspace.PlanSpace.from_env()`; ``trial_log`` (or
+    ``DEAR_TUNE_LOG``) streams one JSONL record per tuner decision.
     """
 
     def __init__(
@@ -145,13 +284,18 @@ class AutoTuner:
         log: Callable[[str], None] = lambda s: None,
         clock=None,
         tuner_seed: int = 0,
+        space=None,
+        alpha_beta: Optional[tuple[float, float]] = None,
+        trial_log: Optional[str] = None,
         **build_kwargs: Any,
     ):
-        if strategy not in ("bo", "wait_time"):
+        if strategy not in ("bo", "wait_time", "plan"):
             raise ValueError(
                 f"unknown strategy {strategy!r}: valid strategies are "
-                "'bo' (Bayesian optimization over the fusion threshold) "
-                "and 'wait_time' (layer-timing split flags)"
+                "'bo' (Bayesian optimization over the fusion threshold), "
+                "'wait_time' (layer-timing split flags) and 'plan' "
+                "(unified plan-space search over fusion x compression x "
+                "wire dtypes x mode x remat)"
             )
         self.strategy = strategy
         self._loss_fn = loss_fn
@@ -160,6 +304,77 @@ class AutoTuner:
         self._build_kwargs.pop("threshold_mb", None)
         self._log = log
         self.rebuilds = 0
+        self.planner = None
+
+        if strategy == "plan":
+            import os as _os
+
+            from dear_pytorch_tpu.tuning import planspace as PS
+
+            # the searched axes come OUT of the static build kwargs and
+            # into the starting PlanConfig — the tuner owns them now
+            base_mode = self._build_kwargs.pop("mode", "dear")
+            if base_mode not in ("dear", "dear-fused"):
+                raise ValueError(
+                    "strategy='plan' searches the dear/dear-fused "
+                    f"schedule family; start from one of those, not "
+                    f"mode={base_mode!r}")
+            if space is not None:
+                self.space = space
+            else:
+                # a non-default bo bound (cfg.bo_bound / DEAR_BO_BOUND)
+                # narrows the threshold axis; DEAR_TUNE_BOUND still wins
+                # when the caller kept the default
+                ov = ({"threshold_bound": tuple(bound)}
+                      if tuple(bound) != (1.0, 256.0) else {})
+                self.space = PS.PlanSpace.from_env(**ov)
+            base_comp = self._build_kwargs.pop("compressor", None)
+            base_density = self._build_kwargs.pop("density", 1.0)
+            base = PS.PlanConfig(
+                threshold_mb=float(threshold_mb or 25.0),
+                mode=base_mode,
+                compressor=base_comp,
+                density=(float(base_density) if base_comp
+                         else self.space.density),
+                comm_dtype=PS.dtype_token(
+                    self._build_kwargs.pop("comm_dtype", None)),
+                gather_dtype=PS.dtype_token(
+                    self._build_kwargs.pop("gather_dtype", None)),
+                remat=self._build_kwargs.pop("remat", None),
+            )
+            kw = {} if clock is None else {"clock": clock}
+            self.planner = PS.PlanTuner(
+                self.space, x=base, max_trials=max_trials,
+                interval=interval, log=log, seed=tuner_seed,
+                trial_log=trial_log, **kw,
+            )
+            self.tuner = self.planner  # shared notify_* driver hooks
+            self.ts = D.build_train_step(
+                loss_fn, params_template, **base.build_kwargs(),
+                **self._build_kwargs,
+            )
+            self._live_config = base
+            self._last_good_config = base
+            self._trial_backup = None
+            self._last_finite_loss: Optional[float] = None
+            if alpha_beta is None and _os.environ.get(
+                    "DEAR_TUNE_FIT", "").strip().lower() in (
+                        "1", "true", "yes", "on"):
+                from dear_pytorch_tpu.observability import overlap as OV
+
+                try:
+                    alpha_beta = OV.fit_interconnect(self.ts.mesh)
+                    self._log(
+                        f"autotune: interconnect fit alpha="
+                        f"{alpha_beta[0]:.3e}s beta={alpha_beta[1]:.3e}s/B")
+                except Exception as exc:
+                    logger.error(
+                        "autotune: interconnect fit failed (%s); analytic "
+                        "pruning disabled", exc)
+            self._alpha_beta = alpha_beta
+            self._install_cost_model()
+            self._host_step = 0
+            return
 
         if strategy == "bo":
             kw = {} if clock is None else {"clock": clock}
@@ -193,7 +408,30 @@ class AutoTuner:
         args = (params,) if model_state is None else (params, model_state)
         return self.ts.init(*args)
 
-    def _rebuild(self, state, **plan_kwargs):
+    @property
+    def plan(self):
+        """The LIVE train step's fusion plan — lets a
+        `utils.guard.GuardedTrainer` wrap the tuner directly (its
+        checkpoint path reads ``ts.plan``)."""
+        return self.ts.plan
+
+    def _install_cost_model(self) -> None:
+        """(Re)build the planner's analytic cost model for the CURRENT
+        world — called at construction and after every elastic rescale
+        (the α-β fit survives; the plans must be rebuilt for the new
+        shard sizes)."""
+        if self.planner is None or self._alpha_beta is None:
+            return
+        from dear_pytorch_tpu.tuning import planspace as PS
+
+        world = self.ts.plan.world
+        template = self._template
+        self.planner.cost_model = PS.CostModel(
+            lambda thr: F.make_plan(template, world, threshold_mb=thr),
+            *self._alpha_beta,
+        )
+
+    def _rebuild(self, state, *, force: bool = False, **plan_kwargs):
         from dear_pytorch_tpu.utils.checkpoint import plan_fingerprint
 
         tr = _telemetry.get_tracer()
@@ -202,7 +440,8 @@ class AutoTuner:
             self._loss_fn, self._template, **plan_kwargs,
             **self._build_kwargs,
         )
-        if plan_fingerprint(new_ts.plan) == plan_fingerprint(old_ts.plan):
+        if not force and \
+                plan_fingerprint(new_ts.plan) == plan_fingerprint(old_ts.plan):
             # a different threshold that bucketizes identically: skip the
             # repack/re-jit AND keep the current (still valid) measurement
             # window
@@ -301,6 +540,13 @@ class AutoTuner:
         plan = F.rescale_plan(old_ts.plan, world, epoch=epoch)
         kw = dict(self._build_kwargs)
         kw["mesh"] = mesh
+        if self.strategy == "plan":
+            # the searched axes live in the current config, not in the
+            # static build kwargs — the rescaled step keeps the live
+            # (tuned) configuration
+            ckw = self._live_config.build_kwargs()
+            ckw.pop("threshold_mb", None)  # the rescaled plan wins
+            kw.update(ckw)
         try:
             with tr.span("autotune.rescale", world=world, epoch=epoch,
                          buckets=plan.num_buckets):
@@ -325,16 +571,122 @@ class AutoTuner:
             tr.event("autotune.rescaled", world=world, epoch=epoch,
                      buckets=new_ts.plan.num_buckets)
         if self.tuner is not None:
-            self.tuner.notify_rebuild()
+            # a rescale is a CONTEXT change, not just a re-jit: timings
+            # measured on the old world are not comparable — shelve the
+            # observation history so the search cannot exploit stale
+            # posteriors (next window is warmup via the same call)
+            self.tuner.notify_context(world=world, epoch=epoch)
+        if self.strategy == "plan":
+            self._trial_backup = None  # snapshot predates the new world
+            self._install_cost_model()
         self._log(
             f"autotune: rescaled plan to world={world} "
             f"(membership epoch {epoch}, {new_ts.plan.num_buckets} buckets)"
         )
         return state
 
+    def _revert_trial(self, state, metrics, why: str):
+        """A live plan-space trial diverged: restore the pre-trial train
+        step AND state from the snapshot, record the trial infeasible, and
+        hand back a FINITE loss (the last one the reverted state actually
+        produced) so a wrapping `GuardedTrainer` does not book a rollback
+        for a failure the tuner already recovered from. The few steps run
+        under the trial plan are discarded with it (the step counter
+        rewinds to the snapshot's)."""
+        old_ts, old_state, old_loss = self._trial_backup
+        bad = self._live_config
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("autotune.trial_failures")
+            tr.event("autotune.trial_infeasible",
+                     config=bad.describe(), why=why[:120])
+        self.planner.mark_infeasible(
+            bad, revert_to=self._last_good_config, why=why)
+        self.ts = old_ts
+        self._live_config = self._last_good_config
+        self._trial_backup = None
+        self._log(
+            f"autotune: trial {bad.describe()} infeasible ({why}); "
+            f"reverted plan AND state to {self._last_good_config.describe()}"
+        )
+        out = dict(metrics)
+        out["trial_loss"] = out.get("loss")
+        if old_loss is not None:
+            out["loss"] = old_loss
+        out["tuner_reverted"] = True
+        return old_state, out
+
+    def _plan_step(self, state, metrics):
+        """Per-step plan-space tuning work (strategy='plan')."""
+        import math as _math
+
+        pt = self.planner
+        if not pt.finished:
+            # drain the async pipeline before the tuner samples its clock
+            # (same scalar-fetch protocol as the bo path) — the fetch also
+            # feeds divergence detection for the live trial
+            loss = float(metrics["loss"])
+            if not _math.isfinite(loss):
+                if self._trial_backup is not None:
+                    return self._revert_trial(state, metrics,
+                                              "non-finite loss")
+                # no live trial to blame: a genuine divergence — the
+                # guard's recovery machinery owns it
+                return state, metrics
+            self._last_finite_loss = loss
+        proposal = pt.step()
+        if proposal is not None:
+            # a NEW proposal means the live config survived a full
+            # measurement window of finite losses: it becomes the revert
+            # target and its snapshot is dropped
+            self._trial_backup = None
+            self._last_good_config = self._live_config
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                tr.count("autotune.trials")
+                tr.event("autotune.proposal", config=proposal.describe())
+            backup = (self.ts,
+                      jax.tree.map(jnp.copy, state),
+                      self._last_finite_loss)
+            try:
+                state = self._rebuild(
+                    state,
+                    force=proposal.key() != self._live_config.key(),
+                    **proposal.build_kwargs(),
+                )
+            except Exception as exc:
+                # a combo the surrounding build kwargs cannot express
+                # (LAMB x dear-fused, clip_norm x compression, ...) is
+                # structurally dead — retire the arm; anything else only
+                # penalizes this threshold
+                fatal = isinstance(exc, (ValueError, TypeError))
+                logger.error(
+                    "autotune: rebuild for trial %s raised %s: %s",
+                    proposal.describe(), type(exc).__name__, exc,
+                )
+                if _telemetry.get_tracer().enabled:
+                    _telemetry.get_tracer().count("autotune.trial_failures")
+                pt.mark_infeasible(
+                    proposal, revert_to=self._last_good_config,
+                    fatal=fatal,
+                    why=f"rebuild raised {type(exc).__name__}: {exc}",
+                )
+            else:
+                self._live_config = proposal
+                self._trial_backup = backup
+        if pt.finished:
+            # the adopted config is not a trial: free the snapshot (it
+            # would otherwise pin a full state copy for the rest of the
+            # run) and stop treating divergence as the tuner's incident
+            self._trial_backup = None
+            self._last_good_config = self._live_config
+        return state, metrics
+
     def step(self, state, batch):
         state, metrics = self.ts.step(state, batch)
         self._host_step += 1
+        if self.strategy == "plan":
+            return self._plan_step(state, metrics)
         if self.strategy == "bo":
             if not self.tuner.finished:
                 # drain the async pipeline before the tuner samples its

@@ -51,8 +51,27 @@ class BayesianOptimizer:
         self.noise = noise
         self.xs: list[float] = []
         self.ys: list[float] = []
+        #: observation namespace — observations are only comparable within
+        #: one (world size, model, membership epoch) context; a rescaled
+        #: fleet must not exploit posteriors fit on another world's timings
+        self.context: str = ""
+        self._archive: dict[str, tuple[list[float], list[float]]] = {}
         self._rng = np.random.default_rng(seed)
         self._grid = np.linspace(0.0, 1.0, grid)
+
+    def set_context(self, context: str) -> None:
+        """Switch the observation namespace. The current observation set is
+        archived under the old context and the (possibly empty) set
+        previously archived under ``context`` becomes live — the posterior
+        never mixes observations across contexts, fixing the
+        history-keyed-only-by-x staleness after an elastic rescale."""
+        context = str(context)
+        if context == self.context:
+            return
+        self._archive[self.context] = (self.xs, self.ys)
+        xs, ys = self._archive.get(context, ([], []))
+        self.xs, self.ys = list(xs), list(ys)
+        self.context = context
 
     def _z(self, x):
         return (np.asarray(x, np.float64) - self.lo) / (self.hi - self.lo)
@@ -134,6 +153,7 @@ class Tuner:
         self._warmup = True
         self._best: Optional[tuple[float, float]] = None
         self._feasible_ys: list[float] = []  # real measurements only
+        self._context_key = ""
         self.finished = False
 
     def _record(self) -> Optional[float]:
@@ -153,6 +173,26 @@ class Tuner:
         """Tell the tuner a re-bucketing happened: next window is warmup."""
         self._warmup = True
         self._timestamps = []
+
+    def notify_context(self, **ctx) -> None:
+        """Invalidate measurement-derived state on a context change the
+        observations cannot survive — world size, membership epoch, model
+        identity (`AutoTuner.rescale` calls this). The GP observations are
+        namespaced per context (`BayesianOptimizer.set_context`), the
+        incumbent best and feasible-measurement history reset, and the
+        next window is warmup; the trial budget is NOT reset (a rescale
+        mid-search spends remaining trials on the new world rather than
+        restarting the run's tuning phase)."""
+        key = ",".join(f"{k}={ctx[k]}" for k in sorted(ctx))
+        if key == self._context_key:
+            return
+        self._context_key = key
+        self._opt.set_context(key)
+        self._best = None
+        self._feasible_ys = []
+        self.notify_rebuild()
+        self._log(f"BO Tuning context changed ({key}); "
+                  "stale observations shelved")
 
     def mark_infeasible(self, x: float, *,
                         revert_to: Optional[float] = None,
@@ -221,3 +261,11 @@ class Tuner:
     @property
     def current(self) -> float:
         return self._current
+
+    @property
+    def budget_steps(self) -> int:
+        """Upper-bound training steps to consume the whole trial budget:
+        one warmup window per rebuild plus one measured window per trial,
+        plus the adoption window (the tune-then-measure protocol sizes
+        its pre-timing loop with this)."""
+        return (2 * self._max + 2) * self._interval

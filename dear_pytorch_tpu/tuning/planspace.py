@@ -1,0 +1,785 @@
+"""Plan-space search: every speed lever the repo owns, in ONE tuned space.
+
+The reference (and this repo until now) autotunes exactly one knob — the
+fusion-buffer threshold, by Bayesian optimization (`bo.Tuner`,
+dopt_rsag_bo.py). But the framework already carries five more levers nobody
+searches: six gradient compressors (`ops.compression`), comm/gather wire
+dtypes (bf16 casts, the qint8 int8-packed format), the schedule mode
+(``dear`` vs the Pallas-ring ``dear-fused``), and rematerialization. Fused
+computation-collective work (arxiv 2305.06942) shows the winning
+combination is model- and topology-dependent — a search problem, not a
+default. This module turns those levers into a typed `PlanSpace` and
+searches it with a mixed bandit/BO strategy:
+
+  - **Axes.** One continuous axis (``threshold_mb``) and five categorical
+    axes (``mode``, ``compressor``, ``comm_dtype``, ``gather_dtype``,
+    ``remat``). A categorical combination is an *arm*; the threshold is
+    refined WITHIN an arm by the existing 1-D GP+EI optimizer
+    (`bo.BayesianOptimizer`) — mixed BO/bandit, not a flat grid.
+  - **Feasibility.** Combinations the schedules cannot execute (compressed
+    payloads through the dear-fused ring kernels; a wire dtype under a
+    compressor that already owns the wire format) are rejected at
+    space-construction time — they never consume a trial. Runtime failures
+    (a build error, a diverging trial) arrive via `mark_infeasible`:
+    penalty observation, arm optionally retired, measurement window reset
+    (the `bo.Tuner` sandboxing contract, PR 2).
+  - **Analytic pruning.** Before an arm burns live trial steps, its
+    communication cost is predicted from the overlap auditor's machinery
+    (`observability.counters.plan_comm_accounting` x the α-β interconnect
+    fit, `observability.overlap.predict_leg_times`). The `CostModel`
+    calibrates the fit against measured step times (one multiplicative
+    scale — the α-β fit systematically overestimates in-program
+    collectives on CPU emulation, see `overlap.audit_train_step`'s model
+    note) and prunes any arm whose ideal-overlap floor
+    ``max(compute_est, comm_cal)`` cannot beat the incumbent by the
+    margin. Pruned arms are counted (``tune.prunes``) and logged — never
+    silently dropped.
+  - **Context invalidation.** `notify_context` (called by
+    `AutoTuner.rescale` on elastic membership changes) shelves every
+    observation, per-arm posterior, and prune decision under the old
+    (world, epoch) key — a rescaled fleet never exploits stale timings.
+
+Telemetry: ``tune.trials`` / ``tune.prunes`` / ``tune.infeasible`` /
+``tune.best_changed`` counters plus one JSONL record per decision through
+`observability.export.JsonlWriter` when a ``trial_log`` path (or
+``DEAR_TUNE_LOG``) is given. All observability imports are lazy so this
+module loads jax-free (`scripts/check_telemetry_overhead.py` measures the
+finished-tuner step gate standalone).
+
+Semantics note (docs/TUNING.md): the compressor and dtype axes are LOSSY —
+the search optimizes step time, not loss trajectory. Restrict the space
+(constructor args or ``DEAR_TUNE_*`` env) when convergence parity matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+#: canonical wire-dtype tokens (None = keep the buffer dtype, f32 masters)
+_DTYPE_ITEMSIZE = {None: 4, "bf16": 2, "f16": 2}
+
+#: compressor names whose ``density`` argument is live (top-k family)
+_SPARSE = ("topk", "eftopk", "gaussian")
+
+
+def dtype_token(dtype) -> Optional[str]:
+    """Map a jnp dtype (or token, or None) to the canonical token."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        tok = {"": None, "none": None, "f32": None, "float32": None,
+               "bf16": "bf16", "bfloat16": "bf16",
+               "f16": "f16", "float16": "f16"}.get(dtype.lower(), dtype)
+    else:
+        name = np.dtype(dtype).name if not hasattr(dtype, "__name__") \
+            else dtype.__name__
+        tok = {"float32": None, "bfloat16": "bf16", "float16": "f16"}.get(
+            str(name), str(name))
+    if tok is not None and tok not in _DTYPE_ITEMSIZE:
+        raise ValueError(f"unknown wire dtype {dtype!r}")
+    return tok
+
+
+def _jnp_dtype(token: Optional[str]):
+    if token is None:
+        return None
+    import jax.numpy as jnp
+
+    return {"bf16": jnp.bfloat16, "f16": jnp.float16}[token]
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanConfig:
+    """One point of the plan space (hashable, JSON-safe)."""
+
+    threshold_mb: float = 25.0
+    mode: str = "dear"
+    compressor: Optional[str] = None
+    density: float = 0.01           # top-k family kept fraction
+    comm_dtype: Optional[str] = None
+    gather_dtype: Optional[str] = None
+    remat: Optional[str] = None     # None | 'full'
+
+    def key(self) -> tuple:
+        """Categorical identity (the bandit arm) — everything but the
+        continuous threshold."""
+        return (self.mode, self.compressor, self.comm_dtype,
+                self.gather_dtype, self.remat)
+
+    def describe(self) -> str:
+        parts = [f"{self.mode}", f"thr={self.threshold_mb:.3g}MB"]
+        if self.compressor:
+            parts.append(self.compressor
+                         + (f"@{self.density:g}"
+                            if self.compressor in _SPARSE else ""))
+        if self.comm_dtype:
+            parts.append(f"comm={self.comm_dtype}")
+        if self.gather_dtype:
+            parts.append(f"gather={self.gather_dtype}")
+        if self.remat:
+            parts.append(f"remat={self.remat}")
+        return "/".join(parts)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def build_kwargs(self) -> dict:
+        """kwargs for `parallel.build_train_step` (jnp dtypes resolved
+        lazily so the module itself stays jax-free)."""
+        return dict(
+            threshold_mb=float(self.threshold_mb),
+            mode=self.mode,
+            compressor=self.compressor,
+            density=float(self.density),
+            comm_dtype=_jnp_dtype(self.comm_dtype),
+            gather_dtype=_jnp_dtype(self.gather_dtype),
+            remat=self.remat,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Axis:
+    """Typed description of one searched dimension."""
+
+    name: str
+    kind: str                      # 'continuous' | 'categorical'
+    choices: tuple = ()            # categorical values
+    bound: tuple = ()              # continuous (lo, hi)
+
+
+class PlanSpace:
+    """The typed search space + its feasibility rules.
+
+    Defaults search both schedule modes, the error-feedback compressor
+    family plus the int8 wire format, bf16 wire casts, and remat.
+    ``DEAR_TUNE_MODES`` / ``DEAR_TUNE_COMPRESSORS`` / ``DEAR_TUNE_DTYPES``
+    / ``DEAR_TUNE_REMAT`` / ``DEAR_TUNE_DENSITY`` restrict or extend each
+    axis from the environment (comma lists; 'none' = the None choice) —
+    see `from_env`.
+    """
+
+    def __init__(
+        self,
+        *,
+        threshold_bound: tuple[float, float] = (1.0, 256.0),
+        modes: Sequence[str] = ("dear", "dear-fused"),
+        compressors: Sequence[Optional[str]] = (
+            None, "eftopk", "gaussian", "efsignum", "qint8"),
+        comm_dtypes: Sequence[Optional[str]] = (None, "bf16"),
+        gather_dtypes: Sequence[Optional[str]] = (None, "bf16"),
+        remats: Sequence[Optional[str]] = (None, "full"),
+        density: float = 0.01,
+    ):
+        if not threshold_bound[1] > threshold_bound[0] > 0:
+            raise ValueError(f"bad threshold bound {threshold_bound}")
+        for m in modes:
+            if m not in ("dear", "dear-fused"):
+                raise ValueError(
+                    f"plan-space mode axis supports 'dear'/'dear-fused', "
+                    f"got {m!r} (other schedules are hand-picked baselines)")
+        self.threshold_bound = (float(threshold_bound[0]),
+                                float(threshold_bound[1]))
+        self.modes = tuple(modes)
+        self.compressors = tuple(compressors)
+        self.comm_dtypes = tuple(dtype_token(d) for d in comm_dtypes)
+        self.gather_dtypes = tuple(dtype_token(d) for d in gather_dtypes)
+        self.remats = tuple(None if r in (None, "none") else r
+                            for r in remats)
+        for r in self.remats:
+            if r not in (None, "full"):
+                raise ValueError(f"bad remat choice {r!r}")
+        self.density = float(density)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "PlanSpace":
+        """Build a space with ``DEAR_TUNE_*`` env restrictions applied
+        (explicit ``overrides`` win)."""
+
+        def _list(var, none_ok=True):
+            raw = os.environ.get(var)
+            if raw is None:
+                return None
+            out = []
+            for tok in raw.split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                out.append(None if none_ok and tok.lower() == "none"
+                           else tok)
+            return tuple(out)
+
+        kw: dict = {}
+        v = _list("DEAR_TUNE_MODES", none_ok=False)
+        if v is not None:
+            kw["modes"] = v
+        v = _list("DEAR_TUNE_COMPRESSORS")
+        if v is not None:
+            kw["compressors"] = v
+        v = _list("DEAR_TUNE_DTYPES")
+        if v is not None:
+            kw["comm_dtypes"] = v
+            kw["gather_dtypes"] = v
+        v = _list("DEAR_TUNE_REMAT")
+        if v is not None:
+            kw["remats"] = v
+        if os.environ.get("DEAR_TUNE_DENSITY"):
+            kw["density"] = float(os.environ["DEAR_TUNE_DENSITY"])
+        if os.environ.get("DEAR_TUNE_BOUND"):
+            lo, hi = os.environ["DEAR_TUNE_BOUND"].split(",")
+            kw["threshold_bound"] = (float(lo), float(hi))
+        kw.update(overrides)
+        return cls(**kw)
+
+    def axes(self) -> tuple[Axis, ...]:
+        return (
+            Axis("threshold_mb", "continuous", bound=self.threshold_bound),
+            Axis("mode", "categorical", choices=self.modes),
+            Axis("compressor", "categorical", choices=self.compressors),
+            Axis("comm_dtype", "categorical", choices=self.comm_dtypes),
+            Axis("gather_dtype", "categorical", choices=self.gather_dtypes),
+            Axis("remat", "categorical", choices=self.remats),
+        )
+
+    def feasible(self, config: PlanConfig) -> Optional[str]:
+        """None when the combination can build, else the reason it cannot
+        (mirrors `parallel.build_train_step`'s build-time guards — checked
+        here so infeasible combos never consume a live trial)."""
+        if config.compressor is not None and config.mode == "dear-fused":
+            return ("dear-fused ring kernels exchange dense fp tiles; "
+                    "compressed payloads need mode='dear'")
+        if config.compressor is not None and config.comm_dtype is not None:
+            return ("the compressed wire format already owns the gradient "
+                    "leg; comm_dtype is dead weight under a compressor")
+        return None
+
+    def configs(self, threshold_mb: Optional[float] = None
+                ) -> list[PlanConfig]:
+        """Every FEASIBLE categorical combination, instantiated at
+        ``threshold_mb`` (default: the bound midpoint)."""
+        thr = (float(threshold_mb) if threshold_mb is not None
+               else 0.5 * (self.threshold_bound[0]
+                           + self.threshold_bound[1]))
+        out = []
+        for mode in self.modes:
+            for comp in self.compressors:
+                for cd in self.comm_dtypes:
+                    for gd in self.gather_dtypes:
+                        for rm in self.remats:
+                            cfg = PlanConfig(
+                                threshold_mb=thr, mode=mode,
+                                compressor=comp, density=self.density,
+                                comm_dtype=cd, gather_dtype=gd, remat=rm,
+                            )
+                            if self.feasible(cfg) is None:
+                                out.append(cfg)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# cost model: the overlap auditor's exposed-comm estimate as a trial pruner
+# ---------------------------------------------------------------------------
+
+
+class CostModel:
+    """Analytic per-config step-time floor from the α-β interconnect fit.
+
+    ``comm(config)`` prices the config's collective legs via
+    `counters.plan_comm_accounting` (compression ratios and wire dtypes
+    included) x `overlap.predict_leg_times`. Because the raw α-β fit
+    systematically overestimates in-program collectives (dispatch overhead
+    the compiled step amortizes — `overlap.audit_train_step` documents
+    this on CPU emulation), the model calibrates one multiplicative scale
+    from live measurements: ``scale = min(measured / comm_pred)`` over
+    observed configs, capped at 1. The pruning floor is the ideal-overlap
+    bound ``max(compute_est, scale x comm_pred)`` where ``compute_est`` is
+    the median of ``measured − scale x comm_pred`` over observations
+    (remat='full' scales it by ``remat_factor``). Sound up to the stated
+    assumption that the fit's error is a config-independent factor.
+    """
+
+    def __init__(self, plan_fn: Callable[[float], Any], alpha: float,
+                 beta: float, *, remat_factor: float = 1.3):
+        self._plan_fn = plan_fn      # threshold_mb -> FusionPlan
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.remat_factor = float(remat_factor)
+        self._plans: dict = {}
+        self._obs: list[tuple[float, float]] = []   # (comm_pred, measured)
+
+    def _plan(self, threshold_mb: float):
+        key = round(float(threshold_mb), 3)
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = self._plans[key] = self._plan_fn(key)
+        return plan
+
+    def comm(self, config: PlanConfig) -> float:
+        """Uncalibrated unoverlapped comm seconds for one config."""
+        from dear_pytorch_tpu.observability import counters as CTR
+        from dear_pytorch_tpu.observability import overlap as OV
+
+        acct = CTR.plan_comm_accounting(
+            self._plan(config.threshold_mb), mode=config.mode,
+            comm_itemsize=_DTYPE_ITEMSIZE[config.comm_dtype],
+            gather_itemsize=_DTYPE_ITEMSIZE[config.gather_dtype],
+            compressor=config.compressor, density=config.density,
+        )
+        return float(sum(OV.predict_leg_times(acct, self.alpha, self.beta)))
+
+    def observe(self, config: PlanConfig, measured_s: float) -> None:
+        if measured_s > 0 and math.isfinite(measured_s):
+            self._obs.append((self.comm(config), float(measured_s)))
+
+    @property
+    def _scale(self) -> float:
+        ratios = [m / c for c, m in self._obs if c > 0]
+        return min(min(ratios), 1.0) if ratios else 1.0
+
+    @property
+    def compute_est(self) -> Optional[float]:
+        """LOWER bound on the config-independent compute: the MINIMUM
+        residual over observations. A config whose slowness is compute
+        the model cannot see (e.g. software-emulated bf16 casts on CPU)
+        would drag any averaged estimate up and prune arms that are
+        genuinely cheap (observed: one 17s/step bf16 trial set a median
+        compute above every arm's bar and retired the whole space) —
+        pruning soundness needs the floor to UNDERestimate, never over."""
+        if not self._obs:
+            return None
+        s = self._scale
+        return min(max(m - s * c, 0.0) for c, m in self._obs)
+
+    def floor(self, config: PlanConfig) -> Optional[float]:
+        """Ideal-overlap step-time floor, or None before any calibration
+        observation exists (never prune blind)."""
+        compute = self.compute_est
+        if compute is None:
+            return None
+        if config.remat == "full":
+            compute = compute * self.remat_factor
+        return max(compute, self._scale * self.comm(config))
+
+
+# ---------------------------------------------------------------------------
+# the mixed bandit/BO tuner
+# ---------------------------------------------------------------------------
+
+
+class PlanTuner:
+    """Step-driven plan-space tuner (`bo.Tuner`-shaped driver contract).
+
+    Call `step()` once per training iteration. It returns a `PlanConfig`
+    when a measurement window completes and a different configuration
+    should be tried, else None; after ``max_trials`` completed windows it
+    adopts the best observed configuration (returning it if not current)
+    and sets ``finished``. Timing protocol parity with `bo.Tuner`: windows
+    of ``interval`` steps, the first window after every (re)build is
+    warmup, the first 3 durations of a window are discarded.
+
+    Arm selection: unvisited arms are swept first in analytic-cost order
+    (cheapest `CostModel.comm` first; arms whose `CostModel.floor` cannot
+    beat the incumbent by ``prune_margin`` are pruned instead of trialed);
+    once every arm is visited or pruned, ε-greedy exploitation picks the
+    best arm (or, with probability ``explore``, a random visited one) and
+    refines its threshold through that arm's own `bo.BayesianOptimizer`.
+    """
+
+    def __init__(
+        self,
+        space: PlanSpace,
+        *,
+        x: Optional[PlanConfig] = None,
+        max_trials: int = 12,
+        interval: int = 5,
+        log: Callable[[str], None] = print,
+        clock: Callable[[], float] = time.perf_counter,
+        seed: int = 0,
+        cost_model: Optional[CostModel] = None,
+        prune_margin: float = 0.25,
+        min_obs_to_prune: int = 2,
+        explore: float = 0.15,
+        trial_log: Optional[str] = None,
+        tracer: Optional[Any] = None,
+        bo_factory: Optional[Callable] = None,
+    ):
+        if interval < 4:
+            raise ValueError(f"interval must be >= 4, got {interval}")
+        self.space = space
+        base = x if x is not None else PlanConfig(
+            threshold_mb=0.5 * sum(space.threshold_bound))
+        why = space.feasible(base)
+        if why is not None:
+            raise ValueError(f"infeasible starting config "
+                             f"{base.describe()}: {why}")
+        self._current = base
+        self._max = int(max_trials)
+        self._interval = int(interval)
+        self._log = log
+        self._clock = clock
+        self._seed = int(seed)
+        self._rng = np.random.default_rng(seed)
+        self.cost_model = cost_model
+        self._prune_margin = float(prune_margin)
+        self._min_obs_to_prune = int(min_obs_to_prune)
+        self._explore = float(explore)
+        self._trial_log_path = trial_log or os.environ.get("DEAR_TUNE_LOG")
+        self._trial_writer = None
+        self._tracer = tracer
+        self._bo_factory = bo_factory
+        # arm universe: feasible combos + the starting arm
+        self._arm_keys: list[tuple] = []
+        self._arm_cfg: dict[tuple, PlanConfig] = {}
+        for cfg in space.configs(base.threshold_mb):
+            self._arm_keys.append(cfg.key())
+            self._arm_cfg[cfg.key()] = cfg
+        if base.key() not in self._arm_cfg:
+            self._arm_keys.insert(0, base.key())
+            self._arm_cfg[base.key()] = base
+        if len(self._arm_keys) > self._max:
+            self._log(
+                f"plan tuner budget ({self._max} trials) is below the "
+                f"feasible arm count ({len(self._arm_keys)}): the sweep "
+                "samples axis values diversity-first (or cost-ordered "
+                "with a fit) but cannot visit every combination — raise "
+                "max_trials or restrict DEAR_TUNE_* axes")
+        # per-context search state (see notify_context)
+        self._context_key = ""
+        self._archive: dict[str, dict] = {}
+        self._reset_observations()
+        self._num_trials = 0
+        self._timestamps: list[float] = []
+        self._warmup = True
+        self.finished = False
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _reset_observations(self) -> None:
+        self._obs: dict[tuple, list[tuple[float, float]]] = {}
+        self._best: Optional[tuple[PlanConfig, float]] = None
+        self._arm_bo: dict[tuple, Any] = {}
+        self._pruned: dict[tuple, str] = {}
+        self._dead: dict[tuple, str] = {}      # fatal build failures
+        self._feasible_ys: list[float] = []
+
+    def _tr(self):
+        if self._tracer is not None:
+            return self._tracer
+        from dear_pytorch_tpu.observability import tracer as T
+
+        return T.get_tracer()
+
+    def _journal(self, kind: str, config: PlanConfig, **fields) -> None:
+        """One JSONL record per tuner decision (lazy writer; a dead log
+        path must never kill the training loop)."""
+        if self._trial_log_path is None:
+            return
+        if self._trial_writer is None:
+            try:
+                from dear_pytorch_tpu.observability.export import (
+                    JsonlWriter,
+                )
+
+                self._trial_writer = JsonlWriter(self._trial_log_path)
+            except Exception:
+                self._trial_log_path = None
+                return
+        try:
+            self._trial_writer.write(dict(
+                kind=kind, trial=self._num_trials,
+                context=self._context_key, config=config.to_dict(),
+                **fields))
+        except Exception:
+            pass
+
+    def _bo_for(self, key: tuple):
+        opt = self._arm_bo.get(key)
+        if opt is None:
+            if self._bo_factory is None:
+                from dear_pytorch_tpu.tuning.bo import BayesianOptimizer
+
+                factory = BayesianOptimizer
+            else:
+                factory = self._bo_factory
+            opt = factory(self.space.threshold_bound,
+                          seed=self._seed + 7 * len(self._arm_bo))
+            self._arm_bo[key] = opt
+        return opt
+
+    # -- bo.Tuner-shaped protocol -------------------------------------------
+
+    def notify_rebuild(self) -> None:
+        """A re-build/re-jit happened: the next window is warmup."""
+        self._warmup = True
+        self._timestamps = []
+
+    def notify_context(self, **ctx) -> None:
+        """Shelve every observation, posterior, and prune decision under
+        the old context key and start clean for the new one (elastic
+        rescale: stale posteriors must not be exploited — the budget is
+        not reset, see `bo.Tuner.notify_context`)."""
+        key = ",".join(f"{k}={ctx[k]}" for k in sorted(ctx))
+        if key == self._context_key:
+            return
+        self._archive[self._context_key] = {
+            "obs": self._obs, "best": self._best, "arm_bo": self._arm_bo,
+            "pruned": self._pruned, "dead": self._dead,
+            "feasible_ys": self._feasible_ys,
+        }
+        shelved = self._archive.get(key)
+        if shelved is not None:
+            self._obs = shelved["obs"]
+            self._best = shelved["best"]
+            self._arm_bo = shelved["arm_bo"]
+            self._pruned = shelved["pruned"]
+            self._dead = shelved["dead"]
+            self._feasible_ys = shelved["feasible_ys"]
+        else:
+            self._reset_observations()
+        self._context_key = key
+        self.notify_rebuild()
+        self._log(f"plan tuner context changed ({key}); "
+                  "stale observations shelved")
+
+    def mark_infeasible(self, config: PlanConfig, *,
+                        revert_to: Optional[PlanConfig] = None,
+                        fatal: bool = False,
+                        why: str = "") -> None:
+        """Sandbox a failed/diverged trial: dominated observation so the
+        search steers away, window reset. ``fatal=True`` retires the
+        whole arm (its build raised — no threshold can fix a structurally
+        impossible combo) WITHOUT consuming a trial from the measurement
+        budget: a build failure costs milliseconds, not a measurement
+        window, and a space full of combos the surrounding static kwargs
+        cannot express (clip_norm x compression, LAMB x dear-fused, ...)
+        must not eat the search budget arm by arm — retirement bounds the
+        total at the arm count. A non-fatal failure (a diverging live
+        trial burned real steps) consumes its trial and only penalizes
+        this threshold."""
+        penalty = (10.0 * max(self._feasible_ys)
+                   if self._feasible_ys else 1e6)
+        key = config.key()
+        self._bo_for(key).register(float(config.threshold_mb), penalty)
+        self._obs.setdefault(key, []).append(
+            (float(config.threshold_mb), penalty))
+        if fatal:
+            self._dead[key] = why or "build failed"
+        else:
+            self._num_trials += 1
+        self._timestamps = []
+        if revert_to is not None:
+            self._current = revert_to
+        tr = self._tr()
+        if tr.enabled:
+            tr.count("tune.infeasible")
+            tr.event("tune.trial_infeasible", config=config.describe(),
+                     fatal=int(fatal), why=why[:120])
+        self._journal("infeasible", config, fatal=fatal, why=why[:200],
+                      penalty=penalty)
+        label = ("arm retired (no trial charged)" if fatal
+                 else f"trial [{self._num_trials - 1}]")
+        self._log(
+            f"plan tuner {label} "
+            f"{config.describe()} INFEASIBLE"
+            + (f" (fatal: {why})" if fatal else f" ({why})" if why else "")
+            + f"; staying at {self._current.describe()}"
+        )
+
+    def _record(self) -> Optional[float]:
+        self._timestamps.append(self._clock())
+        if len(self._timestamps) < self._interval:
+            return None
+        if self._warmup:   # discard the first window (re-jit lands here)
+            self._warmup = False
+            self._timestamps = []
+            return None
+        ts = self._timestamps
+        durations = [ts[i] - ts[i - 1] for i in range(3, len(ts))]
+        self._timestamps = []
+        return float(np.mean(durations)) if durations else None
+
+    # -- selection -----------------------------------------------------------
+
+    def _live_arms(self) -> list[tuple]:
+        return [k for k in self._arm_keys
+                if k not in self._pruned and k not in self._dead]
+
+    def _prune_sweep(self) -> None:
+        """Analytically retire unvisited arms whose ideal-overlap floor
+        cannot beat the incumbent (only once calibrated: >= min_obs
+        measurements and a known best)."""
+        if (self.cost_model is None or self._best is None
+                or len(self._feasible_ys) < self._min_obs_to_prune):
+            return
+        bar = self._best[1] * (1.0 + self._prune_margin)
+        tr = self._tr()
+        for key in self._live_arms():
+            if key in self._obs:
+                continue
+            cfg = self._arm_cfg[key]
+            try:
+                floor = self.cost_model.floor(dataclasses.replace(
+                    cfg, threshold_mb=self._best[0].threshold_mb))
+            except Exception:
+                continue   # an unpriceable arm is trialed, not dropped
+            if floor is not None and floor > bar:
+                self._pruned[key] = (
+                    f"analytic floor {floor * 1e3:.3f} ms > "
+                    f"{bar * 1e3:.3f} ms bar")
+                if tr.enabled:
+                    tr.count("tune.prunes")
+                    tr.event("tune.pruned", config=cfg.describe(),
+                             floor_s=floor, bar_s=bar)
+                self._journal("pruned", cfg, floor_s=floor, bar_s=bar)
+                self._log(f"plan tuner pruned {cfg.describe()} "
+                          f"({self._pruned[key]})")
+
+    def _propose(self) -> Optional[PlanConfig]:
+        self._prune_sweep()
+        live = self._live_arms()
+        if not live:
+            return None
+        unvisited = [k for k in live if k not in self._obs]
+        thr = (self._best[0].threshold_mb if self._best is not None
+               else self._current.threshold_mb)
+        if unvisited:
+            if self.cost_model is not None:
+                def price(k):
+                    try:
+                        return self.cost_model.comm(dataclasses.replace(
+                            self._arm_cfg[k], threshold_mb=thr))
+                    except Exception:
+                        return float("inf")
+
+                key = min(unvisited, key=price)
+            else:
+                # no cost model: maximize AXIS coverage instead of taking
+                # nested-loop order — a budget smaller than the arm count
+                # must still sample every mode/compressor/dtype value at
+                # least once rather than burn every trial on the first
+                # mode's dtype combinations
+                seen: dict[tuple, int] = {}
+                for k in self._obs:
+                    for pos, val in enumerate(k):
+                        seen[(pos, val)] = seen.get((pos, val), 0) + 1
+
+                def novelty(k):
+                    return sum(seen.get((pos, val), 0)
+                               for pos, val in enumerate(k))
+
+                key = min(unvisited, key=novelty)
+            return dataclasses.replace(self._arm_cfg[key],
+                                       threshold_mb=float(thr))
+        visited = [k for k in live if k in self._obs]
+        if not visited:
+            return None
+        if self._best is not None and self._rng.random() >= self._explore:
+            key = self._best[0].key()
+            if key not in self._obs or key in self._dead \
+                    or key in self._pruned:  # best arm retired meanwhile
+                key = visited[0]
+        else:
+            key = visited[int(self._rng.integers(len(visited)))]
+        nxt = float(self._bo_for(key).suggest())
+        return dataclasses.replace(self._arm_cfg[key], threshold_mb=nxt)
+
+    def step(self) -> Optional[PlanConfig]:
+        if self.finished:
+            return None
+        if self._num_trials >= self._max:
+            self.finished = True
+            if self._best is None:
+                self._log("plan tuner finished: no feasible measurement; "
+                          f"keeping {self._current.describe()}")
+                return None
+            cfg, t = self._best
+            self._log(f"plan tuner optimal config: {cfg.describe()}, "
+                      f"iteration time {t:.4f}")
+            self._journal("adopted", cfg, measured_s=t)
+            if cfg != self._current:
+                self._current = cfg
+                return cfg
+            return None
+
+        iter_time = self._record()
+        if iter_time is None:
+            return None
+
+        key = self._current.key()
+        self._obs.setdefault(key, []).append(
+            (float(self._current.threshold_mb), iter_time))
+        self._feasible_ys.append(iter_time)
+        self._bo_for(key).register(
+            float(self._current.threshold_mb), iter_time)
+        if self.cost_model is not None:
+            try:
+                self.cost_model.observe(self._current, iter_time)
+            except Exception:
+                pass
+        tr = self._tr()
+        best_changed = self._best is None or iter_time < self._best[1]
+        if best_changed:
+            self._best = (self._current, iter_time)
+        if tr.enabled:
+            tr.count("tune.trials")
+            if best_changed:
+                tr.count("tune.best_changed")
+            tr.event("tune.trial", config=self._current.describe(),
+                     measured_s=iter_time, best=int(best_changed))
+        self._journal("measured", self._current, measured_s=iter_time,
+                      best=best_changed)
+        self._log(
+            f"plan tuner trial [{self._num_trials}] "
+            f"{self._current.describe()}: iteration time {iter_time:.4f}"
+            + (" *best*" if best_changed else "")
+        )
+        self._num_trials += 1
+        if self._num_trials >= self._max:
+            # budget exhausted: the next step() adopts the best config —
+            # proposing one more trial here would force a rebuild/re-jit
+            # (plus a snapshot state copy) of a config that is abandoned
+            # unmeasured one step later
+            return None
+        nxt = self._propose()
+        if nxt is None or nxt == self._current:
+            return None
+        self._current = nxt
+        return nxt
+
+    @property
+    def current(self) -> PlanConfig:
+        return self._current
+
+    @property
+    def budget_steps(self) -> int:
+        """Upper-bound training steps to consume the whole trial budget:
+        every trial may cost a warmup window (config changes re-jit) plus
+        its measured window, plus the adoption window."""
+        return (2 * self._max + 2) * self._interval
+
+    @property
+    def best_config(self) -> Optional[PlanConfig]:
+        return self._best[0] if self._best is not None else None
+
+    def summary(self) -> dict:
+        """JSON-safe snapshot of the search (driver/bench reporting)."""
+        return {
+            "trials": self._num_trials,
+            "finished": self.finished,
+            "context": self._context_key,
+            "current": self._current.to_dict(),
+            "best": (self._best[0].to_dict()
+                     if self._best is not None else None),
+            "best_s": (self._best[1] if self._best is not None else None),
+            "arms": len(self._arm_keys),
+            "visited": len(self._obs),
+            "pruned": {"/".join(str(p) for p in k): v
+                       for k, v in self._pruned.items()},
+            "dead": {"/".join(str(p) for p in k): v
+                     for k, v in self._dead.items()},
+        }

@@ -824,6 +824,19 @@ def elastic_restore(
     def host(x):
         return jax.tree.map(np.asarray, x)
 
+    raw_comp = get("comp_state", ())
+    comp_state: tuple = ()
+    if raw_comp:
+        # compressor error-feedback residuals ride the elastic restore
+        # too: `repack_state` redistributes the per-device rows mass-
+        # preservingly across a world change (and resets on a structural
+        # mismatch) — a torn/legacy field degrades to reset, not a crash
+        try:
+            comp_state = tuple(host(c) for c in _as_sequence(raw_comp))
+        except Exception as exc:
+            logger.warning(
+                "elastic restore: compressor state unreadable (%s); "
+                "error-feedback residuals reset", exc)
     state = D.DearState(
         buffers=tuple(host(b) for b in _as_sequence(get("buffers"))),
         opt_state=tuple(
@@ -831,7 +844,7 @@ def elastic_restore(
         ),
         step=np.asarray(get("step")),
         model_state=host(get("model_state", ())) or (),
-        comp_state=(),
+        comp_state=comp_state,
     )
     return repack_state(state, _PlanShim(old_plan), ts)
 

@@ -196,6 +196,16 @@ def test_plan_comm_accounting_modes_and_dtypes():
                                      gather_itemsize=4)
     by_leg = {r.leg: r.payload_bytes for r in mixed.rows if r.bucket == 0}
     assert by_leg == {"reduce_scatter": 224, "all_gather": 448}
+    # compressed gradient leg: priced at the BUFFER itemsize (f32 leaves
+    # here), NOT comm_itemsize — the execution path casts back to the
+    # buffer dtype before compressing, so a narrower comm dtype never
+    # shrinks the compressed payload
+    qa = CTR.plan_comm_accounting(plan, mode="dear", comm_itemsize=2,
+                                  gather_itemsize=4, compressor="qint8")
+    qleg = {r.leg: r for r in qa.rows if r.bucket == 0}
+    assert qleg["reduce_scatter"].payload_bytes == round(
+        112 * 4 * (112 + 4) / (112 * 4))          # ~1 B/coord + scale
+    assert qleg["all_gather"].payload_bytes == 448  # AG leg stays dense
     # world=1 plans carry zero wire bytes (collectives are local copies)
     p1 = F.plan_by_nearby_layers({"a": jnp.zeros((8,))}, world=1, k=1)
     acct1 = CTR.plan_comm_accounting(p1, mode="dear")

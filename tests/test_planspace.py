@@ -1,0 +1,515 @@
+"""Plan-space autotuner tests: the typed space and its feasibility rules,
+the analytic cost model built on the overlap auditor's α-β machinery, the
+mixed bandit/BO tuner protocol (pruning, infeasibility sandboxing, context
+invalidation), the live `AutoTuner(strategy='plan')` loop, and the
+guard-interplay contract — a diverging trial reverts plan AND state inside
+the tuner, with zero ``guard.rollbacks`` booked against the run."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.ops import fusion as F
+from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+from dear_pytorch_tpu.tuning import (
+    AutoTuner,
+    CostModel,
+    PlanConfig,
+    PlanSpace,
+    PlanTuner,
+    Tuner,
+)
+from dear_pytorch_tpu.tuning.planspace import dtype_token
+
+from tests.test_dear_numerics import _data, _loss_fn, _mlp_params
+
+
+# ---------------------------------------------------------------------------
+# the space
+# ---------------------------------------------------------------------------
+
+
+def test_space_axes_and_feasibility():
+    space = PlanSpace()
+    axes = {a.name: a for a in space.axes()}
+    assert axes["threshold_mb"].kind == "continuous"
+    assert set(axes["mode"].choices) == {"dear", "dear-fused"}
+    assert None in axes["compressor"].choices
+    # no combination pairs a compressor with dear-fused or a comm dtype
+    for cfg in space.configs():
+        assert not (cfg.compressor and cfg.mode == "dear-fused")
+        assert not (cfg.compressor and cfg.comm_dtype)
+    assert space.feasible(PlanConfig(mode="dear-fused",
+                                     compressor="eftopk")) is not None
+    assert space.feasible(PlanConfig(compressor="eftopk",
+                                     comm_dtype="bf16")) is not None
+    assert space.feasible(PlanConfig()) is None
+    with pytest.raises(ValueError, match="mode axis"):
+        PlanSpace(modes=("allreduce",))
+
+
+def test_space_from_env(monkeypatch):
+    monkeypatch.setenv("DEAR_TUNE_MODES", "dear")
+    monkeypatch.setenv("DEAR_TUNE_COMPRESSORS", "none,eftopk")
+    monkeypatch.setenv("DEAR_TUNE_DTYPES", "none")
+    monkeypatch.setenv("DEAR_TUNE_REMAT", "none")
+    monkeypatch.setenv("DEAR_TUNE_DENSITY", "0.05")
+    space = PlanSpace.from_env()
+    assert space.modes == ("dear",)
+    assert space.compressors == (None, "eftopk")
+    assert space.comm_dtypes == (None,) and space.gather_dtypes == (None,)
+    assert space.remats == (None,)
+    assert space.density == 0.05
+    assert len(space.configs()) == 2  # dense + eftopk
+
+
+def test_dtype_tokens():
+    assert dtype_token(None) is None
+    assert dtype_token("f32") is None
+    assert dtype_token("bfloat16") == "bf16"
+    assert dtype_token(jnp.bfloat16) == "bf16"
+    assert dtype_token(jnp.float16) == "f16"
+    with pytest.raises(ValueError):
+        dtype_token("int7")
+    # build_kwargs resolves tokens back to jnp dtypes
+    kw = PlanConfig(comm_dtype="bf16").build_kwargs()
+    assert kw["comm_dtype"] is jnp.bfloat16
+    assert kw["gather_dtype"] is None
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+
+
+def _toy_plan_fn():
+    params = _mlp_params(jax.random.PRNGKey(0))
+    return lambda thr: F.make_plan(params, 8, threshold_mb=thr)
+
+
+def test_cost_model_orders_wire_formats():
+    cm = CostModel(_toy_plan_fn(), alpha=1e-6, beta=1e-8)
+    dense = cm.comm(PlanConfig(threshold_mb=0.001))
+    bf16 = cm.comm(PlanConfig(threshold_mb=0.001, comm_dtype="bf16",
+                              gather_dtype="bf16"))
+    sparse = cm.comm(PlanConfig(threshold_mb=0.001, compressor="eftopk",
+                                density=0.01))
+    assert bf16 < dense
+    assert sparse < dense
+    # no pruning floor before any calibration observation
+    assert cm.floor(PlanConfig()) is None
+    cm.observe(PlanConfig(threshold_mb=0.001), measured_s=0.010)
+    floor = cm.floor(PlanConfig(threshold_mb=0.001))
+    assert floor is not None and floor > 0
+    # remat recompute inflates the compute side of the floor
+    assert cm.floor(PlanConfig(threshold_mb=0.001, remat="full")) >= floor
+
+
+# ---------------------------------------------------------------------------
+# the tuner protocol (host-only: fake clock, no jax step)
+# ---------------------------------------------------------------------------
+
+
+class _FakeTracer:
+    enabled = True
+
+    def __init__(self):
+        self.counts: dict = {}
+
+    def count(self, name, value=1):
+        self.counts[name] = self.counts.get(name, 0) + value
+
+    def event(self, name, **kw):
+        pass
+
+
+def _drive(tuner, iter_time_of, steps=400):
+    """Run the step protocol against a synthetic per-config cost surface."""
+    t = {"t": 0.0}
+    configs = []
+    for _ in range(steps):
+        if tuner.finished:
+            break
+        t["t"] += iter_time_of(tuner.current)
+        tuner._clock_value = t["t"]
+        p = tuner.step()
+        if p is not None:
+            configs.append(p)
+    return configs
+
+
+def _mk_tuner(space, tracer=None, **kw):
+    def clock():
+        return tuner._clock_value
+
+    tuner = PlanTuner(space, log=lambda s: None, clock=clock,
+                      tracer=tracer or _FakeTracer(), **kw)
+    tuner._clock_value = 0.0
+    return tuner
+
+
+def test_plan_tuner_finds_the_fast_arm():
+    space = PlanSpace(modes=("dear",),
+                      compressors=(None, "eftopk"),
+                      comm_dtypes=(None, "bf16"),
+                      gather_dtypes=(None,), remats=(None,),
+                      threshold_bound=(1.0, 64.0))
+    tracer = _FakeTracer()
+    tuner = _mk_tuner(space, tracer=tracer, max_trials=8, interval=5, seed=0)
+
+    def iter_time(cfg: PlanConfig) -> float:
+        base = 0.02
+        if cfg.comm_dtype == "bf16":
+            base -= 0.008          # the fast arm
+        if cfg.compressor == "eftopk":
+            base += 0.005          # compression overhead dominates here
+        return base
+
+    _drive(tuner, iter_time)
+    assert tuner.finished
+    assert tuner.best_config is not None
+    assert tuner.best_config.comm_dtype == "bf16"
+    assert tuner.current == tuner.best_config  # adopted
+    assert tracer.counts["tune.trials"] >= 3
+    assert tracer.counts["tune.best_changed"] >= 1
+
+
+def test_plan_tuner_prunes_analytically_dominated_arms():
+    space = PlanSpace(modes=("dear",),
+                      compressors=(None, "eftopk"),
+                      comm_dtypes=(None,), gather_dtypes=(None,),
+                      remats=(None,), density=0.9,
+                      threshold_bound=(0.0005, 0.02))
+    # a cost model where the compressed arm's predicted comm alone dwarfs
+    # any plausible step time: it must be pruned, never measured
+    cm = CostModel(_toy_plan_fn(), alpha=0.0, beta=0.0)
+    cm.comm = lambda cfg: 10.0 if cfg.compressor else 1e-4  # type: ignore
+    tracer = _FakeTracer()
+    tuner = _mk_tuner(space, tracer=tracer, max_trials=6, interval=5,
+                      cost_model=cm, prune_margin=0.25,
+                      min_obs_to_prune=1)
+
+    _drive(tuner, lambda cfg: 0.01)
+    assert tuner.finished
+    assert tracer.counts.get("tune.prunes", 0) == 1
+    summary = tuner.summary()
+    assert summary["pruned"], summary
+    # the pruned arm never got a measurement
+    assert all(k[1] is None for k in tuner._obs)
+
+
+def test_plan_tuner_fatal_infeasible_retires_arm():
+    space = PlanSpace(modes=("dear", "dear-fused"),
+                      compressors=(None,), comm_dtypes=(None,),
+                      gather_dtypes=(None,), remats=(None,))
+    tracer = _FakeTracer()
+    tuner = _mk_tuner(space, tracer=tracer, max_trials=6, interval=5)
+    bad = PlanConfig(mode="dear-fused", threshold_mb=25.0)
+    tuner.mark_infeasible(bad, revert_to=PlanConfig(), fatal=True,
+                          why="build raised ValueError")
+    assert tuner.current == PlanConfig()
+    assert ("dear-fused", None, None, None, None) in tuner._dead
+    assert tracer.counts["tune.infeasible"] == 1
+    # a build failure costs milliseconds, not a measurement window: the
+    # arm retirement must NOT consume a trial from the search budget
+    assert tuner._num_trials == 0
+    # the retired arm is never proposed again
+    _drive(tuner, lambda cfg: 0.01)
+    assert all(c.mode == "dear" for c in [tuner.current])
+
+
+def test_plan_tuner_context_invalidation():
+    space = PlanSpace(modes=("dear",), compressors=(None,),
+                      comm_dtypes=(None, "bf16"), gather_dtypes=(None,),
+                      remats=(None,))
+    tuner = _mk_tuner(space, max_trials=20, interval=5)
+    _drive(tuner, lambda cfg: 0.01, steps=60)
+    assert tuner._best is not None
+    visited_before = len(tuner._obs)
+    assert visited_before >= 1
+    tuner.notify_context(world=4, epoch=1)
+    # stale posteriors shelved: nothing observed in the new context
+    assert tuner._best is None and not tuner._obs
+    assert tuner._warmup  # next window is warmup
+    # switching back restores the shelf
+    tuner.notify_context(world=8, epoch=0)
+    tuner.notify_context(world=4, epoch=1)
+    tuner.notify_context(world=8, epoch=0)
+    # original context key was "" at construction; the shelves for the two
+    # explicit contexts stay separate
+    assert len(tuner._archive) >= 2
+
+
+def test_bo_tuner_context_invalidation():
+    """Satellite: `Tuner`/`BayesianOptimizer` history was keyed only by x —
+    `notify_context` must namespace observations so a rescaled fleet
+    cannot exploit stale posteriors."""
+    state = {"t": 0.0}
+    tuner = Tuner(x=25.0, bound=(1.0, 256.0), max_num_steps=20, interval=5,
+                  log=lambda s: None, clock=lambda: state["t"])
+    for _ in range(40):
+        state["t"] += 0.01
+        p = tuner.step()
+        if tuner._opt.xs:
+            break
+    assert tuner._opt.xs, "no observation registered — protocol drift?"
+    xs_before = list(tuner._opt.xs)
+    tuner.notify_context(world=4, epoch=1)
+    assert tuner._opt.xs == [] and tuner._best is None
+    assert tuner._warmup
+    # same context again: no-op
+    tuner.notify_context(world=4, epoch=1)
+    assert tuner._opt.xs == []
+    # returning to the original context restores its observations
+    tuner.notify_context()
+    # empty kwargs -> key "" == construction default context
+    assert tuner._opt.context == ""
+    assert tuner._opt.xs == xs_before
+
+
+# ---------------------------------------------------------------------------
+# live AutoTuner(strategy='plan')
+# ---------------------------------------------------------------------------
+
+
+def _problem():
+    params = _mlp_params(jax.random.PRNGKey(0))
+    batches = [_data(jax.random.PRNGKey(100 + i)) for i in range(5)]
+    return params, batches
+
+
+def _counting_clock():
+    t = {"t": 0.0}
+
+    def clock():
+        t["t"] += 0.01
+        return t["t"]
+
+    return clock
+
+
+def test_autotuner_plan_searches_and_survives(mesh):
+    params, batches = _problem()
+    space = PlanSpace(threshold_bound=(0.0005, 0.02),
+                      modes=("dear",),
+                      compressors=(None, "eftopk", "qint8"),
+                      comm_dtypes=(None, "bf16"),
+                      gather_dtypes=(None,), remats=(None, "full"),
+                      density=0.25)
+    at = AutoTuner(
+        _loss_fn, params, strategy="plan", threshold_mb=0.0008,
+        space=space, max_trials=6, interval=5,
+        mesh=mesh, optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        donate=False, clock=_counting_clock(), tuner_seed=0,
+        alpha_beta=(1e-6, 1e-9),
+    )
+    state = at.init(params)
+    losses = []
+    for i in range(70):
+        state, m = at.step(state, batches[i % 5])
+        losses.append(float(m["loss"]))
+        if at.planner.finished:
+            break
+    assert at.planner.finished
+    assert at.rebuilds >= 1          # categorical arms forced real rebuilds
+    assert all(np.isfinite(x) for x in losses)
+    assert int(jax.device_get(state.step)) > 0
+    summary = at.planner.summary()
+    assert summary["visited"] >= 2   # more than one arm actually measured
+
+
+def test_autotuner_plan_rejects_baseline_modes(mesh):
+    params, _ = _problem()
+    with pytest.raises(ValueError, match="dear/dear-fused"):
+        AutoTuner(_loss_fn, params, strategy="plan", mesh=mesh,
+                  mode="allreduce", donate=False)
+
+
+def test_autotuner_plan_rescale_invalidates_observations(mesh):
+    """Satellite: a rescaled fleet must not exploit stale posteriors —
+    the rescale is a context change for the plan tuner too."""
+    params, batches = _problem()
+    space = PlanSpace(threshold_bound=(0.0005, 0.02), modes=("dear",),
+                      compressors=(None,), comm_dtypes=(None, "bf16"),
+                      gather_dtypes=(None,), remats=(None,))
+    at = AutoTuner(
+        _loss_fn, params, strategy="plan", threshold_mb=0.0008,
+        space=space, max_trials=10, interval=5,
+        mesh=mesh, optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        donate=False, clock=_counting_clock(), tuner_seed=0,
+    )
+    state = at.init(params)
+    for i in range(12):
+        state, m = at.step(state, batches[i % 5])
+    assert at.planner._obs, "no observation before the rescale?"
+
+    class View:
+        world = 4
+        epoch = 1
+
+    state = at.rescale(View(), state=state)
+    assert at.ts.plan.world == 4 and at.ts.plan.epoch == 1
+    assert not at.planner._obs          # stale posteriors shelved
+    assert at.planner._best is None
+    assert at._trial_backup is None     # old-world snapshot dropped
+    # training continues on the rescaled mesh
+    smaller = jax.tree.map(lambda x: x[: x.shape[0] // 2], batches[0])
+    state, m = at.step(state, smaller)
+    assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# guard interplay: diverging trials are the tuner's incident, not the run's
+# ---------------------------------------------------------------------------
+
+
+def test_diverging_trial_reverts_without_guard_rollback(
+        mesh, tmp_path, monkeypatch):
+    """Satellite: a trial whose wire format diverges (the int8-overflow
+    shape) must produce `mark_infeasible` + plan/state revert with ZERO
+    ``guard.rollbacks`` booked against the user's run — the guard never
+    even sees a non-finite loss."""
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops import compression as Z
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    def _nan8():
+        # qint8 with a poisoned scale: decompress -> NaN gradients (a
+        # deterministic stand-in for int8 dynamic-range overflow). Keeps
+        # the family name 'qint8' so the schedule dispatches it to the
+        # int8 reduction; registered under its own key 'nan8'.
+        base = Z.compressors["qint8"]()
+
+        def compress(buf, state, density):
+            payload, st = base.compress(buf, state, density)
+            payload = dict(payload,
+                           scale=payload["scale"] * jnp.float32(jnp.nan))
+            return payload, st
+
+        return Z.Compressor("qint8", base.init, compress, base.decompress)
+
+    monkeypatch.setitem(Z.compressors, "nan8", _nan8)
+    live = T.Tracer([T.MemoryExporter()])
+    old_tracer = T.get_tracer()
+    T.set_tracer(live)
+    try:
+        params, batches = _problem()
+        space = PlanSpace(threshold_bound=(0.0005, 0.02), modes=("dear",),
+                          compressors=("nan8",), comm_dtypes=(None,),
+                          gather_dtypes=(None,), remats=(None,))
+        at = AutoTuner(
+            _loss_fn, params, strategy="plan", threshold_mb=0.0008,
+            space=space, max_trials=3, interval=5,
+            mesh=mesh, optimizer=fused_sgd(lr=0.05, momentum=0.9),
+            donate=False, clock=_counting_clock(), tuner_seed=0,
+        )
+        guard = GuardedTrainer(at, str(tmp_path / "g"), params,
+                               check_every=1, checkpoint_every=10 ** 6)
+        state = at.init(params)
+        reverted = False
+        for i in range(40):
+            state, m = guard.step(state, batches[i % 5])
+            assert np.isfinite(float(m["loss"])), (i, m)
+            reverted = reverted or bool(m.get("tuner_reverted"))
+            if at.planner.finished:
+                break
+        counters = live.counters()
+        assert reverted, "the diverging trial never reached the tuner"
+        assert counters.get("guard.rollbacks", 0) == 0
+        assert counters.get("guard.nan_detected", 0) == 0
+        assert counters.get("autotune.trial_failures", 0) >= 1
+        assert counters.get("tune.infeasible", 0) >= 1
+        assert guard.recoveries == 0
+        # the bad arm carries only dominated (penalty) observations —
+        # 10x the worst feasible measurement, never a real timing
+        nan_key = ("dear", "nan8", None, None, None)
+        nan_obs = at.planner._obs.get(nan_key, [])
+        assert nan_obs, "the bad arm was never penalized"
+        worst_feasible = max(at.planner._feasible_ys)
+        assert all(y >= 5 * worst_feasible for _, y in nan_obs)
+        # ...and the live plan is back on the known-good dense config
+        assert at._live_config.compressor is None
+    finally:
+        T.set_tracer(old_tracer)
+
+
+def test_remat_lever_matches_dense_numerics(mesh):
+    """remat='full' recomputes the forward in backward — numerics must be
+    IDENTICAL to the default (it's a memory/time trade, not an
+    approximation); fsdp owns its own policy and rejects the knob."""
+    from dear_pytorch_tpu.parallel import build_train_step
+
+    params, batches = _problem()
+    opt = lambda: fused_sgd(lr=0.1, momentum=0.9)  # noqa: E731
+    ts0 = build_train_step(_loss_fn, params, mesh=mesh, optimizer=opt(),
+                           threshold_mb=None, donate=False)
+    ts1 = build_train_step(_loss_fn, params, mesh=mesh, optimizer=opt(),
+                           threshold_mb=None, donate=False, remat="full")
+    s0, s1 = ts0.init(params), ts1.init(params)
+    for b in batches[:3]:
+        s0, m0 = ts0.step(s0, b)
+        s1, m1 = ts1.step(s1, b)
+        np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
+                                   rtol=1e-6)
+    with pytest.raises(ValueError, match="fsdp"):
+        build_train_step(_loss_fn, params, mesh=mesh, mode="fsdp",
+                         remat="full")
+    with pytest.raises(ValueError, match="remat"):
+        build_train_step(_loss_fn, params, mesh=mesh, remat="half")
+
+
+def test_repack_carries_error_feedback_and_survives_config_switch(mesh):
+    """`repack_state` preserves compressor residual mass exactly across a
+    re-bucketing, and resets (rather than crashes) when the compressor
+    axis itself changes between plans."""
+    from dear_pytorch_tpu.parallel import build_train_step
+    from dear_pytorch_tpu.tuning.autotune import repack_state
+
+    params, batches = _problem()
+    opt = fused_sgd(lr=0.1, momentum=0.9)
+    kw = dict(mesh=mesh, mode="dear", optimizer=opt, donate=False)
+    ts1 = build_train_step(_loss_fn, params, threshold_mb=0.0008,
+                           compressor="eftopk", density=0.25, **kw)
+    ts2 = build_train_step(_loss_fn, params, threshold_mb=None,
+                           compressor="eftopk", density=0.25, **kw)
+    assert ts1.plan.num_buckets != ts2.plan.num_buckets
+    state = ts1.init(params)
+    for i in range(3):
+        state, _ = ts1.step(state, batches[i])
+
+    def mass(comp, plan):
+        total = 0.0
+        for bi, c in enumerate(comp):
+            arr = np.asarray(c)
+            for r in range(arr.shape[0]):
+                for x in F.unpack_bucket(jnp.asarray(arr[r]),
+                                         plan, bi).values():
+                    total += float(np.sum(np.asarray(x)))
+        return total
+
+    before = mass(state.comp_state, ts1.plan)
+    assert abs(before) > 0  # the residual is real
+    state2 = repack_state(state, ts1, ts2)
+    np.testing.assert_allclose(mass(state2.comp_state, ts2.plan), before,
+                               rtol=1e-5, atol=1e-6)
+    state2, m = ts2.step(state2, batches[3])
+    assert np.isfinite(float(m["loss"]))
+
+    # compressor changed but both carry ADDITIVE residuals in gradient
+    # units (eftopk -> qint8): the unsent mass carries across the switch
+    ts3 = build_train_step(_loss_fn, params, threshold_mb=None,
+                           compressor="qint8", **kw)
+    state3 = repack_state(state, ts1, ts3)
+    np.testing.assert_allclose(mass(state3.comp_state, ts3.plan), before,
+                               rtol=1e-5, atol=1e-6)
+    state3, m = ts3.step(state3, batches[4])
+    assert np.isfinite(float(m["loss"]))
+
+    # a STRUCTURAL mismatch resets: switching to a stateless compressor
+    # has no residual to carry into ('topk' keeps no buffer)
+    ts4 = build_train_step(_loss_fn, params, threshold_mb=None,
+                           compressor="topk", density=0.25, **kw)
+    state4 = repack_state(state, ts1, ts4)
+    assert state4.comp_state == () or all(
+        not jax.tree.leaves(c) for c in state4.comp_state)
