@@ -39,6 +39,11 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     dtype: Any = jnp.float32
+    #: Decode-mode KV-cache ring length (None = the position budget; see
+    #: models/gpt.py — same ring semantics via `serving.kvcache`).
+    kv_cache_len: Optional[int] = None
+    #: Route decode attention through the Pallas flash kernel.
+    decode_use_flash: bool = False
 
     @property
     def padded_vocab_size(self) -> int:
@@ -126,7 +131,8 @@ class BertSelfAttention(nn.Module):
     projection_impl: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, mask, train: bool = True):
+    def __call__(self, x, mask, train: bool = True, decode: bool = False,
+                 decode_positions=None):
         cfg = self.config
         h, nh = cfg.hidden_size, cfg.num_attention_heads
         d = h // nh
@@ -139,17 +145,52 @@ class BertSelfAttention(nn.Module):
             dense = lambda name: nn.DenseGeneral(  # noqa: E731
                 (nh, d), dtype=cfg.dtype, name=name, kernel_init=kinit)
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
-        dropout_rng = None
-        if train and cfg.attention_probs_dropout_prob > 0.0:
-            dropout_rng = self.make_rng("dropout")
-        impl = self.attention_impl or dot_product_attention
-        ctx = impl(q, k, v, mask, dropout_rng=dropout_rng,
-                   dropout_rate=cfg.attention_probs_dropout_prob if train else 0.0,
-                   dtype=cfg.dtype)
+        if decode:
+            ctx = self._decode_attend(q, k, v, decode_positions)
+        else:
+            dropout_rng = None
+            if train and cfg.attention_probs_dropout_prob > 0.0:
+                dropout_rng = self.make_rng("dropout")
+            impl = self.attention_impl or dot_product_attention
+            ctx = impl(q, k, v, mask, dropout_rng=dropout_rng,
+                       dropout_rate=(cfg.attention_probs_dropout_prob
+                                     if train else 0.0),
+                       dtype=cfg.dtype)
         out = nn.DenseGeneral(
             h, axis=(-2, -1), dtype=cfg.dtype, name="output",
             kernel_init=nn.initializers.normal(cfg.initializer_range))(ctx)
         return out
+
+    def _decode_attend(self, q, k, v, positions):
+        """Single-token attention against the ring-buffer KV cache — the
+        serving decode path, identical ring semantics to models/gpt.py
+        (`serving.kvcache` owns the math). Incremental decode is
+        left-to-right by construction, so its logits reproduce the full
+        forward run with ``causal=True`` (pinned by
+        tests/test_serving.py), not the bidirectional training forward."""
+        from dear_pytorch_tpu.serving import kvcache as KV
+
+        cfg = self.config
+        B, S, nh, d = q.shape
+        if S != 1:
+            raise ValueError(
+                f"decode mode feeds one token at a time, got S={S}"
+            )
+        L = cfg.kv_cache_len or cfg.max_position_embeddings
+        initialized = self.has_variable("cache", "k")
+        ck = self.variable("cache", "k",
+                           lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
+        cv = self.variable("cache", "v",
+                           lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
+        if not initialized:
+            return jnp.zeros_like(q)
+        ck.value, cv.value = KV.ring_write(
+            ck.value, cv.value, positions, k.astype(cfg.dtype),
+            v.astype(cfg.dtype))
+        valid = KV.ring_validity(positions, L)
+        return KV.cache_attend(q, ck.value, cv.value, valid,
+                               dtype=cfg.dtype,
+                               use_flash=cfg.decode_use_flash)
 
 
 class BertLayer(nn.Module):
@@ -158,11 +199,13 @@ class BertLayer(nn.Module):
     projection_impl: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, mask, train: bool = True):
+    def __call__(self, x, mask, train: bool = True, decode: bool = False,
+                 decode_positions=None):
         cfg = self.config
         attn = BertSelfAttention(cfg, attention_impl=self.attention_impl,
                                  projection_impl=self.projection_impl,
-                                 name="attention")(x, mask, train)
+                                 name="attention")(x, mask, train, decode,
+                                                   decode_positions)
         attn = nn.Dropout(cfg.hidden_dropout_prob,
                           deterministic=not train)(attn)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
@@ -201,12 +244,20 @@ class BertForPreTraining(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 train: bool = True, position_offset=0, pool_fn=None):
+                 train: bool = True, position_offset=0, pool_fn=None,
+                 causal: bool = False, decode: bool = False):
         """``position_offset`` shifts position ids (a sequence-parallel shard
-        at global offset r*S_local passes that offset); ``pool_fn(x)``
+        at global offset r*S_local passes that offset; in decode mode it may
+        be a per-row ``[B]`` array — see models/gpt.py); ``pool_fn(x)``
         overrides the default ``x[:, 0]`` CLS pooling (under sequence
         parallelism the CLS token lives on shard 0 only — see
-        parallel.sp.sp_cls_pool)."""
+        parallel.sp.sp_cls_pool).
+
+        ``causal=True`` adds the causal triangle to the attention mask —
+        the left-to-right serving forward whose logits the incremental
+        ``decode=True`` path (one token per call, ring KV cache in the
+        'cache' collection, apply with ``mutable=['cache']``) reproduces
+        exactly. The default bidirectional forward is untouched."""
         cfg = self.config
         B, S = input_ids.shape
         if token_type_ids is None:
@@ -219,7 +270,13 @@ class BertForPreTraining(nn.Module):
                             embedding_init=embed_init, dtype=cfg.dtype,
                             name="word_embeddings")
         x = word_emb(input_ids)
-        pos_ids = position_offset + jnp.arange(S)[None, :]
+        offset = jnp.asarray(position_offset, jnp.int32)
+        if offset.ndim == 1:
+            # per-row [B] offsets (the serving engine's mixed batch)
+            pos_ids = offset[:, None] + jnp.arange(S)[None, :]
+        else:
+            # scalar or broadcastable offset array — legacy semantics
+            pos_ids = offset + jnp.arange(S)[None, :]
         x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                          embedding_init=embed_init, dtype=cfg.dtype,
                          name="position_embeddings")(pos_ids)
@@ -233,11 +290,33 @@ class BertForPreTraining(nn.Module):
         # additive mask [B, 1, 1, S]
         mask = (1.0 - attention_mask[:, None, None, :].astype(cfg.dtype))
         mask = mask * jnp.asarray(-1e9, dtype=cfg.dtype)
+        if causal:
+            if self.attention_impl is not None:
+                raise ValueError(
+                    "causal=True builds a [B, 1, S, S] mask the default "
+                    "attention core broadcasts; custom attention_impl "
+                    "hooks expect [B, 1, 1, S] key-padding masks"
+                )
+            tri = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            mask = mask + jnp.where(tri, 0.0, -1e9).astype(
+                cfg.dtype)[None, None]
 
+        decode_positions = None
+        if decode:
+            if offset.ndim == 0:
+                decode_positions = jnp.broadcast_to(offset[None], (B,))
+            elif offset.ndim == 1:
+                decode_positions = offset
+            else:
+                raise ValueError(
+                    "decode mode needs a scalar or per-row [B] "
+                    f"position_offset, got shape {offset.shape}"
+                )
         for i in range(cfg.num_hidden_layers):
             x = BertLayer(cfg, attention_impl=self.attention_impl,
                           projection_impl=self.projection_impl,
-                          name=f"layer_{i}")(x, mask, train)
+                          name=f"layer_{i}")(x, mask, train, decode,
+                                             decode_positions)
 
         # --- MLM head: transform + tied decoder + bias -----------------------
         y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,

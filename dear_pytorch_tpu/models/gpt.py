@@ -63,6 +63,18 @@ class GptConfig:
     #: Padded ids are dead in the loss and in sampling either way.
     vocab_pad_multiple: int = 8
 
+    #: Decode-mode KV-cache ring length (None = ``max_position_embeddings``,
+    #: which never wraps inside the position budget — the legacy linear
+    #: cache). A smaller ring bounds serving memory per slot; once a
+    #: sequence outgrows it, attention becomes a sliding window over the
+    #: last ``kv_cache_len`` tokens (`serving.kvcache`).
+    kv_cache_len: Optional[int] = None
+    #: Route decode-mode attention through the Pallas flash kernel
+    #: (1-token query over the cache, validity mask as its ``kv_mask``)
+    #: instead of the dense core. Same logits at dtype tolerance
+    #: (tests/test_serving.py).
+    decode_use_flash: bool = False
+
     @property
     def padded_vocab_size(self) -> int:
         m = self.vocab_pad_multiple
@@ -152,7 +164,8 @@ class GptBlock(nn.Module):
     projection_impl: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = True, decode: bool = False):
+    def __call__(self, x, train: bool = True, decode: bool = False,
+                 decode_positions=None):
         cfg = self.config
         h, nh = cfg.hidden_size, cfg.num_attention_heads
         d = h // nh
@@ -174,7 +187,7 @@ class GptBlock(nn.Module):
         if train and cfg.attention_probs_dropout_prob > 0.0:
             dropout_rng = self.make_rng("dropout")
         if decode:
-            ctx = self._decode_attend(q, k, v)
+            ctx = self._decode_attend(q, k, v, decode_positions)
         else:
             impl = self.attention_impl or causal_dot_product_attention
             ctx = impl(q, k, v, None, dropout_rng=dropout_rng,
@@ -227,50 +240,44 @@ class GptBlock(nn.Module):
         y = nn.Dropout(cfg.hidden_dropout_prob, deterministic=not train)(y)
         return x + y
 
-    def _decode_attend(self, q, k, v):
-        """Single-token attention against a KV cache (autoregressive
-        decoding). The cache lives in the flax 'cache' collection
-        (``B x max_position x heads x head_dim`` per block plus a write
-        index); each call writes this step's K/V at the index and attends q
-        over the valid prefix. Shapes are static — max cache length is the
-        config's position budget."""
+    def _decode_attend(self, q, k, v, positions):
+        """Single-token attention against the ring-buffer KV cache
+        (autoregressive decoding; `serving.kvcache` owns the ring math).
+        ``positions`` is the per-row global token position ``[B]`` — the
+        write slot is ``pos % L`` and validity derives from the position
+        alone, so the cache carries NO write-index state: resetting a row
+        to position 0 (continuous-batching slot reuse) invalidates every
+        stale entry for free. Shapes are static — the ring length is
+        ``config.kv_cache_len`` (default: the position budget)."""
+        from dear_pytorch_tpu.serving import kvcache as KV
+
         cfg = self.config
         B, S, nh, d = q.shape
         if S != 1:
             raise ValueError(
                 f"decode mode feeds one token at a time, got S={S}"
             )
-        L = cfg.max_position_embeddings
+        L = cfg.kv_cache_len or cfg.max_position_embeddings
         # flax's standard decode-cache pattern: during model.init the
         # variables are being CREATED (has_variable is False) and the call
         # must not execute a cache write — otherwise the returned cache
-        # starts at idx=1 with a phantom entry in slot 0, and every later
-        # key is double-counted one slot over
+        # template already carries a phantom entry in slot 0
         initialized = self.has_variable("cache", "k")
         ck = self.variable("cache", "k",
                            lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
         cv = self.variable("cache", "v",
                            lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
-        ci = self.variable("cache", "idx",
-                           lambda: jnp.zeros((), jnp.int32))
         if not initialized:
             return jnp.zeros_like(q)
-        i = ci.value
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, i, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, i, 0, 0))
-        ci.value = i + 1
-        # additive mask over cache slots: positions > i are invalid
-        valid = jnp.arange(L) <= i
-        mask = jnp.where(valid, 0.0, -1e9).astype(cfg.dtype)[
-            None, None, None, :
-        ]
-        # plain masked attention: causality is carried by the validity
-        # mask (a [1, L] causal triangle would mask everything but slot 0)
-        return dot_product_attention(
-            q, ck.value, cv.value, mask, dtype=cfg.dtype
-        )
+        ck.value, cv.value = KV.ring_write(
+            ck.value, cv.value, positions, k.astype(cfg.dtype),
+            v.astype(cfg.dtype))
+        # causality is carried by the slot-validity mask (only positions
+        # already written — the current token included — are attendable)
+        valid = KV.ring_validity(positions, L)
+        return KV.cache_attend(q, ck.value, cv.value, valid,
+                               dtype=cfg.dtype,
+                               use_flash=cfg.decode_use_flash)
 
 
 class GptLmHeadModel(nn.Module):
@@ -291,14 +298,24 @@ class GptLmHeadModel(nn.Module):
         """``decode=True``: autoregressive mode — ``input_ids`` is one
         token per sequence ``[B, 1]``, attention reads/writes the 'cache'
         collection (apply with ``mutable=['cache']``), and
-        ``position_offset`` is the token's global position."""
+        ``position_offset`` is the token's global position — a scalar, or
+        a per-row ``[B]`` array (a continuous-batching engine serves rows
+        at independent positions: some prefilling, some decoding, in ONE
+        jitted step — `serving.engine`)."""
         cfg = self.config
         B, S = input_ids.shape
         init = nn.initializers.normal(cfg.initializer_range)
         wte = nn.Embed(cfg.padded_vocab_size, cfg.hidden_size,
                        embedding_init=init, dtype=cfg.dtype, name="wte")
         x = wte(input_ids)
-        pos = position_offset + jnp.arange(S)[None, :]
+        offset = jnp.asarray(position_offset, jnp.int32)
+        if offset.ndim == 1:
+            # per-row [B] offsets (the serving engine's mixed batch)
+            pos = offset[:, None] + jnp.arange(S)[None, :]
+        else:
+            # scalar, or a [..., S]-broadcastable per-token offset array
+            # (the zigzag sequence-parallel layout) — legacy semantics
+            pos = offset + jnp.arange(S)[None, :]
         x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                          embedding_init=init, dtype=cfg.dtype,
                          name="wpe")(pos)
@@ -308,10 +325,21 @@ class GptLmHeadModel(nn.Module):
             # static_argnums counts the bound module as arg 0: (self, x,
             # train, decode) -> the two bools are 2 and 3
             block_cls = nn.remat(GptBlock, static_argnums=(2, 3))
+        decode_positions = None
+        if decode:
+            if offset.ndim == 0:
+                decode_positions = jnp.broadcast_to(offset[None], (B,))
+            elif offset.ndim == 1:
+                decode_positions = offset
+            else:
+                raise ValueError(
+                    "decode mode needs a scalar or per-row [B] "
+                    f"position_offset, got shape {offset.shape}"
+                )
         for i in range(cfg.num_hidden_layers):
             x = block_cls(cfg, attention_impl=self.attention_impl,
                           projection_impl=self.projection_impl,
-                          name=f"h_{i}")(x, train, decode)
+                          name=f"h_{i}")(x, train, decode, decode_positions)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_f")(x)
         return wte.attend(x).astype(jnp.float32)

@@ -16,10 +16,20 @@ Fault kinds (all fire exactly once per scheduled entry):
   ``exc``           raise `InjectedFault` from inside the guarded step
   ``hang``          sleep ``arg`` seconds before the step (a hung
                     collective, as seen by the host) — watchdog fodder
+  ``slow``          from step ``N`` ON, sleep ``arg`` seconds before
+                    EVERY step (fires once; the latency persists) — a
+                    straggling rank in training chaos drills, a slow
+                    replica creating admission backpressure in the
+                    serving storm (``slow@10:0.05:r1``)
   ``ckpt_corrupt``  flip bytes in the newest committed checkpoint payload
                     on disk (exercises the checksum-manifest fallback)
   ``preempt``       SIGTERM to the own process (a simulated maintenance
                     preemption; pair with `resilience.preempt`)
+  ``corrupt_resp``  serving-path only: flip bytes in one response payload
+                    AFTER it was checksum-signed (`serving.replica` calls
+                    `corrupt_payload` per response), so the router's
+                    sha256 verification must catch and re-dispatch it;
+                    a training run never consumes this kind
 
 Enable from the environment — ``DEAR_FAULTS="nan@6,exc@9,hang@12:0.5,
 ckpt_corrupt@15,preempt@18"`` — or construct a `FaultInjector` in code and
@@ -52,7 +62,8 @@ logger = logging.getLogger("dear_pytorch_tpu")
 
 FAULT_ENV = "DEAR_FAULTS"
 
-KINDS = ("nan", "exc", "hang", "ckpt_corrupt", "preempt")
+KINDS = ("nan", "exc", "hang", "slow", "ckpt_corrupt", "preempt",
+         "corrupt_resp")
 
 __all__ = [
     "FAULT_ENV", "KINDS", "Fault", "InjectedFault", "FaultInjector",
@@ -213,6 +224,9 @@ class FaultInjector:
             self._by_step.setdefault(int(f.step), []).append(f)
         self.fired: List[Fault] = []
         self.skipped: List[Fault] = []  # rank-targeted, not this rank
+        #: persistent per-step latency armed by ``slow`` faults (additive
+        #: when several fire); every later `before_step` sleeps this long
+        self.slow_s: float = 0.0
         self._own_rank = own_rank
         # kill=False turns ``preempt`` into a no-op marker (tests that
         # assert scheduling without installing a SIGTERM handler)
@@ -294,9 +308,15 @@ class FaultInjector:
         `InjectedFault` for an ``exc`` fault (after firing any co-scheduled
         hang/corrupt/preempt, so stacked faults all land)."""
         raise_after = None
-        for f in self._take(step, ("hang", "ckpt_corrupt", "preempt", "exc")):
+        for f in self._take(step, ("hang", "slow", "ckpt_corrupt",
+                                   "preempt", "exc")):
             if f.kind == "hang":
                 time.sleep(f.arg)
+            elif f.kind == "slow":
+                # one-shot arming of a PERSISTENT latency: a straggler,
+                # not a single hiccup — the slowdown below applies to
+                # this and every subsequent step
+                self.slow_s += max(float(f.arg), 0.0)
             elif f.kind == "ckpt_corrupt":
                 if directory is not None:
                     corrupt_latest_checkpoint(directory)
@@ -309,6 +329,8 @@ class FaultInjector:
                     os.kill(os.getpid(), signal.SIGTERM)
             else:  # exc
                 raise_after = f
+        if self.slow_s > 0.0:
+            time.sleep(self.slow_s)
         if raise_after is not None:
             raise InjectedFault(
                 f"injected step failure at step {raise_after.step}"
@@ -329,3 +351,13 @@ class FaultInjector:
                     f"poison ({exc}); degraded to a step error"
                 ) from None
         return batch
+
+    def corrupt_payload(self, step: int, data: bytes) -> bytes:
+        """Apply a due ``corrupt_resp`` fault to an outbound response
+        payload (returned unchanged otherwise) — the serving replica
+        calls this AFTER checksum-signing, so the consumer's integrity
+        check is what must catch the damage (`serving.router`)."""
+        if self._take(step, ("corrupt_resp",)):
+            head = bytes(b ^ 0xFF for b in data[:16])
+            return head + data[16:]
+        return data

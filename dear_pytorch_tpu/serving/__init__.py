@@ -1,0 +1,54 @@
+"""Serving: the fault-tolerant inference stack (docs/SERVING.md).
+
+The framework's first end-to-end request path, built robustness-first on
+the training stack's own substrate:
+
+  - `kvcache`   — ring-buffer KV-cache math shared by the model decode
+                  paths (models/gpt.py, models/bert.py ``decode=True``),
+                  flash-kernel-backed optionally (`ops.flash_attention`)
+  - `engine`    — continuous batching: ONE jitted step serving mixed
+                  prefill+decode batches over fixed slots
+  - `admission` — bounded queueing with explicit 429-style load shedding
+                  (depth x service-time vs deadline budget); sheds raise
+                  the retryable `SheddingError` for `resilience.retry`
+  - `router`    — jax-free front end: file-protocol dispatch, heartbeat
+                  health checks, checksum verification, and the zero-drop
+                  re-dispatch of a dead replica's in-flight requests
+  - `replica`   — the jax-holding worker: serve loop, SIGTERM drain
+                  (`resilience.preempt`), fault hooks (`resilience.inject`)
+  - `weights`   — versioned weight publishing over the object-store waist
+                  (`utils.objectstore`); rolling restart IS the swap
+
+Submodules import lazily so the jax-free pieces (admission, router,
+weights) stay importable from supervisor-side processes that never touch
+a device.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_SUBMODULES = ("kvcache", "engine", "admission", "router", "replica",
+               "weights")
+
+__all__ = list(_SUBMODULES) + [
+    "AdmissionController", "SheddingError", "DecodeEngine",
+    "ReplicaRouter", "ReplicaServer",
+]
+
+_LAZY = {
+    "AdmissionController": ("admission", "AdmissionController"),
+    "SheddingError": ("admission", "SheddingError"),
+    "DecodeEngine": ("engine", "DecodeEngine"),
+    "ReplicaRouter": ("router", "ReplicaRouter"),
+    "ReplicaServer": ("replica", "ReplicaServer"),
+}
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{__name__}.{name}")
+    if name in _LAZY:
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(f"{__name__}.{mod}"), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,138 @@
+"""Admission control: bounded queueing with explicit backpressure.
+
+A serving system without admission control fails *implicitly* under
+overload — queues grow without bound, every request's latency climbs past
+its deadline, and by the time anything errors the whole backlog is dead
+on arrival. This controller fails *explicitly and early* instead
+(429-style load shedding): a request is rejected at the door when
+
+  - the system already holds ``max_depth`` requests (bounded queue), or
+  - its predicted wait — ``depth x service_time / capacity``, the
+    Little's-law estimate from the EWMA of observed per-request service
+    time and the fleet's live slot capacity — already exceeds the
+    request's deadline budget (admitting it would burn fleet time on a
+    response nobody can use).
+
+A shed request raises `SheddingError`, which is precisely the
+*retryable* signal `resilience.retry` is built for: clients wrap submit
+in ``retry_call(..., retry_on=(SheddingError,))`` and back off with
+decorrelated jitter, so a thundering herd decorrelates instead of
+re-synchronizing on the recovering fleet. Requests admitted are the
+router's zero-drop obligation; requests shed are accounted
+(``serve.shed``) and cost the fleet nothing.
+
+Pure host-side stdlib (no jax) — lives in the front-end router process.
+Telemetry counters (two-lookup disabled gate): ``serve.requests``,
+``serve.admitted``, ``serve.shed``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+
+__all__ = ["SheddingError", "AdmissionController"]
+
+
+class SheddingError(RuntimeError):
+    """Request rejected at admission (overload backpressure). Carries the
+    observed ``depth`` and ``predicted_wait_s`` so clients/telemetry can
+    see *why*; retryable by design (`resilience.retry`)."""
+
+    def __init__(self, msg: str, *, depth: int, predicted_wait_s: float):
+        super().__init__(msg)
+        self.depth = depth
+        self.predicted_wait_s = predicted_wait_s
+
+
+class AdmissionController:
+    """Depth- and deadline-budget-gated admission.
+
+    ``capacity`` is the fleet's live decode-slot count (the router updates
+    it as replicas come and go); ``service_time_s`` is seeded optimistic
+    (0 — the first requests are always admitted) and learned as an EWMA
+    of observed per-request service time via `complete`.
+    """
+
+    def __init__(self, max_depth: int, *, capacity: int = 1,
+                 service_time_s: float = 0.0, ewma: float = 0.2,
+                 clock=time.monotonic):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = int(max_depth)
+        self._capacity = max(int(capacity), 1)
+        self._service_s = float(service_time_s)
+        self._ewma = float(ewma)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._depth = 0
+        # plain-int mirrors so accounting works with telemetry disabled
+        self.requests = 0
+        self.admitted = 0
+        self.shed = 0
+
+    # -- live inputs ---------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    @property
+    def service_time_s(self) -> float:
+        return self._service_s
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._capacity = max(int(capacity), 1)
+
+    # -- the decision --------------------------------------------------------
+
+    def predicted_wait_s(self) -> float:
+        """Little's-law wait estimate for a request arriving NOW."""
+        with self._lock:
+            return self._depth * self._service_s / self._capacity
+
+    def admit(self, deadline_budget_s: Optional[float] = None) -> None:
+        """Admit one request (it now counts toward the depth) or raise
+        `SheddingError`. ``deadline_budget_s`` is the caller's remaining
+        deadline; None = no deadline (only the depth bound gates)."""
+        tr = _telemetry.get_tracer()
+        with self._lock:
+            self.requests += 1
+            if tr.enabled:
+                tr.count("serve.requests")
+            pred = self._depth * self._service_s / self._capacity
+            over_depth = self._depth >= self.max_depth
+            over_budget = (deadline_budget_s is not None
+                           and pred > deadline_budget_s)
+            if over_depth or over_budget:
+                self.shed += 1
+                if tr.enabled:
+                    tr.count("serve.shed")
+                    tr.event("serve.shed", depth=self._depth,
+                             predicted_wait_s=round(pred, 4),
+                             reason="depth" if over_depth else "deadline")
+                raise SheddingError(
+                    f"shed: depth {self._depth}/{self.max_depth}, "
+                    f"predicted wait {pred:.3f}s vs budget "
+                    f"{deadline_budget_s}",
+                    depth=self._depth, predicted_wait_s=pred)
+            self._depth += 1
+            self.admitted += 1
+            if tr.enabled:
+                tr.count("serve.admitted")
+
+    def complete(self, service_s: Optional[float] = None) -> None:
+        """One admitted request left the system; ``service_s`` (admission
+        to response) feeds the EWMA the wait prediction uses."""
+        with self._lock:
+            self._depth = max(self._depth - 1, 0)
+            if service_s is not None and service_s >= 0:
+                if self._service_s <= 0.0:
+                    self._service_s = float(service_s)
+                else:
+                    self._service_s += self._ewma * (float(service_s)
+                                                     - self._service_s)

@@ -1,0 +1,194 @@
+"""Continuous-batching decode engine: ONE jitted step serving mixed
+prefill+decode batches.
+
+The serving hot loop is a single compiled program of static shape
+``[slots, 1]``: every tick feeds each active slot exactly one token — a
+prompt token while the slot is prefilling, its own last sample while it
+is decoding — at that slot's own position. New requests enter the batch
+the moment a slot frees (continuous batching: no generation-length
+barrier, no recompile; the classic static-batch alternative would hold
+short requests hostage to the longest one in the batch). Slot reuse is
+free because the ring KV cache (`serving.kvcache`) derives validity from
+the position alone: assigning a request resets the slot's position to 0
+and every stale cache entry is invalid by construction.
+
+Prefill is deliberately token-at-a-time — the same decode path sampling
+uses (one code path, logits exactly consistent with the model's full
+forward, pinned by tests/test_serving.py), uniform shapes under jit, and
+requests at different phases mix freely in one batch. The cost is O(P)
+ticks for a P-token prompt; a chunked-prefill fast path is a named
+follow-up in docs/SERVING.md, not silently absent.
+
+Sampling is greedy (argmax over the un-padded vocab): deterministic, so
+a re-dispatched request (replica death mid-generation) reproduces the
+SAME tokens on the replica that picks it up — the router's zero-drop
+re-dispatch needs no generation state handoff.
+
+Telemetry: ``serve.decode_steps`` per tick (the standard two-lookup
+disabled gate, budgeted by scripts/check_telemetry_overhead.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import numpy as np
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+
+__all__ = ["DecodeEngine", "FinishedRequest"]
+
+
+@dataclasses.dataclass
+class FinishedRequest:
+    """One completed generation: the request id handed to `submit`, the
+    prompt, and the sampled continuation."""
+
+    request_id: Any
+    prompt: List[int]
+    tokens: List[int]          # generated continuation only
+    steps: int                 # engine ticks this request was live for
+
+
+class _Slot:
+    __slots__ = ("req_id", "prompt", "max_new", "eos_id", "fed",
+                 "generated", "ticks")
+
+    def __init__(self, req_id, prompt, max_new, eos_id):
+        self.req_id = req_id
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.fed = 0               # tokens fed so far == next position
+        self.generated: List[int] = []
+        self.ticks = 0
+
+    def next_token(self) -> int:
+        if self.fed < len(self.prompt):
+            return self.prompt[self.fed]
+        return self.generated[self.fed - len(self.prompt)]
+
+
+class DecodeEngine:
+    """Fixed-slot continuous-batching decoder over a causal LM.
+
+    ``model`` is a flax module with the decode contract of
+    `models.gpt.GptLmHeadModel` / `models.bert.BertForPreTraining`:
+    ``apply({'params', 'cache'}, tokens [B, 1], train=False, decode=True,
+    position_offset=[B], mutable=['cache'])`` returning next-token logits
+    (or a tuple whose first element is the logits). The engine owns the
+    cache arrays and the per-slot positions; `submit` assigns a request
+    to a free slot, `tick` advances every active slot one token.
+    """
+
+    def __init__(self, model, params, *, slots: int = 4,
+                 eos_id: Optional[int] = None, donate: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.eos_id = eos_id
+        cfg = model.config
+        self.vocab_size = int(cfg.vocab_size)
+        self.max_positions = int(cfg.max_position_embeddings)
+        B = self.slots
+
+        # cache template from shapes only (models/gpt.py generate() does
+        # the same): a real init would materialize a random param tree
+        self._cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                lambda: model.init(
+                    {"params": jax.random.PRNGKey(0)},
+                    jnp.zeros((B, 1), jnp.int32), train=False, decode=True,
+                )["cache"]
+            ),
+        )
+
+        def _step(p, cache, toks, pos):
+            out, vars_out = model.apply(
+                {"params": p, "cache": cache}, toks, train=False,
+                decode=True, position_offset=pos, mutable=["cache"],
+            )
+            logits = out[0] if isinstance(out, tuple) else out
+            return logits[:, 0], vars_out["cache"]
+
+        self._step = jax.jit(_step, donate_argnums=(1,) if donate else ())
+        self._slots: List[Optional[_Slot]] = [None] * B
+
+    # -- slot management -----------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def free(self) -> int:
+        return self.slots - self.active
+
+    def submit(self, prompt, max_new_tokens: int,
+               request_id=None) -> Optional[int]:
+        """Assign a request to a free slot (None when the batch is full —
+        admission control lives ABOVE the engine, `serving.admission`).
+        Returns the slot index."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.max_positions:
+            raise ValueError(
+                f"prompt + new tokens ({total}) exceeds the position "
+                f"budget ({self.max_positions})"
+            )
+        for b, s in enumerate(self._slots):
+            if s is None:
+                # position restarts at 0: the ring cache derives validity
+                # from the position, so the previous occupant's entries
+                # are invalid without any reset pass
+                self._slots[b] = _Slot(request_id, prompt, max_new_tokens,
+                                       self.eos_id)
+                return b
+        return None
+
+    # -- the tick ------------------------------------------------------------
+
+    def tick(self) -> List[FinishedRequest]:
+        """Advance every active slot one token through the jitted step;
+        returns the requests that finished this tick."""
+        if self.active == 0:
+            return []
+        B = self.slots
+        toks = np.zeros((B, 1), np.int32)
+        pos = np.zeros((B,), np.int32)
+        for b, s in enumerate(self._slots):
+            if s is None:
+                continue  # idle rows feed token 0 at position 0: their
+                #           row's validity window is 1 slot of garbage
+                #           nothing ever attends to
+            toks[b, 0] = s.next_token()
+            pos[b] = s.fed
+        logits, self._cache = self._step(self.params, self._cache, toks, pos)
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("serve.decode_steps")
+        logits = np.asarray(logits)[:, : self.vocab_size]
+        finished: List[FinishedRequest] = []
+        for b, s in enumerate(self._slots):
+            if s is None:
+                continue
+            s.fed += 1
+            s.ticks += 1
+            if s.fed >= len(s.prompt):       # the prompt is consumed:
+                nxt = int(np.argmax(logits[b]))  # this tick's logits sample
+                s.generated.append(nxt)
+                done = (len(s.generated) >= s.max_new
+                        or (s.eos_id is not None and nxt == s.eos_id))
+                if done:
+                    finished.append(FinishedRequest(
+                        s.req_id, s.prompt, s.generated, s.ticks))
+                    self._slots[b] = None
+        return finished

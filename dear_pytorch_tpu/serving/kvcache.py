@@ -1,0 +1,86 @@
+"""Ring-buffer KV cache: the decode-path memory model of the serving
+stack.
+
+Autoregressive decoding re-reads every past token's K/V projection each
+step; recomputing them is O(S) extra forwards per token. The cache stores
+them once — and a serving fleet additionally needs the cache to be
+**slot-reusable** (continuous batching assigns new requests into the rows
+a finished request vacated) and **bounded** (a misbehaving client must
+not grow device memory). Both fall out of the ring formulation:
+
+  - the cache per layer is ``[B, L, heads, head_dim]`` for a fixed ring
+    length ``L``; token at global position ``p`` (per row) writes slot
+    ``p % L``,
+  - validity is *derived from the position alone*: slots ``< min(p+1, L)``
+    hold the last ``min(p+1, L)`` tokens. There is no write-index state
+    inside the cache — resetting a row is just feeding it ``position 0``
+    again, so slot reuse costs nothing and cannot leak a previous
+    request's tokens into attention (stale slots are invalid until
+    overwritten),
+  - once ``p >= L`` the ring wraps and attention becomes a sliding
+    window over the last ``L`` tokens (exact while the sequence fits —
+    the parity contract `tests/test_serving.py` pins).
+
+The attend step reuses `ops.flash_attention` (``use_flash=True``): a
+1-token query over the ``L``-slot cache is the kernel's
+``causal=False`` + key-validity-mask case (causality is carried by the
+validity mask — only already-written positions are valid), so the same
+Pallas program that serves training serves decode. The dense path
+(default) is the same math through `models.bert.dot_product_attention`
+and is what the CPU-emulated serving storm runs.
+
+Pure functions over arrays — the flax models (`models/gpt.py`,
+`models/bert.py` ``decode=True``) own the cache *variables* and call
+these for the ring semantics, so GPT and BERT cannot drift.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_write", "ring_validity", "cache_attend"]
+
+
+def ring_write(ck: jax.Array, cv: jax.Array, pos: jax.Array,
+               k: jax.Array, v: jax.Array):
+    """Write this step's K/V (``[B, 1, H, D]``) into ring slot
+    ``pos % L`` of the caches (``[B, L, H, D]``); ``pos`` is the per-row
+    global token position ``[B]`` (int32). Returns the updated caches.
+
+    One-hot blend rather than a scatter: per-row dynamic indices would
+    force a loop or a segment scatter; the blend is one fused multiply-add
+    over the cache — O(B·L·H·D), the same bytes the attend step reads
+    anyway."""
+    L = ck.shape[1]
+    oh = jax.nn.one_hot(pos % L, L, dtype=jnp.float32)[:, :, None, None]
+    ck = (ck * (1.0 - oh) + k * oh).astype(ck.dtype)
+    cv = (cv * (1.0 - oh) + v * oh).astype(cv.dtype)
+    return ck, cv
+
+
+def ring_validity(pos: jax.Array, length: int) -> jax.Array:
+    """Boolean ``[B, L]`` validity of each ring slot AFTER the token at
+    per-row position ``pos`` was written: the last ``min(pos+1, L)``
+    tokens are attendable (the current token included — self-attention
+    sees itself), everything else is a stale or never-written slot."""
+    return (jnp.arange(length)[None, :]
+            < jnp.minimum(pos[:, None] + 1, length))
+
+
+def cache_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                 valid: jax.Array, *, dtype, use_flash: bool = False
+                 ) -> jax.Array:
+    """One decode attention step: ``q`` ``[B, 1, H, D]`` over the ring
+    caches under the slot-validity mask ``[B, L]``. ``use_flash`` routes
+    through the Pallas flash kernel (1-row query block, validity as its
+    ``kv_mask``); the default is the dense core — identical math, and the
+    path the CPU-emulated serving storm exercises."""
+    if use_flash:
+        from dear_pytorch_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, ck, cv, kv_mask=valid)
+    from dear_pytorch_tpu.models.bert import dot_product_attention
+
+    mask = jnp.where(valid, 0.0, -1e9).astype(dtype)[:, None, None, :]
+    return dot_product_attention(q, ck, cv, mask, dtype=dtype)

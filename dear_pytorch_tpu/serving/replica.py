@@ -1,0 +1,226 @@
+"""Replica worker: one serving process of the fleet.
+
+A replica is the only jax-holding process in the serving stack: it loads
+the newest committed weights from the object-store waist
+(`serving.weights`), runs the continuous-batching `serving.engine`, and
+speaks the router's file protocol (`serving.router`) — consume request
+files from its inbox, write sha256-signed responses, heartbeat a health
+file every loop.
+
+Lifecycle under the fleet substrate (`launch/supervisor.py`):
+
+  - **crash / SIGKILL**: the supervisor relaunches it (sliding-window
+    budget); the fresh incarnation clears its inbox — safe, because the
+    router re-dispatches the dead incarnation's in-flight work the moment
+    it observes the heartbeat's incarnation change,
+  - **drain (SIGTERM)**: the `resilience.preempt.PreemptionHandler` grace
+    path — the replica marks ``draining`` in its heartbeat (the router
+    stops dispatching to it), finishes every request already in its inbox
+    and active slots, writes a final ``stopped`` heartbeat, and exits 0;
+    the supervisor records it for backfill. Drain + backfill IS the
+    rolling weight swap: the backfilled incarnation loads the newest
+    published version,
+  - **fault injection** (`resilience.inject`): the replica drives its
+    injector once per consumed request — ``slow`` (persistent per-request
+    latency: a straggling replica), ``hang``, ``exc`` (crash-for-
+    relaunch), ``preempt`` (self-SIGTERM into the drain path), and
+    ``corrupt_resp`` (one response's bytes corrupted AFTER signing, so
+    the router's checksum catches it).
+
+Telemetry: ``serve.replica_served`` per response written (two-lookup
+disabled gate, scripts/check_telemetry_overhead.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.serving.router import (
+    REPLICAS_SUBDIR, RESPONSES_SUBDIR, response_sha256,
+)
+
+__all__ = ["ReplicaServer"]
+
+
+class ReplicaServer:
+    """Serve loop around a `serving.engine.DecodeEngine`."""
+
+    def __init__(self, root: str, rank: int, engine, *, version: int = 0,
+                 injector=None, preemption=None, poll_s: float = 0.005,
+                 heartbeat_s: float = 0.2):
+        self.root = os.path.abspath(root)
+        self.rank = int(rank)
+        self.engine = engine
+        self.version = int(version)
+        self.injector = injector
+        self.preemption = preemption
+        self.poll_s = float(poll_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self._dir = os.path.join(self.root, REPLICAS_SUBDIR, str(self.rank))
+        self._inbox = os.path.join(self._dir, "inbox")
+        self._responses = os.path.join(self.root, RESPONSES_SUBDIR)
+        os.makedirs(self._inbox, exist_ok=True)
+        os.makedirs(self._responses, exist_ok=True)
+        # unique per process life: the router detects restarts by the
+        # incarnation changing, which is what makes clearing the inbox safe
+        self.incarnation = f"{os.getpid()}.{time.time():.6f}"
+        self.served = 0
+        self.consumed = 0
+        self.draining = False
+        self._last_beat = 0.0
+        # a fresh incarnation's inbox holds a dead life's requests; the
+        # router re-queues them on the incarnation change, so serving them
+        # here too would only produce ignored duplicate responses
+        for name in os.listdir(self._inbox):
+            try:
+                os.unlink(os.path.join(self._inbox, name))
+            except OSError:
+                pass
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def _beat(self, *, force: bool = False, stopped: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_beat < self.heartbeat_s:
+            return
+        self._last_beat = now
+        doc = {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "incarnation": self.incarnation,
+            "version": self.version,
+            "draining": self.draining,
+            "stopped": stopped,
+            "served": self.served,
+            "active": self.engine.active,
+        }
+        path = os.path.join(self._dir, "health.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    # -- request plumbing ----------------------------------------------------
+
+    def _take_requests(self) -> int:
+        """Move inbox files into free engine slots; returns how many were
+        consumed. Each consumed request advances the injector's step
+        clock (the serving analog of a trainer step)."""
+        if self.engine.free == 0:
+            return 0
+        try:
+            names = sorted(os.listdir(self._inbox))
+        except OSError:
+            return 0
+        taken = 0
+        for name in names:
+            if self.engine.free == 0:
+                break
+            if not name.endswith(".json") or ".tmp." in name:
+                continue
+            path = os.path.join(self._inbox, name)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue  # torn write: next pass
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            if not isinstance(rec, dict) or rec.get("id") is None:
+                continue  # not a router record; nothing to answer
+            self.consumed += 1
+            if self.injector is not None:
+                # slow/hang/exc/preempt land here, once per request
+                self.injector.before_step(self.consumed)
+            try:
+                self.engine.submit(rec.get("prompt") or [],
+                                   rec.get("max_new_tokens", 0),
+                                   request_id=rec["id"])
+            except Exception as exc:  # noqa: BLE001 — a poison request
+                # (empty prompt, position-budget violation, malformed
+                # record) must NOT crash the replica: the router would
+                # re-dispatch the same request to the next replica and
+                # cascade the crash through the whole fleet. The
+                # zero-drop contract is "every accepted request gets a
+                # verified response" — a signed error response IS that
+                # response.
+                self._write_payload(rec["id"], [],
+                                    error=f"{type(exc).__name__}: {exc}")
+                continue
+            taken += 1
+        return taken
+
+    def _write_response(self, fin) -> None:
+        self._write_payload(fin.request_id,
+                            [int(t) for t in fin.tokens])
+
+    def _write_payload(self, request_id, tokens, *,
+                       error: Optional[str] = None) -> None:
+        payload = {
+            "id": request_id,
+            "tokens": tokens,
+            "model_version": self.version,
+            "replica": self.rank,
+        }
+        if error is not None:
+            payload["error"] = error
+        payload["sha256"] = response_sha256(payload)
+        data = json.dumps(payload).encode()
+        if self.injector is not None:
+            # fires AFTER signing: the router's checksum must catch it
+            data = self.injector.corrupt_payload(self.served + 1, data)
+        path = os.path.join(self._responses, f"{request_id}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        self.served += 1
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("serve.replica_served")
+
+    def _inbox_empty(self) -> bool:
+        try:
+            return not any(n.endswith(".json") and ".tmp." not in n
+                           for n in os.listdir(self._inbox))
+        except OSError:
+            return True
+
+    # -- the serve loop ------------------------------------------------------
+
+    def run(self, *, max_requests: Optional[int] = None,
+            deadline_s: Optional[float] = None) -> dict:
+        """Serve until drained (SIGTERM), ``max_requests`` served, or
+        ``deadline_s`` elapsed. Returns a summary dict."""
+        t_end = (None if deadline_s is None
+                 else time.monotonic() + float(deadline_s))
+        self._beat(force=True)
+        while True:
+            if (self.preemption is not None and self.preemption.requested
+                    and not self.draining):
+                self.draining = True
+                self._beat(force=True)
+            if self.draining and self.engine.active == 0 \
+                    and self._inbox_empty():
+                break  # drained: everything assigned to us is answered
+            if max_requests is not None and self.served >= max_requests:
+                break
+            if t_end is not None and time.monotonic() >= t_end:
+                break
+            took = self._take_requests()
+            if self.engine.active:
+                for fin in self.engine.tick():
+                    self._write_response(fin)
+            elif not took:
+                time.sleep(self.poll_s)
+            self._beat()
+        self._beat(force=True, stopped=True)
+        return {"rank": self.rank, "served": self.served,
+                "consumed": self.consumed, "drained": self.draining,
+                "version": self.version}

@@ -1,0 +1,433 @@
+"""Front-end replica router: dispatch, health, and the zero-drop
+re-dispatch guarantee.
+
+The router is the serving fleet's brain stem, and it is deliberately a
+**host-side process with no jax** — replicas die, the router does not.
+It speaks a file-based request/response protocol over a shared directory
+(the same durable-store idiom as `resilience.cluster.FileTransport`:
+atomic tmp+``os.replace`` writes, so a reader never observes a torn
+record; on a real deployment the same protocol maps onto any shared
+object store or RPC mesh):
+
+    <root>/replicas/<rank>/inbox/<reqid>.json   router -> replica
+    <root>/replicas/<rank>/health.json          replica heartbeat
+    <root>/responses/<reqid>.json               replica -> router
+
+**The zero-drop contract**: once `submit` returns (the request passed
+admission), the request WILL receive a response — replica SIGKILL, crash,
+restart, or drain notwithstanding. Three mechanisms compose into that
+guarantee:
+
+  - every dispatched request stays in the router's in-flight table until
+    its response is verified; a replica observed dead (stale heartbeat)
+    or **reincarnated** (heartbeat incarnation changed — the restart may
+    have cleared its inbox) has its in-flight requests re-queued at the
+    FRONT of the pending queue (``serve.redispatched``),
+  - responses carry a sha256 over their canonical payload; a response
+    that fails the checksum (or does not parse) is discarded and the
+    request re-queued (``serve.corrupt_responses``) — a corrupting
+    replica cannot complete a request with garbage,
+  - generation is deterministic (greedy decode, `serving.engine`), so a
+    re-dispatched request reproduces identical tokens on whichever
+    replica picks it up; duplicate responses (the first replica answered
+    after all) are idempotently ignored.
+
+Draining replicas (heartbeat ``draining=true`` — the SIGTERM grace path,
+`resilience.preempt`) receive no new dispatches but keep their in-flight
+work; the rolling-restart weight swap is drain -> backfill -> the
+replica heartbeats a newer weights version (``serve.weight_swaps``).
+
+Deadlines are **accounting, not abandonment**: a response landing after
+its request's deadline counts ``serve.deadline_missed`` but is still
+delivered — the deadline's enforcement point is admission
+(`serving.admission` sheds requests whose predicted wait exceeds the
+budget), where rejecting is cheap.
+"""
+
+from __future__ import annotations
+
+import json
+import hashlib
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, List, Optional
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.serving.admission import AdmissionController
+
+__all__ = ["ReplicaRouter", "response_sha256", "REPLICAS_SUBDIR",
+           "RESPONSES_SUBDIR"]
+
+REPLICAS_SUBDIR = "replicas"
+RESPONSES_SUBDIR = "responses"
+
+
+def response_sha256(payload: dict) -> str:
+    """Checksum over the canonical response payload (``id``, ``tokens``,
+    ``model_version``) — shared by replica (sign) and router (verify)."""
+    canon = json.dumps(
+        {"id": payload["id"], "tokens": payload["tokens"],
+         "model_version": payload["model_version"]},
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+class _Pending:
+    __slots__ = ("record", "event", "response", "submitted_t",
+                 "deadline_ts")
+
+    def __init__(self, record, submitted_t, deadline_ts):
+        self.record = record
+        self.event = threading.Event()
+        self.response: Optional[dict] = None
+        self.submitted_t = submitted_t
+        self.deadline_ts = deadline_ts
+
+
+class _Replica:
+    __slots__ = ("rank", "incarnation", "version", "last_wall_ts",
+                 "draining", "healthy", "inflight", "seen_t")
+
+    def __init__(self, rank):
+        self.rank = rank
+        self.incarnation = None
+        self.version = None
+        self.last_wall_ts = 0.0
+        self.draining = False
+        self.healthy = False
+        self.inflight: set = set()
+        self.seen_t = 0.0
+
+
+class ReplicaRouter:
+    """Route admitted requests across a fleet of replica workers."""
+
+    def __init__(self, root: str, *, admission: AdmissionController,
+                 slots_per_replica: int = 4, health_timeout_s: float = 6.0,
+                 poll_s: float = 0.02):
+        self.root = os.path.abspath(root)
+        self.admission = admission
+        self.slots_per_replica = int(slots_per_replica)
+        self.health_timeout_s = float(health_timeout_s)
+        self.poll_s = float(poll_s)
+        self._replicas_dir = os.path.join(self.root, REPLICAS_SUBDIR)
+        self._responses_dir = os.path.join(self.root, RESPONSES_SUBDIR)
+        os.makedirs(self._replicas_dir, exist_ok=True)
+        os.makedirs(self._responses_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._pending: deque = deque()          # reqids awaiting dispatch
+        self._requests: Dict[str, _Pending] = {}
+        self._assigned: Dict[str, int] = {}     # reqid -> replica rank
+        self._replicas: Dict[int, _Replica] = {}
+        self.accepted: set = set()
+        self.completed: set = set()
+        # plain-int accounting (works with telemetry disabled)
+        self.redispatched = 0
+        self.deadline_missed = 0
+        self.corrupt_responses = 0
+        self.weight_swaps = 0
+        self.latencies_s: List[float] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ReplicaRouter":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-router")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- the client surface --------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: int,
+               deadline_s: Optional[float] = None) -> str:
+        """Admit one request (raises `serving.admission.SheddingError`
+        under backpressure) and queue it for dispatch; returns the
+        request id. ``deadline_s`` is relative to now."""
+        self.admission.admit(deadline_s)
+        rid = uuid.uuid4().hex[:16]
+        now_wall = time.time()
+        record = {
+            "id": rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "deadline_ts": (None if deadline_s is None
+                            else now_wall + float(deadline_s)),
+        }
+        pend = _Pending(record, time.monotonic(), record["deadline_ts"])
+        with self._lock:
+            self._requests[rid] = pend
+            self._pending.append(rid)
+            self.accepted.add(rid)
+        return rid
+
+    def result(self, rid: str, timeout: Optional[float] = None) -> dict:
+        """Block for a request's verified response."""
+        pend = self._requests.get(rid)
+        if pend is None:
+            raise KeyError(rid)
+        if not pend.event.wait(timeout):
+            raise TimeoutError(f"request {rid} not completed in {timeout}s")
+        return pend.response
+
+    def open_requests(self) -> set:
+        """Accepted-but-unanswered request ids — the zero-drop gate
+        asserts this drains to empty."""
+        with self._lock:
+            return set(self.accepted) - set(self.completed)
+
+    def inflight_on(self, rank: int) -> int:
+        """Requests currently dispatched to replica ``rank`` (chaos
+        harnesses aim their SIGKILL at a replica holding work)."""
+        with self._lock:
+            rep = self._replicas.get(rank)
+            return len(rep.inflight) if rep is not None else 0
+
+    def healthy_replicas(self) -> List[int]:
+        with self._lock:
+            return sorted(r.rank for r in self._replicas.values()
+                          if r.healthy and not r.draining)
+
+    def fleet_versions(self) -> Dict[int, Optional[int]]:
+        with self._lock:
+            return {r.rank: r.version for r in self._replicas.values()
+                    if r.healthy}
+
+    def stats(self) -> dict:
+        with self._lock:
+            lats = sorted(self.latencies_s)
+
+        def pct(p):
+            if not lats:
+                return None
+            return lats[min(int(p * (len(lats) - 1)), len(lats) - 1)]
+
+        return {
+            "requests": self.admission.requests,
+            "admitted": self.admission.admitted,
+            "shed": self.admission.shed,
+            "completed": len(self.completed),
+            "open": len(self.accepted) - len(self.completed),
+            "redispatched": self.redispatched,
+            "deadline_missed": self.deadline_missed,
+            "corrupt_responses": self.corrupt_responses,
+            "weight_swaps": self.weight_swaps,
+            "latency_p50_ms": (None if not lats
+                               else round(pct(0.50) * 1e3, 2)),
+            "latency_p99_ms": (None if not lats
+                               else round(pct(0.99) * 1e3, 2)),
+            "healthy": self.healthy_replicas(),
+        }
+
+    # -- the routing loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._scan_health()
+                self._collect_responses()
+                self._dispatch()
+            except Exception:  # noqa: BLE001 — the router must outlive
+                #               any single bad record on the shared dir
+                import logging
+
+                logging.getLogger("dear_pytorch_tpu").exception(
+                    "router: routing pass failed; continuing")
+            self._stop.wait(self.poll_s)
+
+    def _reclaim_locked(self, rep: _Replica, why: str) -> None:
+        """Re-queue a replica's in-flight requests at the FRONT (oldest
+        obligations first). Caller holds the lock."""
+        tr = _telemetry.get_tracer()
+        stale = [rid for rid in rep.inflight if rid not in self.completed]
+        for rid in reversed(sorted(
+                stale, key=lambda r: self._requests[r].submitted_t)):
+            self._assigned.pop(rid, None)
+            self._pending.appendleft(rid)
+        rep.inflight.clear()
+        if stale:
+            self.redispatched += len(stale)
+            if tr.enabled:
+                tr.count("serve.redispatched", len(stale))
+                tr.event("serve.redispatch", replica=rep.rank,
+                         requests=len(stale), why=why)
+
+    def _scan_health(self) -> None:
+        try:
+            ranks = sorted(int(d) for d in os.listdir(self._replicas_dir)
+                           if d.isdigit())
+        except OSError:
+            ranks = []
+        now_wall = time.time()
+        tr = _telemetry.get_tracer()
+        with self._lock:
+            for rank in ranks:
+                rep = self._replicas.setdefault(rank, _Replica(rank))
+                path = os.path.join(self._replicas_dir, str(rank),
+                                    "health.json")
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue  # absent or mid-write; staleness will catch
+                    #           a replica that never writes again
+                incarnation = doc.get("incarnation")
+                version = doc.get("version")
+                if (rep.incarnation is not None
+                        and incarnation != rep.incarnation):
+                    # restart observed: its inbox may have been cleared
+                    self._reclaim_locked(rep, "reincarnated")
+                if (rep.version is not None and version is not None
+                        and version > rep.version):
+                    # the rolling restart's purpose: this replica now
+                    # serves newer weights
+                    self.weight_swaps += 1
+                    if tr.enabled:
+                        tr.count("serve.weight_swaps")
+                        tr.event("serve.weight_swap", replica=rank,
+                                 version=version, prev=rep.version)
+                rep.incarnation = incarnation
+                if version is not None:
+                    rep.version = version
+                rep.last_wall_ts = float(doc.get("ts", 0.0))
+                rep.draining = bool(doc.get("draining"))
+                was_healthy = rep.healthy
+                rep.healthy = (now_wall - rep.last_wall_ts
+                               < self.health_timeout_s
+                               and not doc.get("stopped"))
+                if was_healthy and not rep.healthy:
+                    self._reclaim_locked(rep, "dead")
+            # replicas that stopped heartbeating entirely
+            for rep in self._replicas.values():
+                if rep.healthy and (now_wall - rep.last_wall_ts
+                                    >= self.health_timeout_s):
+                    rep.healthy = False
+                    self._reclaim_locked(rep, "heartbeat_lost")
+            live_slots = sum(
+                self.slots_per_replica for r in self._replicas.values()
+                if r.healthy and not r.draining)
+            self.admission.set_capacity(max(live_slots, 1))
+
+    def _dispatch(self) -> None:
+        # the inbox writes happen OUTSIDE the lock: per-request file I/O
+        # under it would block the whole client surface (submit/result/
+        # stats) for the disk-write duration of a dispatch batch
+        while True:
+            with self._lock:
+                targets = [r for r in self._replicas.values()
+                           if r.healthy and not r.draining
+                           and len(r.inflight) < self.slots_per_replica]
+                if not self._pending or not targets:
+                    return
+                rep = min(targets, key=lambda r: (len(r.inflight), r.rank))
+                rid = self._pending.popleft()
+                record = self._requests[rid].record
+                rep.inflight.add(rid)
+                self._assigned[rid] = rep.rank
+            inbox = os.path.join(self._replicas_dir, str(rep.rank),
+                                 "inbox")
+            path = os.path.join(inbox, f"{rid}.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                os.makedirs(inbox, exist_ok=True)
+                with open(tmp, "w") as f:
+                    json.dump(record, f)
+                os.replace(tmp, path)
+            except OSError:
+                # undo the assignment so the request is not stranded
+                # in-flight with no inbox file behind it
+                with self._lock:
+                    if self._assigned.get(rid) == rep.rank:
+                        self._assigned.pop(rid, None)
+                        rep.inflight.discard(rid)
+                        self._pending.appendleft(rid)
+                raise
+
+    def _collect_responses(self) -> None:
+        try:
+            names = os.listdir(self._responses_dir)
+        except OSError:
+            return
+        tr = _telemetry.get_tracer()
+        for name in names:
+            if not name.endswith(".json") or ".tmp." in name:
+                continue
+            rid = name[:-len(".json")]
+            path = os.path.join(self._responses_dir, name)
+            with self._lock:
+                pend = self._requests.get(rid)
+                already = rid in self.completed
+            if pend is None or already:
+                # duplicate (re-dispatched request answered twice) or a
+                # foreign record: idempotently drop
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                ok = (isinstance(doc, dict)
+                      and doc.get("sha256") == response_sha256(doc))
+            except (OSError, ValueError, KeyError, TypeError):
+                ok = False
+            if not ok:
+                with self._lock:
+                    self.corrupt_responses += 1
+                    rank = self._assigned.pop(rid, None)
+                    if rank is not None:
+                        self._replicas[rank].inflight.discard(rid)
+                        self._pending.appendleft(rid)
+                    # rank is None => the assignment was already
+                    # reclaimed (the replica died before its corrupt
+                    # response was read) and rid is back in the pending
+                    # queue — re-queueing again would dispatch the
+                    # request twice and leak the loser's decode slot
+                if tr.enabled:
+                    tr.count("serve.corrupt_responses")
+                    tr.event("serve.corrupt_response", request=rid)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            now_wall = time.time()
+            service_s = time.monotonic() - pend.submitted_t
+            with self._lock:
+                self.completed.add(rid)
+                rank = self._assigned.pop(rid, None)
+                if rank is not None and rank in self._replicas:
+                    self._replicas[rank].inflight.discard(rid)
+                self.latencies_s.append(service_s)
+                missed = (pend.deadline_ts is not None
+                          and now_wall > pend.deadline_ts)
+                if missed:
+                    self.deadline_missed += 1
+            self.admission.complete(service_s)
+            if tr.enabled:
+                tr.count("serve.completed")
+                if missed:
+                    tr.count("serve.deadline_missed")
+            pend.response = doc
+            pend.event.set()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass

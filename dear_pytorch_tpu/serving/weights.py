@@ -1,0 +1,144 @@
+"""Versioned weight publishing over the object-store waist.
+
+The serving fleet's weights move through the same
+`utils.objectstore.LocalObjectStore` seven-method waist the training
+side's `CheckpointStreamer` uploads to — a trainer publishes a version,
+replicas load the newest committed one at startup, and a **rolling
+restart is the weight swap** (the PR-7 drain protocol: drain one replica,
+backfill it, it comes up on the new version while the rest of the fleet
+keeps serving — docs/SERVING.md).
+
+Commit protocol (mirrors the checkpoint streamer's manifest-last rule):
+
+    weights/v<NNNNNN>/params.npz      flattened param tree (numpy savez)
+    weights/v<NNNNNN>/MANIFEST.json   sha256 + byte count — written LAST,
+                                      so a version exists iff its
+                                      manifest does
+    weights/LATEST                    newest version pointer (best-effort
+                                      hint; readers fall back to listing)
+
+`load_params` re-verifies the sha256 on download and **walks back** past
+a corrupted or torn version (counting ``serve.weight_corrupt_detected``)
+— the same degrade-never-crash posture as
+`utils.checkpoint.restore_from_object_store`.
+
+Numpy + stdlib only (no jax): publishable and loadable from any host-side
+process; flax applies numpy arrays directly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+
+__all__ = ["publish_params", "load_params", "list_versions",
+           "latest_version"]
+
+_PREFIX = "weights"
+
+
+def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for key in sorted(tree):
+        val = tree[key]
+        name = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(val, dict):
+            out.update(_flatten(val, name))
+        else:
+            out[name] = np.asarray(val)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> dict:
+    tree: dict = {}
+    for name, val in flat.items():
+        parts = name.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
+
+
+def _vdir(version: int) -> str:
+    return f"{_PREFIX}/v{int(version):06d}"
+
+
+def publish_params(store, params, version: int) -> str:
+    """Publish a (nested-dict) param tree as ``version``. Returns the
+    version key. Idempotent: re-publishing the same tree overwrites with
+    identical bytes (atomic per object)."""
+    flat = _flatten(params)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    data = buf.getvalue()
+    vdir = _vdir(version)
+    store.put_bytes(f"{vdir}/params.npz", data)
+    # manifest LAST: the per-version commit marker
+    store.put_bytes(f"{vdir}/MANIFEST.json", json.dumps({
+        "version": int(version),
+        "sha256": hashlib.sha256(data).hexdigest(),
+        "bytes": len(data),
+        "leaves": len(flat),
+    }).encode())
+    store.put_bytes(f"{_PREFIX}/LATEST", str(int(version)).encode())
+    return vdir
+
+
+def list_versions(store) -> List[int]:
+    """Committed versions (manifest present), newest first."""
+    out = []
+    for key in store.list(_PREFIX):
+        m = re.fullmatch(rf"{_PREFIX}/v(\d+)/MANIFEST\.json", key)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(set(out), reverse=True)
+
+
+def latest_version(store) -> Optional[int]:
+    try:
+        return int(store.get_bytes(f"{_PREFIX}/LATEST").decode().strip())
+    except (KeyError, ValueError):
+        versions = list_versions(store)
+        return versions[0] if versions else None
+
+
+def load_params(store, version: Optional[int] = None
+                ) -> Tuple[dict, int]:
+    """Load ``version`` (default: newest committed), sha256-reverified;
+    a corrupted or torn version is walked past toward older ones rather
+    than crashing the replica (``serve.weight_corrupt_detected``).
+    Raises ``KeyError`` when no loadable version exists."""
+    if version is not None:
+        candidates = [int(version)]
+    else:
+        newest = latest_version(store)
+        candidates = list_versions(store)
+        # the LATEST pointer may race a publish; try it first regardless
+        if newest is not None and newest not in candidates:
+            candidates.insert(0, newest)
+    tr = _telemetry.get_tracer()
+    for v in candidates:
+        vdir = _vdir(v)
+        try:
+            manifest = json.loads(store.get_bytes(f"{vdir}/MANIFEST.json"))
+            data = store.get_bytes(f"{vdir}/params.npz")
+        except (KeyError, ValueError):
+            continue
+        if hashlib.sha256(data).hexdigest() != manifest.get("sha256"):
+            if tr.enabled:
+                tr.count("serve.weight_corrupt_detected")
+                tr.event("serve.weight_corrupt", version=v)
+            continue
+        with np.load(io.BytesIO(data)) as npz:
+            flat = {k: npz[k] for k in npz.files}
+        return _unflatten(flat), int(v)
+    raise KeyError(
+        f"no loadable weight version in the store (tried {candidates})")

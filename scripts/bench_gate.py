@@ -14,10 +14,20 @@ artifact into an automated check:
 floor** on a run metric — the continuous-training service contract
 ("N steps/hour despite churn", scripts/chaos_check.py --autoscale) is a
 floor, not a ratio, so it gates independently of any baseline; with only
-``--slo`` flags the baseline may be omitted entirely:
+``--slo`` flags the baseline may be omitted entirely. Latency-shaped
+metrics gate the other direction: ``METRIC<=MAX`` enforces a **ceiling**
+(``METRIC>=MIN`` is an explicit floor spelling; a bare ``=`` stays a
+floor for back-compat). A floor and a ceiling on the SAME metric form a
+band — both bounds are enforced. Both directions share the
+NaN-fails-loudly rule:
+the check is ``not (value within bound)``, so a NaN metric violates a
+floor AND a ceiling — a service reporting NaN for its SLO is broken,
+not healthy:
 
   python scripts/bench_gate.py --run /tmp/autoscale.json \
       --slo steps_per_hour=120
+  python scripts/bench_gate.py --run /tmp/serve.json \
+      --slo requests_per_s=2 --slo "p99_latency_ms<=500"
 
 ``--ab-methods CANDIDATE:BASE`` gates one driver-sweep METHOD against
 another inside a single `benchmarks/driver.py` ``reports.json`` — the
@@ -145,11 +155,12 @@ def main(argv=None) -> int:
     ap.add_argument("--allow-missing", action="store_true",
                     help="metrics the run lost vs the baseline only warn")
     ap.add_argument("--slo", action="append", default=[],
-                    metavar="METRIC=MIN",
-                    help="absolute floor for a run metric (repeatable); "
-                         "a missing metric fails the gate — a service "
-                         "that stopped reporting its SLO is down, not "
-                         "quiet")
+                    metavar="METRIC=MIN|METRIC<=MAX",
+                    help="absolute floor (METRIC=MIN or METRIC>=MIN) or "
+                         "ceiling (METRIC<=MAX, for latency metrics) on "
+                         "a run metric (repeatable); a missing or NaN "
+                         "metric fails the gate — a service that stopped "
+                         "reporting its SLO is down, not quiet")
     ap.add_argument("--ab-methods", default=None, metavar="CANDIDATE:BASE",
                     help="gate one driver-sweep method against another "
                          "inside --run (a benchmarks/driver.py "
@@ -205,14 +216,28 @@ def main(argv=None) -> int:
     # stdlib-only import path: anomaly.py never touches jax
     from dear_pytorch_tpu.observability import anomaly as A
 
-    slos = {}
+    # a LIST, not a dict keyed on the metric: one metric may carry BOTH
+    # a floor and a ceiling (a band) and neither may silently win
+    slos = []
     for spec in args.slo:
-        name, _, floor = spec.partition("=")
+        # direction by operator: '<=' ceiling, '>=' explicit floor, bare
+        # '=' the legacy floor spelling — checked in that order so the
+        # two-char operators are not mis-split at their '=' char
+        if "<=" in spec:
+            name, _, bound = spec.partition("<=")
+            direction = "max"
+        elif ">=" in spec:
+            name, _, bound = spec.partition(">=")
+            direction = "min"
+        else:
+            name, _, bound = spec.partition("=")
+            direction = "min"
         try:
-            slos[name.strip()] = float(floor)
+            slos.append((name.strip(), direction, float(bound)))
         except ValueError:
             print(json.dumps({"ok": False,
-                              "error": f"bad --slo {spec!r} (METRIC=MIN)"}))
+                              "error": f"bad --slo {spec!r} "
+                                       "(METRIC=MIN or METRIC<=MAX)"}))
             return 3
     if args.baseline is None and not slos:
         ap.error("pass --baseline, --slo, or both")
@@ -246,15 +271,19 @@ def main(argv=None) -> int:
     if args.allow_missing and verdict["missing"] \
             and not verdict["regressions"]:
         verdict["ok"] = True
-    # absolute SLO floors gate on the RUN alone. NOT-above-floor (rather
-    # than below-floor) so a NaN metric FAILS: a service reporting NaN
-    # for its SLO is broken, not healthy.
+    # absolute SLO bounds gate on the RUN alone. NOT-within-bound (rather
+    # than outside-bound) so a NaN metric FAILS in either direction: a
+    # service reporting NaN for its SLO is broken, not healthy.
     verdict["slo_violations"] = []
-    for name, floor in sorted(slos.items()):
+    for name, direction, bound in sorted(slos):
         value = run_metrics.get(name)
-        if value is None or not (value >= floor):
-            verdict["slo_violations"].append(
-                {"metric": name, "floor": floor, "run": value})
+        ok = (value is not None
+              and (value <= bound if direction == "max"
+                   else value >= bound))
+        if not ok:
+            row = {"metric": name, "run": value}
+            row["ceiling" if direction == "max" else "floor"] = bound
+            verdict["slo_violations"].append(row)
             verdict["ok"] = False
     print(json.dumps(verdict))
     if not verdict["ok"]:
@@ -265,7 +294,9 @@ def main(argv=None) -> int:
                   for m in verdict["missing"]]
         lines += [f"  {v['metric']}: "
                   + ("missing" if v["run"] is None else f"{v['run']:g}")
-                  + f" below SLO floor {v['floor']:g}"
+                  + (f" above SLO ceiling {v['ceiling']:g}"
+                     if "ceiling" in v
+                     else f" below SLO floor {v['floor']:g}")
                   for v in verdict["slo_violations"]]
         sys.stderr.write("bench_gate: REGRESSION/SLO failure:\n"
                          + "\n".join(lines) + "\n")

@@ -1167,6 +1167,422 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
     return summary
 
 
+# -- the serving storm ---------------------------------------------------------
+
+
+def _serve_model():
+    """The storm's tiny causal LM (identical on publisher and every
+    replica — the params travel through the object store, the
+    architecture through this function)."""
+    from dear_pytorch_tpu.models.gpt import GptConfig, GptLmHeadModel
+
+    cfg = GptConfig(
+        vocab_size=61, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, kv_cache_len=16,
+        embd_dropout_prob=0.0, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    return GptLmHeadModel(cfg), cfg
+
+
+def run_serve_publish(version: int, workdir: str) -> dict:
+    """Publish weight version ``version`` to the serving object store —
+    the 'trainer published a checkpoint' leg of the rolling weight swap.
+    Different versions use different init seeds, so a swapped fleet is
+    observably serving different logits."""
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(1, scrub_env=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dear_pytorch_tpu.serving import weights as W
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    model, _cfg = _serve_model()
+    params = model.init(
+        {"params": jax.random.PRNGKey(1000 + version)},
+        jnp.zeros((1, 4), jnp.int32), train=False)["params"]
+    store = LocalObjectStore(os.environ["DEAR_SERVE_STORE"])
+    key = W.publish_params(store, params, version)
+    print(f"SERVE_PUBLISH v{version} -> {key}", flush=True)
+    return {"passed": True, "version": version}
+
+
+def run_worker_serve_replica(workdir: str) -> dict:
+    """One serving replica (spawned — and respawned — by
+    `launch/supervisor.py` under the elastic env contract). Loads the
+    NEWEST committed weights from the object store (which is what makes
+    drain+backfill a weight swap), serves the router's file protocol
+    through a continuous-batching `serving.engine`, and exits 0 only via
+    the SIGTERM drain path (`resilience.preempt`)."""
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(1, scrub_env=True)
+
+    from dear_pytorch_tpu.resilience import PreemptionHandler
+    from dear_pytorch_tpu.resilience import inject as INJ
+    from dear_pytorch_tpu.serving import weights as W
+    from dear_pytorch_tpu.serving.engine import DecodeEngine
+    from dear_pytorch_tpu.serving.replica import ReplicaServer
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    rank = int(os.environ["DEAR_ELASTIC_RANK"])
+    serve_dir = os.environ["DEAR_SERVE_DIR"]
+    store = LocalObjectStore(os.environ["DEAR_SERVE_STORE"])
+    # rank-targeted serving faults (slow replica, corrupted response):
+    # own_rank comes from the supervisor contract, not jax.process_index
+    raw = os.environ.get(INJ.FAULT_ENV, "").strip()
+    injector = (INJ.FaultInjector(INJ.parse_faults(raw), own_rank=rank)
+                if raw else None)
+    params, version = W.load_params(store)
+    model, _cfg = _serve_model()
+    engine = DecodeEngine(
+        model, params,
+        slots=int(os.environ.get("DEAR_SERVE_SLOTS", "4")))
+    pre = PreemptionHandler().install()
+    srv = ReplicaServer(serve_dir, rank, engine, version=version,
+                        injector=injector, preemption=pre)
+    summary = srv.run(
+        deadline_s=float(os.environ.get("DEAR_SERVE_DEADLINE", "600")))
+    print("CHAOS_SERVE_REPLICA " + json.dumps(summary), flush=True)
+    return summary
+
+
+def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
+    #                                          purpose in one narrative
+    """Parent of the SERVING storm — the fault-tolerant-fleet acceptance
+    gate. A 2-replica supervised fleet serves closed-loop traffic while:
+
+      1. an overload burst exceeds the admission depth — requests are
+         shed with explicit 429-style backpressure and the clients'
+         decorrelated-jitter retries (`resilience.retry`) land them;
+      2. one replica is SIGKILLed MID-TRAFFIC — its in-flight requests
+         are re-dispatched to the survivor (zero accepted-then-lost
+         requests), and the supervisor relaunches it within the
+         sliding-window budget;
+      3. a scheduled ``corrupt_resp`` fault ships a checksum-broken
+         response — the router discards and re-dispatches it;
+      4. a new weight version is published to the object store and a
+         ROLLING drain/backfill restart swaps every replica onto it with
+         the fleet serving continuously (responses complete during every
+         drain window);
+      5. the capacity file scales the fleet 2 -> 3 under load;
+      6. `bench_gate.py --slo` machine-checks the service contract: a
+         throughput floor AND a p99-latency ceiling across the storm.
+
+    The parent is jax-free: it runs the admission-controlled router
+    (`serving.router`), drives `launch/supervisor.py` +
+    `resilience.scale.ScalePolicy` through the capacity file, and
+    SIGKILLs via the supervisor's pid files — exactly an operator's
+    surface."""
+    import importlib.util
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.resilience.retry import RetryError, retry_call
+    from dear_pytorch_tpu.resilience.scale import ScalePolicy
+    from dear_pytorch_tpu.serving.admission import (
+        AdmissionController, SheddingError,
+    )
+    from dear_pytorch_tpu.serving.router import ReplicaRouter
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_serve_")
+    os.makedirs(workdir, exist_ok=True)
+    serve_dir = os.path.join(workdir, "serve")
+    store_dir = os.path.join(workdir, "store")
+    elastic_dir = os.path.join(workdir, "elastic")
+    capacity = os.path.join(workdir, "capacity.json")
+    failures: list[str] = []
+
+    def write_capacity(doc):
+        with open(capacity + ".tmp", "w") as f:
+            json.dump(doc, f)
+        os.replace(capacity + ".tmp", capacity)
+
+    write_capacity({"target_world": 2})
+
+    kill_rank = 1
+    env = dict(os.environ)
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_SERVE_DIR"] = serve_dir
+    env["DEAR_SERVE_STORE"] = store_dir
+    env["DEAR_SERVE_SLOTS"] = "4"
+    env["DEAR_SERVE_DEADLINE"] = "600"
+    # the serving fault schedule: replica 1 straggles from its 8th
+    # request on (admission backpressure fodder), replica 0's 3rd
+    # response is corrupted after signing (checksum re-dispatch)
+    env["DEAR_FAULTS"] = "slow@8:0.05:r1,corrupt_resp@3:r0"
+
+    # v1 weights land in the store before any replica boots
+    pub = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--serve-publish", "--version", "1", "--workdir", workdir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120)
+    _check(pub.returncode == 0,
+           f"weight v1 published: {pub.stdout[-800:]}", failures)
+
+    spec = importlib.util.spec_from_file_location(
+        "dear_launch_supervisor",
+        os.path.join(REPO, "launch", "supervisor.py"))
+    sup_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sup_mod)
+    policy = ScalePolicy(capacity_file=capacity, hysteresis_s=0.5,
+                         max_world=3)
+    sup = sup_mod.ElasticSupervisor(
+        2,
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--serve-replica", "--workdir", workdir],
+        elastic_dir=elastic_dir, env=env,
+        max_relaunches=2, relaunch_window_s=120.0, policy=policy,
+    ).start()
+
+    prev_tracer = T._tracer
+    T.set_tracer(T.Tracer([T.MemoryExporter()]))
+    admission = AdmissionController(max_depth=8)
+    router = ReplicaRouter(serve_dir, admission=admission,
+                           slots_per_replica=4,
+                           health_timeout_s=5.0).start()
+    t0 = time.monotonic()
+    deadline = t0 + 480.0
+
+    def pump(cond, what, timeout_s=120.0):
+        t_end = min(time.monotonic() + timeout_s, deadline)
+        while time.monotonic() < t_end:
+            sup.poll()
+            if cond():
+                return True
+            time.sleep(0.1)
+        failures.append(f"timeout waiting for: {what}")
+        return False
+
+    stop_clients = threading.Event()
+    client_failures: list[str] = []
+    retry_exhausted = [0]
+
+    def one_request(tag, i, deadline_s=60.0, timeout_s=240.0):
+        prompt = [(tag * 31 + i * 7 + k) % 61 for k in range(4 + i % 3)]
+        try:
+            rid = retry_call(
+                router.submit, prompt, max_new_tokens=3,
+                deadline_s=deadline_s, attempts=8, base_delay_s=0.05,
+                max_delay_s=0.8, retry_on=(SheddingError,),
+                name=f"serve-client-{tag}")
+        except RetryError:
+            retry_exhausted[0] += 1  # shed to exhaustion: accounted, not
+            return None              # dropped (it was never accepted)
+        try:
+            return router.result(rid, timeout=timeout_s)
+        except TimeoutError:
+            client_failures.append(f"client {tag} req {i}: no response")
+            return None
+
+    def steady_client(tag):
+        i = 0
+        while not stop_clients.is_set():
+            one_request(tag, i)
+            i += 1
+            time.sleep(0.05)
+
+    clients = [threading.Thread(target=steady_client, args=(t,),
+                                daemon=True) for t in range(2)]
+
+    try:
+        # -- phase A: fleet up, traffic flowing ---------------------------
+        _check(pump(lambda: len(router.healthy_replicas()) >= 2,
+                    "2 replicas healthy", 180.0),
+               "initial fleet of 2 replicas is serving", failures)
+        for c in clients:
+            c.start()
+        _check(pump(lambda: len(router.completed) >= 5,
+                    "first responses", 60.0),
+               "closed-loop traffic completes", failures)
+
+        # -- phase B: overload burst -> explicit shedding -----------------
+        burst_results = []
+        burst_threads = [
+            threading.Thread(target=lambda i=i: burst_results.append(
+                one_request(100 + i, i, deadline_s=120.0)), daemon=True)
+            for i in range(14)]
+        for th in burst_threads:
+            th.start()
+        pump(lambda: admission.shed >= 1, "burst sheds", 30.0)
+        _check(admission.shed >= 1,
+               f"admission shed under the burst (shed={admission.shed}, "
+               f"depth bound {admission.max_depth})", failures)
+
+        # -- phase C: SIGKILL a replica MID-traffic -----------------------
+        pump(lambda: router.inflight_on(kill_rank) >= 1,
+             "in-flight work on the victim", 30.0)
+        pid_path = os.path.join(elastic_dir, "supervisor", "pids",
+                                str(kill_rank))
+        with open(pid_path) as f:
+            victim_pid = int(f.read())
+        os.kill(victim_pid, signal.SIGKILL)
+        _check(pump(lambda: router.redispatched >= 1,
+                    "redispatch after SIGKILL", 60.0),
+               "the dead replica's in-flight requests were re-dispatched",
+               failures)
+        _check(pump(lambda: sup.relaunches.get(kill_rank, 0) >= 1
+                    and kill_rank in router.healthy_replicas(),
+                    "victim relaunched + healthy", 120.0),
+               "the supervisor relaunched the SIGKILLed replica within "
+               "its window budget", failures)
+        for th in burst_threads:
+            th.join(timeout=240)
+
+        # -- phase D: rolling weight swap (drain -> backfill per rank) ----
+        pub2 = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--serve-publish", "--version", "2", "--workdir", workdir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=120)
+        _check(pub2.returncode == 0,
+               f"weight v2 published: {pub2.stdout[-800:]}", failures)
+        min_healthy_during_swap = [99]
+
+        def sampling(base_cond):
+            # sample the healthy count on EVERY pump poll THROUGHOUT the
+            # drain/backfill window — a single post-backfill sample would
+            # always read a healthy-by-construction fleet and the
+            # continuous-serving assertion below would be vacuous
+            def cond():
+                min_healthy_during_swap[0] = min(
+                    min_healthy_during_swap[0],
+                    len(router.healthy_replicas()))
+                return base_cond()
+            return cond
+
+        for rank in (0, 1):
+            before = len(router.completed)
+            write_capacity({"target_world": 2, "drain": [rank]})
+            ok = pump(sampling(lambda r=rank: ("drained", r) in sup.events),
+                      f"rank {rank} drained cleanly", 90.0)
+            _check(ok, f"rank {rank} drained via the SIGTERM grace path",
+                   failures)
+            _check(pump(sampling(lambda r=rank:
+                                 router.fleet_versions().get(r) == 2),
+                        f"rank {rank} back on v2", 120.0),
+                   f"backfilled rank {rank} serves weight v2", failures)
+            _check(pump(sampling(lambda b=before:
+                                 len(router.completed) > b),
+                        f"traffic during rank-{rank} swap", 60.0),
+                   f"responses completed during the rank-{rank} drain "
+                   "window (continuous serving)", failures)
+        _check(router.weight_swaps >= 2,
+               f"the router observed both weight swaps "
+               f"(serve.weight_swaps={router.weight_swaps})", failures)
+
+        # -- phase E: capacity-up under load ------------------------------
+        write_capacity({"target_world": 3})
+        _check(pump(lambda: len(router.healthy_replicas()) >= 3,
+                    "scale-up to 3", 120.0),
+               "the capacity hint scaled the serving fleet to 3 replicas",
+               failures)
+        _check(router.fleet_versions().get(2) == 2,
+               "the scaled-up replica came up on the newest weights",
+               failures)
+
+        # -- wind down: every accepted request must be answered -----------
+        stop_clients.set()
+        for c in clients:
+            c.join(timeout=240)
+        _check(pump(lambda: not router.open_requests(),
+                    "all accepted requests answered", 120.0),
+               "zero dropped requests: every admitted request got a "
+               f"verified response (open={sorted(router.open_requests())})",
+               failures)
+        _check(not client_failures,
+               f"no client timed out ({client_failures[:4]})", failures)
+        _check(router.corrupt_responses >= 1,
+               "the corrupted response was caught by its checksum and "
+               f"re-served (corrupt={router.corrupt_responses})", failures)
+        _check(sup.relaunches.get(kill_rank, 0) == 1
+               and all(n == 0 for r, n in sup.relaunches.items()
+                       if r != kill_rank),
+               f"exactly the SIGKILLed replica was relaunched "
+               f"({sup.relaunches})", failures)
+        kinds = [d.kind for d in policy.decisions]
+        _check(kinds.count("drain") >= 2 and kinds.count("scale_up") >= 3,
+               f"policy drove both drains, both backfills, and the "
+               f"scale-up ({kinds})", failures)
+        _check(min_healthy_during_swap[0] >= 1,
+               "at least one replica stayed healthy through the rolling "
+               "swap", failures)
+    finally:
+        stop_clients.set()
+        elapsed_s = time.monotonic() - t0
+        sup.policy = None  # shutdown must not be 'lost capacity'
+        sup.kill_all(signal.SIGTERM)  # drain path: clean exits
+        t_end = time.monotonic() + 60.0
+        while sup.poll() and time.monotonic() < t_end:
+            time.sleep(0.1)
+        if sup._procs:
+            sup.kill_all(signal.SIGKILL)
+        stats = router.stats()
+        router.close()
+        counters = T.get_tracer().counters()
+        T.set_tracer(prev_tracer)
+
+    bad_exits = {r: rc for r, rc in sup._final_rc.items()
+                 if rc not in (0, -signal.SIGKILL.value)
+                 and r != kill_rank}
+    _check(not bad_exits, f"replicas exited clean ({bad_exits})", failures)
+
+    # the machine-checked service contract: a throughput FLOOR and a
+    # p99-latency CEILING through bench_gate --slo, across the whole storm
+    completed = stats["completed"]
+    rps = completed / max(elapsed_s, 1e-9)
+    rps_floor = float(os.environ.get("DEAR_CHAOS_SERVE_RPS", "0.2"))
+    p99_ceil = float(os.environ.get("DEAR_CHAOS_SERVE_P99_MS", "60000"))
+    run_json = os.path.join(workdir, "serve_contract.json")
+    with open(run_json, "w") as f:
+        json.dump({"metric": "requests_per_s", "value": round(rps, 3),
+                   "extra_metrics": [
+                       {"metric": "p99_latency_ms",
+                        "value": stats["latency_p99_ms"]},
+                       {"metric": "served", "value": completed},
+                       {"metric": "shed", "value": stats["shed"]},
+                   ]}, f)
+    gate_spec = importlib.util.spec_from_file_location(
+        "dear_bench_gate", os.path.join(REPO, "scripts", "bench_gate.py"))
+    gate = importlib.util.module_from_spec(gate_spec)
+    gate_spec.loader.exec_module(gate)
+    gate_rc = gate.main(["--run", run_json,
+                         "--slo", f"requests_per_s={rps_floor}",
+                         "--slo", f"p99_latency_ms<={p99_ceil}"])
+    _check(gate_rc == 0,
+           f"bench_gate --slo holds the serving contract "
+           f"({rps:.2f} req/s >= {rps_floor}; p99 "
+           f"{stats['latency_p99_ms']}ms <= {p99_ceil}ms)", failures)
+
+    return {
+        "passed": not failures,
+        "workdir": workdir,
+        "elapsed_s": round(elapsed_s, 1),
+        "requests_per_s": round(rps, 3),
+        "stats": stats,
+        "retry_exhausted": retry_exhausted[0],
+        "policy_decisions": [d.kind for d in policy.decisions],
+        "serve_counters": {k: v for k, v in sorted(counters.items())
+                           if k.startswith("serve.")},
+        "failures": failures,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="multi-fault recovery check (see module docstring)")
@@ -1186,12 +1602,40 @@ def main(argv=None) -> int:
                          "3 ranks, SIGKILL shrink + relaunch, spot-drain "
                          "planned shrink + backfill, steps/hour SLO gate, "
                          "and a cold start from the remote checkpoint tier")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving storm: a supervised replica fleet "
+                         "absorbs an overload burst (shed+retry), a "
+                         "SIGKILL mid-traffic (zero dropped requests), "
+                         "a checksum-corrupted response, a rolling "
+                         "weight swap, and a capacity scale-up — gated "
+                         "by a throughput floor + p99 ceiling")
     ap.add_argument("--cold-start", action="store_true",
                     help=argparse.SUPPRESS)  # internal: scale-from-zero leg
+    ap.add_argument("--serve-replica", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one serving replica
+    ap.add_argument("--serve-publish", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: weight publisher
+    ap.add_argument("--version", type=int, default=1,
+                    help=argparse.SUPPRESS)  # --serve-publish version
     ap.add_argument("--worker", action="store_true",
                     help=argparse.SUPPRESS)  # internal: one storm rank
     args = ap.parse_args(argv)
 
+    if args.worker and args.serve_publish:
+        summary = run_serve_publish(args.version, workdir=args.workdir)
+        return 0 if summary["passed"] else 1
+    if args.worker and args.serve_replica:
+        # one serving replica: health/responses are the output; the
+        # parent's router + gate do the asserting
+        run_worker_serve_replica(workdir=args.workdir)
+        return 0
+    if args.serve:
+        summary = run_serve(workdir=args.workdir)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "stats"}))
+        print("CHAOS CHECK " + ("PASSED" if summary["passed"]
+                                else "FAILED"))
+        return 0 if summary["passed"] else 1
     if args.worker and args.cold_start:
         summary = run_cold_start(workdir=args.workdir)
         return 0 if summary["passed"] else 1
@@ -1247,10 +1691,12 @@ if __name__ == "__main__":
         # parent of the multi-process storm: pure process supervisor, no
         # jax in this process (the workers own the devices)
         sys.exit(main())
-    if "--elastic" in sys.argv or "--autoscale" in sys.argv:
-        # parent of the elastic/autoscale storms: likewise jax-free — it
-        # drives launch/supervisor.py (+ the ScalePolicy / capacity file)
-        # and reads the ranks' verdict files and decision records
+    if "--elastic" in sys.argv or "--autoscale" in sys.argv \
+            or "--serve" in sys.argv:
+        # parent of the elastic/autoscale/serving storms: likewise
+        # jax-free — it drives launch/supervisor.py (+ the ScalePolicy /
+        # capacity file, + the serving router) and reads the ranks'
+        # verdict/health files and decision records
         sys.exit(main())
     # standalone single-process: emulate the 8-device CPU world the test
     # suite uses

@@ -128,6 +128,23 @@ def main(argv=None) -> int:
             tr.count("kernel.fused_rs_builds")
             tr.event("kernel.fused_rs_build", elements=1024, world=8)
 
+    # serve-path gates, the way serving/{admission,router,engine}.py run
+    # them on the request hot path (admission decision, response
+    # completion, engine tick): count + event under one enabled check —
+    # the serving stack's per-request cost when telemetry is off must be
+    # the same two lookups as the training step's.
+    def serve_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("serve.requests")
+            tr.event("serve.shed", depth=3)
+
+    def serve_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("serve.requests")
+            tr.event("serve.shed", depth=3, predicted_wait_s=0.01)
+
     # plan-tuner decision-loop gate, the way tuning/autotune.py's step
     # path runs it once the search has FINISHED (or never started): the
     # per-step cost must be one attribute check + return — the tuner
@@ -156,6 +173,8 @@ def main(argv=None) -> int:
     fl_enabled_ns = _bench(flight_enabled_site, max(args.iters // 10, 1))
     k_disabled_ns = _bench(kernel_disabled_gate, args.iters)
     k_enabled_ns = _bench(kernel_enabled_site, max(args.iters // 10, 1))
+    s_disabled_ns = _bench(serve_disabled_gate, args.iters)
+    s_enabled_ns = _bench(serve_enabled_site, max(args.iters // 10, 1))
     tuner_finished_ns = _bench(plan_tuner_finished_gate, args.iters)
     overhead_ns = max(disabled_ns - baseline_ns, 0.0)
 
@@ -167,12 +186,15 @@ def main(argv=None) -> int:
         "flight_enabled_ns_per_call": round(fl_enabled_ns, 1),
         "kernel_disabled_ns_per_call": round(k_disabled_ns, 1),
         "kernel_enabled_ns_per_call": round(k_enabled_ns, 1),
+        "serve_disabled_ns_per_call": round(s_disabled_ns, 1),
+        "serve_enabled_ns_per_call": round(s_enabled_ns, 1),
         "tuner_finished_ns_per_call": round(tuner_finished_ns, 1),
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
         "ok": (disabled_ns <= args.budget_ns
                and fl_disabled_ns <= args.budget_ns
                and k_disabled_ns <= args.budget_ns
+               and s_disabled_ns <= args.budget_ns
                and tuner_finished_ns <= args.budget_ns),
     }
     print(json.dumps(out))

@@ -788,6 +788,65 @@ def test_bench_gate_slo_floor(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_bench_gate_slo_ceiling(tmp_path, capsys):
+    """`--slo METRIC<=MAX` gates an absolute CEILING — the latency
+    direction of the serving contract (scripts/chaos_check.py --serve
+    gates p99 latency this way), with the same NaN/missing-fails-loudly
+    semantics as floors, and `METRIC>=MIN` as the explicit floor
+    spelling."""
+    gate = _gate()
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps({
+        "metric": "requests_per_s", "value": 12.0,
+        "extra_metrics": [{"metric": "p99_latency_ms", "value": 340.0}]}))
+    # ceiling held -> 0; floor and ceiling compose in one invocation
+    assert gate.main(["--run", str(run),
+                      "--slo", "p99_latency_ms<=500"]) == 0
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["ok"] and verdict["slo_violations"] == []
+    assert gate.main(["--run", str(run),
+                      "--slo", "requests_per_s>=10",
+                      "--slo", "p99_latency_ms<=500"]) == 0
+    capsys.readouterr()
+    # ceiling broken -> 2, and the verdict names the ceiling
+    assert gate.main(["--run", str(run),
+                      "--slo", "p99_latency_ms<=100"]) == 2
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["slo_violations"][0] == {
+        "metric": "p99_latency_ms", "run": 340.0, "ceiling": 100.0}
+    # a missing metric fails a ceiling exactly like a floor
+    assert gate.main(["--run", str(run),
+                      "--slo", "p50_latency_ms<=100"]) == 2
+    capsys.readouterr()
+    # NaN is never within a bound: not-(value<=max) fails loudly
+    nan_run = tmp_path / "nan.json"
+    nan_run.write_text('{"metric": "p99_latency_ms", "value": NaN}')
+    assert gate.main(["--run", str(nan_run),
+                      "--slo", "p99_latency_ms<=1e9"]) == 2
+    capsys.readouterr()
+    # malformed bound -> 3
+    assert gate.main(["--run", str(run),
+                      "--slo", "p99_latency_ms<=fast"]) == 3
+    capsys.readouterr()
+    # a BAND (floor AND ceiling on the SAME metric) enforces BOTH bounds
+    # — neither may silently overwrite the other
+    assert gate.main(["--run", str(run),
+                      "--slo", "p99_latency_ms>=10",
+                      "--slo", "p99_latency_ms<=500"]) == 0
+    capsys.readouterr()
+    assert gate.main(["--run", str(run),
+                      "--slo", "p99_latency_ms>=400",   # broken floor...
+                      "--slo", "p99_latency_ms<=500"]) == 2  # ...gates
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["slo_violations"] == [
+        {"metric": "p99_latency_ms", "run": 340.0, "floor": 400.0}]
+    assert gate.main(["--run", str(run),
+                      "--slo", "p99_latency_ms>=400",
+                      "--slo", "p99_latency_ms<=100"]) == 2  # both broken
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(verdict["slo_violations"]) == 2
+
+
 def test_bench_gate_reads_contract_line_amid_output(tmp_path, capsys):
     gate = _gate()
     base = tmp_path / "b.json"

@@ -1,0 +1,574 @@
+"""Serving stack: ring-buffer KV-cache decode parity, the
+continuous-batching engine, admission backpressure, the router's
+zero-drop re-dispatch machinery, the serving fault grammar — and the
+`scripts/chaos_check.py --serve` replica-kill storm as the end-to-end
+gate (docs/SERVING.md)."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.models.bert import (
+    BertConfig, BertForPreTraining, dot_product_attention,
+)
+from dear_pytorch_tpu.models.gpt import GptConfig, GptLmHeadModel, generate
+from dear_pytorch_tpu.serving import kvcache as KV
+from dear_pytorch_tpu.serving.admission import (
+    AdmissionController, SheddingError,
+)
+from dear_pytorch_tpu.serving.engine import DecodeEngine
+from dear_pytorch_tpu.serving.router import ReplicaRouter, response_sha256
+
+
+def _gpt(dtype=jnp.float32, **kw):
+    cfg = GptConfig(
+        vocab_size=61, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, embd_dropout_prob=0.0,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        dtype=dtype, **kw)
+    model = GptLmHeadModel(cfg)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((2, 4), jnp.int32), train=False)["params"]
+    return model, params
+
+
+def _bert(dtype=jnp.float32, **kw):
+    cfg = BertConfig(
+        vocab_size=60, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, dtype=dtype, **kw)
+    model = BertForPreTraining(cfg)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((2, 4), jnp.int32), train=False)["params"]
+    return model, params
+
+
+def _gpt_decode_logits(model, params, ids):
+    """Stepwise decode over every position of ``ids``; stacked logits."""
+    cache = model.init(
+        {"params": jax.random.PRNGKey(0)}, ids[:, :1], train=False,
+        decode=True)["cache"]
+    steps = []
+    for t in range(ids.shape[1]):
+        step, vars_out = model.apply(
+            {"params": params, "cache": cache}, ids[:, t:t + 1],
+            train=False, decode=True, position_offset=t, mutable=["cache"])
+        cache = vars_out["cache"]
+        steps.append(np.asarray(step[:, 0]))
+    return np.stack(steps, axis=1)
+
+
+def _bert_decode_logits(model, params, ids):
+    cache = model.init(
+        {"params": jax.random.PRNGKey(0)}, ids[:, :1], train=False,
+        decode=True)["cache"]
+    steps = []
+    for t in range(ids.shape[1]):
+        (step, _nsp), vars_out = model.apply(
+            {"params": params, "cache": cache}, ids[:, t:t + 1],
+            train=False, decode=True, position_offset=t, mutable=["cache"])
+        cache = vars_out["cache"]
+        steps.append(np.asarray(step[:, 0]))
+    return np.stack(steps, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode parity (the satellite contract: non-divisible sequence
+# lengths, bf16 activations, both model families, flash-backed attend)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seq_len", [13, 7])
+def test_gpt_decode_parity_nondivisible(seq_len):
+    model, params = _gpt(kv_cache_len=16)
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 61, (2, seq_len)))
+    full = np.asarray(model.apply({"params": params}, ids, train=False))
+    dec = _gpt_decode_logits(model, params, ids)
+    np.testing.assert_allclose(dec, full, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_decode_parity_bf16():
+    """bf16 activations through the ring cache: the cached K/V travel in
+    bf16 exactly like the full forward's, so decode matches at bf16
+    tolerance."""
+    model, params = _gpt(dtype=jnp.bfloat16, kv_cache_len=16)
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 61, (2, 13)))
+    full = np.asarray(model.apply({"params": params}, ids, train=False))
+    dec = _gpt_decode_logits(model, params, ids)
+    np.testing.assert_allclose(dec, full, rtol=5e-2, atol=5e-2)
+
+
+def test_gpt_decode_parity_flash():
+    """`decode_use_flash=True` routes the decode attend through the
+    Pallas flash kernel (1-row query over the cache, validity as its
+    kv_mask) — same logits as the dense path at dtype tolerance."""
+    model, params = _gpt(kv_cache_len=16, decode_use_flash=True)
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 61, (2, 13)))
+    full = np.asarray(model.apply({"params": params}, ids, train=False))
+    dec = _gpt_decode_logits(model, params, ids)
+    np.testing.assert_allclose(dec, full, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("dtype,rtol", [(jnp.float32, 2e-4),
+                                        (jnp.bfloat16, 5e-2)])
+def test_bert_decode_parity(dtype, rtol):
+    """BERT's incremental decode is left-to-right by construction; its
+    logits reproduce the full forward under ``causal=True`` — at every
+    position, for a non-divisible length, in f32 and bf16."""
+    model, params = _bert(dtype=dtype, kv_cache_len=16)
+    ids = jnp.asarray(np.random.RandomState(4).randint(0, 60, (2, 13)))
+    full, _ = model.apply({"params": params}, ids, train=False, causal=True)
+    dec = _bert_decode_logits(model, params, ids)
+    np.testing.assert_allclose(dec, np.asarray(full), rtol=rtol, atol=rtol)
+
+
+def test_bert_causal_rejects_custom_attention_impl():
+    model, params = _bert()
+    model = BertForPreTraining(model.config,
+                               attention_impl=dot_product_attention)
+    ids = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="causal=True"):
+        model.apply({"params": params}, ids, train=False, causal=True)
+
+
+def test_ring_cache_wraps_to_sliding_window():
+    """Past the ring length the cache holds exactly the last L tokens:
+    attention equals dense attention over that window, at every step."""
+    B, L, H, D, T = 2, 8, 2, 4, 13
+    rs = np.random.RandomState(5)
+    ks = jnp.asarray(rs.randn(B, T, H, D), jnp.float32)
+    vs = jnp.asarray(rs.randn(B, T, H, D), jnp.float32)
+    qs = jnp.asarray(rs.randn(B, T, H, D), jnp.float32)
+    ck = jnp.zeros((B, L, H, D))
+    cv = jnp.zeros((B, L, H, D))
+    for t in range(T):
+        pos = jnp.full((B,), t, jnp.int32)
+        ck, cv = KV.ring_write(ck, cv, pos, ks[:, t:t + 1], vs[:, t:t + 1])
+        valid = KV.ring_validity(pos, L)
+        out = KV.cache_attend(qs[:, t:t + 1], ck, cv, valid,
+                              dtype=jnp.float32)
+        lo = max(0, t + 1 - L)
+        ref = dot_product_attention(
+            qs[:, t:t + 1], ks[:, lo:t + 1], vs[:, lo:t + 1], None,
+            dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching engine
+# ---------------------------------------------------------------------------
+
+
+def test_engine_mixed_prefill_decode_matches_generate():
+    """Three requests of different prompt lengths, arriving staggered
+    into two slots (continuous batching: one finishes, the next enters),
+    must each reproduce the per-request `generate()` tokens exactly —
+    prefill and decode phases mix in ONE jitted step."""
+    model, params = _gpt(kv_cache_len=16)
+    rs = np.random.RandomState(6)
+    prompts = [list(rs.randint(0, 61, n)) for n in (4, 7, 5)]
+    refs = [list(np.asarray(
+        generate(model, params, jnp.asarray([p]), max_new_tokens=5)
+        [0, len(p):])) for p in prompts]
+    eng = DecodeEngine(model, params, slots=2)
+    assert eng.submit(prompts[0], 5, request_id="a") is not None
+    assert eng.submit(prompts[1], 5, request_id="b") is not None
+    assert eng.submit(prompts[2], 5, request_id="c") is None  # batch full
+    done, pending = {}, [("c", prompts[2])]
+    for _ in range(100):
+        for fin in eng.tick():
+            done[fin.request_id] = fin.tokens
+            if pending and eng.free:
+                rid, p = pending.pop()
+                eng.submit(p, 5, request_id=rid)
+        if len(done) == 3:
+            break
+    assert done["a"] == refs[0]
+    assert done["b"] == refs[1]
+    assert done["c"] == refs[2]  # served in a reused slot
+    assert eng.active == 0 and eng.free == 2
+
+
+def test_engine_rejects_over_budget_and_empty_prompts():
+    model, params = _gpt()
+    eng = DecodeEngine(model, params, slots=1)
+    with pytest.raises(ValueError, match="position budget"):
+        eng.submit(list(range(30)), 10)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([], 4)
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_depth_and_deadline_shedding():
+    adm = AdmissionController(max_depth=2, capacity=1)
+    adm.admit(None)
+    adm.admit(None)
+    with pytest.raises(SheddingError) as exc:
+        adm.admit(None)                      # bounded queue depth
+    assert exc.value.depth == 2
+    adm.complete(1.0)                        # service time learned: 1s
+    assert adm.service_time_s == pytest.approx(1.0)
+    # depth 1, svc 1s -> predicted wait 1s: a 0.1s budget is hopeless
+    with pytest.raises(SheddingError):
+        adm.admit(0.1)
+    adm.admit(2.0)                           # a 2s budget fits
+    assert adm.requests == 5 and adm.admitted == 3 and adm.shed == 2
+
+
+def test_admission_capacity_scales_predicted_wait():
+    adm = AdmissionController(max_depth=10, capacity=1,
+                              service_time_s=1.0)
+    adm.admit(None)
+    adm.admit(None)
+    with pytest.raises(SheddingError):
+        adm.admit(1.5)                       # 2 deep x 1s / 1 slot = 2s
+    adm.set_capacity(4)                      # fleet grew: 2s -> 0.5s
+    adm.admit(1.5)
+
+
+def test_shed_retry_with_decorrelated_jitter():
+    """The client contract: SheddingError is retryable through
+    `resilience.retry` and eventually lands."""
+    from dear_pytorch_tpu.resilience.retry import retry_call
+
+    calls = [0]
+
+    def submit():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise SheddingError("shed", depth=5, predicted_wait_s=1.0)
+        return "rid"
+
+    assert retry_call(submit, attempts=5, base_delay_s=0.001,
+                      max_delay_s=0.01,
+                      retry_on=(SheddingError,)) == "rid"
+    assert calls[0] == 3
+
+
+# ---------------------------------------------------------------------------
+# router: health, re-dispatch, checksum, weight swaps (fake replicas —
+# plain threads speaking the file protocol; no jax)
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    """Thread speaking the replica file protocol with scriptable
+    behavior: heartbeat-only, serve, or corrupt-once."""
+
+    def __init__(self, root, rank, *, version=1, incarnation="a",
+                 serve=True, corrupt_first=False):
+        self.root, self.rank = root, rank
+        self.version, self.incarnation = version, incarnation
+        self.serve, self.corrupt_first = serve, corrupt_first
+        self.corrupted = 0
+        self._stop = threading.Event()
+        self._dir = os.path.join(root, "replicas", str(rank))
+        self._inbox = os.path.join(self._dir, "inbox")
+        os.makedirs(self._inbox, exist_ok=True)
+        os.makedirs(os.path.join(root, "responses"), exist_ok=True)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def _beat(self):
+        doc = {"ts": time.time(), "pid": os.getpid(),
+               "incarnation": self.incarnation, "version": self.version,
+               "draining": False, "stopped": False}
+        path = os.path.join(self._dir, "health.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(doc, f)
+        os.replace(path + ".tmp", path)
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._beat()
+            if self.serve:
+                for name in sorted(os.listdir(self._inbox)):
+                    if not name.endswith(".json"):
+                        continue
+                    p = os.path.join(self._inbox, name)
+                    try:
+                        with open(p) as f:
+                            rec = json.load(f)
+                        os.unlink(p)
+                    except (OSError, ValueError):
+                        continue
+                    payload = {"id": rec["id"],
+                               "tokens": rec["prompt"][::-1],
+                               "model_version": self.version,
+                               "replica": self.rank}
+                    payload["sha256"] = response_sha256(payload)
+                    if self.corrupt_first and not self.corrupted:
+                        payload["sha256"] = "0" * 64
+                        self.corrupted += 1
+                    rp = os.path.join(self.root, "responses",
+                                      rec["id"] + ".json")
+                    with open(rp + ".tmp", "w") as f:
+                        json.dump(payload, f)
+                    os.replace(rp + ".tmp", rp)
+            time.sleep(0.01)
+
+
+def _router(root, **kw):
+    kw.setdefault("admission", AdmissionController(max_depth=16))
+    kw.setdefault("slots_per_replica", 2)
+    kw.setdefault("health_timeout_s", 0.6)
+    kw.setdefault("poll_s", 0.01)
+    return ReplicaRouter(root, **kw)
+
+
+def _wait(cond, timeout=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_router_serves_and_accounts_deadline_miss(tmp_path):
+    root = str(tmp_path)
+    rep = _FakeReplica(root, 0).start()
+    with _router(root) as router:
+        assert _wait(lambda: router.healthy_replicas() == [0])
+        rid = router.submit([1, 2, 3], max_new_tokens=2, deadline_s=30.0)
+        resp = router.result(rid, timeout=10)
+        assert resp["tokens"] == [3, 2, 1]
+        # a deadline in the past is still SERVED, but accounted as missed
+        rid2 = router.submit([4, 5], max_new_tokens=1, deadline_s=0.0)
+        assert router.result(rid2, timeout=10)["tokens"] == [5, 4]
+        assert router.deadline_missed == 1
+        assert not router.open_requests()
+    rep.stop()
+
+
+def test_router_redispatches_from_dead_replica(tmp_path):
+    """The zero-drop mechanism: a replica that heartbeats, takes work,
+    and dies has its in-flight requests re-dispatched to a survivor."""
+    root = str(tmp_path)
+    dead = _FakeReplica(root, 0, serve=False).start()
+    with _router(root) as router:
+        assert _wait(lambda: router.healthy_replicas() == [0])
+        rid = router.submit([7, 8, 9], max_new_tokens=2, deadline_s=None)
+        assert _wait(lambda: router.inflight_on(0) == 1)
+        dead.stop()                       # heartbeats cease: replica dies
+        live = _FakeReplica(root, 1, incarnation="b").start()
+        resp = router.result(rid, timeout=15)
+        assert resp["tokens"] == [9, 8, 7] and resp["replica"] == 1
+        assert router.redispatched >= 1
+        assert not router.open_requests()
+        live.stop()
+
+
+def test_router_redispatches_on_incarnation_change(tmp_path):
+    """A FAST restart (new incarnation before the staleness window
+    expires) also triggers reclaim — the restarted replica cleared its
+    inbox, so waiting on it would drop the request."""
+    root = str(tmp_path)
+    first = _FakeReplica(root, 0, serve=False, incarnation="a").start()
+    with _router(root) as router:
+        assert _wait(lambda: router.healthy_replicas() == [0])
+        rid = router.submit([1, 2], max_new_tokens=1, deadline_s=None)
+        assert _wait(lambda: router.inflight_on(0) == 1)
+        first.stop()
+        # same rank, new life, and it actually serves
+        second = _FakeReplica(root, 0, incarnation="b").start()
+        assert router.result(rid, timeout=15)["tokens"] == [2, 1]
+        assert router.redispatched >= 1
+        second.stop()
+
+
+def test_router_rejects_corrupt_response_and_reserves(tmp_path):
+    root = str(tmp_path)
+    rep = _FakeReplica(root, 0, corrupt_first=True).start()
+    with _router(root) as router:
+        assert _wait(lambda: router.healthy_replicas() == [0])
+        rid = router.submit([5, 6, 7], max_new_tokens=2, deadline_s=None)
+        resp = router.result(rid, timeout=15)
+        assert resp["tokens"] == [7, 6, 5]          # re-served, verified
+        assert resp["sha256"] == response_sha256(resp)
+        assert router.corrupt_responses == 1
+    rep.stop()
+
+
+def test_corrupt_response_after_reclaim_not_requeued_twice(tmp_path):
+    """A corrupt response racing its replica's death must not re-queue
+    the request a second time — the death reclaim already did; a second
+    copy would dispatch the request twice and leak the losing replica's
+    decode slot."""
+    root = str(tmp_path)
+    dead = _FakeReplica(root, 0, serve=False).start()
+    with _router(root) as router:
+        assert _wait(lambda: router.healthy_replicas() == [0])
+        rid = router.submit([1, 2, 3], max_new_tokens=1, deadline_s=None)
+        assert _wait(lambda: router.inflight_on(0) == 1)
+        dead.stop()                  # replica dies; the reclaim re-queues
+        assert _wait(lambda: router.redispatched >= 1)
+        # ...and only NOW the dead life's corrupt response surfaces
+        payload = {"id": rid, "tokens": [9], "model_version": 1,
+                   "replica": 0, "sha256": "0" * 64}
+        rp = os.path.join(root, "responses", rid + ".json")
+        with open(rp + ".tmp", "w") as f:
+            json.dump(payload, f)
+        os.replace(rp + ".tmp", rp)
+        assert _wait(lambda: router.corrupt_responses >= 1)
+        with router._lock:
+            copies = list(router._pending).count(rid)
+        assert copies == 1
+        # a live replica then serves the single copy to completion
+        live = _FakeReplica(root, 1, incarnation="b").start()
+        assert router.result(rid, timeout=15)["tokens"] == [3, 2, 1]
+        assert not router.open_requests()
+        live.stop()
+
+
+def test_replica_answers_poison_request_with_signed_error(tmp_path):
+    """An admitted request that violates the engine's position budget
+    must NOT crash the replica — the router would re-dispatch the poison
+    to the next replica and cascade the crash through the fleet. The
+    replica answers it with a SIGNED error response instead (the
+    zero-drop contract is 'every accepted request gets a verified
+    response')."""
+    from dear_pytorch_tpu.serving.replica import ReplicaServer
+
+    model, params = _gpt()
+    engine = DecodeEngine(model, params, slots=2)
+    root = str(tmp_path)
+    srv = ReplicaServer(root, 0, engine, version=1)
+    inbox = os.path.join(root, "replicas", "0", "inbox")
+    with open(os.path.join(inbox, "poison01.json"), "w") as f:
+        json.dump({"id": "poison01", "prompt": list(range(30)),
+                   "max_new_tokens": 10}, f)   # 40 > 32-position budget
+    srv._take_requests()
+    assert engine.active == 0          # the poison never entered a slot
+    with open(os.path.join(root, "responses", "poison01.json")) as f:
+        resp = json.load(f)
+    assert resp["sha256"] == response_sha256(resp)
+    assert resp["tokens"] == [] and "error" in resp
+    # the replica survived: a well-formed request still serves
+    with open(os.path.join(inbox, "ok01.json"), "w") as f:
+        json.dump({"id": "ok01", "prompt": [1, 2],
+                   "max_new_tokens": 1}, f)
+    assert srv._take_requests() == 1
+    assert engine.active == 1
+
+
+def test_router_counts_weight_swap(tmp_path):
+    root = str(tmp_path)
+    v1 = _FakeReplica(root, 0, version=1, incarnation="a").start()
+    with _router(root) as router:
+        assert _wait(lambda: router.fleet_versions().get(0) == 1)
+        v1.stop()
+        v2 = _FakeReplica(root, 0, version=2, incarnation="b").start()
+        assert _wait(lambda: router.fleet_versions().get(0) == 2)
+        assert router.weight_swaps == 1
+        v2.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving fault grammar (resilience.inject satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_slow_and_corrupt_resp_faults():
+    from dear_pytorch_tpu.resilience.inject import parse_faults
+
+    faults = parse_faults("slow@3:0.05:r1,corrupt_resp@5")
+    assert faults[0].kind == "slow" and faults[0].step == 3
+    assert faults[0].arg == pytest.approx(0.05) and faults[0].rank == 1
+    assert faults[1].kind == "corrupt_resp" and faults[1].rank is None
+
+
+def test_slow_fault_is_persistent(monkeypatch):
+    """``slow`` arms a PERSISTENT per-step latency (a straggler), unlike
+    ``hang``'s one-shot sleep."""
+    from dear_pytorch_tpu.resilience import inject as INJ
+
+    sleeps = []
+    monkeypatch.setattr(INJ.time, "sleep", sleeps.append)
+    inj = INJ.FaultInjector(
+        [INJ.Fault(kind="slow", step=2, arg=0.05)], own_rank=0)
+    inj.before_step(1)
+    assert sleeps == []
+    inj.before_step(2)
+    inj.before_step(3)
+    assert sleeps == [0.05, 0.05] and inj.slow_s == pytest.approx(0.05)
+    assert inj.pending == 0
+
+
+def test_slow_fault_rank_targeted_skip(monkeypatch):
+    from dear_pytorch_tpu.resilience import inject as INJ
+
+    sleeps = []
+    monkeypatch.setattr(INJ.time, "sleep", sleeps.append)
+    inj = INJ.FaultInjector(
+        [INJ.Fault(kind="slow", step=1, arg=0.5, rank=1)], own_rank=0)
+    inj.before_step(1)
+    assert sleeps == [] and inj.slow_s == 0.0
+    assert [f.kind for f in inj.skipped] == ["slow"]
+
+
+def test_corrupt_resp_fault_fires_once():
+    from dear_pytorch_tpu.resilience import inject as INJ
+
+    inj = INJ.FaultInjector(
+        [INJ.Fault(kind="corrupt_resp", step=2)], own_rank=0)
+    data = b'{"id": "x", "tokens": [1, 2], "sha256": "abc"}'
+    assert inj.corrupt_payload(1, data) == data
+    flipped = inj.corrupt_payload(2, data)
+    assert flipped != data and flipped[16:] == data[16:]
+    assert inj.corrupt_payload(3, data) == data
+    assert inj.pending == 0
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(560, method="signal")
+def test_chaos_check_serve_storm(tmp_path):
+    """scripts/chaos_check.py --serve: the fault-tolerant serving fleet
+    gate (ISSUE-11 acceptance). A 2-replica supervised fleet absorbs an
+    overload burst (explicit 429-style shedding + decorrelated-jitter
+    client retries), a SIGKILL mid-traffic (in-flight requests
+    re-dispatched — zero accepted-then-lost), a checksum-corrupted
+    response, a rolling weight swap through the drain/backfill protocol
+    with the fleet continuously serving, and a capacity scale-up to 3 —
+    all machine-checked, ending in `bench_gate.py --slo` holding a
+    throughput floor AND a p99-latency ceiling across the storm."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "chaos_check.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, "--serve", "--workdir", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=520,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]
