@@ -1,0 +1,33 @@
+"""Online continual learning: the bridge between the serving fleet and
+the continuous-training service (docs/ONLINE.md).
+
+  - `feedback` — durable append-only feedback log on the object-store
+                 waist: bounded-buffer writers off the decode hot path,
+                 manifest-LAST segment commits, a damage-tolerant
+                 deduplicating reader with an explicit cursor
+  - `ingest`   — `FeedbackIngest`: the log as a growing dataset behind
+                 the `runtime.pipeline` contract — cursor in every
+                 checkpoint sidecar (exactly-once under rollback /
+                 reshard / cold start), base-batch blending when the
+                 trainer outruns the log
+  - `publish`  — `VersionPublisher`: cadenced weight publishing through
+                 `serving.weights` with cursor provenance, closing the
+                 loop via the router's rolling drain+backfill swap
+
+Submodules import lazily so the jax-free pieces stay importable from
+router/supervisor-side processes that never touch a device.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_SUBMODULES = ("feedback", "ingest", "publish")
+
+__all__ = list(_SUBMODULES)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

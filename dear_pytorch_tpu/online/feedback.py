@@ -1,0 +1,612 @@
+"""Durable feedback log over the object-store waist.
+
+Serving replicas generate *data*, not just traffic: every answered
+request is a (prompt, response, feedback) record the trainer wants back.
+This module is the durable pipe between the two fleets — an append-only
+segmented log on `utils.objectstore` (the same seven-method waist the
+checkpoint streamer and `serving.weights` publish through), with the
+serving side never blocking and the training side never crashing on
+damaged data:
+
+  - **Writer** (`FeedbackWriter`, one per serving replica): ``append``
+    pushes a record into a *bounded* in-memory buffer and returns — the
+    decode hot path never touches the store. A background flusher batches
+    records into segments and commits them with the **manifest-LAST**
+    protocol (`serving.weights` / `CheckpointStreamer` rule): payload
+    object first, then a sha256+count manifest whose presence IS the
+    commit. The manifest is published with
+    `LocalObjectStore.put_bytes_if_absent` (first-writer-wins), so a
+    duplicate publication — a crash-retry re-flushing the same segment
+    id — is idempotent. Store failures go through `resilience.retry`
+    backoff; exhaustion *counts* (``online.flush_errors``,
+    ``online.records_dropped_flush``) and drops that segment, it never
+    raises into serving.
+  - **Reader** (`FeedbackReader`, the ingest side): walks each writer's
+    segments in committed order, re-verifies the sha256, and **walks
+    past** torn or corrupt segments (payload without manifest, checksum
+    mismatch — ``online.records_dropped_torn``) instead of crashing;
+    duplicate records (at-least-once producer retries, the
+    ``dup_feedback`` fault) are absorbed by a monotonic per-writer
+    sequence (``online.dedup_hits``). The read position is an explicit
+    `Cursor` the caller persists (`online.ingest` puts it in every
+    checkpoint sidecar) — replaying from a restored cursor re-yields
+    exactly the records consumed after it, which is what makes
+    exactly-once ingest a checkpoint property instead of a protocol.
+
+Key layout (all under one stream prefix)::
+
+    feedback/<stream>/<writer>/seg_00000007.jsonl   records, one JSON/line
+    feedback/<stream>/<writer>/seg_00000007.json    manifest, written LAST
+
+Each **writer id owns its subtree** (single-writer streams): segment
+numbers and record sequences are writer-local and strictly monotonic, so
+manifests commit in order — which is what makes "manifest missing but a
+LATER manifest exists" a *permanent* verdict (torn), never a pending
+write. Record uids are ``<writer>:<seq>``.
+
+Honest caveats (docs/ONLINE.md): a torn segment's records are **lost**
+(the log is durable at segment granularity — serving never blocks on the
+store, so a crash mid-commit costs one buffer); a full append buffer
+drops the newest record (``online.append_drops``) rather than stall a
+decode tick.
+
+Numpy-free, jax-free, stdlib + the store only: importable from the
+jax-free router/parent processes and from
+`scripts/check_telemetry_overhead.py`'s standalone harness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.resilience.retry import RetryError, retry_call
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+__all__ = ["FeedbackWriter", "FeedbackReader", "Cursor", "record_digest",
+           "STREAM_PREFIX"]
+
+STREAM_PREFIX = "feedback"
+
+_SEG_RE = re.compile(r"seg_(\d{8})\.(jsonl|json)$")
+
+
+def _seg_payload_key(stream: str, writer: str, seg: int) -> str:
+    return f"{STREAM_PREFIX}/{stream}/{writer}/seg_{seg:08d}.jsonl"
+
+
+def _seg_manifest_key(stream: str, writer: str, seg: int) -> str:
+    return f"{STREAM_PREFIX}/{stream}/{writer}/seg_{seg:08d}.json"
+
+
+def record_digest(writer: str, seq: int) -> int:
+    """Order-independent per-record digest: the ingest's running checksum
+    is the SUM of these mod 2**64, so an auditor can recompute it from
+    the log alone without replaying the consumer's interleaving across
+    writers — equality proves the exact unique-record *set* was consumed,
+    no gaps, no dups (collision odds are sha256's)."""
+    h = hashlib.sha256(f"{writer}:{int(seq)}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+
+class FeedbackWriter:
+    """Append-only single-writer feedback stream with an off-hot-path
+    background flusher. One instance per serving replica; ``writer_id``
+    must be stable across the replica's incarnations (the relaunched
+    process resumes the same stream at the committed tail)."""
+
+    def __init__(self, store, *, writer_id: str, stream: str = "main",
+                 max_buffer: int = 1024, flush_records: int = 32,
+                 flush_interval_s: float = 0.5, injector=None,
+                 retry_attempts: int = 3, start: bool = True):
+        self.store = store
+        self.stream = str(stream)
+        self.writer_id = str(writer_id)
+        self.max_buffer = int(max_buffer)
+        self.flush_records = max(int(flush_records), 1)
+        self.flush_interval_s = float(flush_interval_s)
+        self.injector = injector
+        self.retry_attempts = int(retry_attempts)
+        self._lock = threading.Lock()
+        # flush is single-writer by protocol (segment numbers must be
+        # claimed in order); serialize it so a manual flush racing the
+        # background flusher cannot interleave two segments' commits
+        self._flush_lock = threading.Lock()
+        self._buffer: List[dict] = []
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # resume at the committed tail: seq after the newest committed
+        # manifest's last_seq, segment after the newest payload OR
+        # manifest (a torn tail segment's number is not reused, so its
+        # lost seq range stays unambiguous in the record history)
+        self._next_seg, self._next_seq = self._scan_tail()
+        self._appends = 0          # injector step clock (dup_feedback)
+        self._flushes = 0          # injector step clock (torn_seg)
+        self._last_committed: Optional[dict] = None
+        self._dup_pending = False
+        # plain-int accounting (works with telemetry disabled)
+        self.appended = 0
+        self.committed = 0
+        self.dropped_flush = 0
+        self.append_drops = 0
+        self.flush_errors = 0
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"feedback-flusher-{self.writer_id}")
+            self._thread.start()
+
+    # -- tail discovery ------------------------------------------------------
+
+    def _scan_tail(self) -> Tuple[int, int]:
+        prefix = f"{STREAM_PREFIX}/{self.stream}/{self.writer_id}/"
+        next_seg, next_seq = 0, 0
+        try:
+            keys = self.store.list(prefix)
+        except Exception:  # noqa: BLE001 — an unreachable store at boot
+            #               degrades to a fresh stream; commits will retry
+            return 0, 0
+        for key in keys:
+            m = _SEG_RE.search(key)
+            if not m:
+                continue
+            next_seg = max(next_seg, int(m.group(1)) + 1)
+            if m.group(2) == "json":
+                try:
+                    man = json.loads(self.store.get_bytes(key))
+                    next_seq = max(next_seq, int(man["last_seq"]) + 1)
+                except (KeyError, ValueError, TypeError):
+                    continue
+        return next_seg, next_seq
+
+    # -- the serving-side hot path -------------------------------------------
+
+    def append(self, record: dict) -> bool:
+        """Enqueue one record (dict of JSON-safe fields; ``uid``/``seq``/
+        ``writer``/``ts`` are stamped here). Never blocks, never raises
+        into the caller: a full buffer drops the NEW record and counts
+        ``online.append_drops``. Returns False on a drop."""
+        self._appends += 1
+        if (self.injector is not None
+                and self.injector.duplicate_feedback(self._appends)):
+            # an at-least-once producer retry: re-append the last
+            # COMMITTED record verbatim (same uid/seq) — the reader's
+            # dedup, not the writer, must absorb it
+            self._dup_pending = True
+        with self._lock:
+            if len(self._buffer) >= self.max_buffer:
+                self.append_drops += 1
+                tr = _telemetry.get_tracer()
+                if tr.enabled:
+                    tr.count("online.append_drops")
+                return False
+            rec = dict(record)
+            rec["writer"] = self.writer_id
+            rec["seq"] = self._next_seq
+            rec["uid"] = f"{self.writer_id}:{self._next_seq}"
+            rec["ts"] = time.time()
+            self._next_seq += 1
+            self._buffer.append(rec)
+            if self._dup_pending and self._last_committed is not None:
+                self._buffer.append(dict(self._last_committed))
+                self._dup_pending = False
+            self.appended += 1
+            full = len(self._buffer) >= self.flush_records
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("online.records_appended")
+        if full:
+            self._wake.set()
+        return True
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._buffer)
+
+    # -- the background flusher ----------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.flush_interval_s)
+            self._wake.clear()
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — the flusher must outlive
+                #               any single bad segment; flush() already
+                #               accounts its own failures
+                logger.exception("feedback: flusher pass failed; continuing")
+        # final drain on close
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001
+            logger.exception("feedback: final flush failed")
+
+    def flush(self) -> int:
+        """Commit the buffered records as one segment (payload, then the
+        manifest LAST). Returns how many records were committed. Store
+        failures retry with backoff; exhaustion drops the segment and
+        counts — **never raises** (the serving loop above must survive a
+        dead store)."""
+        with self._flush_lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> int:
+        with self._lock:
+            if not self._buffer:
+                return 0
+            records, self._buffer = self._buffer, []
+        self._flushes += 1
+        seg = self._next_seg
+        self._next_seg += 1
+        payload = ("\n".join(json.dumps(r, sort_keys=True)
+                             for r in records) + "\n").encode()
+        # first/last are MIN/MAX, not positional: a duplicate re-append
+        # (always inserted after the newest record) would otherwise
+        # understate last_seq, and a relaunched writer resuming at
+        # last_seq+1 would re-stamp already-committed seq numbers that
+        # every reader then silently dedup-drops
+        seqs = [int(r["seq"]) for r in records]
+        manifest = json.dumps({
+            "segment": seg,
+            "writer": self.writer_id,
+            "count": len(records),
+            "first_seq": min(seqs),
+            "last_seq": max(seqs),
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "ts": time.time(),
+        }).encode()
+        torn = (self.injector is not None
+                and self.injector.torn_segment(self._flushes))
+        tr = _telemetry.get_tracer()
+        try:
+            retry_call(self.store.put_bytes,
+                       _seg_payload_key(self.stream, self.writer_id, seg),
+                       payload, attempts=self.retry_attempts,
+                       base_delay_s=0.05, max_delay_s=0.5,
+                       retry_on=(OSError,), name="feedback.segment_payload")
+            if torn:
+                # crash between the two writes of the manifest-LAST
+                # protocol: payload on disk, commit marker never — the
+                # reader must walk past this segment
+                logger.warning(
+                    "feedback: injected torn segment %s/%s/seg_%08d "
+                    "(%d records lost)", self.stream, self.writer_id, seg,
+                    len(records))
+                return 0
+            # manifest LAST, first-writer-wins: a duplicate publication
+            # of the same segment id (crash-retry) is idempotent. Same
+            # retry budget as the payload — a transient error here would
+            # otherwise permanently tear a segment whose payload already
+            # landed
+            retry_call(self.store.put_bytes_if_absent,
+                       _seg_manifest_key(self.stream, self.writer_id, seg),
+                       manifest, attempts=self.retry_attempts,
+                       base_delay_s=0.05, max_delay_s=0.5,
+                       retry_on=(OSError,), name="feedback.segment_commit")
+        except (RetryError, OSError) as exc:
+            self.flush_errors += 1
+            self.dropped_flush += len(records)
+            if tr.enabled:
+                tr.count("online.flush_errors")
+                tr.count("online.records_dropped_flush", len(records))
+                tr.event("online.flush_error", segment=seg,
+                         records=len(records),
+                         error=type(exc).__name__)
+            logger.error(
+                "feedback: segment %d flush exhausted retries (%s); %d "
+                "records dropped, serving continues", seg, exc,
+                len(records))
+            return 0
+        self.committed += len(records)
+        self._last_committed = dict(records[-1])
+        if tr.enabled:
+            tr.count("online.records_committed", len(records))
+            tr.event("online.segment_committed", segment=seg,
+                     records=len(records))
+        return len(records)
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop the flusher after a final drain."""
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        else:
+            self.flush()
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _WriterPos:
+    """One writer's read position: next segment to open, line offset
+    into it, the dedup high-water seq, and consumed count."""
+
+    seg: int = 0
+    off: int = 0
+    max_seq: int = -1
+    consumed: int = 0
+
+    def to_dict(self) -> dict:
+        return {"seg": self.seg, "off": self.off,
+                "max_seq": self.max_seq, "consumed": self.consumed}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "_WriterPos":
+        return cls(seg=int(d["seg"]), off=int(d["off"]),
+                   max_seq=int(d["max_seq"]), consumed=int(d["consumed"]))
+
+
+class Cursor:
+    """The deterministic ingest position: a per-writer (segment, offset,
+    max-seq) map plus roll-up accounting. JSON-safe (`to_dict` /
+    `from_dict`) so it rides checkpoint sidecars; restoring a cursor and
+    re-reading yields exactly the records consumed after it."""
+
+    def __init__(self):
+        self.writers: Dict[str, _WriterPos] = {}
+        self.consumed_total = 0
+        self.dedup_hits = 0
+        self.torn_segments = 0
+        #: manifest-counted records lost to corrupt-payload segments the
+        #: cursor walked past — committed_records() includes them, so lag
+        #: math must subtract them or it never returns to zero
+        self.dropped_committed = 0
+        self.checksum = 0  # sum of record_digest() mod 2**64
+
+    def to_dict(self) -> dict:
+        return {
+            "writers": {w: p.to_dict() for w, p in self.writers.items()},
+            "consumed_total": self.consumed_total,
+            "dedup_hits": self.dedup_hits,
+            "torn_segments": self.torn_segments,
+            "dropped_committed": self.dropped_committed,
+            "checksum": str(self.checksum),  # > 2**53: travels as string
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Cursor":
+        c = cls()
+        c.writers = {w: _WriterPos.from_dict(p)
+                     for w, p in (d.get("writers") or {}).items()}
+        c.consumed_total = int(d.get("consumed_total", 0))
+        c.dedup_hits = int(d.get("dedup_hits", 0))
+        c.torn_segments = int(d.get("torn_segments", 0))
+        c.dropped_committed = int(d.get("dropped_committed", 0))
+        c.checksum = int(d.get("checksum", 0))
+        return c
+
+    def copy(self) -> "Cursor":
+        return Cursor.from_dict(self.to_dict())
+
+
+class FeedbackReader:
+    """Ordered, deduplicating, damage-tolerant reads over every writer's
+    stream. Stateless between calls — the `Cursor` the caller passes (and
+    persists) is the only position."""
+
+    def __init__(self, store, *, stream: str = "main",
+                 discover_every: int = 16):
+        self.store = store
+        self.stream = str(stream)
+        # frontier fast path: advance each known writer by probing
+        # exists(next manifest) — O(writers) per call — with a FULL
+        # listing every ``discover_every`` calls to pick up brand-new
+        # writers and to jump numbering gaps the probe cannot see (a
+        # dropped or torn segment may have no objects at all, so a
+        # bounded-lookahead probe would stall below it forever)
+        self.discover_every = max(int(discover_every), 1)
+        self._frontier: Dict[str, int] = {}
+        self._frontier_calls = 0
+        # committed objects are immutable (manifest-LAST, single-writer
+        # streams): cache manifests forever and the most recent payload
+        # per writer, so a per-step lag/availability poll costs one
+        # listing, not a re-read of the whole log
+        self._manifest_cache: Dict[Tuple[str, int], dict] = {}
+        self._payload_cache: Dict[str, Tuple[int, List[str], str]] = {}
+        # incremental committed-record accounting: per writer, prefix
+        # sums of manifest counts by segment (element i = records
+        # committed through segment i). Extended forward on demand —
+        # manifests below an observed commit are immutable, so a prefix
+        # once computed is exact for ANY frontier (a smaller consensus
+        # frontier after a larger local one must not overcount) and the
+        # per-step lag poll costs O(new segments), not O(log age)
+        self._cum_counts: Dict[str, List[int]] = {}
+
+    # -- discovery -----------------------------------------------------------
+
+    def frontier(self, *, full: bool = False) -> Dict[str, int]:
+        """Per-writer newest COMMITTED segment number (manifest
+        present). This is the consensus unit: every rank of a trainer
+        fleet exchanges its local frontier and reads up to the fleet
+        MIN — manifests at or below an observed frontier are immutable
+        (single-writer streams commit manifests in order), so two ranks
+        reading to the same frontier read identical data.
+
+        Cost: O(writers) ``exists`` probes per call (commits are
+        in-order, so the frontier advances one manifest at a time), with
+        a full listing every ``discover_every``-th call for writer
+        discovery and gap jumps — the per-step poll of a long-lived
+        service must not re-list the whole log's history. Probes can
+        NEVER advance past a numbering gap (a dropped/torn segment),
+        only the discovery listing can — so a caller that needs the
+        definitive frontier NOW (a one-shot audit, a drain decision)
+        must pass ``full=True`` rather than hope its call lands on the
+        discovery cadence."""
+        self._frontier_calls += 1
+        # calls 1, N+1, 2N+1, ... run discovery — the (calls-1) % N form
+        # keeps the extreme discover_every=1 meaning "every call", where
+        # `% N == 1` would invert it into "never"
+        if full or (self._frontier_calls - 1) % self.discover_every == 0 \
+                or not self._frontier:
+            prefix = f"{STREAM_PREFIX}/{self.stream}/"
+            for key in self.store.list(prefix):
+                m = _SEG_RE.search(key)
+                if not m or m.group(2) != "json":
+                    continue
+                writer = key[len(prefix):].split("/", 1)[0]
+                seg = int(m.group(1))
+                if self._frontier.get(writer, -1) < seg:
+                    self._frontier[writer] = seg
+        else:
+            for writer, top in self._frontier.items():
+                while self.store.exists(
+                        _seg_manifest_key(self.stream, writer, top + 1)):
+                    top += 1
+                self._frontier[writer] = top
+        return dict(self._frontier)
+
+    def committed_records(self,
+                          frontier: Optional[Dict[str, int]] = None) -> int:
+        """Total records in committed segments (manifest counts summed,
+        duplicates included) up to ``frontier`` — the log-side half of
+        the exactly-once ledger. Incremental via per-writer prefix sums
+        (manifests below the frontier are immutable), so the per-step
+        lag poll costs O(new segments), not O(log age), and stays exact
+        for any — even a smaller consensus — frontier."""
+        if frontier is None:
+            frontier = self.frontier()
+        total = 0
+        for writer, top in frontier.items():
+            cum = self._cum_counts.setdefault(writer, [])
+            while len(cum) <= top:
+                man = self._manifest(writer, len(cum))
+                n = 0 if man is None else int(man.get("count", 0))
+                cum.append((cum[-1] if cum else 0) + n)
+            total += cum[top] if top >= 0 else 0
+        return total
+
+    def _manifest(self, writer: str, seg: int) -> Optional[dict]:
+        cached = self._manifest_cache.get((writer, seg))
+        if cached is not None:
+            return cached
+        try:
+            man = json.loads(self.store.get_bytes(
+                _seg_manifest_key(self.stream, writer, seg)))
+        except (KeyError, ValueError, TypeError):
+            return None  # absence is NOT cached: the commit may land
+        self._manifest_cache[(writer, seg)] = man
+        return man
+
+    def _payload(self, writer: str, seg: int
+                 ) -> Tuple[Optional[List[str]], Optional[str]]:
+        """(lines, sha256-of-raw-bytes) — the digest is over the exact
+        stored bytes, so verification cannot be fooled by decode
+        normalization."""
+        cached = self._payload_cache.get(writer)
+        if cached is not None and cached[0] == seg:
+            return cached[1], cached[2]
+        try:
+            raw = self.store.get_bytes(
+                _seg_payload_key(self.stream, writer, seg))
+        except KeyError:
+            return None, None
+        lines = raw.decode(errors="replace").splitlines()
+        digest = hashlib.sha256(raw).hexdigest()
+        self._payload_cache[writer] = (seg, lines, digest)
+        return lines, digest
+
+    # -- the read ------------------------------------------------------------
+
+    def take(self, cursor: Cursor, frontier: Dict[str, int],
+             max_records: int) -> List[dict]:
+        """Advance ``cursor`` by up to ``max_records`` NEW records, in
+        writer-sorted order, never past ``frontier``. Torn/corrupt
+        segments strictly below the frontier are walked past (their seg
+        number can no longer commit — single-writer manifests commit in
+        order); duplicates are dropped by the per-writer monotonic seq.
+        Mutates ``cursor`` in place and returns the records consumed —
+        the caller persists the cursor WITH the model state it trained,
+        which is what makes consumption exactly-once under rollback."""
+        tr = _telemetry.get_tracer()
+        out: List[dict] = []
+        for writer in sorted(frontier):
+            top = frontier[writer]
+            pos = cursor.writers.setdefault(writer, _WriterPos())
+            while len(out) < max_records and pos.seg <= top:
+                man = self._manifest(writer, pos.seg)
+                lines, digest = self._payload(writer, pos.seg)
+                if man is None or lines is None \
+                        or digest != man.get("sha256"):
+                    # torn (no manifest / no payload) or corrupt (sha
+                    # mismatch): permanent below the frontier — walk past
+                    dropped = len(lines) - pos.off if lines else 0
+                    cursor.torn_segments += 1
+                    if man is not None:
+                        # committed-but-corrupt: committed_records()
+                        # counts this manifest, so the lag ledger must
+                        # write these records off or it never drains
+                        cursor.dropped_committed += int(
+                            man.get("count", 0))
+                    if tr.enabled:
+                        tr.count("online.segments_dropped_torn")
+                        if dropped > 0:
+                            tr.count("online.records_dropped_torn",
+                                     dropped)
+                        tr.event("online.torn_segment", writer=writer,
+                                 segment=pos.seg, records=dropped)
+                    logger.warning(
+                        "feedback: walking past torn/corrupt segment "
+                        "%s/seg_%08d (~%d records lost)", writer, pos.seg,
+                        dropped)
+                    pos.seg += 1
+                    pos.off = 0
+                    continue
+                while pos.off < len(lines) and len(out) < max_records:
+                    try:
+                        rec = json.loads(lines[pos.off])
+                        seq = int(rec["seq"])
+                    except (ValueError, KeyError, TypeError):
+                        pos.off += 1
+                        continue  # unparseable line in a verified
+                        #           segment: impossible short of store
+                        #           bugs; skip, never crash
+                    pos.off += 1
+                    if seq <= pos.max_seq:
+                        cursor.dedup_hits += 1
+                        if tr.enabled:
+                            tr.count("online.dedup_hits")
+                        continue
+                    pos.max_seq = seq
+                    pos.consumed += 1
+                    cursor.consumed_total += 1
+                    cursor.checksum = (
+                        cursor.checksum + record_digest(writer, seq)
+                    ) % (1 << 64)
+                    out.append(rec)
+                if pos.off >= len(lines):
+                    pos.seg += 1
+                    pos.off = 0
+            if len(out) >= max_records:
+                break
+        if out and tr.enabled:
+            tr.count("online.records_trained", len(out))
+        return out
+
+    def drained(self, cursor: Cursor, frontier: Dict[str, int]) -> bool:
+        """True when ``cursor`` sits past every committed segment of
+        ``frontier`` — nothing left to consume without new commits."""
+        for writer, top in frontier.items():
+            pos = cursor.writers.get(writer)
+            if pos is None or pos.seg <= top:
+                return False
+        return True

@@ -1,0 +1,198 @@
+"""Exactly-once streaming ingest: the feedback log as a *growing*
+training dataset.
+
+`FeedbackIngest` extends `runtime.pipeline`'s resumable pipeline contract
+(``next`` / ``state_dict`` / ``load_state_dict`` / ``reshard`` — the
+exact surface `utils.guard.GuardedTrainer` persists into every checkpoint
+sidecar and re-seats on rollback) to an append-only log that outruns or
+lags the trainer:
+
+  - Every ``next()`` draws one **base** batch from the wrapped pipeline
+    and consumes up to ``batch_records`` NEW feedback records at the
+    ingest `Cursor` (`online.feedback.FeedbackReader.take`); ``batch_fn``
+    embeds the records into the base batch. When the trainer outruns the
+    log, the shortfall is simply more base (synthetic) rows — training
+    **blends instead of stalling** (``online.blend_batches``). When the
+    log outruns the trainer, the cursor falls behind gracefully and the
+    lag is exported as a gauge-style counter (``online.ingest_lag`` is
+    counted by delta, so the exported total IS the current lag — the
+    `cluster.epoch` idiom).
+  - The cursor — per-writer (segment, offset, max-seq) plus the roll-up
+    accounting (consumed_total, dedup_hits, torn_segments, an
+    order-independent checksum) — lives INSIDE ``state_dict()``, next to
+    the base pipeline's own position. A guard rollback, an elastic
+    membership transition, or a cold start therefore restores data
+    position and model state **transactionally**: records trained after
+    the restored checkpoint are re-consumed exactly once, records trained
+    before it are never replayed. Exactly-once is a checkpoint property,
+    not a protocol.
+  - On a replicated trainer fleet the feed/blend decision must be
+    byte-identical on every rank (the desync sentinel compares loss
+    fingerprints). ``consensus_fn`` — typically one
+    `ElasticCluster.exchange` returning the per-writer MIN frontier —
+    pins every rank to the same availability snapshot; manifests at or
+    below an observed frontier are immutable (single-writer streams
+    commit in order), so same frontier ⇒ same records. Without a
+    cluster, the local frontier is the consensus.
+  - ``reshard(index, world, epoch)`` (the guard's membership-transition
+    call) folds the epoch into the base stream but deliberately keeps
+    the ingest **replica-global** (shard 0 of 1): the host-level fleet
+    trains replica-identical batches (the chaos-harness convention), so
+    the cursor is one fleet-wide position every member derives
+    identically. Per-shard feedback partitioning is a named follow-up in
+    docs/ONLINE.md, not silently absent.
+
+Telemetry on the step path uses the standard two-lookup disabled gate
+(budgeted by scripts/check_telemetry_overhead.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.online.feedback import Cursor, FeedbackReader
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+__all__ = ["FeedbackIngest"]
+
+
+class FeedbackIngest:
+    """Pipeline wrapper blending a base (synthetic) stream with the
+    feedback log at a checkpointed cursor.
+
+    ``batch_fn(base_batch, records)`` must be a deterministic pure
+    function — same base batch + same records ⇒ same training batch on
+    every rank and on every replay.
+    """
+
+    def __init__(self, base, reader: FeedbackReader, *,
+                 batch_records: int,
+                 batch_fn: Callable[[dict, List[dict]], dict],
+                 consensus_fn: Optional[
+                     Callable[[Dict[str, int]], Dict[str, int]]] = None):
+        self.base = base
+        self.reader = reader
+        self.batch_records = int(batch_records)
+        self.batch_fn = batch_fn
+        self.consensus_fn = consensus_fn
+        self.cursor = Cursor()
+        self._epoch = 0
+        self._last_lag = 0
+        #: force full-discovery frontiers (instead of the O(writers)
+        #: exists-probe fast path, which cannot jump a torn segment's
+        #: numbering gap until the next discovery listing). A trainer
+        #: daemon sets this once it intends to DRAIN the log — the
+        #: drained verdict must rest on the definitive frontier. Local
+        #: views may differ across ranks; the consensus merge keeps the
+        #: fleet deterministic either way.
+        self.full_frontier = False
+        #: refreshed every ``next()``: the fleet-agreed frontier and
+        #: whether the cursor sits at its end (exchange fodder for a
+        #: trainer daemon's consensus exit decision)
+        self.last_frontier: Dict[str, int] = {}
+        self.last_drained = True
+        self.last_records = 0
+
+    # -- the step-path fetch -------------------------------------------------
+
+    def next(self, timeout_ms: int = 10_000) -> dict:
+        base = self.base.next(timeout_ms)
+        frontier = self.reader.frontier(full=self.full_frontier)
+        if self.consensus_fn is not None:
+            frontier = self.consensus_fn(frontier) or {}
+        self.last_frontier = frontier
+        records = self.reader.take(self.cursor, frontier,
+                                   self.batch_records)
+        self.last_records = len(records)
+        self.last_drained = self.reader.drained(self.cursor, frontier)
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            lag = max(self.reader.committed_records(frontier)
+                      - self.cursor.consumed_total
+                      - self.cursor.dedup_hits
+                      - self.cursor.dropped_committed, 0)
+            # gauge-style (the cluster.epoch idiom): export the DELTA so
+            # the counter's running total is the current lag
+            if lag != self._last_lag:
+                tr.count("online.ingest_lag", lag - self._last_lag)
+                self._last_lag = lag
+            if not records:
+                tr.count("online.blend_batches")
+        return self.batch_fn(base, records)
+
+    def lag(self, frontier: Optional[Dict[str, int]] = None) -> int:
+        """Committed-but-unconsumed records behind the cursor (records
+        written off to corrupt segments excluded — a drained cursor must
+        read lag 0)."""
+        if frontier is None:
+            frontier = self.reader.frontier()
+        return max(self.reader.committed_records(frontier)
+                   - self.cursor.consumed_total - self.cursor.dedup_hits
+                   - self.cursor.dropped_committed, 0)
+
+    # -- the guard contract: sidecar state + elastic reshard ------------------
+
+    def state_dict(self) -> dict:
+        """Base-pipeline position + the ingest cursor, as one sidecar
+        payload: the guard persists it with every checkpoint and restores
+        it on every rollback, making cursor and model state move
+        together."""
+        return {
+            "backend": "feedback-ingest",
+            "base": self.base.state_dict(),
+            "cursor": self.cursor.to_dict(),
+            "epoch": self._epoch,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if state.get("backend") != "feedback-ingest":
+            # a sidecar written by a bare pipeline (the run predates the
+            # online wrapper): restore the base stream and RESET the
+            # cursor — keeping the in-memory position would leave records
+            # consumed after this checkpoint trained only into the
+            # rolled-back state and never re-consumed (re-reading from
+            # zero re-trains, which the transactional contract prefers
+            # over silently losing data)
+            logger.warning(
+                "ingest: restoring a bare-pipeline sidecar; feedback "
+                "cursor starts fresh")
+            self.base.load_state_dict(state)
+            self.cursor = Cursor()
+            return
+        self.base.load_state_dict(state["base"])
+        self.cursor = Cursor.from_dict(state.get("cursor") or {})
+        self._epoch = int(state.get("epoch", 0))
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.event("online.cursor_restored",
+                     consumed=self.cursor.consumed_total,
+                     epoch=self._epoch)
+
+    def reshard(self, shard: int, num_shards: int, *, epoch: int = 0) -> None:
+        """Membership transition: fold the epoch into the base stream but
+        keep the feed replica-global — every member of the new world must
+        train identical batches from one fleet-wide cursor (see module
+        docstring). The (shard, world) arguments are accepted for the
+        guard's pipeline contract and deliberately not used to partition
+        the feedback stream."""
+        del shard, num_shards
+        self._epoch = int(epoch)
+        self.base.reshard(0, 1, epoch=epoch)
+
+    # -- passthroughs ---------------------------------------------------------
+
+    @property
+    def produced(self) -> int:
+        return self.base.produced
+
+    def close(self) -> None:
+        self.base.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,118 @@
+"""Version advancement: the trainer side of the training↔serving loop.
+
+`VersionPublisher` periodically publishes the live parameter tree through
+`serving.weights.publish_params` (manifest-LAST versioned commits over
+the object-store waist) and stamps each version with an **ONLINE
+sidecar** recording the ingest cursor it was trained through — the
+provenance record that lets an auditor (or the `--online` chaos gate)
+compute *feedback freshness*: for any committed feedback record, which
+version first contains it, and how many seconds after its commit that
+version started serving.
+
+The serving fleet closes the loop without ever talking to the trainer:
+the router observes the store's version bump in replica heartbeats and
+the drain+backfill rolling swap (docs/SERVING.md) brings each replica up
+on the newest committed version.
+
+Version numbers are store-authoritative (``latest_version + 1``), so a
+relaunched trainer — or a rollback that re-runs a publish step — never
+reuses a number: versions only advance, and a version published from
+since-rolled-back state is simply superseded by the next publish (stale
+but intact, the same durability posture as checkpoint uploads).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Optional
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.serving import weights as W
+
+logger = logging.getLogger("dear_pytorch_tpu")
+
+__all__ = ["VersionPublisher", "ONLINE_SIDECAR", "read_online_sidecar"]
+
+ONLINE_SIDECAR = "ONLINE.json"
+
+
+def read_online_sidecar(store, version: int) -> Optional[dict]:
+    """The cursor-provenance sidecar stamped next to a published version
+    (None when missing — e.g. a version published outside the online
+    loop)."""
+    try:
+        return json.loads(store.get_bytes(
+            f"{W._PREFIX}/v{int(version):06d}/{ONLINE_SIDECAR}"))
+    except (KeyError, ValueError, TypeError):
+        return None
+
+
+class VersionPublisher:
+    """Cadenced weight publishing with cursor provenance.
+
+    ``params_fn`` returns the CURRENT host-side parameter tree (nested
+    dicts of arrays — e.g. ``lambda: jax.device_get(state.params)``);
+    ``cursor_fn`` returns the ingest cursor dict to stamp (or None).
+    Publish failures count and keep the previous version serving — the
+    trainer must survive a dead store exactly like the checkpoint
+    streamer does.
+    """
+
+    def __init__(self, store, *, publish_every: int,
+                 params_fn, cursor_fn=None):
+        self.store = store
+        self.publish_every = max(int(publish_every), 1)
+        self.params_fn = params_fn
+        self.cursor_fn = cursor_fn
+        self.published: list = []          # versions this process published
+        self.publish_failures = 0
+        self._last_publish_step: Optional[int] = None
+
+    def maybe_publish(self, step: int, *, leader: bool = True,
+                      force: bool = False) -> Optional[int]:
+        """Publish when ``step`` crosses the cadence (leader only —
+        exactly one member of a replicated fleet publishes). Returns the
+        new version number, or None when nothing was published."""
+        step = int(step)
+        if not leader:
+            return None
+        if not force:
+            if self._last_publish_step is not None \
+                    and step - self._last_publish_step < self.publish_every:
+                return None
+        tr = _telemetry.get_tracer()
+        try:
+            version = (W.latest_version(self.store) or 0) + 1
+            params = self.params_fn()
+            W.publish_params(self.store, params, version)
+            cursor = self.cursor_fn() if self.cursor_fn is not None else None
+            self.store.put_bytes(
+                f"{W._PREFIX}/v{version:06d}/{ONLINE_SIDECAR}",
+                json.dumps({
+                    "version": version,
+                    "step": step,
+                    "cursor": cursor,
+                    "published_ts": time.time(),
+                }).encode())
+        except Exception as exc:  # noqa: BLE001 — a dead store must not
+            #               kill the training loop; the previous version
+            #               keeps serving, the next cadence retries
+            self.publish_failures += 1
+            if tr.enabled:
+                tr.count("online.publish_failures")
+                tr.event("online.publish_failed", step=step,
+                         error=type(exc).__name__)
+            logger.error("publish: version publish failed at step %d: %s",
+                         step, exc)
+            return None
+        self._last_publish_step = step
+        self.published.append(version)
+        if tr.enabled:
+            tr.count("online.versions_published")
+            tr.event("online.version_published", version=version,
+                     step=step)
+        logger.info("publish: version %d published at step %d",
+                    version, step)
+        return version

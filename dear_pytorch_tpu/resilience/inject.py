@@ -30,6 +30,17 @@ Fault kinds (all fire exactly once per scheduled entry):
                     `corrupt_payload` per response), so the router's
                     sha256 verification must catch and re-dispatch it;
                     a training run never consumes this kind
+  ``torn_seg``      feedback-log only: the Nth segment FLUSH publishes
+                    its payload but never its manifest (a crash between
+                    the two writes of the manifest-LAST commit), and the
+                    buffered records are lost with it — the ingest reader
+                    must walk past the torn segment, never crash
+                    (`online.feedback` drives `torn_segment` per flush)
+  ``dup_feedback``  feedback-log only: the Nth record APPEND re-appends
+                    an already-committed record verbatim (an at-least-
+                    once producer retry), so the reader's seq-based dedup
+                    must absorb it (`online.feedback` drives
+                    `duplicate_feedback` per append)
 
 Enable from the environment — ``DEAR_FAULTS="nan@6,exc@9,hang@12:0.5,
 ckpt_corrupt@15,preempt@18"`` — or construct a `FaultInjector` in code and
@@ -63,7 +74,7 @@ logger = logging.getLogger("dear_pytorch_tpu")
 FAULT_ENV = "DEAR_FAULTS"
 
 KINDS = ("nan", "exc", "hang", "slow", "ckpt_corrupt", "preempt",
-         "corrupt_resp")
+         "corrupt_resp", "torn_seg", "dup_feedback")
 
 __all__ = [
     "FAULT_ENV", "KINDS", "Fault", "InjectedFault", "FaultInjector",
@@ -351,6 +362,24 @@ class FaultInjector:
                     f"poison ({exc}); degraded to a step error"
                 ) from None
         return batch
+
+    def torn_segment(self, flush_no: int) -> bool:
+        """True when a due ``torn_seg`` fault fires for this segment
+        flush (the feedback writer's flush counter is the step clock) —
+        the writer then publishes the segment payload WITHOUT its
+        manifest and drops the buffered records, simulating a crash
+        between the two writes of the manifest-LAST commit protocol.
+        The data-path analog of ``ckpt_corrupt``: what must survive is
+        the READER (`online.feedback.FeedbackReader` walks past)."""
+        return bool(self._take(flush_no, ("torn_seg",)))
+
+    def duplicate_feedback(self, append_no: int) -> bool:
+        """True when a due ``dup_feedback`` fault fires for this record
+        append (the feedback writer's append counter is the step clock) —
+        the writer then re-appends an already-committed record verbatim,
+        an at-least-once producer retry the reader's monotonic-seq dedup
+        must absorb exactly-once (``online.dedup_hits``)."""
+        return bool(self._take(append_no, ("dup_feedback",)))
 
     def corrupt_payload(self, step: int, data: bytes) -> bytes:
         """Apply a due ``corrupt_resp`` fault to an outbound response

@@ -198,6 +198,11 @@ class ElasticCluster:
     #: The guard feature-detects this before passing ``draining=`` to
     #: `health_check` (scripted test coordinators may not accept it).
     supports_draining = True
+    #: membership can change (shrink/rejoin/scale-up): the guard must keep
+    #: its coordinated health sync running even at world 1 — the sync is
+    #: where the sole survivor polls rejoin requests (utils/guard.py
+    #: `_coordinated`)
+    supports_membership = True
 
     def __init__(
         self,

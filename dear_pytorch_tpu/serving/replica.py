@@ -50,14 +50,19 @@ class ReplicaServer:
     """Serve loop around a `serving.engine.DecodeEngine`."""
 
     def __init__(self, root: str, rank: int, engine, *, version: int = 0,
-                 injector=None, preemption=None, poll_s: float = 0.005,
-                 heartbeat_s: float = 0.2):
+                 injector=None, preemption=None, feedback=None,
+                 poll_s: float = 0.005, heartbeat_s: float = 0.2):
         self.root = os.path.abspath(root)
         self.rank = int(rank)
         self.engine = engine
         self.version = int(version)
         self.injector = injector
         self.preemption = preemption
+        # optional `online.feedback.FeedbackWriter`: every successful
+        # response also becomes a (prompt, response, feedback) record —
+        # append is a bounded-buffer enqueue, so the serve loop never
+        # blocks on the log (docs/ONLINE.md)
+        self.feedback = feedback
         self.poll_s = float(poll_s)
         self.heartbeat_s = float(heartbeat_s)
         self._dir = os.path.join(self.root, REPLICAS_SUBDIR, str(self.rank))
@@ -159,6 +164,15 @@ class ReplicaServer:
     def _write_response(self, fin) -> None:
         self._write_payload(fin.request_id,
                             [int(t) for t in fin.tokens])
+        if self.feedback is not None:
+            # implicit-accept feedback signal: a production surface would
+            # carry real user labels; the loop's plumbing is identical
+            self.feedback.append({
+                "prompt": [int(t) for t in fin.prompt],
+                "response": [int(t) for t in fin.tokens],
+                "feedback": 1,
+                "model_version": self.version,
+            })
 
     def _write_payload(self, request_id, tokens, *,
                        error: Optional[str] = None) -> None:

@@ -300,6 +300,10 @@ class ReplicaRouter:
                     self.weight_swaps += 1
                     if tr.enabled:
                         tr.count("serve.weight_swaps")
+                        # the online continual-learning loop's closure
+                        # signal (docs/ONLINE.md): a trainer-published
+                        # version reached a serving replica
+                        tr.count("online.version_swaps_observed")
                         tr.event("serve.weight_swap", replica=rank,
                                  version=version, prev=rep.version)
                 rep.incarnation = incarnation

@@ -228,9 +228,19 @@ class GuardedTrainer:
     @property
     def _coordinated(self) -> bool:
         """True when recovery decisions go through the cluster consensus
-        protocol (a coordinator over a real multi-process world)."""
-        return (self._coordinator is not None
-                and self._coordinator.process_count > 1)
+        protocol — a coordinator over a real multi-process world, OR an
+        elastic membership (`supports_membership`) at ANY world size: a
+        fleet shrunk to a sole survivor must keep running its health
+        sync (the world-1 exchange is a no-op, but the sync is where
+        rejoin requests are polled) or the relaunched ranks are never
+        admitted and the fleet can never grow back (observed: a 2-rank
+        fleet whose victim was SIGKILLed stayed world-1 forever while
+        the relaunch waited out its entire admission timeout)."""
+        if self._coordinator is None:
+            return False
+        return (self._coordinator.process_count > 1
+                or getattr(self._coordinator, "supports_membership",
+                           False))
 
     @property
     def _mem_epoch(self) -> Optional[int]:

@@ -17,14 +17,22 @@ real object store offers (GCS/S3 semantics, no rename, no append):
     get_bytes(key) -> bytes  whole-object read (KeyError when absent)
     put_file(key, path)      upload one local file
     get_file(key, dest)      download one object to a local path
-    list(prefix) -> [key]    every key under a prefix
+    list(prefix) -> [key]    every key under a prefix, **sorted
+                             lexicographically by key** — pinned: readers
+                             (feedback-log segment walks, version scans)
+                             rely on the order being stable under
+                             concurrent appenders
     delete_prefix(prefix)    best-effort recursive delete
     exists(key) -> bool
+    put_bytes_if_absent(key, data) -> bool
+                             first-writer-wins whole-object publish
+                             (GCS ``ifGenerationMatch=0`` / S3
+                             ``If-None-Match:*`` semantics)
 
-A production deployment implements the same seven methods over its
+A production deployment implements the same eight methods over its
 bucket client; everything above the waist (manifest commit protocol,
-retry, sha256 reverify, retention) lives in `utils.checkpoint` and is
-backend-agnostic.
+retry, sha256 reverify, retention) lives in `utils.checkpoint` /
+`online.feedback` and is backend-agnostic.
 
 `LocalObjectStore` maps keys to files under a root directory with
 tmp-then-``os.replace`` atomicity — a reader can never observe a torn
@@ -36,6 +44,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 from typing import List
 
 __all__ = ["LocalObjectStore"]
@@ -89,9 +98,50 @@ class LocalObjectStore:
         shutil.copyfile(src, tmp)
         os.replace(tmp, dest)
 
+    def put_bytes_if_absent(self, key: str, data: bytes) -> bool:
+        """First-writer-wins whole-object publish: write ``data`` under
+        ``key`` unless a committed object is already there; returns True
+        when this call created the object, False when it lost (the
+        existing object is left intact either way). Atomic via the
+        hard-link idiom (`resilience.cluster.FileTransport.decide_once`):
+        the tmp file is complete before linking, so a reader can never
+        observe a torn winner, and ``link`` fails with EEXIST when
+        another writer won. Real bucket clients map this to conditional
+        puts (GCS ``ifGenerationMatch=0``, S3 ``If-None-Match: *``).
+        This is what makes duplicate segment publication idempotent for
+        the feedback log's commit markers (`online.feedback`)."""
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            # filesystem without hard links (some FUSE mounts): exclusive
+            # create of the final path — racier (a concurrent reader can
+            # catch the value mid-write) but still first-writer-wins
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                return True
+            except FileExistsError:
+                return False
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
     def list(self, prefix: str) -> List[str]:
         """Every committed key under ``prefix`` (in-flight tmp files
-        excluded), as full keys relative to the store root."""
+        excluded), as full keys relative to the store root, **sorted
+        lexicographically by key** — the ordering contract concurrent
+        appenders and segment-walking readers rely on."""
         base = self._path(prefix)
         if not os.path.isdir(base):
             return []

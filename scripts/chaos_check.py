@@ -46,6 +46,14 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+# the storm parents' shared fleet-pump / capacity / loader helpers —
+# one module, composed by --serve, --autoscale, and --online instead of
+# per-storm copies
+import chaos_common as CC  # noqa: E402
 
 
 # -- tiny deterministic workload (mirrors the test suite's MLP scale) ---------
@@ -87,11 +95,7 @@ def _data(key, n=64):
     return x, jnp.argmax(x @ teacher, axis=-1)
 
 
-def _check(cond, what, failures):
-    status = "ok" if cond else "FAIL"
-    print(f"chaos_check: [{status}] {what}")
-    if not cond:
-        failures.append(what)
+_check = CC.check  # every storm phase asserts through the shared helper
 
 
 def run(steps: int = 20, checkpoint_every: int = 4,
@@ -920,7 +924,6 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
     The parent stays jax-free: it watches the durable decision records
     (`{ns}/decided/e*` — the signed world-delta commits) to sequence its
     phases, exactly as an external operator would."""
-    import importlib.util
     import subprocess
     import tempfile
     import time
@@ -930,19 +933,10 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
     remote_root = os.path.join(workdir, "remote")
     os.makedirs(remote_root, exist_ok=True)
     capacity = os.path.join(workdir, "capacity.json")
-
-    def write_capacity(doc):
-        with open(capacity + ".tmp", "w") as f:
-            json.dump(doc, f)
-        os.replace(capacity + ".tmp", capacity)
-
+    write_capacity = CC.capacity_writer(capacity)
     write_capacity({"target_world": 2})
 
-    spec = importlib.util.spec_from_file_location(
-        "dear_launch_supervisor",
-        os.path.join(REPO, "launch", "supervisor.py"))
-    sup_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(sup_mod)
+    sup_mod = CC.load_supervisor()
     from dear_pytorch_tpu.resilience.scale import ScalePolicy
 
     kill_rank, drain_rank, target_epoch, post = 1, 0, 5, 3
@@ -1116,24 +1110,14 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
     # through the bench gate's absolute SLO floor
     slo_floor = float(os.environ.get("DEAR_CHAOS_SLO_STEPS_PER_HOUR", "50"))
     steps_per_hour = final_step * 3600.0 / max(elapsed_s, 1e-9)
-    run_json = os.path.join(workdir, "autoscale_contract.json")
-    with open(run_json, "w") as f:
-        json.dump({"metric": "steps_per_hour",
-                   "value": round(steps_per_hour, 2),
-                   "extra_metrics": [
-                       {"metric": "final_step", "value": final_step},
-                       {"metric": "ckpt_uploads",
-                        "value": merged.get("ckpt.uploads", 0)},
-                   ]}, f)
-    gate_spec = importlib.util.spec_from_file_location(
-        "dear_bench_gate", os.path.join(REPO, "scripts", "bench_gate.py"))
-    gate = importlib.util.module_from_spec(gate_spec)
-    gate_spec.loader.exec_module(gate)
-    gate_rc = gate.main(["--run", run_json,
-                         "--slo", f"steps_per_hour={slo_floor}"])
-    _check(gate_rc == 0,
-           f"bench_gate --slo holds the steps/hour contract "
-           f"({steps_per_hour:.0f}/h vs floor {slo_floor:.0f}/h)", failures)
+    CC.slo_gate(
+        os.path.join(workdir, "autoscale_contract.json"),
+        "steps_per_hour", round(steps_per_hour, 2),
+        [{"metric": "final_step", "value": final_step},
+         {"metric": "ckpt_uploads", "value": merged.get("ckpt.uploads", 0)}],
+        [f"steps_per_hour={slo_floor}"], failures,
+        f"bench_gate --slo holds the steps/hour contract "
+        f"({steps_per_hour:.0f}/h vs floor {slo_floor:.0f}/h)")
 
     # scale-from-zero: a machine with NO local state restores from the
     # remote tier alone
@@ -1245,10 +1229,33 @@ def run_worker_serve_replica(workdir: str) -> dict:
         model, params,
         slots=int(os.environ.get("DEAR_SERVE_SLOTS", "4")))
     pre = PreemptionHandler().install()
+    feedback = None
+    if os.environ.get("DEAR_ONLINE_FEEDBACK") == "1":
+        # the online loop's data plane: every response also becomes a
+        # (prompt, response, feedback) record — bounded-buffer append
+        # off the decode hot path, background segment flusher; the
+        # writer id is the STABLE rank, so a relaunched incarnation
+        # resumes the same single-writer stream at its committed tail
+        from dear_pytorch_tpu.online.feedback import FeedbackWriter
+
+        feedback = FeedbackWriter(
+            store, writer_id=f"r{rank}", stream="main",
+            flush_records=int(
+                os.environ.get("DEAR_ONLINE_FLUSH_RECORDS", "8")),
+            flush_interval_s=float(
+                os.environ.get("DEAR_ONLINE_FLUSH_INTERVAL_S", "0.3")),
+            injector=injector)
     srv = ReplicaServer(serve_dir, rank, engine, version=version,
-                        injector=injector, preemption=pre)
+                        injector=injector, preemption=pre,
+                        feedback=feedback)
     summary = srv.run(
         deadline_s=float(os.environ.get("DEAR_SERVE_DEADLINE", "600")))
+    if feedback is not None:
+        # drain path: the final responses' records must be committed
+        # before the process exits (the drain grace window covers this)
+        feedback.close()
+        summary["feedback_appended"] = feedback.appended
+        summary["feedback_committed"] = feedback.committed
     print("CHAOS_SERVE_REPLICA " + json.dumps(summary), flush=True)
     return summary
 
@@ -1280,7 +1287,6 @@ def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
     `resilience.scale.ScalePolicy` through the capacity file, and
     SIGKILLs via the supervisor's pid files — exactly an operator's
     surface."""
-    import importlib.util
     import signal
     import subprocess
     import tempfile
@@ -1303,11 +1309,7 @@ def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
     capacity = os.path.join(workdir, "capacity.json")
     failures: list[str] = []
 
-    def write_capacity(doc):
-        with open(capacity + ".tmp", "w") as f:
-            json.dump(doc, f)
-        os.replace(capacity + ".tmp", capacity)
-
+    write_capacity = CC.capacity_writer(capacity)
     write_capacity({"target_world": 2})
 
     kill_rank = 1
@@ -1335,11 +1337,7 @@ def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
     _check(pub.returncode == 0,
            f"weight v1 published: {pub.stdout[-800:]}", failures)
 
-    spec = importlib.util.spec_from_file_location(
-        "dear_launch_supervisor",
-        os.path.join(REPO, "launch", "supervisor.py"))
-    sup_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(sup_mod)
+    sup_mod = CC.load_supervisor()
     policy = ScalePolicy(capacity_file=capacity, hysteresis_s=0.5,
                          max_world=3)
     sup = sup_mod.ElasticSupervisor(
@@ -1357,17 +1355,8 @@ def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
                            slots_per_replica=4,
                            health_timeout_s=5.0).start()
     t0 = time.monotonic()
-    deadline = t0 + 480.0
-
-    def pump(cond, what, timeout_s=120.0):
-        t_end = min(time.monotonic() + timeout_s, deadline)
-        while time.monotonic() < t_end:
-            sup.poll()
-            if cond():
-                return True
-            time.sleep(0.1)
-        failures.append(f"timeout waiting for: {what}")
-        return False
+    fleet = CC.FleetPump([sup], failures, deadline_s=480.0)
+    pump = fleet.pump
 
     stop_clients = threading.Event()
     client_failures: list[str] = []
@@ -1548,26 +1537,17 @@ def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
     rps = completed / max(elapsed_s, 1e-9)
     rps_floor = float(os.environ.get("DEAR_CHAOS_SERVE_RPS", "0.2"))
     p99_ceil = float(os.environ.get("DEAR_CHAOS_SERVE_P99_MS", "60000"))
-    run_json = os.path.join(workdir, "serve_contract.json")
-    with open(run_json, "w") as f:
-        json.dump({"metric": "requests_per_s", "value": round(rps, 3),
-                   "extra_metrics": [
-                       {"metric": "p99_latency_ms",
-                        "value": stats["latency_p99_ms"]},
-                       {"metric": "served", "value": completed},
-                       {"metric": "shed", "value": stats["shed"]},
-                   ]}, f)
-    gate_spec = importlib.util.spec_from_file_location(
-        "dear_bench_gate", os.path.join(REPO, "scripts", "bench_gate.py"))
-    gate = importlib.util.module_from_spec(gate_spec)
-    gate_spec.loader.exec_module(gate)
-    gate_rc = gate.main(["--run", run_json,
-                         "--slo", f"requests_per_s={rps_floor}",
-                         "--slo", f"p99_latency_ms<={p99_ceil}"])
-    _check(gate_rc == 0,
-           f"bench_gate --slo holds the serving contract "
-           f"({rps:.2f} req/s >= {rps_floor}; p99 "
-           f"{stats['latency_p99_ms']}ms <= {p99_ceil}ms)", failures)
+    CC.slo_gate(
+        os.path.join(workdir, "serve_contract.json"),
+        "requests_per_s", round(rps, 3),
+        [{"metric": "p99_latency_ms", "value": stats["latency_p99_ms"]},
+         {"metric": "served", "value": completed},
+         {"metric": "shed", "value": stats["shed"]}],
+        [f"requests_per_s={rps_floor}", f"p99_latency_ms<={p99_ceil}"],
+        failures,
+        f"bench_gate --slo holds the serving contract "
+        f"({rps:.2f} req/s >= {rps_floor}; p99 "
+        f"{stats['latency_p99_ms']}ms <= {p99_ceil}ms)")
 
     return {
         "passed": not failures,
@@ -1581,6 +1561,765 @@ def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
                            if k.startswith("serve.")},
         "failures": failures,
     }
+
+
+# -- the online continual-learning storm ---------------------------------------
+
+
+def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
+    """One rank of the ONLINE trainer fleet (spawned — and relaunched —
+    by `launch/supervisor.py` under the rejoin env contract). Mirrors the
+    autoscale worker (guard + elastic cluster + checkpoint streamer +
+    preemption) with the data path swapped for the online loop:
+
+      - the pipeline is a `online.ingest.FeedbackIngest` over the shared
+        object store — every step blends a base synthetic batch with up
+        to one batch-row's worth of feedback records at the checkpointed
+        cursor,
+      - the feed/blend decision and the frontier are fleet-consensus:
+        ONE `ElasticCluster.exchange` per step carries each rank's local
+        frontier, stop-file observation, drained flag, and newest store
+        version; every rank derives the identical MIN/ALL merge, so
+        replicas train byte-identical batches (the desync sentinel
+        watches),
+      - the member-0 leader publishes weights through
+        `online.publish.VersionPublisher` every N steps with cursor
+        provenance,
+      - the scheduled victim SIGKILLs itself a fixed number of steps
+        after the fleet's consumed-record count crosses a threshold
+        (consumed_total is lockstep-identical, so the schedule is
+        deterministic without wall clocks),
+      - exit is itself a consensus: all members observed the parent's
+        stop file AND the cursor drained AND the version target AND the
+        post-rejoin epoch — so the fleet finishes in lockstep with
+        identical final cursors.
+    """
+    import signal
+    import time
+
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    os.environ["DEAR_CKPT_SHARED"] = "0"
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(2, scrub_env=True)
+
+    import jax
+    import numpy as np
+
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.online.feedback import FeedbackReader
+    from dear_pytorch_tpu.online.ingest import FeedbackIngest
+    from dear_pytorch_tpu.online.publish import VersionPublisher
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.resilience import PreemptionHandler
+    from dear_pytorch_tpu.resilience import membership as M
+    from dear_pytorch_tpu.resilience.cluster import PeerTimeout
+    from dear_pytorch_tpu.runtime import build as RB
+    from dear_pytorch_tpu.runtime import pipeline as P
+    from dear_pytorch_tpu.serving import weights as W
+    from dear_pytorch_tpu.tuning.autotune import AutoTuner
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    EH = _load_harness()
+    cluster = M.ElasticCluster.from_env(max_candidates=256)
+    rejoining = M.ElasticCluster.rejoining_by_env()
+    rank = cluster.rank
+    kr, kc, kx = os.environ["DEAR_CHAOS_ONLINE_KILL"].split(":")
+    kill_rank, kill_consumed, kill_extra = int(kr), int(kc), int(kx)
+    publish_every = int(
+        os.environ.get("DEAR_CHAOS_ONLINE_PUBLISH_EVERY", "25"))
+    target_versions = int(os.environ.get("DEAR_CHAOS_ONLINE_VERSIONS", "3"))
+    target_epoch = int(os.environ.get("DEAR_CHAOS_ONLINE_EPOCHS", "2"))
+    stop_file = os.environ["DEAR_CHAOS_ONLINE_STOP"]
+    remote_root = os.environ["DEAR_CHAOS_REMOTE"]
+    store = LocalObjectStore(os.environ["DEAR_CHAOS_ONLINE_STORE"])
+    ckpt_dir = os.path.join(workdir, f"trainer_rank{rank}", "ckpts")
+    tracer = T.get_tracer()
+
+    # the trainer trains THE MODEL THE FLEET SERVES — the same tiny
+    # causal LM `run_worker_serve_replica` decodes with — so a published
+    # version really is a new set of serving weights, and the feedback
+    # records (served prompt+response token sequences) really are its
+    # training data
+    import jax.numpy as jnp
+
+    model, _cfg = _serve_model()
+
+    def gpt_loss(p, batch):
+        logits = model.apply({"params": p}, batch, train=False)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32),
+                                  axis=-1)
+        tgt = batch[:, 1:]
+        ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+        return -jnp.mean(ll)
+
+    B, S = 8, 16
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((B, S), jnp.int32),
+                        train=False)["params"]
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:min(cluster.world, 2)]), ("dp",))
+    tuner = AutoTuner(
+        gpt_loss, params, strategy="bo", threshold_mb=0.0008,
+        interval=10**9, mesh=mesh, donate=False,
+        optimizer=fused_sgd(lr=0.01, momentum=0.9),
+    )
+
+    # the online data path: base synthetic token stream + the feedback
+    # log. batch_fn is a deterministic pure function (same base batch +
+    # same records => same training batch on every rank and every
+    # replay): each record's served prompt+response tokens overwrite the
+    # head of one base row.
+    spec = P.SyntheticSpec((
+        P.Field("input_ids", (B, S), RB.KIND_UNIFORM_I32, 0, 61),
+    ))
+    base = P.NumpyPipeline(spec, seed=123, shard=0, num_shards=1)
+
+    def batch_fn(base_batch, records):
+        ids = np.array(base_batch["input_ids"], dtype=np.int32)
+        for j, rec in enumerate(records[:B]):
+            toks = (list(rec.get("prompt") or [])
+                    + list(rec.get("response") or []))[:S]
+            ids[j, :len(toks)] = np.asarray(toks, np.int32) % 61
+        return ids
+
+    # ONE consensus exchange per step: frontier MIN (same availability
+    # snapshot => byte-identical feed/blend on every rank) + the exit
+    # votes. A dead peer costs one short timeout and a blend step; the
+    # guard's own health sync then commits the shrink.
+    shared = {"stop": False, "drained": False, "version": 0}
+
+    def consensus(frontier):
+        stop_seen = os.path.exists(stop_file)
+        if stop_seen:
+            # drain intent: the drained verdict must rest on the
+            # DEFINITIVE frontier (the probe fast path cannot jump a
+            # torn segment's numbering gap until a discovery listing)
+            ing.full_frontier = True
+        payload = json.dumps({
+            "f": frontier,
+            "stop": stop_seen,
+            "drained": bool(ing.last_drained),
+            "v": int(W.latest_version(store) or 0),
+        })
+        try:
+            views = cluster.exchange("online.avail", payload, timeout_s=4.0)
+        except PeerTimeout:
+            shared["stop"] = shared["drained"] = False
+            return {}
+        docs = [json.loads(v) for v in views]
+        shared["stop"] = all(d["stop"] for d in docs)
+        shared["drained"] = all(d["drained"] for d in docs)
+        shared["version"] = min(d["v"] for d in docs)
+        merged = {}
+        for w in set().union(*(set(d["f"]) for d in docs)):
+            vals = [d["f"].get(w) for d in docs]
+            if any(v is None for v in vals):
+                continue  # a writer one rank has not discovered yet
+            merged[w] = min(vals)
+        return merged
+
+    ing = FeedbackIngest(
+        base, FeedbackReader(store, stream="main"), batch_records=B,
+        batch_fn=batch_fn, consensus_fn=consensus)
+
+    streamer = ckpt.CheckpointStreamer(
+        ckpt_dir, LocalObjectStore(os.path.join(remote_root, f"rank{rank}")),
+        upload_every=2, pin_last=4)
+    pre = PreemptionHandler().install()
+    guard = GuardedTrainer(
+        tuner.ts, ckpt_dir, params,
+        check_every=1, checkpoint_every=checkpoint_every, max_keep=1000,
+        max_recoveries=8, coordinator=cluster, pipeline=ing,
+        preemption=pre, streamer=streamer,
+    )
+    EH.attach_elastic(guard, tuner)
+    rollback_steps = []
+    guard.on_rollback = lambda c, at: rollback_steps.append(at)
+
+    holder = {"state": None}
+    publisher = VersionPublisher(
+        store, publish_every=publish_every,
+        params_fn=lambda: jax.device_get(
+            guard.ts.gather_params(holder["state"])),
+        cursor_fn=lambda: ing.cursor.to_dict())
+
+    resumed_at = None
+    if rejoining:
+        # hydrate from a fleet peer's remote tier so the consensus
+        # restore loses at most the upload lag, not this rank's downtime
+        hydrate, _ = _newest_remote_store(remote_root, skip_rank=rank)
+        state, resumed_at, _last_epoch = EH.reenter(
+            cluster, tuner, guard, ckpt_dir, hydrate_store=hydrate)
+    else:
+        state = tuner.init(params)
+    holder["state"] = state
+
+    deadline = time.monotonic() + 380.0
+    kill_at = None
+    preempted = False
+    last_pub_consumed = [-1]
+
+    def leader() -> bool:
+        return bool(cluster.members) and cluster.members[0] == rank
+
+    while True:
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"trainer rank {rank} never reached the consensus exit "
+                f"(epoch {cluster.epoch}, consumed "
+                f"{ing.cursor.consumed_total})")
+        if not rejoining and kill_rank == rank:
+            # deterministic mid-step loss: a fixed number of steps after
+            # the (lockstep-identical) consumed-record threshold
+            if kill_at is None \
+                    and ing.cursor.consumed_total >= kill_consumed:
+                kill_at = guard.steps_seen + 1 + kill_extra
+            if kill_at is not None and guard.steps_seen + 1 == kill_at:
+                os.kill(os.getpid(), signal.SIGKILL)  # abrupt host loss
+        batch = ing.next()
+        state, m = guard.step(state, batch)
+        holder["state"] = state
+        if m.get("preempted"):
+            preempted = True
+            break  # parent shutdown: drain cleanly with the grace window
+        # publish on the cadence, but (past v1) only versions that
+        # actually contain NEW feedback — a version bump should mean new
+        # data reached the fleet, and the freshness audit relies on it
+        if ing.cursor.consumed_total > last_pub_consumed[0] \
+                or not publisher.published:
+            v = publisher.maybe_publish(guard.steps_seen, leader=leader())
+            if v is not None:
+                last_pub_consumed[0] = ing.cursor.consumed_total
+        if shared["stop"] and shared["drained"] \
+                and cluster.epoch >= target_epoch:
+            if shared["version"] >= target_versions:
+                break
+            # the log is frozen but the version target is short: the
+            # leader force-publishes the remaining versions (the final
+            # ones cover the fully-drained cursor); followers keep
+            # exchanging until the store shows the target
+            publisher.maybe_publish(guard.steps_seen, leader=leader(),
+                                    force=True)
+        time.sleep(0.04)
+
+    streamer.flush(20.0)
+    streamer.close()
+    counters = tracer.counters()
+    verdict = {
+        "rank": rank,
+        "pid": os.getpid(),
+        "rejoined": bool(rejoining),
+        "preempted": preempted,
+        "epoch": cluster.epoch,
+        "members": list(cluster.members),
+        "resumed_at": resumed_at,
+        "rollback_steps": rollback_steps,
+        "final_step": int(jax.device_get(state.step)),
+        "steps_seen": guard.steps_seen,
+        "plan_world": guard.ts.plan.world,
+        "plan_epoch": guard.ts.plan.epoch,
+        "ingest": ing.cursor.to_dict(),
+        "published": publisher.published,
+        "publish_failures": publisher.publish_failures,
+        "uploaded": sorted(streamer.uploaded),
+        "upload_failed": sorted(streamer.failed),
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("cluster.", "guard.", "pipeline.",
+                                      "online.", "ckpt."))},
+    }
+    if not preempted:
+        # the lockstep verdict is itself a member-scoped collective
+        views = cluster.exchange("chaos.verdict", json.dumps(
+            [verdict["final_step"], verdict["ingest"]["consumed_total"],
+             verdict["ingest"]["checksum"], verdict["epoch"]]))
+        verdict["lockstep"] = all(
+            json.loads(v) == json.loads(views[0]) for v in views)
+    path = os.path.join(workdir,
+                        f"trainer_verdict_rank{rank}.{os.getpid()}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(verdict, f)
+    os.replace(path + ".tmp", path)
+    print(f"CHAOS_ONLINE_TRAINER rank={rank} " + json.dumps(verdict),
+          flush=True)
+    return verdict
+
+
+def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C901
+    """Parent of the ONLINE storm — the training↔serving closed-loop
+    acceptance gate (ROADMAP item 4). Two supervised fleets share one
+    object store:
+
+      - a 2-replica SERVING fleet under closed-loop client traffic,
+        every response appended to the durable feedback log
+        (``torn_seg`` and ``dup_feedback`` faults scheduled on the
+        writers),
+      - a 2-rank TRAINER fleet ingesting the log exactly-once at a
+        checkpointed consensus cursor, publishing weight versions with
+        cursor provenance.
+
+    The storm: SIGKILL a serving replica mid-traffic (zero
+    accepted-then-lost), SIGKILL a trainer rank mid-step (elastic shrink
+    + rejoin = the forced reshard; cursor restored from the consensus
+    checkpoint), walk a torn feedback segment and absorb a duplicate
+    record, and execute the PR-11 drain+backfill rolling swap every time
+    the trainer's published version bumps — twice. The gate then freezes
+    the log (clients stopped, serving fleet drained), lets the trainer
+    drain the cursor, and asserts the exactly-once ledger: the fleet's
+    final cursor equals a jax-free replay of the whole log (consumed
+    count AND order-independent checksum — no gaps, no dups), with the
+    torn segment walked past and the duplicate deduplicated. Freshness
+    (feedback-commit → first version serving it) and throughput are
+    machine-checked through `bench_gate.py --slo`."""
+    import signal
+    import tempfile
+    import threading
+    import time
+
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.online.feedback import Cursor, FeedbackReader
+    from dear_pytorch_tpu.online.publish import read_online_sidecar
+    from dear_pytorch_tpu.resilience.retry import RetryError, retry_call
+    from dear_pytorch_tpu.resilience.scale import ScalePolicy
+    from dear_pytorch_tpu.serving import weights as W
+    from dear_pytorch_tpu.serving.admission import (
+        AdmissionController, SheddingError,
+    )
+    from dear_pytorch_tpu.serving.router import ReplicaRouter
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_online_")
+    os.makedirs(workdir, exist_ok=True)
+    serve_dir = os.path.join(workdir, "serve")
+    store_dir = os.path.join(workdir, "store")        # weights + feedback
+    remote_root = os.path.join(workdir, "remote")     # trainer ckpt tier
+    trainer_elastic = os.path.join(workdir, "trainer_elastic")
+    serve_elastic = os.path.join(workdir, "serve_elastic")
+    capacity = os.path.join(workdir, "capacity.json")
+    stop_file = os.path.join(workdir, "STOP_TRAINER")
+    os.makedirs(remote_root, exist_ok=True)
+    failures: list[str] = []
+    write_capacity = CC.capacity_writer(capacity)
+    write_capacity({"target_world": 2})
+
+    trainer_kill_rank, serve_kill_rank = 1, 1
+    target_versions = 3
+    env = dict(os.environ)
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_CHAOS_ONLINE_STORE"] = store_dir
+    env["DEAR_CHAOS_REMOTE"] = remote_root
+    env["DEAR_CHAOS_ONLINE_STOP"] = stop_file
+    env["DEAR_CHAOS_ONLINE_KILL"] = f"{trainer_kill_rank}:8:1"
+    env["DEAR_CHAOS_ONLINE_PUBLISH_EVERY"] = "20"
+    env["DEAR_CHAOS_ONLINE_VERSIONS"] = str(target_versions)
+    env["DEAR_PREEMPT_GRACE_S"] = "30"
+    # a peer's post-transition XLA recompile must not read as a death
+    env.setdefault("DEAR_CLUSTER_TIMEOUT_SECS", "30")
+
+    sup_mod = CC.load_supervisor()
+    trainer_env = dict(env)
+    sup_t = sup_mod.ElasticSupervisor(
+        2,
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--online-trainer", "--checkpoint-every", str(checkpoint_every),
+         "--workdir", workdir],
+        elastic_dir=trainer_elastic, env=trainer_env,
+        max_relaunches=2, relaunch_window_s=180.0,
+    ).start()
+
+    store = LocalObjectStore(store_dir)
+    reader = FeedbackReader(store, stream="main")
+    t0 = time.monotonic()
+    fleet = CC.FleetPump([sup_t], failures, deadline_s=460.0)
+    pump = fleet.pump
+
+    # -- phase A: the trainer publishes v1 before any replica boots -------
+    _check(pump(lambda: (W.latest_version(store) or 0) >= 1,
+                "trainer publishes v1", 150.0),
+           "the trainer fleet published weight v1 to the store", failures)
+
+    # -- phase B: serving fleet + closed-loop traffic + feedback ----------
+    serve_env = dict(env)
+    serve_env["DEAR_SERVE_DIR"] = serve_dir
+    serve_env["DEAR_SERVE_STORE"] = store_dir
+    serve_env["DEAR_SERVE_SLOTS"] = "4"
+    serve_env["DEAR_ONLINE_FEEDBACK"] = "1"
+    serve_env["DEAR_ONLINE_FLUSH_RECORDS"] = "8"
+    serve_env["DEAR_ONLINE_FLUSH_INTERVAL_S"] = "0.3"
+    # the data-path faults, writer-targeted: replica 0 tears its 2nd
+    # segment flush (manifest-less partial write), replica 1 re-appends
+    # an already-committed record on its 6th append. The slow fault
+    # makes replica 1 a straggler from its 4th request on — which is
+    # what guarantees the SIGKILL below lands while it HOLDS in-flight
+    # work (without it the tiny model answers in milliseconds and the
+    # mid-traffic kill is a coin flip)
+    serve_env["DEAR_FAULTS"] = \
+        "torn_seg@2:r0,dup_feedback@6:r1,slow@4:0.1:r1"
+    policy = ScalePolicy(capacity_file=capacity, hysteresis_s=0.5,
+                         max_world=3)
+    sup_s = sup_mod.ElasticSupervisor(
+        2,
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--serve-replica", "--workdir", workdir],
+        elastic_dir=serve_elastic, env=serve_env,
+        max_relaunches=2, relaunch_window_s=180.0, policy=policy,
+    ).start()
+    fleet.add_supervisor(sup_s)
+
+    prev_tracer = T._tracer
+    T.set_tracer(T.Tracer([T.MemoryExporter()]))
+    admission = AdmissionController(max_depth=8)
+    router = ReplicaRouter(serve_dir, admission=admission,
+                           slots_per_replica=4,
+                           health_timeout_s=5.0).start()
+
+    # continuous observation: first wall-clock time each weight version
+    # was seen SERVING (freshness), min healthy during the swaps
+    first_served: dict[int, float] = {}
+    min_healthy = [99]
+
+    def sample():
+        versions = router.fleet_versions()
+        now = time.time()
+        for _r, v in versions.items():
+            if v is not None:
+                first_served.setdefault(int(v), now)
+        min_healthy[0] = min(min_healthy[0],
+                             len(router.healthy_replicas()))
+
+    stop_clients = threading.Event()
+    client_failures: list[str] = []
+
+    def one_request(tag, i):
+        prompt = [(tag * 31 + i * 7 + k) % 61 for k in range(4 + i % 3)]
+        try:
+            rid = retry_call(
+                router.submit, prompt, max_new_tokens=3, deadline_s=60.0,
+                attempts=8, base_delay_s=0.05, max_delay_s=0.8,
+                retry_on=(SheddingError,), name=f"online-client-{tag}")
+        except RetryError:
+            return None  # shed to exhaustion: accounted, never accepted
+        try:
+            return router.result(rid, timeout=240.0)
+        except TimeoutError:
+            client_failures.append(f"client {tag} req {i}: no response")
+            return None
+
+    def steady_client(tag):
+        i = 0
+        while not stop_clients.is_set():
+            one_request(tag, i)
+            i += 1
+            time.sleep(0.08)
+
+    clients = [threading.Thread(target=steady_client, args=(t,),
+                                daemon=True) for t in range(2)]
+    try:
+        _check(pump(lambda: len(router.healthy_replicas()) >= 2,
+                    "2 replicas healthy", 180.0),
+               "the serving fleet of 2 replicas came up on v1", failures)
+        fleet.add_sampler(sample)
+        for c in clients:
+            c.start()
+        _check(pump(lambda: len(router.completed) >= 5,
+                    "first responses", 90.0),
+               "closed-loop traffic completes", failures)
+        _check(pump(lambda: reader.committed_records() >= 30,
+                    "feedback committed", 90.0),
+               "serving responses are landing in the durable feedback "
+               "log", failures)
+
+        # -- phase C: SIGKILL a serving replica MID-traffic ---------------
+        # a burst outnumbering the fast replica's slot cap spills work
+        # onto the slow victim (least-loaded dispatch otherwise starves
+        # a straggler at low load — observed: 1683 vs 52 served), and
+        # the straggler latency keeps it in-flight long enough for the
+        # kill to land mid-request
+        burst_threads = [
+            threading.Thread(target=lambda i=i: one_request(100 + i, i),
+                             daemon=True) for i in range(10)]
+        for th in burst_threads:
+            th.start()
+        pump(lambda: router.inflight_on(serve_kill_rank) >= 1,
+             "in-flight work on the serving victim", 30.0)
+        with open(os.path.join(serve_elastic, "supervisor", "pids",
+                               str(serve_kill_rank))) as f:
+            victim_pid = int(f.read())
+        os.kill(victim_pid, signal.SIGKILL)
+        _check(pump(lambda: router.redispatched >= 1,
+                    "redispatch after serving SIGKILL", 60.0),
+               "the dead replica's in-flight requests were re-dispatched",
+               failures)
+        _check(pump(lambda: sup_s.relaunches.get(serve_kill_rank, 0) >= 1
+                    and serve_kill_rank in router.healthy_replicas(),
+                    "serving victim relaunched", 120.0),
+               "the supervisor relaunched the SIGKILLed serving replica",
+               failures)
+        for th in burst_threads:
+            th.join(timeout=240)
+
+        # -- phase D: the trainer SIGKILL committed a shrink + rejoin -----
+        decided_dir = os.path.join(trainer_elastic, "dearel", "elastic",
+                                   "decided")
+
+        def decided(n):
+            try:
+                with open(os.path.join(decided_dir, f"e{n}")) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return None
+
+        _check(pump(lambda: decided(2) is not None,
+                    "trainer shrink+rejoin epochs", 150.0),
+               "the trainer SIGKILL forced an elastic shrink and the "
+               "relaunch rejoined (epoch 2 committed)", failures)
+        rec1, rec2 = decided(1), decided(2)
+        _check(isinstance(rec1, dict)
+               and rec1.get("delta", {}).get("removed")
+               == [trainer_kill_rank],
+               f"epoch-1 record signs the trainer shrink ({rec1})",
+               failures)
+        _check(isinstance(rec2, dict)
+               and rec2.get("delta", {}).get("added")
+               == [trainer_kill_rank],
+               f"epoch-2 record signs the rejoin ({rec2})", failures)
+
+        # -- phase E: the version-advancement loop, twice -----------------
+        # every time the trainer's published version bumps, execute the
+        # PR-11 drain+backfill rolling swap so the fleet serves it
+        def drains_of(r):
+            return sum(1 for e in sup_s.events if e == ("drained", r))
+
+        for round_no in (1, 2):
+            want = round_no + 1  # v2, then v3
+            _check(pump(lambda w=want: (W.latest_version(store) or 0) >= w,
+                        f"v{want} published", 150.0),
+                   f"the live trainer published v{want} from ingested "
+                   "feedback", failures)
+            for r in (0, 1):
+                before = drains_of(r)
+                write_capacity({"target_world": 2, "drain": [r]})
+                ok = pump(lambda r=r, b=before: drains_of(r) > b,
+                          f"serving rank {r} drained (round {round_no})",
+                          90.0)
+                _check(ok, f"serving rank {r} drained via the SIGTERM "
+                       f"grace path (round {round_no})", failures)
+                _check(pump(lambda r=r, w=want:
+                            (router.fleet_versions().get(r) or 0) >= w,
+                            f"rank {r} serving >= v{want}", 120.0),
+                       f"backfilled serving rank {r} came up on "
+                       f">= v{want}", failures)
+        write_capacity({"target_world": 2})  # clear the stale drain hint
+        _check(router.weight_swaps >= 2,
+               f"the router observed the served version advance >= 2 "
+               f"times (serve.weight_swaps={router.weight_swaps})",
+               failures)
+        _check(min_healthy[0] >= 1,
+               "at least one replica stayed healthy through every "
+               "rolling swap", failures)
+
+        # -- phase F: freeze the log, drain the cursor --------------------
+        stop_clients.set()
+        for c in clients:
+            c.join(timeout=240)
+        _check(pump(lambda: not router.open_requests(),
+                    "all accepted requests answered", 120.0),
+               "zero accepted-then-lost requests "
+               f"(open={sorted(router.open_requests())})", failures)
+        _check(not client_failures,
+               f"no client timed out ({client_failures[:4]})", failures)
+        sup_s.policy = None  # shutdown must not read as lost capacity
+        sup_s.kill_all(signal.SIGTERM)  # drain: final feedback flush
+        _check(pump(lambda: not sup_s.poll(), "serving fleet drained",
+                    90.0),
+               "the serving fleet drained cleanly (writers flushed)",
+               failures)
+        with open(stop_file, "w") as f:
+            f.write("done")
+        _check(pump(lambda: not sup_t.poll(), "trainer consensus exit",
+                    150.0),
+               "the trainer fleet drained the log and exited in lockstep",
+               failures)
+    finally:
+        stop_clients.set()
+        elapsed_s = time.monotonic() - t0
+        sup_s.policy = None
+        sup_s.kill_all(signal.SIGTERM)
+        sup_t.kill_all(signal.SIGTERM)
+        t_end = time.monotonic() + 60.0
+        while (sup_s.poll() or sup_t.poll()) \
+                and time.monotonic() < t_end:
+            time.sleep(0.1)
+        for sup in (sup_s, sup_t):
+            if sup._procs:
+                sup.kill_all(signal.SIGKILL)
+        stats = router.stats()
+        router.close()
+        counters = T.get_tracer().counters()
+        T.set_tracer(prev_tracer)
+
+    bad_t = {r: rc for r, rc in sup_t._final_rc.items() if rc != 0}
+    _check(not bad_t, f"trainer ranks exited clean ({bad_t})", failures)
+    _check(sup_t.relaunches.get(trainer_kill_rank) == 1
+           and all(n == 0 for r, n in sup_t.relaunches.items()
+                   if r != trainer_kill_rank),
+           f"exactly the SIGKILLed trainer rank was relaunched "
+           f"({sup_t.relaunches})", failures)
+    _check(sup_s.relaunches.get(serve_kill_rank, 0) == 1,
+           f"exactly the SIGKILLed serving replica was relaunched "
+           f"({sup_s.relaunches})", failures)
+
+    # -- the exactly-once ledger: jax-free replay of the whole log --------
+    # full=True: the one-shot audit needs the definitive frontier, not
+    # the probe fast path (which stalls below torn-segment gaps between
+    # discovery listings — observed: a stale pump-era reader audited 789
+    # of 894 records)
+    frontier = reader.frontier(full=True)
+    audit = Cursor()
+    records = []
+    while True:
+        got = reader.take(audit, frontier, 512)
+        if not got:
+            break
+        records.append(got)
+    flat = [r for chunk in records for r in chunk]
+    ts_by_writer: dict[str, list[float]] = {}
+    for r in flat:
+        ts_by_writer.setdefault(r["writer"], []).append(float(r["ts"]))
+    _check(audit.torn_segments >= 1,
+           f"the injected torn segment was walked past "
+           f"(torn_segments={audit.torn_segments})", failures)
+    _check(audit.dedup_hits >= 1,
+           f"the injected duplicate record was deduplicated "
+           f"(dedup_hits={audit.dedup_hits})", failures)
+
+    # newest verdict per trainer rank (churned ranks write one per life)
+    finals: dict[int, dict] = {}
+    for name in sorted(os.listdir(workdir)):
+        if not (name.startswith("trainer_verdict_rank")
+                and name.endswith(".json")):
+            continue
+        with open(os.path.join(workdir, name)) as f:
+            v = json.load(f)
+        prev = finals.get(int(v["rank"]))
+        if prev is None or v["steps_seen"] >= prev["steps_seen"]:
+            finals[int(v["rank"])] = v
+    summary = {"passed": False, "workdir": workdir,
+               "elapsed_s": round(elapsed_s, 1),
+               "stats": stats, "finals": finals, "failures": failures}
+    if sorted(finals) != [0, 1]:
+        failures.append(f"expected final verdicts from trainer ranks 0-1, "
+                        f"got {sorted(finals)}")
+        return summary
+
+    for r, v in sorted(finals.items()):
+        ig = v["ingest"]
+        _check(v["epoch"] >= 2 and v["members"] == [0, 1],
+               f"trainer rank {r} ends at epoch >= 2, full membership "
+               f"(epoch {v['epoch']}, members {v['members']})", failures)
+        _check(v.get("lockstep"), f"trainer rank {r} finished in lockstep",
+               failures)
+        _check(ig["consumed_total"] == audit.consumed_total,
+               f"rank {r} exactly-once count: records_trained "
+               f"{ig['consumed_total']} == records_committed "
+               f"{audit.consumed_total}", failures)
+        _check(int(ig["checksum"]) == audit.checksum,
+               f"rank {r} exactly-once checksum matches the log replay "
+               "(no gaps, no dups, no reorders of the unique-record set)",
+               failures)
+        _check(ig["dedup_hits"] >= 1 and ig["torn_segments"] >= 1,
+               f"rank {r} ingest absorbed the data faults (dedup "
+               f"{ig['dedup_hits']}, torn {ig['torn_segments']})",
+               failures)
+        # zero training progress lost past the newest upload
+        rstore = LocalObjectStore(os.path.join(remote_root, f"rank{r}"))
+        from dear_pytorch_tpu.utils import checkpoint as _ck
+        remote = _ck.remote_steps(rstore)
+        _check(bool(remote) and v["final_step"] >= remote[0],
+               f"rank {r} final step {v['final_step']} >= newest uploaded "
+               f"checkpoint {remote[0] if remote else None}", failures)
+    merged: dict = {}
+    for v in finals.values():
+        for k, n in v.get("counters", {}).items():
+            merged[k] = merged.get(k, 0) + n
+    _check(merged.get("cluster.reconfigs", 0) >= 1
+           and merged.get("cluster.rejoins", 0) >= 1,
+           "the trainer kill committed a shrink and a rejoin", failures)
+    _check(merged.get("pipeline.reshards", 0) >= 2,
+           "the ingest pipeline resharded through both transitions",
+           failures)
+    published = sorted(set().union(*(set(v["published"])
+                                     for v in finals.values())))
+    _check(len(published) >= target_versions,
+           f"the trainer published >= {target_versions} versions "
+           f"({published})", failures)
+
+    # -- feedback freshness: commit -> first version serving it -----------
+    # for each version the fleet actually served, the oldest NEWLY
+    # included record (per the cursor-provenance sidecar) waited
+    # first_served - its append ts; the ceiling bounds the worst wait
+    freshness = []
+    served_versions = sorted(v for v in first_served if v >= 2)
+    for v in served_versions:
+        side = read_online_sidecar(store, v)
+        prev_side = read_online_sidecar(store, v - 1)
+        if side is None or side.get("cursor") is None:
+            continue
+        prev_writers = ((prev_side or {}).get("cursor") or {}) \
+            .get("writers", {})
+        for w, pos in (side["cursor"].get("writers") or {}).items():
+            prev_c = int(prev_writers.get(w, {}).get("consumed", 0))
+            if int(pos["consumed"]) <= prev_c:
+                continue  # no new records from this writer in v
+            ts_list = ts_by_writer.get(w, [])
+            if prev_c < len(ts_list):
+                freshness.append(first_served[v] - ts_list[prev_c])
+    fresh_s = max(freshness) if freshness else None
+    _check(fresh_s is not None,
+           f"freshness measurable for the served versions "
+           f"({served_versions})", failures)
+    fresh_ceil = float(os.environ.get("DEAR_CHAOS_ONLINE_FRESH_S", "300"))
+    rps = len(router.completed) / max(elapsed_s, 1e-9)
+    rps_floor = float(os.environ.get("DEAR_CHAOS_ONLINE_RPS", "0.2"))
+    CC.slo_gate(
+        os.path.join(workdir, "online_contract.json"),
+        "requests_per_s", round(rps, 3),
+        [{"metric": "feedback_freshness_s",
+          "value": (round(fresh_s, 2) if fresh_s is not None
+                    else float("nan"))},
+         {"metric": "records_committed", "value": audit.consumed_total},
+         {"metric": "records_trained",
+          "value": finals[0]["ingest"]["consumed_total"]},
+         {"metric": "versions_served", "value": len(served_versions)}],
+        [f"requests_per_s={rps_floor}",
+         f"feedback_freshness_s<={fresh_ceil}"],
+        failures,
+        f"bench_gate --slo holds the online contract ({rps:.2f} req/s "
+        f">= {rps_floor}; freshness {fresh_s if fresh_s is None else round(fresh_s, 1)}s "
+        f"<= {fresh_ceil:.0f}s)")
+
+    summary.update({
+        "passed": not failures,
+        "requests_per_s": round(rps, 3),
+        "feedback_freshness_s": (round(fresh_s, 2)
+                                 if fresh_s is not None else None),
+        "records_committed_unique": audit.consumed_total,
+        "dedup_hits": audit.dedup_hits,
+        "torn_segments": audit.torn_segments,
+        "published": published,
+        "served_versions": served_versions,
+        "weight_swaps": router.weight_swaps,
+        "serve_counters": {k: v for k, v in sorted(counters.items())
+                           if k.startswith(("serve.", "online."))},
+        "failures": failures,
+    })
+    return summary
 
 
 def main(argv=None) -> int:
@@ -1609,6 +2348,19 @@ def main(argv=None) -> int:
                          "a checksum-corrupted response, a rolling "
                          "weight swap, and a capacity scale-up — gated "
                          "by a throughput floor + p99 ceiling")
+    ap.add_argument("--online", action="store_true",
+                    help="online continual-learning storm: a serving "
+                         "fleet feeds a live trainer through the durable "
+                         "feedback log while replicas AND a trainer rank "
+                         "are SIGKILLed, a torn segment and a duplicate "
+                         "record are injected, and the published version "
+                         "advances through rolling swaps — gated on "
+                         "exactly-once ingest accounting, zero "
+                         "accepted-then-lost requests, zero training "
+                         "progress lost, and a feedback-freshness "
+                         "ceiling")
+    ap.add_argument("--online-trainer", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one trainer rank
     ap.add_argument("--cold-start", action="store_true",
                     help=argparse.SUPPRESS)  # internal: scale-from-zero leg
     ap.add_argument("--serve-replica", action="store_true",
@@ -1623,6 +2375,19 @@ def main(argv=None) -> int:
 
     if args.worker and args.serve_publish:
         summary = run_serve_publish(args.version, workdir=args.workdir)
+        return 0 if summary["passed"] else 1
+    if args.worker and args.online_trainer:
+        # one online trainer rank: the verdict file is the output
+        run_worker_online_trainer(
+            checkpoint_every=args.checkpoint_every, workdir=args.workdir)
+        return 0
+    if args.online:
+        summary = run_online(checkpoint_every=args.checkpoint_every,
+                             workdir=args.workdir)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k not in ("stats", "finals")}))
+        print("CHAOS CHECK " + ("PASSED" if summary["passed"]
+                                else "FAILED"))
         return 0 if summary["passed"] else 1
     if args.worker and args.serve_replica:
         # one serving replica: health/responses are the output; the
@@ -1692,8 +2457,8 @@ if __name__ == "__main__":
         # jax in this process (the workers own the devices)
         sys.exit(main())
     if "--elastic" in sys.argv or "--autoscale" in sys.argv \
-            or "--serve" in sys.argv:
-        # parent of the elastic/autoscale/serving storms: likewise
+            or "--serve" in sys.argv or "--online" in sys.argv:
+        # parent of the elastic/autoscale/serving/online storms: likewise
         # jax-free — it drives launch/supervisor.py (+ the ScalePolicy /
         # capacity file, + the serving router) and reads the ranks'
         # verdict/health files and decision records

@@ -1,0 +1,134 @@
+"""Shared plumbing for the chaos-storm parents (scripts/chaos_check.py).
+
+The ``--serve`` and ``--autoscale`` storms each grew their own copy of
+the fleet-pump / capacity-file / module-loading helpers; ``--online``
+composes BOTH fleets, so the helpers live here once and every storm
+parent imports them. Everything in this module is **jax-free** — storm
+parents supervise workers and read their files, they never touch a
+device.
+
+  - `check`            the printing assertion every gate phase uses
+  - `load_module`      importlib-by-path (supervisor, bench_gate — the
+                       scripts are not packages)
+  - `load_supervisor` / `load_bench_gate`
+  - `capacity_writer`  atomic writes to a `resilience.scale.ScalePolicy`
+                       capacity file
+  - `FleetPump`        poll-the-supervisor-until-condition with one
+                       shared deadline and failure accounting — the
+                       heartbeat-poll loop every storm phase runs
+  - `slo_gate`         write a contract JSON and machine-check it through
+                       `scripts/bench_gate.py --slo`
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import time
+from typing import Callable, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check(cond, what: str, failures: List[str]) -> bool:
+    """Print one gate line; record the failure. Returns ``cond``."""
+    status = "ok" if cond else "FAIL"
+    print(f"chaos_check: [{status}] {what}")
+    if not cond:
+        failures.append(what)
+    return bool(cond)
+
+
+def load_module(name: str, path: str):
+    """Load a script file as a module (scripts/ and launch/ are not
+    packages; the storms import them by path)."""
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_supervisor():
+    return load_module("dear_launch_supervisor",
+                       os.path.join(REPO, "launch", "supervisor.py"))
+
+
+def load_bench_gate():
+    return load_module("dear_bench_gate",
+                       os.path.join(REPO, "scripts", "bench_gate.py"))
+
+
+def capacity_writer(path: str) -> Callable[[dict], None]:
+    """Atomic JSON writes to the `ScalePolicy` capacity file (the env
+    contract standing in for a spot-pool API)."""
+    def write(doc: dict) -> None:
+        with open(path + ".tmp", "w") as f:
+            json.dump(doc, f)
+        os.replace(path + ".tmp", path)
+    return write
+
+
+class FleetPump:
+    """The storm parents' heartbeat-poll loop: keep the supervisor(s)
+    reaped while waiting for a condition, against one storm-wide
+    deadline. ``pump(cond, what, timeout_s)`` returns True when ``cond``
+    held in time; a timeout records a failure and returns False, so gate
+    phases degrade into assertions instead of hangs.
+
+    ``samplers`` run on EVERY poll — the continuous-observation hooks
+    (e.g. min-healthy-during-swap) that made single post-hoc samples
+    vacuous in earlier storms.
+    """
+
+    def __init__(self, supervisors, failures: List[str], *,
+                 deadline_s: float, poll_s: float = 0.1):
+        self.supervisors = list(supervisors)
+        self.failures = failures
+        self.deadline = time.monotonic() + float(deadline_s)
+        self.poll_s = float(poll_s)
+        self.samplers: List[Callable[[], None]] = []
+
+    def add_supervisor(self, sup) -> None:
+        self.supervisors.append(sup)
+
+    def add_sampler(self, fn: Callable[[], None]) -> None:
+        self.samplers.append(fn)
+
+    def poll(self) -> None:
+        for sup in self.supervisors:
+            sup.poll()
+        for fn in self.samplers:
+            fn()
+
+    def remaining(self) -> float:
+        return max(self.deadline - time.monotonic(), 0.0)
+
+    def pump(self, cond: Callable[[], bool], what: str,
+             timeout_s: float = 120.0) -> bool:
+        t_end = min(time.monotonic() + float(timeout_s), self.deadline)
+        while time.monotonic() < t_end:
+            self.poll()
+            if cond():
+                return True
+            time.sleep(self.poll_s)
+        self.failures.append(f"timeout waiting for: {what}")
+        return False
+
+
+def slo_gate(run_json: str, metric: str, value, extra_metrics: List[dict],
+             slos: List[str], failures: List[str], what: str,
+             gate=None) -> bool:
+    """Write the bench contract JSON and hold it to absolute SLO bounds
+    through `scripts/bench_gate.py --slo` — the machine-checked service
+    contract every storm ends on."""
+    with open(run_json, "w") as f:
+        json.dump({"metric": metric, "value": value,
+                   "extra_metrics": extra_metrics}, f)
+    if gate is None:
+        gate = load_bench_gate()
+    argv = ["--run", run_json]
+    for s in slos:
+        argv += ["--slo", s]
+    rc = gate.main(argv)
+    return check(rc == 0, what, failures)

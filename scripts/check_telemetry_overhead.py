@@ -145,6 +145,35 @@ def main(argv=None) -> int:
             tr.count("serve.requests")
             tr.event("serve.shed", depth=3, predicted_wait_s=0.01)
 
+    # online-loop gates, the way online/feedback.py's append (the decode
+    # hot path's only feedback cost) and online/ingest.py's per-step
+    # cursor accounting run them: count (+ event on the cursor side)
+    # under one enabled check. The serving fleet's feedback plumbing and
+    # the trainer's ingest must be free when telemetry is off — same
+    # 1 µs budget as every other step-path gate.
+    def online_append_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("online.records_appended")
+
+    def online_append_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("online.records_appended")
+
+    def online_cursor_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("online.records_trained", 8)
+            tr.count("online.ingest_lag", 3)
+            tr.event("online.cursor_restored", consumed=100)
+
+    def online_cursor_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("online.records_trained", 8)
+            tr.count("online.ingest_lag", 3)
+
     # plan-tuner decision-loop gate, the way tuning/autotune.py's step
     # path runs it once the search has FINISHED (or never started): the
     # per-step cost must be one attribute check + return — the tuner
@@ -175,6 +204,12 @@ def main(argv=None) -> int:
     k_enabled_ns = _bench(kernel_enabled_site, max(args.iters // 10, 1))
     s_disabled_ns = _bench(serve_disabled_gate, args.iters)
     s_enabled_ns = _bench(serve_enabled_site, max(args.iters // 10, 1))
+    oa_disabled_ns = _bench(online_append_disabled_gate, args.iters)
+    oa_enabled_ns = _bench(online_append_enabled_site,
+                           max(args.iters // 10, 1))
+    oc_disabled_ns = _bench(online_cursor_disabled_gate, args.iters)
+    oc_enabled_ns = _bench(online_cursor_enabled_site,
+                           max(args.iters // 10, 1))
     tuner_finished_ns = _bench(plan_tuner_finished_gate, args.iters)
     overhead_ns = max(disabled_ns - baseline_ns, 0.0)
 
@@ -188,6 +223,10 @@ def main(argv=None) -> int:
         "kernel_enabled_ns_per_call": round(k_enabled_ns, 1),
         "serve_disabled_ns_per_call": round(s_disabled_ns, 1),
         "serve_enabled_ns_per_call": round(s_enabled_ns, 1),
+        "online_append_disabled_ns_per_call": round(oa_disabled_ns, 1),
+        "online_append_enabled_ns_per_call": round(oa_enabled_ns, 1),
+        "online_cursor_disabled_ns_per_call": round(oc_disabled_ns, 1),
+        "online_cursor_enabled_ns_per_call": round(oc_enabled_ns, 1),
         "tuner_finished_ns_per_call": round(tuner_finished_ns, 1),
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
@@ -195,6 +234,8 @@ def main(argv=None) -> int:
                and fl_disabled_ns <= args.budget_ns
                and k_disabled_ns <= args.budget_ns
                and s_disabled_ns <= args.budget_ns
+               and oa_disabled_ns <= args.budget_ns
+               and oc_disabled_ns <= args.budget_ns
                and tuner_finished_ns <= args.budget_ns),
     }
     print(json.dumps(out))

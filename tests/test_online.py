@@ -1,0 +1,504 @@
+"""Online continual-learning subsystem: the durable feedback log
+(manifest-LAST segments, torn-segment walk-past, seq dedup), the
+exactly-once streaming ingest (cursor in the sidecar state, blend vs
+feed, consensus frontier), the object-store ordering/first-writer-wins
+contracts they ride on, the new data-path fault grammar — and the
+`scripts/chaos_check.py --online` storm as the end-to-end gate
+(docs/ONLINE.md)."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.online.feedback import (
+    Cursor, FeedbackReader, FeedbackWriter, record_digest,
+)
+from dear_pytorch_tpu.online.ingest import FeedbackIngest
+from dear_pytorch_tpu.resilience.inject import (
+    Fault, FaultInjector, parse_faults,
+)
+from dear_pytorch_tpu.runtime import build as RB
+from dear_pytorch_tpu.runtime import pipeline as P
+from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+
+# ---------------------------------------------------------------------------
+# object store: the pinned ordering + first-writer-wins contracts
+# ---------------------------------------------------------------------------
+
+
+def test_list_ordering_under_concurrent_appenders(tmp_path):
+    """list(prefix) is lexicographic-by-key no matter how many appenders
+    raced — the ordering contract segment-walking readers rely on."""
+    store = LocalObjectStore(str(tmp_path))
+    gate = threading.Barrier(4)
+
+    def appender(w):
+        gate.wait()
+        for i in range(25):
+            store.put_bytes(f"logs/w{w}/seg_{i:08d}", b"x")
+
+    threads = [threading.Thread(target=appender, args=(w,))
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    keys = store.list("logs")
+    assert len(keys) == 100
+    assert keys == sorted(keys)
+    # and per-writer the segment files come back in segment order
+    w0 = [k for k in keys if k.startswith("logs/w0/")]
+    assert w0 == [f"logs/w0/seg_{i:08d}" for i in range(25)]
+
+
+def test_put_bytes_if_absent_first_writer_wins(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    gate = threading.Barrier(8)
+    wins = []
+
+    def racer(i):
+        gate.wait()
+        if store.put_bytes_if_absent("decided/e7", f"writer{i}".encode()):
+            wins.append(i)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert store.get_bytes("decided/e7") == f"writer{wins[0]}".encode()
+    # a later duplicate publication is idempotent: loses, content intact
+    assert store.put_bytes_if_absent("decided/e7", b"late") is False
+    assert store.get_bytes("decided/e7") == f"writer{wins[0]}".encode()
+
+
+# ---------------------------------------------------------------------------
+# feedback log: commit protocol, damage tolerance, dedup, cursor replay
+# ---------------------------------------------------------------------------
+
+
+def _writer(store, wid="r0", **kw):
+    kw.setdefault("stream", "s")
+    kw.setdefault("flush_records", 4)
+    kw.setdefault("start", False)
+    return FeedbackWriter(store, writer_id=wid, **kw)
+
+
+def test_roundtrip_and_manifest_last_commit(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for i in range(6):
+        assert w.append({"prompt": [i], "response": [i + 1]})
+    w.flush()  # 6 records -> one segment
+    r = FeedbackReader(store, stream="s")
+    fr = r.frontier()
+    assert fr == {"r0": 0}
+    assert r.committed_records(fr) == 6
+    cur = Cursor()
+    recs = r.take(cur, fr, 100)
+    assert [x["uid"] for x in recs] == [f"r0:{i}" for i in range(6)]
+    assert recs[0]["prompt"] == [0] and recs[0]["writer"] == "r0"
+    assert cur.consumed_total == 6 and r.drained(cur, fr)
+    # manifest-LAST: a payload without its manifest is invisible to the
+    # frontier (an in-flight flush can never be read half-committed)
+    store.put_bytes("feedback/s/r0/seg_00000001.jsonl", b'{"seq": 6}\n')
+    assert r.frontier() == {"r0": 0}
+
+
+def test_torn_segment_walked_past_never_crashes(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    inj = FaultInjector([Fault(kind="torn_seg", step=2)], own_rank=0)
+    w = _writer(store, injector=inj)
+    for i in range(12):
+        w.append({"i": i})
+        if (i + 1) % 4 == 0:
+            w.flush()
+    # flush 2 (records 4..7) published its payload but no manifest
+    r = FeedbackReader(store, stream="s")
+    cur = Cursor()
+    recs = r.take(cur, r.frontier(), 100)
+    assert [x["seq"] for x in recs] == [0, 1, 2, 3, 8, 9, 10, 11]
+    assert cur.torn_segments == 1
+    assert cur.consumed_total == 8
+
+
+def test_corrupt_payload_walked_past_and_lag_drains(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for i in range(8):
+        w.append({"i": i})
+        if (i + 1) % 4 == 0:
+            w.flush()
+    raw = bytearray(store.get_bytes("feedback/s/r0/seg_00000000.jsonl"))
+    raw[0] ^= 0xFF
+    store.put_bytes("feedback/s/r0/seg_00000000.jsonl", bytes(raw))
+    r = FeedbackReader(store, stream="s")
+    cur = Cursor()
+    recs = r.take(cur, r.frontier(), 100)
+    assert [x["seq"] for x in recs] == [4, 5, 6, 7]
+    assert cur.torn_segments == 1
+    # the corrupt segment's manifest count is written off, so the lag
+    # ledger drains to zero — a permanent nonzero ingest_lag would be a
+    # standing false alert on a fully-caught-up consumer
+    assert cur.dropped_committed == 4
+    assert (r.committed_records() - cur.consumed_total - cur.dedup_hits
+            - cur.dropped_committed) == 0
+
+
+def test_duplicate_record_deduplicated(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    inj = FaultInjector([Fault(kind="dup_feedback", step=6)], own_rank=0)
+    w = _writer(store, injector=inj)
+    for i in range(4):
+        w.append({"i": i})
+    w.flush()
+    for i in range(4, 8):
+        w.append({"i": i})  # append 6 re-appends the last COMMITTED rec
+    w.flush()
+    r = FeedbackReader(store, stream="s")
+    cur = Cursor()
+    recs = r.take(cur, r.frontier(), 100)
+    assert [x["seq"] for x in recs] == list(range(8))
+    assert cur.dedup_hits == 1
+    assert cur.consumed_total == 8
+    # the committed count INCLUDES the duplicate line; the unique count
+    # is the exactly-once quantity
+    assert r.committed_records() == 9
+
+
+def test_writer_restart_resumes_committed_tail(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    w1 = _writer(store)
+    for i in range(5):
+        w1.append({"i": i})
+    w1.flush()
+    w1.append({"i": 5})  # dies with this record buffered (never flushed)
+    w2 = _writer(store)  # the relaunched incarnation
+    assert w2._next_seg == 1 and w2._next_seq == 5
+    w2.append({"i": "fresh"})
+    w2.flush()
+    r = FeedbackReader(store, stream="s")
+    cur = Cursor()
+    recs = r.take(cur, r.frontier(), 100)
+    # seq 5 is REUSED by the new life (the buffered record was lost
+    # before commit), so the stream stays gap-free
+    assert [x["seq"] for x in recs] == list(range(6))
+    assert cur.dedup_hits == 0 and cur.torn_segments == 0
+
+
+def test_restart_after_dup_at_segment_tail_does_not_reuse_seqs(tmp_path):
+    """The duplicate re-append lands AFTER the newest record, so a
+    positional last_seq would understate the manifest and a relaunched
+    writer would re-stamp already-committed seq numbers — which every
+    reader then silently dedup-drops (committed-but-never-consumed data
+    loss the ledger cannot even see). last_seq must be the MAX."""
+    store = LocalObjectStore(str(tmp_path))
+    inj = FaultInjector([Fault(kind="dup_feedback", step=5)], own_rank=0)
+    w1 = _writer(store, injector=inj)
+    for i in range(4):
+        w1.append({"i": i})
+    w1.flush()                      # seqs 0..3 committed
+    w1.append({"i": 4})             # append 5: seq 4 + dup of seq 3
+    w1.flush()                      # segment tail is the dup (seq 3)
+    w2 = _writer(store)             # relaunched incarnation
+    assert w2._next_seq == 5        # NOT 4: seq 4 is already committed
+    w2.append({"i": "fresh"})
+    w2.flush()
+    r = FeedbackReader(store, stream="s")
+    cur = Cursor()
+    recs = r.take(cur, r.frontier(), 100)
+    # every unique committed record consumed, the dup alone dropped
+    assert [x["seq"] for x in recs] == [0, 1, 2, 3, 4, 5]
+    assert cur.dedup_hits == 1
+
+
+def test_flush_exhaustion_counts_never_raises(tmp_path):
+    class DeadStore(LocalObjectStore):
+        def __init__(self, root):
+            super().__init__(root)
+            self.dead = False
+
+        def put_bytes(self, key, data):
+            if self.dead:
+                raise OSError("store down")
+            super().put_bytes(key, data)
+
+    store = DeadStore(str(tmp_path))
+    w = _writer(store, retry_attempts=2)
+    for i in range(4):
+        w.append({"i": i})
+    store.dead = True
+    assert w.flush() == 0           # exhausted: dropped, not raised
+    assert w.flush_errors == 1 and w.dropped_flush == 4
+    store.dead = False
+    for i in range(4, 8):
+        w.append({"i": i})
+    assert w.flush() == 4           # the writer survived its dead store
+    assert w.committed == 4
+
+
+def test_append_never_blocks_on_full_buffer(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store, max_buffer=3)
+    assert all(w.append({"i": i}) for i in range(3))
+    assert w.append({"i": 99}) is False    # dropped, counted, no raise
+    assert w.append_drops == 1
+    w.flush()
+    assert w.committed == 3
+
+
+def test_parse_data_path_faults():
+    faults = parse_faults("torn_seg@2:r1,dup_feedback@6")
+    assert faults[0].kind == "torn_seg" and faults[0].step == 2
+    assert faults[0].rank == 1
+    assert faults[1].kind == "dup_feedback" and faults[1].rank is None
+    # rank targeting: the fault is consumed (skipped) on other ranks so
+    # schedules drain identically everywhere
+    inj = FaultInjector([faults[0]], own_rank=0)
+    assert inj.torn_segment(2) is False
+    assert [f.kind for f in inj.skipped] == ["torn_seg"]
+    assert inj.pending == 0
+
+
+# ---------------------------------------------------------------------------
+# ingest: blend vs feed, exactly-once cursor replay, consensus frontier
+# ---------------------------------------------------------------------------
+
+
+def _ingest(store, *, batch_records=4, consensus_fn=None, rows=4):
+    spec = P.SyntheticSpec((
+        P.Field("x", (rows, 6), RB.KIND_NORMAL_F32, 0.0, 1.0),
+    ))
+    base = P.NumpyPipeline(spec, seed=7)
+
+    def batch_fn(base_batch, records):
+        x = np.array(base_batch["x"])
+        for j, rec in enumerate(records[:rows]):
+            rng = np.random.default_rng(
+                record_digest(rec["writer"], rec["seq"]) % (1 << 32))
+            x[j] = rng.normal(size=x.shape[1]).astype(np.float32)
+        return {"x": x, "nrec": len(records)}
+
+    return FeedbackIngest(base, FeedbackReader(store, stream="s"),
+                          batch_records=batch_records, batch_fn=batch_fn,
+                          consensus_fn=consensus_fn)
+
+
+def test_ingest_blends_when_starved_feeds_when_available(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    ing = _ingest(store)
+    b = ing.next()
+    assert b["nrec"] == 0 and ing.last_drained    # empty log: pure blend
+    w = _writer(store)
+    for i in range(6):
+        w.append({"i": i})
+    w.flush()
+    b = ing.next()
+    assert b["nrec"] == 4 and not ing.last_drained
+    assert ing.lag() == 2
+    b = ing.next()
+    assert b["nrec"] == 2 and ing.last_drained and ing.lag() == 0
+    b = ing.next()
+    assert b["nrec"] == 0                          # drained: blend again
+
+
+def test_ingest_cursor_replay_is_exactly_once(tmp_path):
+    """Restoring the state dict (what a guard rollback does) replays the
+    stream byte-identically: same records, same batches, same checksum —
+    the sidecar transactionality that makes ingest exactly-once."""
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for i in range(14):
+        w.append({"i": i})
+        if (i + 1) % 4 == 0:
+            w.flush()
+    w.flush()
+    ing = _ingest(store)
+    ing.next()
+    snap = ing.state_dict()
+    snap_json = json.dumps(snap)          # must be sidecar-JSON-safe
+    after = [ing.next() for _ in range(3)]
+    end_state = ing.state_dict()
+    ing.load_state_dict(json.loads(snap_json))
+    replay = [ing.next() for _ in range(3)]
+    for a, b in zip(after, replay):
+        assert np.allclose(a["x"], b["x"]) and a["nrec"] == b["nrec"]
+    assert ing.state_dict() == end_state
+    assert ing.cursor.consumed_total == 14
+
+
+def test_ingest_consensus_frontier_caps_the_read(tmp_path):
+    """The fleet-MIN frontier pins every rank to the same availability
+    snapshot: records committed past the agreed frontier are invisible
+    until the next exchange, so replicas can never diverge on feed vs
+    blend."""
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for i in range(8):
+        w.append({"i": i})
+        if (i + 1) % 4 == 0:
+            w.flush()
+
+    calls = []
+
+    def consensus(frontier):
+        calls.append(dict(frontier))
+        return {"r0": 0}  # a lagging peer has only seen segment 0
+
+    ing = _ingest(store, consensus_fn=consensus)
+    b = ing.next()
+    assert b["nrec"] == 4 and ing.cursor.consumed_total == 4
+    b = ing.next()
+    assert b["nrec"] == 0                  # frontier-capped: blend
+    assert calls and calls[-1] == {"r0": 1}  # local view did see seg 1
+
+
+def test_ingest_checksum_is_interleave_independent(tmp_path):
+    """Two consumers with different batch sizes (different interleaves
+    across writers) converge to the same consumed_total AND checksum —
+    what lets a jax-free auditor replay the log and verify the trainer's
+    ledger without reproducing its step cadence."""
+    store = LocalObjectStore(str(tmp_path))
+    for wid in ("r0", "r1"):
+        w = _writer(store, wid=wid)
+        for i in range(10):
+            w.append({"i": i})
+            if (i + 1) % 5 == 0:
+                w.flush()
+    a, b = _ingest(store, batch_records=3), _ingest(store, batch_records=7)
+    for ing in (a, b):
+        while not (ing.next() is not None and ing.last_drained
+                   and ing.last_records == 0):
+            pass
+    assert a.cursor.consumed_total == b.cursor.consumed_total == 20
+    assert a.cursor.checksum == b.cursor.checksum
+
+
+def test_ingest_bare_sidecar_restore_resets_cursor(tmp_path):
+    """Rolling back to a sidecar written by a bare pipeline (a run that
+    predates the online wrapper) must RESET the cursor: keeping the
+    in-memory position would leave records trained only into the
+    discarded state and never re-consumed — re-training from zero is
+    the transactional answer."""
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for i in range(6):
+        w.append({"i": i})
+    w.flush()
+    ing = _ingest(store)
+    bare_state = ing.base.state_dict()     # a pre-online sidecar
+    ing.next()
+    assert ing.cursor.consumed_total == 4
+    ing.load_state_dict(bare_state)
+    assert ing.cursor.consumed_total == 0  # reset, not stale
+    ing.next()
+    ing.next()
+    assert ing.cursor.consumed_total == 6  # everything re-consumed
+
+
+def test_frontier_probe_advances_without_listing(tmp_path):
+    """Between discovery listings the frontier advances by exists()
+    probes (O(writers) per step, not O(log age)); a numbering gap (a
+    wholly-dropped segment) is jumped at the next discovery listing."""
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for i in range(4):
+        w.append({"i": i})
+    w.flush()
+    r = FeedbackReader(store, stream="s", discover_every=4)
+    assert r.frontier() == {"r0": 0}       # call 1: discovery listing
+    for i in range(4, 8):
+        w.append({"i": i})
+    w.flush()
+    assert r.frontier() == {"r0": 1}       # call 2: probe fast path
+    # a wholly-dropped segment (no objects at all): the writer moved on
+    w._next_seg += 1
+    for i in range(8, 12):
+        w.append({"i": i})
+    w.flush()                              # commits seg 3, seg 2 empty
+    assert r.frontier() == {"r0": 1}       # call 3: probe stalls at gap
+    assert r.frontier(full=True) == {"r0": 3}  # definitive view on demand
+    r2 = FeedbackReader(store, stream="s", discover_every=4)
+    r2.frontier()                          # fresh reader: discovery
+    assert r2.frontier() == {"r0": 3}      # probes continue from there
+    cur = Cursor()
+    recs = r.take(cur, r.frontier(), 100)
+    assert [x["seq"] for x in recs] == list(range(12))
+
+
+def test_ingest_reshard_keeps_replica_identical_blend(tmp_path):
+    """A membership transition reshards the base stream by EPOCH only:
+    every member of the new world draws the identical blend stream (the
+    ingest is replica-global), while the epoch fold still makes the
+    post-transition stream distinct from the pre-transition one."""
+    store = LocalObjectStore(str(tmp_path))
+    a, b = _ingest(store), _ingest(store)
+    a.reshard(0, 3, epoch=2)   # rank 0's view of a 3-world
+    b.reshard(2, 3, epoch=2)   # rank 2's view of the same transition
+    ba, bb = a.next(), b.next()
+    assert np.allclose(ba["x"], bb["x"])
+    assert a.state_dict()["epoch"] == 2
+    fresh = _ingest(store)     # epoch 0: a different stream
+    assert not np.allclose(fresh.next()["x"], ba["x"])
+
+
+def test_sole_survivor_guard_stays_coordinated(tmp_path):
+    """The --online storm's root-caused bug: a 2-rank fleet shrinks to
+    ONE survivor — the guard must keep running the coordinated health
+    sync (it is where rejoin requests are polled), or the relaunched
+    rank is never admitted and the fleet can never grow back."""
+    from dear_pytorch_tpu.resilience import membership as M
+    from dear_pytorch_tpu.resilience.cluster import FileTransport
+    from dear_pytorch_tpu.utils import guard as G
+
+    cluster = M.ElasticCluster(
+        transport=FileTransport(str(tmp_path / "store")), rank=0, world=1)
+    shim = object.__new__(G.GuardedTrainer)  # property check only
+    shim._coordinator = cluster
+    assert cluster.process_count == 1
+    assert shim._coordinated is True
+
+    class PlainWorld1:
+        process_count = 1
+
+    shim._coordinator = PlainWorld1()
+    assert shim._coordinated is False  # non-elastic world-1: unchanged
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(560, method="signal")
+def test_chaos_check_online_storm(tmp_path):
+    """scripts/chaos_check.py --online: the training↔serving closed-loop
+    gate (ISSUE-12 acceptance). A serving fleet feeds a live 2-rank
+    trainer through the durable feedback log while a serving replica and
+    a trainer rank are SIGKILLed, a torn segment and a duplicate record
+    are injected, and the published version advances through rolling
+    drain+backfill swaps (>= 2 observed serving). Asserts zero
+    accepted-then-lost requests, zero training progress lost past the
+    newest upload, exactly-once ingest accounting (count AND
+    order-independent checksum vs a jax-free replay of the log), and
+    `bench_gate.py --slo` holding a throughput floor and the
+    feedback-freshness ceiling."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "chaos_check.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, "--online", "--workdir", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=520,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]

@@ -487,6 +487,65 @@ def test_router_counts_weight_swap(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# weight-version walk-back (previously only the storm exercised this
+# indirectly): corrupt the newest version's MANIFEST in one case and a
+# PAYLOAD file in the other — load_params must land on the newest intact
+# version both times
+# ---------------------------------------------------------------------------
+
+
+def _publish_versions(tmp_path, n=3):
+    from dear_pytorch_tpu.serving import weights as W
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    store = LocalObjectStore(str(tmp_path / "store"))
+    for v in range(1, n + 1):
+        W.publish_params(
+            store, {"layer": {"kernel": np.full((2, 2), float(v))}}, v)
+    return store, W
+
+
+def test_weights_walk_back_past_corrupt_manifest(tmp_path):
+    store, W = _publish_versions(tmp_path)
+    store.put_bytes("weights/v000003/MANIFEST.json", b"{not json")
+    params, version = W.load_params(store)
+    assert version == 2
+    assert params["layer"]["kernel"][0, 0] == 2.0
+
+
+def test_weights_walk_back_past_corrupt_payload(tmp_path):
+    store, W = _publish_versions(tmp_path)
+    data = bytearray(store.get_bytes("weights/v000003/params.npz"))
+    data[:16] = bytes(b ^ 0xFF for b in data[:16])  # sha mismatch
+    store.put_bytes("weights/v000003/params.npz", bytes(data))
+    params, version = W.load_params(store)
+    assert version == 2
+    assert params["layer"]["kernel"][0, 0] == 2.0
+
+
+def test_weights_walk_back_counts_and_explicit_version(tmp_path):
+    from dear_pytorch_tpu.observability import tracer as T
+
+    store, W = _publish_versions(tmp_path)
+    data = bytearray(store.get_bytes("weights/v000003/params.npz"))
+    data[0] ^= 0xFF
+    store.put_bytes("weights/v000003/params.npz", bytes(data))
+    old = T._tracer
+    T.set_tracer(T.Tracer([T.MemoryExporter()]))
+    try:
+        _params, version = W.load_params(store)
+        assert version == 2
+        counters = T.get_tracer().counters()
+        assert counters.get("serve.weight_corrupt_detected", 0) >= 1
+    finally:
+        T.set_tracer(old)
+    # an EXPLICITLY requested corrupt version must fail loudly, not
+    # silently serve an older one
+    with pytest.raises(KeyError):
+        W.load_params(store, version=3)
+
+
+# ---------------------------------------------------------------------------
 # serving fault grammar (resilience.inject satellites)
 # ---------------------------------------------------------------------------
 
