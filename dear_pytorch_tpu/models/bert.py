@@ -42,8 +42,12 @@ class BertConfig:
     #: Decode-mode KV-cache ring length (None = the position budget; see
     #: models/gpt.py — same ring semantics via `serving.kvcache`).
     kv_cache_len: Optional[int] = None
-    #: Route decode attention through the Pallas flash kernel.
+    #: Route decode attention through the Pallas flash kernel (decode
+    #: ticks only; chunked prefill uses the dense core — models/gpt.py).
     decode_use_flash: bool = False
+    #: Storage dtype of the decode KV cache (None = ``dtype``; see
+    #: models/gpt.py — the serving cache-memory knob).
+    kv_cache_dtype: Any = None
 
     @property
     def padded_vocab_size(self) -> int:
@@ -132,7 +136,7 @@ class BertSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask, train: bool = True, decode: bool = False,
-                 decode_positions=None):
+                 decode_positions=None, prefill_lengths=None):
         cfg = self.config
         h, nh = cfg.hidden_size, cfg.num_attention_heads
         d = h // nh
@@ -146,7 +150,8 @@ class BertSelfAttention(nn.Module):
                 (nh, d), dtype=cfg.dtype, name=name, kernel_init=kinit)
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
         if decode:
-            ctx = self._decode_attend(q, k, v, decode_positions)
+            ctx = self._decode_attend(q, k, v, decode_positions,
+                                      prefill_lengths)
         else:
             dropout_rng = None
             if train and cfg.attention_probs_dropout_prob > 0.0:
@@ -161,32 +166,48 @@ class BertSelfAttention(nn.Module):
             kernel_init=nn.initializers.normal(cfg.initializer_range))(ctx)
         return out
 
-    def _decode_attend(self, q, k, v, positions):
-        """Single-token attention against the ring-buffer KV cache — the
-        serving decode path, identical ring semantics to models/gpt.py
-        (`serving.kvcache` owns the math). Incremental decode is
-        left-to-right by construction, so its logits reproduce the full
-        forward run with ``causal=True`` (pinned by
-        tests/test_serving.py), not the bidirectional training forward."""
+    def _decode_attend(self, q, k, v, positions, prefill_lengths=None):
+        """Attention against the ring-buffer KV cache — the serving
+        decode path, identical ring semantics to models/gpt.py
+        (`serving.kvcache` owns the math; S > 1 with ``prefill_lengths``
+        is a chunked prefill tick — see GptBlock._decode_attend).
+        Incremental decode is left-to-right by construction, so its
+        logits reproduce the full forward run with ``causal=True``
+        (pinned by tests/test_serving.py), not the bidirectional
+        training forward."""
         from dear_pytorch_tpu.serving import kvcache as KV
 
         cfg = self.config
         B, S, nh, d = q.shape
-        if S != 1:
-            raise ValueError(
-                f"decode mode feeds one token at a time, got S={S}"
-            )
         L = cfg.kv_cache_len or cfg.max_position_embeddings
+        if S > 1 and prefill_lengths is None:
+            raise ValueError(
+                f"decode with S={S} > 1 is a chunked prefill and needs "
+                "per-row prefill_lengths"
+            )
+        if S > L:
+            raise ValueError(
+                f"prefill chunk ({S}) exceeds the KV ring length ({L}); "
+                "a chunk must not overwrite its own window"
+            )
+        kv_dtype = cfg.kv_cache_dtype or cfg.dtype
         initialized = self.has_variable("cache", "k")
         ck = self.variable("cache", "k",
-                           lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
+                           lambda: jnp.zeros((B, L, nh, d), kv_dtype))
         cv = self.variable("cache", "v",
-                           lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
+                           lambda: jnp.zeros((B, L, nh, d), kv_dtype))
         if not initialized:
             return jnp.zeros_like(q)
+        if S > 1:
+            ctx = KV.chunk_attend(q, ck.value, cv.value, k, v, positions,
+                                  prefill_lengths, dtype=cfg.dtype)
+            ck.value, cv.value = KV.ring_write_chunk(
+                ck.value, cv.value, positions, k.astype(kv_dtype),
+                v.astype(kv_dtype), prefill_lengths)
+            return ctx
         ck.value, cv.value = KV.ring_write(
-            ck.value, cv.value, positions, k.astype(cfg.dtype),
-            v.astype(cfg.dtype))
+            ck.value, cv.value, positions, k.astype(kv_dtype),
+            v.astype(kv_dtype))
         valid = KV.ring_validity(positions, L)
         return KV.cache_attend(q, ck.value, cv.value, valid,
                                dtype=cfg.dtype,
@@ -200,12 +221,13 @@ class BertLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask, train: bool = True, decode: bool = False,
-                 decode_positions=None):
+                 decode_positions=None, prefill_lengths=None):
         cfg = self.config
         attn = BertSelfAttention(cfg, attention_impl=self.attention_impl,
                                  projection_impl=self.projection_impl,
                                  name="attention")(x, mask, train, decode,
-                                                   decode_positions)
+                                                   decode_positions,
+                                                   prefill_lengths)
         attn = nn.Dropout(cfg.hidden_dropout_prob,
                           deterministic=not train)(attn)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
@@ -245,7 +267,8 @@ class BertForPreTraining(nn.Module):
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  train: bool = True, position_offset=0, pool_fn=None,
-                 causal: bool = False, decode: bool = False):
+                 causal: bool = False, decode: bool = False,
+                 prefill_lengths=None):
         """``position_offset`` shifts position ids (a sequence-parallel shard
         at global offset r*S_local passes that offset; in decode mode it may
         be a per-row ``[B]`` array — see models/gpt.py); ``pool_fn(x)``
@@ -277,6 +300,10 @@ class BertForPreTraining(nn.Module):
         else:
             # scalar or broadcastable offset array — legacy semantics
             pos_ids = offset + jnp.arange(S)[None, :]
+        if decode:
+            # a partial final prefill chunk's padding rows must not index
+            # past the position table (see models/gpt.py)
+            pos_ids = jnp.minimum(pos_ids, cfg.max_position_embeddings - 1)
         x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                          embedding_init=embed_init, dtype=cfg.dtype,
                          name="position_embeddings")(pos_ids)
@@ -316,7 +343,8 @@ class BertForPreTraining(nn.Module):
             x = BertLayer(cfg, attention_impl=self.attention_impl,
                           projection_impl=self.projection_impl,
                           name=f"layer_{i}")(x, mask, train, decode,
-                                             decode_positions)
+                                             decode_positions,
+                                             prefill_lengths)
 
         # --- MLM head: transform + tied decoder + bias -----------------------
         y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,

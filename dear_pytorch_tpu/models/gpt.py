@@ -72,8 +72,14 @@ class GptConfig:
     #: Route decode-mode attention through the Pallas flash kernel
     #: (1-token query over the cache, validity mask as its ``kv_mask``)
     #: instead of the dense core. Same logits at dtype tolerance
-    #: (tests/test_serving.py).
+    #: (tests/test_serving.py). Chunked prefill (S > 1 decode calls)
+    #: always uses the dense core — its per-(query, key) window mask is
+    #: outside the kernel's per-row ``kv_mask`` contract.
     decode_use_flash: bool = False
+    #: Storage dtype of the decode KV cache (None = ``dtype``). bf16
+    #: halves serving cache memory per slot; decode logits then match the
+    #: full forward at bf16 tolerance (a `ServeSpace` axis, docs/TUNING.md).
+    kv_cache_dtype: Any = None
 
     @property
     def padded_vocab_size(self) -> int:
@@ -165,7 +171,7 @@ class GptBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True, decode: bool = False,
-                 decode_positions=None):
+                 decode_positions=None, prefill_lengths=None):
         cfg = self.config
         h, nh = cfg.hidden_size, cfg.num_attention_heads
         d = h // nh
@@ -187,7 +193,8 @@ class GptBlock(nn.Module):
         if train and cfg.attention_probs_dropout_prob > 0.0:
             dropout_rng = self.make_rng("dropout")
         if decode:
-            ctx = self._decode_attend(q, k, v, decode_positions)
+            ctx = self._decode_attend(q, k, v, decode_positions,
+                                      prefill_lengths)
         else:
             impl = self.attention_impl or causal_dot_product_attention
             ctx = impl(q, k, v, None, dropout_rng=dropout_rng,
@@ -240,38 +247,62 @@ class GptBlock(nn.Module):
         y = nn.Dropout(cfg.hidden_dropout_prob, deterministic=not train)(y)
         return x + y
 
-    def _decode_attend(self, q, k, v, positions):
-        """Single-token attention against the ring-buffer KV cache
-        (autoregressive decoding; `serving.kvcache` owns the ring math).
-        ``positions`` is the per-row global token position ``[B]`` — the
-        write slot is ``pos % L`` and validity derives from the position
-        alone, so the cache carries NO write-index state: resetting a row
-        to position 0 (continuous-batching slot reuse) invalidates every
-        stale entry for free. Shapes are static — the ring length is
-        ``config.kv_cache_len`` (default: the position budget)."""
+    def _decode_attend(self, q, k, v, positions, prefill_lengths=None):
+        """Attention against the ring-buffer KV cache (autoregressive
+        decoding; `serving.kvcache` owns the ring math). ``positions`` is
+        the per-row global token position ``[B]`` — the write slot is
+        ``pos % L`` and validity derives from the position alone, so the
+        cache carries NO write-index state: resetting a row to position 0
+        (continuous-batching slot reuse) invalidates every stale entry
+        for free. Shapes are static — the ring length is
+        ``config.kv_cache_len`` (default: the position budget).
+
+        ``S == 1``: the single-token decode tick. ``S > 1``: a chunked
+        prefill tick — ``prefill_lengths`` (``[B]``) gives each row's
+        valid prefix of the chunk (0 freezes the row: no write, output
+        garbage the engine ignores); queries attend the pre-chunk cache
+        plus the chunk's own K/V under exact per-query window masking
+        (`serving.kvcache.chunk_attend`), so chunk logits match the
+        token-at-a-time path at every position, wrap boundary included."""
         from dear_pytorch_tpu.serving import kvcache as KV
 
         cfg = self.config
         B, S, nh, d = q.shape
-        if S != 1:
-            raise ValueError(
-                f"decode mode feeds one token at a time, got S={S}"
-            )
         L = cfg.kv_cache_len or cfg.max_position_embeddings
+        if S > 1 and prefill_lengths is None:
+            raise ValueError(
+                f"decode with S={S} > 1 is a chunked prefill and needs "
+                "per-row prefill_lengths"
+            )
+        if S > L:
+            raise ValueError(
+                f"prefill chunk ({S}) exceeds the KV ring length ({L}); "
+                "a chunk must not overwrite its own window"
+            )
+        kv_dtype = cfg.kv_cache_dtype or cfg.dtype
         # flax's standard decode-cache pattern: during model.init the
         # variables are being CREATED (has_variable is False) and the call
         # must not execute a cache write — otherwise the returned cache
         # template already carries a phantom entry in slot 0
         initialized = self.has_variable("cache", "k")
         ck = self.variable("cache", "k",
-                           lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
+                           lambda: jnp.zeros((B, L, nh, d), kv_dtype))
         cv = self.variable("cache", "v",
-                           lambda: jnp.zeros((B, L, nh, d), cfg.dtype))
+                           lambda: jnp.zeros((B, L, nh, d), kv_dtype))
         if not initialized:
             return jnp.zeros_like(q)
+        if S > 1:
+            # attend BEFORE the write: the chunk's tail may overwrite ring
+            # slots its own head is still entitled to see (see chunk_attend)
+            ctx = KV.chunk_attend(q, ck.value, cv.value, k, v, positions,
+                                  prefill_lengths, dtype=cfg.dtype)
+            ck.value, cv.value = KV.ring_write_chunk(
+                ck.value, cv.value, positions, k.astype(kv_dtype),
+                v.astype(kv_dtype), prefill_lengths)
+            return ctx
         ck.value, cv.value = KV.ring_write(
-            ck.value, cv.value, positions, k.astype(cfg.dtype),
-            v.astype(cfg.dtype))
+            ck.value, cv.value, positions, k.astype(kv_dtype),
+            v.astype(kv_dtype))
         # causality is carried by the slot-validity mask (only positions
         # already written — the current token included — are attendable)
         valid = KV.ring_validity(positions, L)
@@ -294,14 +325,22 @@ class GptLmHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, train: bool = True, position_offset=0,
-                 decode: bool = False):
+                 decode: bool = False, prefill_lengths=None):
         """``decode=True``: autoregressive mode — ``input_ids`` is one
         token per sequence ``[B, 1]``, attention reads/writes the 'cache'
         collection (apply with ``mutable=['cache']``), and
         ``position_offset`` is the token's global position — a scalar, or
         a per-row ``[B]`` array (a continuous-batching engine serves rows
         at independent positions: some prefilling, some decoding, in ONE
-        jitted step — `serving.engine`)."""
+        jitted step — `serving.engine`).
+
+        ``decode=True`` with ``input_ids`` of shape ``[B, C]`` (C > 1) is
+        a CHUNKED PREFILL tick: each row consumes its valid prefix
+        (``prefill_lengths`` ``[B]``, required; 0 freezes a row) of C
+        prompt tokens into the ring cache in one step — ceil(P/C) ticks
+        per P-token prompt instead of P. Logits at in-chunk position j
+        equal the token-at-a-time logits at global position
+        ``position_offset + j`` (tests/test_serving.py)."""
         cfg = self.config
         B, S = input_ids.shape
         init = nn.initializers.normal(cfg.initializer_range)
@@ -316,6 +355,12 @@ class GptLmHeadModel(nn.Module):
             # scalar, or a [..., S]-broadcastable per-token offset array
             # (the zigzag sequence-parallel layout) — legacy semantics
             pos = offset + jnp.arange(S)[None, :]
+        if decode:
+            # a partial final prefill chunk's PADDING rows can index past
+            # the position table (their outputs are masked/ignored, but
+            # the embedding gather must stay in bounds by construction,
+            # not by XLA's clamping being merciful)
+            pos = jnp.minimum(pos, cfg.max_position_embeddings - 1)
         x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                          embedding_init=init, dtype=cfg.dtype,
                          name="wpe")(pos)
@@ -339,7 +384,8 @@ class GptLmHeadModel(nn.Module):
         for i in range(cfg.num_hidden_layers):
             x = block_cls(cfg, attention_impl=self.attention_impl,
                           projection_impl=self.projection_impl,
-                          name=f"h_{i}")(x, train, decode, decode_positions)
+                          name=f"h_{i}")(x, train, decode, decode_positions,
+                                         prefill_lengths)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ln_f")(x)
         return wte.attend(x).astype(jnp.float32)

@@ -37,8 +37,19 @@ from typing import IO, Optional
 
 __all__ = [
     "JsonlWriter", "PromFileExporter", "HealthStreamExporter",
-    "write_streams",
+    "write_streams", "sorted_quantile",
 ]
+
+
+def sorted_quantile(sorted_vals, p: float):
+    """Nearest-rank quantile of an ASCENDING-sorted sequence (the one
+    convention every latency gauge in the repo uses — router stats,
+    engine phase gauges, the serve-tune episodes — so a change to the
+    estimator lands everywhere at once). Returns None when empty."""
+    if not sorted_vals:
+        return None
+    n = len(sorted_vals)
+    return sorted_vals[min(int(p * (n - 1)), n - 1)]
 
 
 def _json_safe(v):

@@ -716,14 +716,30 @@ def make_ring_projection_impl(axis_name) -> Callable:
     device's rows; summed across devices by the bucket reduce-scatter
     that is the sum of per-device gradients — numerically the same total
     (see module docstring). Falls back to the dense matmul when the
-    input-feature dim does not divide by the axis size.
+    input-feature dim does not divide by the axis size, and outside any
+    bound ``axis_name`` (model.init, an unmapped eval) where there is no
+    ring to drive — the impl IS dense there, which is what lets
+    `serving.engine.DecodeEngine` build its cache template from the same
+    model object it later shard_maps.
 
-    Honest status: under ``mode="dear-fused"`` the bucket all-gather has
-    already materialized the full kernel, so using this impl adds ring
-    transport rather than eliding the gather — it exercises and measures
-    the fused matmul in the real model graph (the auditor's fused-mode
-    rows); eliding the upfront gather for projection-owned buckets is the
-    named next step in docs/KERNELS.md."""
+    Two call sites ride this hook:
+
+    - **training** (``--ring-projections``, mode="dear-fused"): forward
+      AND backward rings in the fused train step — the auditor's
+      fused-mode rows;
+    - **serving ring-TP decode** (`serving.engine.DecodeEngine`
+      ``tp_mesh=``): the forward ring only, inside the jitted decode /
+      chunked-prefill ticks — decode is weight-bytes-bound, so the
+      streamed operand is exactly the one that dominates
+      (docs/SERVING.md "Ring-TP decode").
+
+    Honest status: in both sites the full kernel is MATERIALIZED on every
+    device (training: the bucket all-gather already gathered it; serving:
+    the replica holds replicated params), so the impl adds ring transport
+    rather than eliding the gather/replication — it exercises and
+    measures the fused matmul in the real model graph; gather elision and
+    resident weight sharding are the named next steps in
+    docs/KERNELS.md."""
     try:
         from flax.linen import dtypes as _fdtypes
     except ImportError:  # pragma: no cover - flax always present in repo

@@ -5,12 +5,19 @@ the training stack's own substrate:
 
   - `kvcache`   — ring-buffer KV-cache math shared by the model decode
                   paths (models/gpt.py, models/bert.py ``decode=True``),
-                  flash-kernel-backed optionally (`ops.flash_attention`)
-  - `engine`    — continuous batching: ONE jitted step serving mixed
-                  prefill+decode batches over fixed slots
+                  flash-kernel-backed optionally (`ops.flash_attention`);
+                  chunked multi-token writes + exact pre-write chunk
+                  attend for the prefill fast path
+  - `engine`    — continuous batching over fixed slots: a ``[slots, 1]``
+                  decode tick + a ``[slots, C]`` chunked-prefill tick
+                  (ceil(P/C) prefill ticks per prompt) interleaved under
+                  a decode-latency budget; optional ring-TP decode
+                  (``tp_mesh=`` routes QKV/MLP through the
+                  `ops.collective_matmul` ring kernels)
   - `admission` — bounded queueing with explicit 429-style load shedding
-                  (depth x service-time vs deadline budget); sheds raise
-                  the retryable `SheddingError` for `resilience.retry`
+                  (queue wait + the request's own split prefill/decode
+                  estimate vs its deadline budget); sheds raise the
+                  retryable `SheddingError` for `resilience.retry`
   - `router`    — jax-free front end: file-protocol dispatch, heartbeat
                   health checks, checksum verification, and the zero-drop
                   re-dispatch of a dead replica's in-flight requests

@@ -7,11 +7,13 @@ on arrival. This controller fails *explicitly and early* instead
 (429-style load shedding): a request is rejected at the door when
 
   - the system already holds ``max_depth`` requests (bounded queue), or
-  - its predicted wait — ``depth x service_time / capacity``, the
-    Little's-law estimate from the EWMA of observed per-request service
-    time and the fleet's live slot capacity — already exceeds the
-    request's deadline budget (admitting it would burn fleet time on a
-    response nobody can use).
+  - its predicted wait — the Little's-law queue estimate
+    ``depth x service_time / capacity`` plus the request's OWN predicted
+    service time ``prefill_rate x prompt_tokens + decode_rate x
+    max_new_tokens`` from split per-phase EWMAs (see
+    `AdmissionController`) — already exceeds the request's deadline
+    budget (admitting it would burn fleet time on a response nobody can
+    use).
 
 A shed request raises `SheddingError`, which is precisely the
 *retryable* signal `resilience.retry` is built for: clients wrap submit
@@ -55,6 +57,22 @@ class AdmissionController:
     it as replicas come and go); ``service_time_s`` is seeded optimistic
     (0 — the first requests are always admitted) and learned as an EWMA
     of observed per-request service time via `complete`.
+
+    **Split prefill/decode estimates.** One blended service-time EWMA
+    mis-budgets a mixed workload: a burst of long prompts inflates the
+    estimate and the controller starts shedding short decode-bound
+    requests whose actual cost is a fraction of it. When per-phase
+    observations arrive (`complete` with ``prefill_tokens``/``prefill_s``
+    and ``decode_tokens``/``decode_s`` — the engine attributes tick time
+    per phase and the response payload carries it back), the controller
+    learns per-TOKEN rates for each phase and budgets an arriving request
+    as
+
+        queue_wait + prefill_est(prompt_tokens) + decode_est(max_tokens)
+
+    so the deadline check prices the request's OWN shape, not the
+    fleet-average request. Without phase data (legacy callers, cold
+    start) the behavior is exactly the blended-EWMA original.
     """
 
     def __init__(self, max_depth: int, *, capacity: int = 1,
@@ -69,6 +87,9 @@ class AdmissionController:
         self._clock = clock
         self._lock = threading.Lock()
         self._depth = 0
+        # per-token phase rates (seconds/token EWMAs; 0 = not yet learned)
+        self._prefill_rate_s = 0.0
+        self._decode_rate_s = 0.0
         # plain-int mirrors so accounting works with telemetry disabled
         self.requests = 0
         self.admitted = 0
@@ -84,27 +105,56 @@ class AdmissionController:
     def service_time_s(self) -> float:
         return self._service_s
 
+    @property
+    def prefill_rate_s(self) -> float:
+        """Learned prefill seconds per prompt token (0 until observed)."""
+        return self._prefill_rate_s
+
+    @property
+    def decode_rate_s(self) -> float:
+        """Learned decode seconds per generated token (0 until observed)."""
+        return self._decode_rate_s
+
     def set_capacity(self, capacity: int) -> None:
         with self._lock:
             self._capacity = max(int(capacity), 1)
 
     # -- the decision --------------------------------------------------------
 
+    def _request_est_locked(self, prompt_tokens, max_new_tokens) -> float:
+        """This request's own predicted service time from the split
+        per-token rates; 0.0 when the rates or the shape are unknown
+        (legacy behavior: only the queue term gates)."""
+        if prompt_tokens is None and max_new_tokens is None:
+            return 0.0
+        est = 0.0
+        if prompt_tokens and self._prefill_rate_s > 0.0:
+            est += float(prompt_tokens) * self._prefill_rate_s
+        if max_new_tokens and self._decode_rate_s > 0.0:
+            est += float(max_new_tokens) * self._decode_rate_s
+        return est
+
     def predicted_wait_s(self) -> float:
         """Little's-law wait estimate for a request arriving NOW."""
         with self._lock:
             return self._depth * self._service_s / self._capacity
 
-    def admit(self, deadline_budget_s: Optional[float] = None) -> None:
+    def admit(self, deadline_budget_s: Optional[float] = None, *,
+              prompt_tokens: Optional[int] = None,
+              max_new_tokens: Optional[int] = None) -> None:
         """Admit one request (it now counts toward the depth) or raise
         `SheddingError`. ``deadline_budget_s`` is the caller's remaining
-        deadline; None = no deadline (only the depth bound gates)."""
+        deadline; None = no deadline (only the depth bound gates).
+        ``prompt_tokens``/``max_new_tokens`` let the controller price THIS
+        request through the split phase rates (docstring above)."""
         tr = _telemetry.get_tracer()
         with self._lock:
             self.requests += 1
             if tr.enabled:
                 tr.count("serve.requests")
-            pred = self._depth * self._service_s / self._capacity
+            pred = (self._depth * self._service_s / self._capacity
+                    + self._request_est_locked(prompt_tokens,
+                                               max_new_tokens))
             over_depth = self._depth >= self.max_depth
             over_budget = (deadline_budget_s is not None
                            and pred > deadline_budget_s)
@@ -125,9 +175,15 @@ class AdmissionController:
             if tr.enabled:
                 tr.count("serve.admitted")
 
-    def complete(self, service_s: Optional[float] = None) -> None:
+    def complete(self, service_s: Optional[float] = None, *,
+                 prefill_tokens: Optional[int] = None,
+                 prefill_s: Optional[float] = None,
+                 decode_tokens: Optional[int] = None,
+                 decode_s: Optional[float] = None) -> None:
         """One admitted request left the system; ``service_s`` (admission
-        to response) feeds the EWMA the wait prediction uses."""
+        to response) feeds the blended EWMA the queue term uses, and the
+        per-phase observations (when present) feed the split per-token
+        rate EWMAs the request-shape estimate uses."""
         with self._lock:
             self._depth = max(self._depth - 1, 0)
             if service_s is not None and service_s >= 0:
@@ -136,3 +192,13 @@ class AdmissionController:
                 else:
                     self._service_s += self._ewma * (float(service_s)
                                                      - self._service_s)
+            for tokens, secs, attr in (
+                    (prefill_tokens, prefill_s, "_prefill_rate_s"),
+                    (decode_tokens, decode_s, "_decode_rate_s")):
+                if not tokens or secs is None or secs < 0:
+                    continue
+                rate = float(secs) / float(tokens)
+                cur = getattr(self, attr)
+                setattr(self, attr,
+                        rate if cur <= 0.0
+                        else cur + self._ewma * (rate - cur))

@@ -1,36 +1,69 @@
-"""Continuous-batching decode engine: ONE jitted step serving mixed
-prefill+decode batches.
+"""Continuous-batching decode engine: jitted steps serving mixed
+prefill+decode batches, with a chunked-prefill fast path.
 
-The serving hot loop is a single compiled program of static shape
-``[slots, 1]``: every tick feeds each active slot exactly one token — a
-prompt token while the slot is prefilling, its own last sample while it
-is decoding — at that slot's own position. New requests enter the batch
-the moment a slot frees (continuous batching: no generation-length
-barrier, no recompile; the classic static-batch alternative would hold
-short requests hostage to the longest one in the batch). Slot reuse is
-free because the ring KV cache (`serving.kvcache`) derives validity from
-the position alone: assigning a request resets the slot's position to 0
-and every stale cache entry is invalid by construction.
+The serving hot loop is built from two compiled programs of static shape:
 
-Prefill is deliberately token-at-a-time — the same decode path sampling
-uses (one code path, logits exactly consistent with the model's full
-forward, pinned by tests/test_serving.py), uniform shapes under jit, and
-requests at different phases mix freely in one batch. The cost is O(P)
-ticks for a P-token prompt; a chunked-prefill fast path is a named
-follow-up in docs/SERVING.md, not silently absent.
+  - the **decode tick** ``[slots, 1]``: every active slot advances exactly
+    one token — a prompt token while the slot is prefilling, its own last
+    sample while it is decoding — at that slot's own position. New
+    requests enter the batch the moment a slot frees (continuous
+    batching: no generation-length barrier, no recompile; the classic
+    static-batch alternative would hold short requests hostage to the
+    longest one in the batch);
+  - the **prefill tick** ``[slots, C]`` (``prefill_chunk=C > 1``): every
+    PREFILLING slot consumes up to C prompt tokens into the ring KV cache
+    in one step — ceil(P/C) prefill ticks for a P-token prompt instead of
+    P. Decoding slots ride along frozen (their rows carry zero valid
+    tokens); the interleave policy below keeps them from starving.
 
-Sampling is greedy (argmax over the un-padded vocab): deterministic, so
-a re-dispatched request (replica death mid-generation) reproduces the
-SAME tokens on the replica that picks it up — the router's zero-drop
-re-dispatch needs no generation state handoff.
+Slot reuse is free because the ring KV cache (`serving.kvcache`) derives
+validity from the position alone: assigning a request resets the slot's
+position to 0 and every stale cache entry is invalid by construction.
+Chunk logits equal the token-at-a-time logits at every position (the
+pre-write chunk attend, `serving.kvcache.chunk_attend`), so the fast path
+changes latency, never tokens — pinned by tests/test_serving.py.
 
-Telemetry: ``serve.decode_steps`` per tick (the standard two-lookup
-disabled gate, budgeted by scripts/check_telemetry_overhead.py).
+**Interleave policy** (the decode-latency budget): a prefill tick is taken
+only when some slot has at least 2 prompt tokens left (otherwise a mixed
+decode tick serves everyone), and at most ``prefill_burst`` consecutive
+prefill ticks run while any slot is decoding — then a decode tick is
+forced, so a burst of long prompts cannot starve in-flight decodes.
+``prefill_chunk=1`` bypasses the policy entirely: every tick is the
+original mixed decode tick, bit-identical to the pre-chunking engine.
+
+Sampling is greedy (argmax over the un-padded vocab): deterministic, so a
+re-dispatched request (replica death mid-generation) reproduces the SAME
+tokens on the replica that picks it up — the router's zero-drop
+re-dispatch needs no generation state handoff. The constructor ENFORCES
+this (``sampler="greedy"`` is the only accepted value): a future
+stochastic sampler knob must break loudly here rather than silently
+voiding the re-dispatch correctness.
+
+**Ring-TP decode** (``tp_mesh``/``tp_axis``): the jitted steps run under
+``shard_map`` over the device mesh with the model's QKV/MLP projections
+routed through the ring collective-matmul Pallas kernels
+(`ops.collective_matmul.make_ring_projection_impl`) — each device starts
+the projection matmul on its row shard of the weight while the remaining
+shards stream in via async remote copies. Activations and cache are
+replicated (the decode batch is latency- not throughput-bound; the win
+is streaming the WEIGHTS, which dominate decode bytes). The dense path
+is untouched when ``tp_mesh`` is None, and projections whose input
+features do not divide by the mesh fall back to dense inside the impl.
+
+Telemetry: ``serve.decode_steps`` / ``serve.prefill_steps`` per tick (the
+standard two-lookup disabled gate, budgeted by
+scripts/check_telemetry_overhead.py). Per-phase wall latencies are
+always accounted (plain floats — they feed the admission controller's
+split prefill/decode estimates) and exported as quantile gauges through
+`phase_gauges` (``serve.prefill_ms_*`` / ``serve.decode_tick_ms_*``,
+docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
+from collections import deque
 from typing import Any, List, Optional
 
 import numpy as np
@@ -43,17 +76,21 @@ __all__ = ["DecodeEngine", "FinishedRequest"]
 @dataclasses.dataclass
 class FinishedRequest:
     """One completed generation: the request id handed to `submit`, the
-    prompt, and the sampled continuation."""
+    prompt, the sampled continuation, and per-phase accounting (token
+    counts + attributed wall seconds — the admission controller's split
+    service-time estimates feed from these)."""
 
     request_id: Any
     prompt: List[int]
     tokens: List[int]          # generated continuation only
     steps: int                 # engine ticks this request was live for
+    prefill_s: float = 0.0     # wall seconds attributed to prefill ticks
+    decode_s: float = 0.0      # wall seconds attributed to decode ticks
 
 
 class _Slot:
     __slots__ = ("req_id", "prompt", "max_new", "eos_id", "fed",
-                 "generated", "ticks")
+                 "generated", "ticks", "prefill_s", "decode_s")
 
     def __init__(self, req_id, prompt, max_new, eos_id):
         self.req_id = req_id
@@ -63,11 +100,17 @@ class _Slot:
         self.fed = 0               # tokens fed so far == next position
         self.generated: List[int] = []
         self.ticks = 0
+        self.prefill_s = 0.0
+        self.decode_s = 0.0
 
     def next_token(self) -> int:
         if self.fed < len(self.prompt):
             return self.prompt[self.fed]
         return self.generated[self.fed - len(self.prompt)]
+
+    @property
+    def prompt_remaining(self) -> int:
+        return max(len(self.prompt) - self.fed, 0)
 
 
 class DecodeEngine:
@@ -75,18 +118,33 @@ class DecodeEngine:
 
     ``model`` is a flax module with the decode contract of
     `models.gpt.GptLmHeadModel` / `models.bert.BertForPreTraining`:
-    ``apply({'params', 'cache'}, tokens [B, 1], train=False, decode=True,
-    position_offset=[B], mutable=['cache'])`` returning next-token logits
-    (or a tuple whose first element is the logits). The engine owns the
-    cache arrays and the per-slot positions; `submit` assigns a request
-    to a free slot, `tick` advances every active slot one token.
+    ``apply({'params', 'cache'}, tokens [B, S], train=False, decode=True,
+    position_offset=[B], prefill_lengths=[B] (S > 1), mutable=['cache'])``
+    returning next-token logits (or a tuple whose first element is the
+    logits). The engine owns the cache arrays and the per-slot positions;
+    `submit` assigns a request to a free slot, `tick` advances the batch
+    one program step (decode or chunked-prefill, per the interleave
+    policy).
     """
 
     def __init__(self, model, params, *, slots: int = 4,
-                 eos_id: Optional[int] = None, donate: bool = True):
+                 eos_id: Optional[int] = None, donate: bool = True,
+                 prefill_chunk: int = 1, prefill_burst: int = 2,
+                 sampler: str = "greedy",
+                 tp_mesh=None, tp_axis: str = "dp",
+                 phase_window: int = 256):
         import jax
         import jax.numpy as jnp
 
+        if sampler != "greedy":
+            raise ValueError(
+                f"DecodeEngine supports only sampler='greedy', got "
+                f"{sampler!r}: generation must be deterministic so the "
+                "router can re-dispatch a dead replica's in-flight "
+                "requests and get byte-identical responses "
+                "(docs/SERVING.md zero-drop contract). A stochastic "
+                "sampler needs a generation-state handoff protocol first."
+            )
         self._jax = jax
         self.model = model
         self.params = params
@@ -95,10 +153,37 @@ class DecodeEngine:
         cfg = model.config
         self.vocab_size = int(cfg.vocab_size)
         self.max_positions = int(cfg.max_position_embeddings)
+        ring_len = int(cfg.kv_cache_len or cfg.max_position_embeddings)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if self.prefill_chunk > ring_len:
+            raise ValueError(
+                f"prefill_chunk ({self.prefill_chunk}) exceeds the KV "
+                f"ring length ({ring_len}); a chunk must not overwrite "
+                "its own attention window")
+        self.prefill_burst = max(int(prefill_burst), 1)
+        self.tp_axis = tp_axis
+        self._tp = (tp_mesh is not None
+                    and int(np.prod(list(tp_mesh.shape.values()))) > 1)
+        if self._tp:
+            from dear_pytorch_tpu.ops.collective_matmul import (
+                make_ring_projection_impl,
+            )
+
+            # same config/params, projections re-routed through the ring
+            # collective-matmul kernels (flax Module.clone keeps every
+            # other field — same param names, same shapes)
+            model = model.clone(
+                projection_impl=make_ring_projection_impl(tp_axis))
+            self.model = model
         B = self.slots
 
         # cache template from shapes only (models/gpt.py generate() does
-        # the same): a real init would materialize a random param tree
+        # the same): a real init would materialize a random param tree.
+        # Built from the DENSE model shape contract — the ring projection
+        # impl is dense outside shard_map, so the template is identical.
         self._cache = jax.tree.map(
             lambda s: jnp.zeros(s.shape, s.dtype),
             jax.eval_shape(
@@ -117,8 +202,54 @@ class DecodeEngine:
             logits = out[0] if isinstance(out, tuple) else out
             return logits[:, 0], vars_out["cache"]
 
-        self._step = jax.jit(_step, donate_argnums=(1,) if donate else ())
+        def _prefill(p, cache, toks, pos, nvalid):
+            out, vars_out = model.apply(
+                {"params": p, "cache": cache}, toks, train=False,
+                decode=True, position_offset=pos, prefill_lengths=nvalid,
+                mutable=["cache"],
+            )
+            logits = out[0] if isinstance(out, tuple) else out
+            # greedy sample at each row's LAST valid chunk position over
+            # the un-padded vocab: the tick that consumes a prompt's final
+            # token yields its first generated token (token-at-a-time
+            # parity — no wasted tick)
+            nxt = jnp.argmax(logits[..., :self.vocab_size], axis=-1)
+            last = jnp.clip(nvalid - 1, 0, toks.shape[1] - 1)
+            sampled = jnp.take_along_axis(nxt, last[:, None], axis=1)[:, 0]
+            return sampled.astype(jnp.int32), vars_out["cache"]
+
+        donate_arg = (1,) if donate else ()
+        if self._tp:
+            P = jax.P
+            sm = jax.shard_map
+
+            def _wrap(fn, n_in):
+                return jax.jit(
+                    sm(fn, mesh=tp_mesh, in_specs=(P(),) * n_in,
+                       out_specs=(P(), P()), check_vma=False),
+                    donate_argnums=donate_arg)
+
+            self._step = _wrap(_step, 4)
+            self._prefill_step = (_wrap(_prefill, 5)
+                                  if self.prefill_chunk > 1 else None)
+        else:
+            self._step = jax.jit(_step, donate_argnums=donate_arg)
+            self._prefill_step = (
+                jax.jit(_prefill, donate_argnums=donate_arg)
+                if self.prefill_chunk > 1 else None)
         self._slots: List[Optional[_Slot]] = [None] * B
+        self._prefill_streak = 0
+        # bounded per-phase tick-latency rings (plain floats, always on —
+        # they feed phase_gauges and the admission split estimates)
+        self._prefill_tick_s: deque = deque(maxlen=int(phase_window))
+        self._decode_tick_s: deque = deque(maxlen=int(phase_window))
+        # each program's FIRST execution is its XLA compile: excluded
+        # from phase attribution and gauges, or the first completed
+        # requests would carry compile-inflated per-token rates into the
+        # admission controller and shed deadline-bearing requests on an
+        # idle fleet until the EWMA decays
+        self._decode_warm = False
+        self._prefill_warm = False
 
     # -- slot management -----------------------------------------------------
 
@@ -154,16 +285,101 @@ class DecodeEngine:
                 return b
         return None
 
+    # -- per-phase latency export --------------------------------------------
+
+    def phase_gauges(self) -> dict:
+        """Quantile gauges over the recent per-phase tick latencies —
+        exported into the Prometheus snapshot / health stream by the
+        replica's heartbeat (`observability.export.write_streams`)."""
+        from dear_pytorch_tpu.observability.export import sorted_quantile
+
+        out = {}
+        for name, ring in (("serve.prefill_ms", self._prefill_tick_s),
+                           ("serve.decode_tick_ms", self._decode_tick_s)):
+            if not ring:
+                continue
+            lats = sorted(ring)
+            out[f"{name}_p50"] = round(sorted_quantile(lats, 0.50) * 1e3, 3)
+            out[f"{name}_p99"] = round(sorted_quantile(lats, 0.99) * 1e3, 3)
+        return out
+
     # -- the tick ------------------------------------------------------------
 
+    def _want_prefill_tick(self) -> bool:
+        """The interleave policy (module docstring): chunk when it helps,
+        never more than ``prefill_burst`` in a row while decodes wait."""
+        if self.prefill_chunk <= 1:
+            return False
+        chunkable = any(s is not None and s.prompt_remaining >= 2
+                        for s in self._slots)
+        if not chunkable:
+            return False
+        decoding = any(s is not None and s.prompt_remaining == 0
+                       for s in self._slots)
+        return not (decoding and self._prefill_streak >= self.prefill_burst)
+
     def tick(self) -> List[FinishedRequest]:
-        """Advance every active slot one token through the jitted step;
-        returns the requests that finished this tick."""
+        """Advance the batch one program step — a chunked prefill tick or
+        a mixed decode tick per the interleave policy; returns the
+        requests that finished this tick."""
         if self.active == 0:
             return []
+        if self._want_prefill_tick():
+            self._prefill_streak += 1
+            return self._prefill_tick()
+        self._prefill_streak = 0
+        return self._decode_tick()
+
+    def _prefill_tick(self) -> List[FinishedRequest]:
+        B, C = self.slots, self.prefill_chunk
+        toks = np.zeros((B, C), np.int32)
+        pos = np.zeros((B,), np.int32)
+        nvalid = np.zeros((B,), np.int32)
+        for b, s in enumerate(self._slots):
+            if s is None or s.prompt_remaining == 0:
+                continue  # decoding/idle rows ride along frozen: zero
+                #           valid tokens — no cache write, garbage logits
+            n = min(C, s.prompt_remaining)
+            toks[b, :n] = s.prompt[s.fed:s.fed + n]
+            pos[b] = s.fed
+            nvalid[b] = n
+        t0 = time.monotonic()
+        sampled, self._cache = self._prefill_step(
+            self.params, self._cache, toks, pos, nvalid)
+        sampled = np.asarray(sampled)          # device sync: honest timing
+        dt = time.monotonic() - t0
+        if not self._prefill_warm:             # the compile tick
+            self._prefill_warm = True
+            dt = 0.0
+        else:
+            self._prefill_tick_s.append(dt)
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("serve.prefill_steps")
+        finished: List[FinishedRequest] = []
+        for b, s in enumerate(self._slots):
+            if s is None:
+                continue
+            n = int(nvalid[b])
+            if n == 0:
+                continue                       # frozen this tick
+            s.fed += n
+            s.ticks += 1
+            s.prefill_s += dt
+            if s.fed >= len(s.prompt):         # prompt consumed: this
+                nxt = int(sampled[b])          # tick's logits sample
+                s.generated.append(nxt)
+                done = (len(s.generated) >= s.max_new
+                        or (s.eos_id is not None and nxt == s.eos_id))
+                if done:
+                    finished.append(self._finish(b, s))
+        return finished
+
+    def _decode_tick(self) -> List[FinishedRequest]:
         B = self.slots
         toks = np.zeros((B, 1), np.int32)
         pos = np.zeros((B,), np.int32)
+        prefilling = [False] * B
         for b, s in enumerate(self._slots):
             if s is None:
                 continue  # idle rows feed token 0 at position 0: their
@@ -171,24 +387,42 @@ class DecodeEngine:
                 #           nothing ever attends to
             toks[b, 0] = s.next_token()
             pos[b] = s.fed
+            prefilling[b] = s.prompt_remaining > 0
+        t0 = time.monotonic()
         logits, self._cache = self._step(self.params, self._cache, toks, pos)
+        logits = np.asarray(logits)[:, : self.vocab_size]
+        dt = time.monotonic() - t0
+        if not self._decode_warm:              # the compile tick
+            self._decode_warm = True
+            dt = 0.0
+        else:
+            self._decode_tick_s.append(dt)
         tr = _telemetry.get_tracer()
         if tr.enabled:
             tr.count("serve.decode_steps")
-        logits = np.asarray(logits)[:, : self.vocab_size]
         finished: List[FinishedRequest] = []
         for b, s in enumerate(self._slots):
             if s is None:
                 continue
             s.fed += 1
             s.ticks += 1
+            # a mixed tick is attributed per-slot by the phase the slot
+            # was actually in (a prefilling slot's token was prompt)
+            if prefilling[b]:
+                s.prefill_s += dt
+            else:
+                s.decode_s += dt
             if s.fed >= len(s.prompt):       # the prompt is consumed:
                 nxt = int(np.argmax(logits[b]))  # this tick's logits sample
                 s.generated.append(nxt)
                 done = (len(s.generated) >= s.max_new
                         or (s.eos_id is not None and nxt == s.eos_id))
                 if done:
-                    finished.append(FinishedRequest(
-                        s.req_id, s.prompt, s.generated, s.ticks))
-                    self._slots[b] = None
+                    finished.append(self._finish(b, s))
         return finished
+
+    def _finish(self, b: int, s: _Slot) -> FinishedRequest:
+        self._slots[b] = None
+        return FinishedRequest(s.req_id, s.prompt, s.generated, s.ticks,
+                               prefill_s=round(s.prefill_s, 6),
+                               decode_s=round(s.decode_s, 6))

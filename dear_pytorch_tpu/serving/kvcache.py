@@ -39,7 +39,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ring_write", "ring_validity", "cache_attend"]
+__all__ = ["ring_write", "ring_validity", "cache_attend",
+           "ring_write_chunk", "chunk_attend"]
 
 
 def ring_write(ck: jax.Array, cv: jax.Array, pos: jax.Array,
@@ -68,6 +69,81 @@ def ring_validity(pos: jax.Array, length: int) -> jax.Array:
             < jnp.minimum(pos[:, None] + 1, length))
 
 
+def ring_write_chunk(ck: jax.Array, cv: jax.Array, pos: jax.Array,
+                     k: jax.Array, v: jax.Array, n_valid: jax.Array):
+    """Write a CHUNK of K/V (``[B, C, H, D]``) into ring slots
+    ``(pos + j) % L`` for the per-row valid prefix ``j < n_valid`` —
+    the multi-token layout the chunked-prefill fast path needs. ``pos``
+    is each row's global position of the chunk's FIRST token ``[B]``;
+    rows with ``n_valid == 0`` (decoding or idle rows riding along in the
+    fixed-shape batch) leave their cache untouched.
+
+    Requires ``C <= L`` (checked by the callers at trace time): then the
+    chunk's positions map to C distinct ring slots and the whole write is
+    one blended scatter — the same one-fused-multiply-add shape as
+    `ring_write`, with the chunk dimension folded in by an einsum."""
+    L = ck.shape[1]
+    C = k.shape[1]
+    j = jnp.arange(C)
+    # oh[b, j, l] = 1 iff chunk token j of row b lands in slot l and is a
+    # real (non-padding) token
+    slots = (pos[:, None] + j[None, :]) % L                     # [B, C]
+    oh = jax.nn.one_hot(slots, L, dtype=jnp.float32)            # [B, C, L]
+    oh = oh * (j[None, :] < n_valid[:, None])[..., None]
+    touched = jnp.sum(oh, axis=1)[..., None, None]              # [B, L, 1, 1]
+    kw = jnp.einsum("bcl,bchd->blhd", oh, k.astype(jnp.float32))
+    vw = jnp.einsum("bcl,bchd->blhd", oh, v.astype(jnp.float32))
+    ck = (ck * (1.0 - touched) + kw).astype(ck.dtype)
+    cv = (cv * (1.0 - touched) + vw).astype(cv.dtype)
+    return ck, cv
+
+
+def chunk_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                 k_new: jax.Array, v_new: jax.Array, pos: jax.Array,
+                 n_valid: jax.Array, *, dtype) -> jax.Array:
+    """Chunked-prefill attention: C queries ``[B, C, H, D]`` attend the
+    PRE-chunk ring caches (``[B, L, H, D]``) plus the chunk's own K/V,
+    with exact per-query masking — so chunk logits equal the
+    token-at-a-time decode logits at every position, including a chunk
+    that spans the ring's wrap boundary.
+
+    Why the cache must be read pre-write: writing the whole chunk first
+    would let a LATE chunk token overwrite a ring slot an EARLY query is
+    still entitled to see (position ``p+C-1`` lands in slot
+    ``(p+C-1) % L``, which may hold a token inside query ``p``'s sliding
+    window). Splitting the keys into (old cache, in-chunk) keeps every
+    query's window intact:
+
+      - old slot ``s`` holds token ``t_s = pos-1 - ((pos-1-s) mod L)``;
+        query ``j`` (global position ``pos+j``) may attend it iff the
+        slot is populated (``s < min(pos, L)``) and the token is inside
+        the window (``t_s >= pos+j-(L-1)``),
+      - in-chunk token ``c`` is attendable iff ``c <= j`` (causal; the
+        window is automatic since ``C <= L``).
+
+    Rows with ``n_valid == 0`` produce garbage the engine ignores (their
+    self-attention entry keeps the softmax finite). Dense core only: the
+    per-(query, key) mask is outside the flash kernel's per-row
+    ``kv_mask`` contract — decode ticks keep the flash option."""
+    from dear_pytorch_tpu.models.bert import dot_product_attention
+
+    B, C, H, D = q.shape
+    L = ck.shape[1]
+    s = jnp.arange(L)[None, None, :]                   # [1, 1, L]
+    j = jnp.arange(C)[None, :, None]                   # [1, C, 1]
+    p = pos[:, None, None]                             # [B, 1, 1]
+    held = p - 1 - jnp.mod(p - 1 - s, L)               # token in slot s
+    old_ok = (s < jnp.minimum(p, L)) & (held >= p + j - (L - 1))
+    new_ok = (jnp.arange(C)[None, :, None]
+              >= jnp.arange(C)[None, None, :])         # [1, C, C] causal
+    new_ok = jnp.broadcast_to(new_ok, (B, C, C))
+    ok = jnp.concatenate([old_ok, new_ok], axis=-1)    # [B, C, L+C]
+    mask = jnp.where(ok, 0.0, -1e9).astype(dtype)[:, None]  # [B,1,C,L+C]
+    keys = jnp.concatenate([ck.astype(dtype), k_new.astype(dtype)], axis=1)
+    vals = jnp.concatenate([cv.astype(dtype), v_new.astype(dtype)], axis=1)
+    return dot_product_attention(q, keys, vals, mask, dtype=dtype)
+
+
 def cache_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
                  valid: jax.Array, *, dtype, use_flash: bool = False
                  ) -> jax.Array:
@@ -79,8 +155,13 @@ def cache_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
     if use_flash:
         from dear_pytorch_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, ck, cv, kv_mask=valid)
+        # cast to the compute dtype: a reduced-precision cache
+        # (kv_cache_dtype) must not leak a mixed-dtype q/k pair into the
+        # kernel (no-op when cache and compute dtypes agree)
+        return flash_attention(q.astype(dtype), ck.astype(dtype),
+                               cv.astype(dtype), kv_mask=valid)
     from dear_pytorch_tpu.models.bert import dot_product_attention
 
     mask = jnp.where(valid, 0.0, -1e9).astype(dtype)[:, None, None, :]
-    return dot_product_attention(q, ck, cv, mask, dtype=dtype)
+    return dot_product_attention(q, ck.astype(dtype), cv.astype(dtype),
+                                 mask, dtype=dtype)

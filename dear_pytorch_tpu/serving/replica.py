@@ -93,6 +93,14 @@ class ReplicaServer:
         if not force and now - self._last_beat < self.heartbeat_s:
             return
         self._last_beat = now
+        # per-phase latency quantiles -> prom/health-stream sinks on the
+        # heartbeat cadence (write_streams no-ops when telemetry is off
+        # or no snapshot sink is configured)
+        gauges = getattr(self.engine, "phase_gauges", None)
+        if gauges is not None:
+            from dear_pytorch_tpu.observability.export import write_streams
+
+            write_streams(gauges=gauges())
         doc = {
             "ts": time.time(),
             "pid": os.getpid(),
@@ -163,7 +171,9 @@ class ReplicaServer:
 
     def _write_response(self, fin) -> None:
         self._write_payload(fin.request_id,
-                            [int(t) for t in fin.tokens])
+                            [int(t) for t in fin.tokens],
+                            prefill_s=getattr(fin, "prefill_s", None),
+                            decode_s=getattr(fin, "decode_s", None))
         if self.feedback is not None:
             # implicit-accept feedback signal: a production surface would
             # carry real user labels; the loop's plumbing is identical
@@ -175,7 +185,9 @@ class ReplicaServer:
             })
 
     def _write_payload(self, request_id, tokens, *,
-                       error: Optional[str] = None) -> None:
+                       error: Optional[str] = None,
+                       prefill_s: Optional[float] = None,
+                       decode_s: Optional[float] = None) -> None:
         payload = {
             "id": request_id,
             "tokens": tokens,
@@ -184,6 +196,13 @@ class ReplicaServer:
         }
         if error is not None:
             payload["error"] = error
+        # engine-attributed per-phase seconds: OUTSIDE the signed
+        # canonical fields (id/tokens/model_version), read by the router
+        # to feed the admission controller's split service estimates
+        if prefill_s is not None:
+            payload["prefill_s"] = prefill_s
+        if decode_s is not None:
+            payload["decode_s"] = decode_s
         payload["sha256"] = response_sha256(payload)
         data = json.dumps(payload).encode()
         if self.injector is not None:

@@ -159,8 +159,12 @@ class ReplicaRouter:
                deadline_s: Optional[float] = None) -> str:
         """Admit one request (raises `serving.admission.SheddingError`
         under backpressure) and queue it for dispatch; returns the
-        request id. ``deadline_s`` is relative to now."""
-        self.admission.admit(deadline_s)
+        request id. ``deadline_s`` is relative to now. The request's own
+        shape (prompt length, max tokens) goes to admission so the
+        deadline check prices THIS request through the split
+        prefill/decode rates, not the fleet-average request."""
+        self.admission.admit(deadline_s, prompt_tokens=len(prompt),
+                             max_new_tokens=int(max_new_tokens))
         rid = uuid.uuid4().hex[:16]
         now_wall = time.time()
         record = {
@@ -210,13 +214,13 @@ class ReplicaRouter:
                     if r.healthy}
 
     def stats(self) -> dict:
+        from dear_pytorch_tpu.observability.export import sorted_quantile
+
         with self._lock:
             lats = sorted(self.latencies_s)
 
         def pct(p):
-            if not lats:
-                return None
-            return lats[min(int(p * (len(lats) - 1)), len(lats) - 1)]
+            return sorted_quantile(lats, p)
 
         return {
             "requests": self.admission.requests,
@@ -424,7 +428,15 @@ class ReplicaRouter:
                           and now_wall > pend.deadline_ts)
                 if missed:
                     self.deadline_missed += 1
-            self.admission.complete(service_s)
+            # per-phase observations (replica-measured, riding in the
+            # response outside the signed canonical payload) feed the
+            # admission controller's split prefill/decode rate EWMAs
+            self.admission.complete(
+                service_s,
+                prefill_tokens=len(pend.record["prompt"]),
+                prefill_s=doc.get("prefill_s"),
+                decode_tokens=len(doc.get("tokens") or []) or None,
+                decode_s=doc.get("decode_s"))
             if tr.enabled:
                 tr.count("serve.completed")
                 if missed:

@@ -11,6 +11,11 @@ Three strategies:
     the overlap auditor's α-β cost model pruning dominated configurations
     analytically (docs/TUNING.md)
 
+The same machinery retargeted at SERVING (`planspace.ServeTuner` over a
+`ServeSpace`: prefill chunk x batch slots x KV dtype x flash x ring-TP
+decode) optimizes closed-loop p99 request latency instead of step time,
+pruned by an α-β `ServeCostModel` (scripts/serve_tune.py drives it).
+
 `autotune.AutoTuner` drives any of them against a live training loop,
 re-bucketing (and re-jitting) when a new plan is adopted.
 """
@@ -22,6 +27,10 @@ from dear_pytorch_tpu.tuning.planspace import (  # noqa: F401
     PlanConfig,
     PlanSpace,
     PlanTuner,
+    ServeConfig,
+    ServeCostModel,
+    ServeSpace,
+    ServeTuner,
 )
 from dear_pytorch_tpu.tuning.mgwfbp import (  # noqa: F401
     mgwfbp_layer_groups,

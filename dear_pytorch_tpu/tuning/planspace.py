@@ -49,6 +49,13 @@ finished-tuner step gate standalone).
 Semantics note (docs/TUNING.md): the compressor and dtype axes are LOSSY —
 the search optimizes step time, not loss trajectory. Restrict the space
 (constructor args or ``DEAR_TUNE_*`` env) when convergence parity matters.
+
+The same machinery is RETARGETED at serving (`ServeSpace` /
+`ServeCostModel` / `ServeTuner`, bottom of this module): the continuous
+axis becomes the prefill chunk, the arms become slots x KV dtype x flash
+x ring-TP decode, and the objective becomes closed-loop p99 request
+latency measured per EPISODE instead of per step
+(`scripts/serve_tune.py`, docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -236,6 +243,15 @@ class PlanSpace:
         kw.update(overrides)
         return cls(**kw)
 
+    @property
+    def cont_bound(self) -> tuple[float, float]:
+        """The continuous axis' (lo, hi) — the tuner-facing name shared
+        with `ServeSpace` (whose continuous axis is the prefill chunk)."""
+        return self.threshold_bound
+
+    def default_config(self) -> "PlanConfig":
+        return PlanConfig(threshold_mb=0.5 * sum(self.threshold_bound))
+
     def axes(self) -> tuple[Axis, ...]:
         return (
             Axis("threshold_mb", "continuous", bound=self.threshold_bound),
@@ -374,6 +390,12 @@ class CostModel:
 class PlanTuner:
     """Step-driven plan-space tuner (`bo.Tuner`-shaped driver contract).
 
+    The search machinery is config-type-generic: the space provides the
+    arms (`configs`/`feasible`/`cont_bound`/`default_config`) and
+    ``CONT_FIELD`` names the one continuous dataclass field the per-arm
+    BO refines — ``threshold_mb`` here, ``prefill_chunk`` for the
+    serving retarget (`ServeTuner`).
+
     Call `step()` once per training iteration. It returns a `PlanConfig`
     when a measurement window completes and a different configuration
     should be tried, else None; after ``max_trials`` completed windows it
@@ -389,6 +411,16 @@ class PlanTuner:
     best arm (or, with probability ``explore``, a random visited one) and
     refines its threshold through that arm's own `bo.BayesianOptimizer`.
     """
+
+    #: name of the config dataclass' continuous field (per-arm BO axis)
+    CONT_FIELD = "threshold_mb"
+
+    def _cont(self, config) -> float:
+        return float(getattr(config, self.CONT_FIELD))
+
+    def _with_cont(self, config, value: float):
+        return dataclasses.replace(config,
+                                   **{self.CONT_FIELD: float(value)})
 
     def __init__(
         self,
@@ -411,8 +443,7 @@ class PlanTuner:
         if interval < 4:
             raise ValueError(f"interval must be >= 4, got {interval}")
         self.space = space
-        base = x if x is not None else PlanConfig(
-            threshold_mb=0.5 * sum(space.threshold_bound))
+        base = x if x is not None else space.default_config()
         why = space.feasible(base)
         if why is not None:
             raise ValueError(f"infeasible starting config "
@@ -435,7 +466,7 @@ class PlanTuner:
         # arm universe: feasible combos + the starting arm
         self._arm_keys: list[tuple] = []
         self._arm_cfg: dict[tuple, PlanConfig] = {}
-        for cfg in space.configs(base.threshold_mb):
+        for cfg in space.configs(self._cont(base)):
             self._arm_keys.append(cfg.key())
             self._arm_cfg[cfg.key()] = cfg
         if base.key() not in self._arm_cfg:
@@ -506,7 +537,7 @@ class PlanTuner:
                 factory = BayesianOptimizer
             else:
                 factory = self._bo_factory
-            opt = factory(self.space.threshold_bound,
+            opt = factory(self.space.cont_bound,
                           seed=self._seed + 7 * len(self._arm_bo))
             self._arm_bo[key] = opt
         return opt
@@ -564,9 +595,9 @@ class PlanTuner:
         penalty = (10.0 * max(self._feasible_ys)
                    if self._feasible_ys else 1e6)
         key = config.key()
-        self._bo_for(key).register(float(config.threshold_mb), penalty)
+        self._bo_for(key).register(self._cont(config), penalty)
         self._obs.setdefault(key, []).append(
-            (float(config.threshold_mb), penalty))
+            (self._cont(config), penalty))
         if fatal:
             self._dead[key] = why or "build failed"
         else:
@@ -623,8 +654,8 @@ class PlanTuner:
                 continue
             cfg = self._arm_cfg[key]
             try:
-                floor = self.cost_model.floor(dataclasses.replace(
-                    cfg, threshold_mb=self._best[0].threshold_mb))
+                floor = self.cost_model.floor(
+                    self._with_cont(cfg, self._cont(self._best[0])))
             except Exception:
                 continue   # an unpriceable arm is trialed, not dropped
             if floor is not None and floor > bar:
@@ -645,14 +676,14 @@ class PlanTuner:
         if not live:
             return None
         unvisited = [k for k in live if k not in self._obs]
-        thr = (self._best[0].threshold_mb if self._best is not None
-               else self._current.threshold_mb)
+        thr = self._cont(self._best[0] if self._best is not None
+                         else self._current)
         if unvisited:
             if self.cost_model is not None:
                 def price(k):
                     try:
-                        return self.cost_model.comm(dataclasses.replace(
-                            self._arm_cfg[k], threshold_mb=thr))
+                        return self.cost_model.comm(
+                            self._with_cont(self._arm_cfg[k], thr))
                     except Exception:
                         return float("inf")
 
@@ -673,8 +704,7 @@ class PlanTuner:
                                for pos, val in enumerate(k))
 
                 key = min(unvisited, key=novelty)
-            return dataclasses.replace(self._arm_cfg[key],
-                                       threshold_mb=float(thr))
+            return self._with_cont(self._arm_cfg[key], thr)
         visited = [k for k in live if k in self._obs]
         if not visited:
             return None
@@ -686,36 +716,34 @@ class PlanTuner:
         else:
             key = visited[int(self._rng.integers(len(visited)))]
         nxt = float(self._bo_for(key).suggest())
-        return dataclasses.replace(self._arm_cfg[key], threshold_mb=nxt)
+        return self._with_cont(self._arm_cfg[key], nxt)
 
-    def step(self) -> Optional[PlanConfig]:
-        if self.finished:
+    def _adopt(self) -> Optional[PlanConfig]:
+        """Budget exhausted: install the best observed config."""
+        self.finished = True
+        if self._best is None:
+            self._log("plan tuner finished: no feasible measurement; "
+                      f"keeping {self._current.describe()}")
             return None
-        if self._num_trials >= self._max:
-            self.finished = True
-            if self._best is None:
-                self._log("plan tuner finished: no feasible measurement; "
-                          f"keeping {self._current.describe()}")
-                return None
-            cfg, t = self._best
-            self._log(f"plan tuner optimal config: {cfg.describe()}, "
-                      f"iteration time {t:.4f}")
-            self._journal("adopted", cfg, measured_s=t)
-            if cfg != self._current:
-                self._current = cfg
-                return cfg
-            return None
+        cfg, t = self._best
+        self._log(f"plan tuner optimal config: {cfg.describe()}, "
+                  f"iteration time {t:.4f}")
+        self._journal("adopted", cfg, measured_s=t)
+        if cfg != self._current:
+            self._current = cfg
+            return cfg
+        return None
 
-        iter_time = self._record()
-        if iter_time is None:
-            return None
-
+    def _ingest(self, iter_time: float) -> Optional[PlanConfig]:
+        """Book one completed measurement of ``self._current`` and
+        propose the next config (None = stay). Shared by the step-driven
+        protocol (`step`) and the episode-driven one
+        (`ServeTuner.observe`)."""
         key = self._current.key()
         self._obs.setdefault(key, []).append(
-            (float(self._current.threshold_mb), iter_time))
+            (self._cont(self._current), iter_time))
         self._feasible_ys.append(iter_time)
-        self._bo_for(key).register(
-            float(self._current.threshold_mb), iter_time)
+        self._bo_for(key).register(self._cont(self._current), iter_time)
         if self.cost_model is not None:
             try:
                 self.cost_model.observe(self._current, iter_time)
@@ -751,6 +779,16 @@ class PlanTuner:
         self._current = nxt
         return nxt
 
+    def step(self) -> Optional[PlanConfig]:
+        if self.finished:
+            return None
+        if self._num_trials >= self._max:
+            return self._adopt()
+        iter_time = self._record()
+        if iter_time is None:
+            return None
+        return self._ingest(iter_time)
+
     @property
     def current(self) -> PlanConfig:
         return self._current
@@ -783,3 +821,278 @@ class PlanTuner:
             "dead": {"/".join(str(p) for p in k): v
                      for k, v in self._dead.items()},
         }
+
+
+# ---------------------------------------------------------------------------
+# the serving retarget: ServeSpace x p99-latency objective (docs/TUNING.md)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """One point of the serving plan space (hashable, JSON-safe).
+
+    ``prefill_chunk`` is the CONTINUOUS axis (per-arm BO refines it;
+    `engine_kwargs` rounds to the integer the engine takes); the four
+    categorical axes form the bandit arm. The objective these configs are
+    measured on is **p99 request latency** from a closed-loop episode
+    (`scripts/serve_tune.py`), not step time."""
+
+    prefill_chunk: float = 4.0
+    slots: int = 4
+    kv_dtype: Optional[str] = None      # None = f32 masters, 'bf16'
+    decode_use_flash: bool = False
+    tp_decode: bool = False
+
+    def key(self) -> tuple:
+        return (self.slots, self.kv_dtype, self.decode_use_flash,
+                self.tp_decode)
+
+    @property
+    def chunk(self) -> int:
+        return max(int(round(self.prefill_chunk)), 1)
+
+    def describe(self) -> str:
+        parts = [f"C={self.chunk}", f"slots={self.slots}",
+                 f"kv={self.kv_dtype or 'f32'}"]
+        if self.decode_use_flash:
+            parts.append("flash")
+        if self.tp_decode:
+            parts.append("tp")
+        return "/".join(parts)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["chunk"] = self.chunk
+        return d
+
+    def engine_kwargs(self) -> dict:
+        """kwargs for `serving.engine.DecodeEngine` (the tp mesh itself
+        is the harness's to supply)."""
+        return dict(slots=int(self.slots), prefill_chunk=self.chunk)
+
+    def model_kwargs(self) -> dict:
+        """Model-config overrides (`dataclasses.replace` on a
+        GptConfig/BertConfig); jnp resolved lazily — jax-free module."""
+        return dict(kv_cache_dtype=_jnp_dtype(self.kv_dtype),
+                    decode_use_flash=bool(self.decode_use_flash))
+
+
+class ServeSpace:
+    """The serving search space: prefill chunk (continuous) x batch slots
+    x KV-cache dtype x flash decode x ring-TP decode, with the same
+    tuner-facing interface as `PlanSpace` (`configs` / `feasible` /
+    `cont_bound` / `default_config`) so `PlanTuner`'s sweep/prune/BO
+    machinery drives it unchanged (`ServeTuner`)."""
+
+    def __init__(
+        self,
+        *,
+        chunk_bound: tuple[float, float] = (1.0, 16.0),
+        slots: Sequence[int] = (2, 4, 8),
+        kv_dtypes: Sequence[Optional[str]] = (None, "bf16"),
+        flash: Sequence[bool] = (False, True),
+        tp: Sequence[bool] = (False, True),
+        world: int = 1,
+        ring_len: Optional[int] = None,
+    ):
+        if not chunk_bound[1] >= chunk_bound[0] >= 1:
+            raise ValueError(f"bad chunk bound {chunk_bound}")
+        self.chunk_bound = (float(chunk_bound[0]), float(chunk_bound[1]))
+        self.slots = tuple(int(s) for s in slots)
+        if any(s < 1 for s in self.slots):
+            raise ValueError(f"bad slots axis {slots}")
+        self.kv_dtypes = tuple(dtype_token(d) for d in kv_dtypes)
+        self.flash = tuple(bool(f) for f in flash)
+        self.tp = tuple(bool(t) for t in tp)
+        self.world = int(world)
+        self.ring_len = None if ring_len is None else int(ring_len)
+
+    @property
+    def cont_bound(self) -> tuple[float, float]:
+        return self.chunk_bound
+
+    def default_config(self) -> ServeConfig:
+        return ServeConfig(prefill_chunk=0.5 * sum(self.chunk_bound),
+                           slots=self.slots[0])
+
+    def axes(self) -> tuple[Axis, ...]:
+        return (
+            Axis("prefill_chunk", "continuous", bound=self.chunk_bound),
+            Axis("slots", "categorical", choices=self.slots),
+            Axis("kv_dtype", "categorical", choices=self.kv_dtypes),
+            Axis("decode_use_flash", "categorical", choices=self.flash),
+            Axis("tp_decode", "categorical", choices=self.tp),
+        )
+
+    def feasible(self, config: ServeConfig) -> Optional[str]:
+        if config.tp_decode and self.world < 2:
+            return ("tp_decode needs a multi-device mesh; this space was "
+                    f"built for world={self.world}")
+        if self.ring_len is not None and config.chunk > self.ring_len:
+            return (f"prefill chunk {config.chunk} exceeds the KV ring "
+                    f"length {self.ring_len} (a chunk must not overwrite "
+                    "its own window)")
+        return None
+
+    def configs(self, chunk: Optional[float] = None) -> list[ServeConfig]:
+        c = (float(chunk) if chunk is not None
+             else 0.5 * sum(self.chunk_bound))
+        out = []
+        for s in self.slots:
+            for kd in self.kv_dtypes:
+                for fl in self.flash:
+                    for tp in self.tp:
+                        cfg = ServeConfig(prefill_chunk=c, slots=s,
+                                          kv_dtype=kd,
+                                          decode_use_flash=fl,
+                                          tp_decode=tp)
+                        if self.feasible(cfg) is None:
+                            out.append(cfg)
+        return out
+
+
+class ServeCostModel:
+    """Analytic per-request latency floor for `ServeConfig`s — the α-β
+    serve-cost model that lets the tuner prune serving arms before they
+    burn a live closed-loop episode.
+
+    The request model: a P-token prompt + D generated tokens costs
+    ``ceil(P/C) + D`` engine ticks; ring-TP decode adds per-tick ring
+    transport priced by the α-β interconnect fit — each of the
+    ``n_projections`` ring collective-matmuls per tick moves the weight's
+    non-local rows: ``(W-1) x α latency + (W-1)/W x weight_bytes x β``.
+    Mirroring `CostModel`'s soundness rule, the per-tick compute base is
+    calibrated from live episodes as the MINIMUM residual rate (an
+    underestimate — pruning must never retire a genuinely cheap arm),
+    and `floor` returns None before any calibration exists (never prune
+    blind).
+    """
+
+    def __init__(self, *, prompt_tokens: float, decode_tokens: float,
+                 alpha: float = 0.0, beta: float = 0.0, world: int = 1,
+                 weight_bytes: float = 0.0, n_projections: int = 0):
+        self.prompt_tokens = float(prompt_tokens)
+        self.decode_tokens = float(decode_tokens)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.world = max(int(world), 1)
+        self.weight_bytes = float(weight_bytes)
+        self.n_projections = int(n_projections)
+        self._obs: list[tuple[float, float, float]] = []  # (ticks, comm, y)
+
+    def ticks(self, config: ServeConfig) -> float:
+        """Engine ticks to serve the model request under ``config``."""
+        return (math.ceil(self.prompt_tokens / config.chunk)
+                + self.decode_tokens)
+
+    def _comm_per_tick(self, config: ServeConfig) -> float:
+        if not config.tp_decode or self.world < 2:
+            return 0.0
+        w = self.world
+        per_ring = (w - 1) * self.alpha \
+            + (w - 1) / w * self.weight_bytes * self.beta
+        return self.n_projections * per_ring
+
+    def comm(self, config: ServeConfig) -> float:
+        """Analytic sweep price: per-request ring-transport seconds, with
+        a tick-count epsilon so equal-comm (dense) arms order
+        fewest-ticks-first."""
+        return (self.ticks(config) * self._comm_per_tick(config)
+                + 1e-9 * self.ticks(config))
+
+    def observe(self, config: ServeConfig, measured_s: float) -> None:
+        if measured_s > 0 and math.isfinite(measured_s):
+            self._obs.append((self.ticks(config), self.comm(config),
+                              float(measured_s)))
+
+    @property
+    def _scale(self) -> float:
+        ratios = [y / c for t, c, y in self._obs if c > 1e-6]
+        return min(min(ratios), 1.0) if ratios else 1.0
+
+    @property
+    def tick_rate_est(self) -> Optional[float]:
+        """LOWER bound on the per-tick compute cost: minimum residual
+        rate over observations (`CostModel.compute_est` rationale)."""
+        if not self._obs:
+            return None
+        s = self._scale
+        return min(max(y - s * c, 0.0) / t for t, c, y in self._obs if t)
+
+    def floor(self, config: ServeConfig) -> Optional[float]:
+        rate = self.tick_rate_est
+        if rate is None:
+            return None
+        return (rate * self.ticks(config)
+                + self._scale * self.ticks(config)
+                * self._comm_per_tick(config))
+
+
+class ServeTuner(PlanTuner):
+    """`PlanTuner`'s sweep/prune/BO machinery retargeted at serving:
+    episode-driven (one closed-loop storm episode per trial, objective =
+    measured p99 request latency in seconds) instead of step-driven.
+
+    Protocol::
+
+        tuner = ServeTuner(ServeSpace(world=8), max_trials=8,
+                           cost_model=ServeCostModel(...))
+        while not tuner.finished:
+            p99 = run_episode(tuner.current)      # the storm harness
+            tuner.observe(p99)                    # may switch tuner.current
+        best = tuner.current                      # the adopted plan
+
+    `mark_infeasible` keeps its `PlanTuner` semantics for an episode that
+    fails to build (fatal arm retirement) or diverges. The step-driven
+    `step()`/`notify_rebuild` timing protocol is unused here — episodes
+    measure themselves."""
+
+    CONT_FIELD = "prefill_chunk"
+
+    def __init__(self, space: ServeSpace, **kw):
+        kw.setdefault("interval", 4)   # unused by the episode protocol,
+        super().__init__(space, **kw)  # validated by PlanTuner anyway
+
+    def mark_infeasible(self, config, *, revert_to=None,
+                        fatal: bool = False, why: str = "") -> None:
+        """Episode semantics on top of `PlanTuner.mark_infeasible`: there
+        is no live training plan to revert, so after sandboxing the
+        failure the tuner must MOVE — a step-driven caller passes
+        ``revert_to`` and keeps training on the old plan, but an episode
+        driver that retries ``current`` would spin forever on a
+        deterministically-failing build (and a diverging arm would burn
+        every remaining trial in place). A space with no live arms left
+        finishes outright rather than stranding the driver loop."""
+        super().mark_infeasible(config, revert_to=revert_to, fatal=fatal,
+                                why=why)
+        if self.finished or revert_to is not None:
+            return
+        nxt = self._propose()
+        if nxt is not None:
+            self._current = nxt
+        elif not self._live_arms():
+            self.finished = True
+            self._log("serve tuner: every arm retired or pruned; "
+                      f"keeping {self._current.describe()}")
+
+    def observe(self, p99_s: float) -> Optional[ServeConfig]:
+        """Book one completed episode of ``current``; returns the next
+        config to trial (None = stay / finished). A non-finite or
+        non-positive measurement is a diverged episode: sandboxed via
+        `mark_infeasible` (consuming the trial and moving to another
+        config — see above)."""
+        if self.finished:
+            return None
+        m = float(p99_s)
+        if not (m > 0 and math.isfinite(m)):
+            self.mark_infeasible(self._current,
+                                 why=f"non-finite episode p99 ({p99_s})")
+            nxt = self._current if not self.finished else None
+        else:
+            nxt = self._ingest(m)
+        if self._num_trials >= self._max and not self.finished:
+            # episode mode adopts immediately — there is no trailing
+            # step() call to do it
+            return self._adopt()
+        return nxt

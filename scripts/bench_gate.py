@@ -42,6 +42,11 @@ methods produced; a cell the base has but the candidate lost fails
   python scripts/bench_gate.py --run logs/reports.json \
       --ab-methods dear-fused:dear --tolerance 0.05
 
+``--ab-objective latency`` flips the A/B direction for lower-is-better
+cells (candidate <= (1 + tolerance) x base) — the serving p99 fixture
+(`scripts/serve_tune.py` ab_reports.json) gates chunked-vs-token on
+throughput and tp-vs-dense on latency.
+
 Both files may be either the raw contract line (``{"metric", "value",
 "extra_metrics": [...]}``) or the driver's round record (``{"parsed":
 {...}}``). Metrics are throughput numbers (higher is better); entries
@@ -100,14 +105,21 @@ def _load(path: str) -> dict:
 
 
 def compare_driver_methods(report: dict, candidate: str, base: str,
-                           tolerance: float) -> dict:
+                           tolerance: float,
+                           objective: str = "throughput") -> dict:
     """A/B two methods of a `benchmarks/driver.py` reports.json.
 
     Shape: ``report[model][method][nworkers] = [mean, ci] | None``. Every
     (model, nworkers) cell where the BASE has a scraped result is gated:
     candidate missing/failed counts as ``missing`` (a method that stopped
     producing results is a harness regression, not parity); present cells
-    must satisfy ``candidate >= (1 - tolerance) * base``."""
+    must satisfy ``candidate >= (1 - tolerance) * base`` for the default
+    ``objective="throughput"`` (higher is better), or ``candidate <=
+    (1 + tolerance) * base`` for ``objective="latency"`` (lower is
+    better — the serving p99 fixtures, scripts/serve_tune.py)."""
+    if objective not in ("throughput", "latency"):
+        raise ValueError(f"objective must be 'throughput' or 'latency', "
+                         f"got {objective!r}")
     rows, missing = [], []
     for model in sorted(report):
         methods = report[model]
@@ -126,14 +138,17 @@ def compare_driver_methods(report: dict, candidate: str, base: str,
                 missing.append(f"{model}[{nw}]")
                 continue
             ratio = cv[0] / bv[0] if bv[0] else float("inf")
+            ok = (ratio <= 1.0 + tolerance if objective == "latency"
+                  else ratio >= 1.0 - tolerance)
             rows.append({
                 "model": model, "nworkers": nw,
                 "candidate": cv[0], "base": bv[0],
                 "ratio": round(ratio, 4),
-                "ok": bool(ratio >= 1.0 - tolerance),
+                "ok": bool(ok),
             })
     return {
         "candidate": candidate, "base": base, "tolerance": tolerance,
+        "objective": objective,
         "cells": rows, "missing": missing,
         "ok": bool(rows) and all(r["ok"] for r in rows) and not missing,
     }
@@ -166,6 +181,13 @@ def main(argv=None) -> int:
                          "inside --run (a benchmarks/driver.py "
                          "reports.json): candidate >= (1-tolerance) x "
                          "base per (model, nworkers) cell")
+    ap.add_argument("--ab-objective", default="throughput",
+                    choices=("throughput", "latency"),
+                    help="--ab-methods direction: 'throughput' gates "
+                         "candidate >= (1-tol) x base (default); "
+                         "'latency' gates candidate <= (1+tol) x base "
+                         "(lower-is-better metrics, e.g. the serving "
+                         "p99 fixture)")
     args = ap.parse_args(argv)
 
     if args.ab_methods:
@@ -193,7 +215,8 @@ def main(argv=None) -> int:
                               "error": f"{type(exc).__name__}: {exc}"}))
             return 3
         verdict = compare_driver_methods(report, cand.strip(),
-                                         base.strip(), args.tolerance)
+                                         base.strip(), args.tolerance,
+                                         objective=args.ab_objective)
         if args.allow_missing and verdict["missing"] \
                 and verdict["cells"] and all(
                     r["ok"] for r in verdict["cells"]):

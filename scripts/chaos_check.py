@@ -1227,7 +1227,11 @@ def run_worker_serve_replica(workdir: str) -> dict:
     model, _cfg = _serve_model()
     engine = DecodeEngine(
         model, params,
-        slots=int(os.environ.get("DEAR_SERVE_SLOTS", "4")))
+        slots=int(os.environ.get("DEAR_SERVE_SLOTS", "4")),
+        # the chunked-prefill fast path (ceil(P/C) prefill ticks),
+        # interleaved with decode ticks under the engine's burst budget;
+        # "1" restores the token-at-a-time path bit-identically
+        prefill_chunk=int(os.environ.get("DEAR_SERVE_PREFILL_CHUNK", "1")))
     pre = PreemptionHandler().install()
     feedback = None
     if os.environ.get("DEAR_ONLINE_FEEDBACK") == "1":
@@ -1323,6 +1327,12 @@ def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
     env["DEAR_SERVE_STORE"] = store_dir
     env["DEAR_SERVE_SLOTS"] = "4"
     env["DEAR_SERVE_DEADLINE"] = "600"
+    # the storm runs the chunked-prefill fast path: the zero-drop /
+    # re-dispatch / drain guarantees must hold on the path production
+    # would actually serve (deterministic greedy decode is unchanged, so
+    # re-dispatched requests still reproduce identical tokens)
+    env["DEAR_SERVE_PREFILL_CHUNK"] = os.environ.get(
+        "DEAR_SERVE_PREFILL_CHUNK", "4")
     # the serving fault schedule: replica 1 straggles from its 8th
     # request on (admission backpressure fodder), replica 0's 3rd
     # response is corrupted after signing (checksum re-dispatch)

@@ -145,6 +145,23 @@ def main(argv=None) -> int:
             tr.count("serve.requests")
             tr.event("serve.shed", depth=3, predicted_wait_s=0.01)
 
+    # engine per-tick phase gates, the way serving/engine.py's chunked
+    # prefill and decode ticks run them (one counter under one enabled
+    # check per tick; the per-phase latency rings are plain deque
+    # appends, accounted separately below as the ALWAYS-ON cost of the
+    # split admission estimates — they too must stay under the budget)
+    def serve_phase_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("serve.prefill_steps")
+
+    import collections
+
+    _phase_ring = collections.deque(maxlen=256)
+
+    def serve_phase_ring_append():
+        _phase_ring.append(0.00123)
+
     # online-loop gates, the way online/feedback.py's append (the decode
     # hot path's only feedback cost) and online/ingest.py's per-step
     # cursor accounting run them: count (+ event on the cursor side)
@@ -204,6 +221,8 @@ def main(argv=None) -> int:
     k_enabled_ns = _bench(kernel_enabled_site, max(args.iters // 10, 1))
     s_disabled_ns = _bench(serve_disabled_gate, args.iters)
     s_enabled_ns = _bench(serve_enabled_site, max(args.iters // 10, 1))
+    sp_disabled_ns = _bench(serve_phase_disabled_gate, args.iters)
+    sp_ring_ns = _bench(serve_phase_ring_append, args.iters)
     oa_disabled_ns = _bench(online_append_disabled_gate, args.iters)
     oa_enabled_ns = _bench(online_append_enabled_site,
                            max(args.iters // 10, 1))
@@ -223,6 +242,8 @@ def main(argv=None) -> int:
         "kernel_enabled_ns_per_call": round(k_enabled_ns, 1),
         "serve_disabled_ns_per_call": round(s_disabled_ns, 1),
         "serve_enabled_ns_per_call": round(s_enabled_ns, 1),
+        "serve_phase_disabled_ns_per_call": round(sp_disabled_ns, 1),
+        "serve_phase_ring_ns_per_call": round(sp_ring_ns, 1),
         "online_append_disabled_ns_per_call": round(oa_disabled_ns, 1),
         "online_append_enabled_ns_per_call": round(oa_enabled_ns, 1),
         "online_cursor_disabled_ns_per_call": round(oc_disabled_ns, 1),
@@ -234,6 +255,8 @@ def main(argv=None) -> int:
                and fl_disabled_ns <= args.budget_ns
                and k_disabled_ns <= args.budget_ns
                and s_disabled_ns <= args.budget_ns
+               and sp_disabled_ns <= args.budget_ns
+               and sp_ring_ns <= args.budget_ns
                and oa_disabled_ns <= args.budget_ns
                and oc_disabled_ns <= args.budget_ns
                and tuner_finished_ns <= args.budget_ns),

@@ -513,3 +513,134 @@ def test_repack_carries_error_feedback_and_survives_config_switch(mesh):
     state4 = repack_state(state, ts1, ts4)
     assert state4.comp_state == () or all(
         not jax.tree.leaves(c) for c in state4.comp_state)
+
+
+# ---------------------------------------------------------------------------
+# the serving retarget: ServeSpace x p99 objective through the same
+# PlanTuner machinery (docs/TUNING.md "ServeSpace")
+# ---------------------------------------------------------------------------
+
+
+def test_serve_space_axes_and_feasibility():
+    from dear_pytorch_tpu.tuning.planspace import ServeConfig, ServeSpace
+
+    space = ServeSpace(world=1, ring_len=16)
+    axes = {a.name: a for a in space.axes()}
+    assert axes["prefill_chunk"].kind == "continuous"
+    assert set(axes["slots"].choices) == {2, 4, 8}
+    # world=1: every tp arm is rejected at space construction
+    assert all(not c.tp_decode for c in space.configs())
+    assert space.feasible(ServeConfig(tp_decode=True)) is not None
+    # a chunk past the ring length cannot build
+    assert space.feasible(ServeConfig(prefill_chunk=17.0)) is not None
+    assert space.feasible(ServeConfig(prefill_chunk=8.0)) is None
+    # world>1 admits tp arms
+    assert any(c.tp_decode
+               for c in ServeSpace(world=8, ring_len=16).configs())
+    # the continuous chunk rounds to the engine's integer knob
+    kw = ServeConfig(prefill_chunk=3.6, slots=4).engine_kwargs()
+    assert kw == {"slots": 4, "prefill_chunk": 4}
+    mk = ServeConfig(kv_dtype="bf16", decode_use_flash=True).model_kwargs()
+    assert mk["kv_cache_dtype"] is jnp.bfloat16
+    assert mk["decode_use_flash"] is True
+
+
+def test_serve_cost_model_ticks_and_floor():
+    from dear_pytorch_tpu.tuning.planspace import (
+        ServeConfig, ServeCostModel,
+    )
+
+    cm = ServeCostModel(prompt_tokens=12, decode_tokens=4, world=8,
+                        alpha=1e-5, beta=1e-9, weight_bytes=4096,
+                        n_projections=8)
+    c1 = ServeConfig(prefill_chunk=1.0)
+    c4 = ServeConfig(prefill_chunk=4.0)
+    assert cm.ticks(c1) == 16 and cm.ticks(c4) == 7
+    # tp arms carry ring transport; dense arms order by tick count
+    assert cm.comm(ServeConfig(prefill_chunk=4.0, tp_decode=True)) \
+        > cm.comm(c4)
+    assert cm.comm(c1) > cm.comm(c4)
+    assert cm.floor(c1) is None          # never prune blind
+    cm.observe(c4, 0.7)                  # 0.1 s/tick calibration
+    floor1 = cm.floor(c1)
+    assert floor1 == pytest.approx(1.6, rel=1e-6)
+    # the floor is an UNDERESTIMATE built from the minimum residual rate
+    cm.observe(c1, 3.2)                  # a slower rate never lowers it
+    assert cm.floor(c1) == pytest.approx(floor1, rel=1e-6)
+
+
+def test_serve_tuner_adopts_best_and_prunes():
+    """The episode-driven protocol: sweep arms cheapest-first, observe
+    synthetic p99s, prune hopeless chunk-1-like arms once calibrated,
+    adopt the best config at budget exhaustion."""
+    import math
+
+    from dear_pytorch_tpu.tuning.planspace import (
+        ServeCostModel, ServeSpace, ServeTuner,
+    )
+
+    space = ServeSpace(world=8, slots=(2, 4), kv_dtypes=(None, "bf16"),
+                       flash=(False,), tp=(False, True), ring_len=16)
+    cm = ServeCostModel(prompt_tokens=12, decode_tokens=5, world=8,
+                        alpha=1e-4, beta=1e-8, weight_bytes=4096,
+                        n_projections=8)
+    tuner = ServeTuner(space, max_trials=6, cost_model=cm,
+                       log=lambda s: None, seed=0)
+
+    def p99(cfg):
+        ticks = math.ceil(12 / cfg.chunk) + 5
+        per_tick = 0.010 * (0.9 if cfg.kv_dtype == "bf16" else 1.0) \
+            + (0.008 if cfg.tp_decode else 0.0)
+        return ticks * per_tick
+
+    while not tuner.finished:
+        tuner.observe(p99(tuner.current))
+    best = tuner.current
+    assert best.kv_dtype == "bf16" and not best.tp_decode
+    assert best.chunk >= 4
+    s = tuner.summary()
+    assert s["finished"] and s["best_s"] == pytest.approx(p99(best))
+
+
+def test_serve_tuner_sandboxes_failed_episode_and_moves_on():
+    """Episode-mode sandboxing MUST move `current`: a step-driven caller
+    reverts to its last good plan, but an episode driver retrying
+    `current` would spin forever on a deterministically-failing config
+    (and a diverging arm would burn the whole budget in place)."""
+    from dear_pytorch_tpu.tuning.planspace import ServeSpace, ServeTuner
+
+    space = ServeSpace(world=1, slots=(2,), kv_dtypes=(None, "bf16"),
+                       flash=(False,), tp=(False,), ring_len=16)
+    tuner = ServeTuner(space, max_trials=4, log=lambda s: None, seed=1)
+    first = tuner.current
+    # a crashed episode (non-finite p99) consumes the trial AND switches
+    # to a different arm — never re-trial the diverged config in place
+    tuner.observe(float("nan"))
+    assert not tuner.finished
+    assert tuner.current.key() != first.key()
+    # a build failure retires the whole arm without charging a trial and
+    # likewise moves off it
+    broken = tuner.current
+    tuner.mark_infeasible(broken, fatal=True, why="no such dtype")
+    assert tuner.summary()["dead"]
+    assert tuner.current.key() != broken.key()
+    while not tuner.finished:
+        tuner.observe(0.5)
+    assert tuner.current is not None
+
+
+def test_serve_tuner_finishes_when_every_arm_dies():
+    """A space whose every arm fails fatally must FINISH, not strand the
+    episode driver loop retrying dead configs."""
+    from dear_pytorch_tpu.tuning.planspace import ServeSpace, ServeTuner
+
+    space = ServeSpace(world=1, slots=(2,), kv_dtypes=(None, "bf16"),
+                       flash=(False,), tp=(False,), ring_len=16)
+    tuner = ServeTuner(space, max_trials=8, log=lambda s: None, seed=2)
+    for _ in range(4):       # 2 arms; every trial "fails to build"
+        if tuner.finished:
+            break
+        tuner.mark_infeasible(tuner.current, fatal=True, why="boom")
+    assert tuner.finished
+    assert len(tuner.summary()["dead"]) == 2
+    assert tuner.best_config is None   # nothing measured — caller's cue

@@ -166,6 +166,102 @@ def test_ring_cache_wraps_to_sliding_window():
 
 
 # ---------------------------------------------------------------------------
+# chunked prefill: chunk logits == token-at-a-time == full forward, at
+# non-divisible prompt lengths (S=13, C=4), f32 and bf16, including a
+# chunk spanning the ring-cache wrap boundary
+# ---------------------------------------------------------------------------
+
+
+def _chunk_decode_logits(model, params, ids, C, *, bert=False):
+    """Chunked prefill over every position of ``ids``: ceil(S/C) model
+    calls of shape [B, C]; stacked logits at prompt positions."""
+    B, S = ids.shape
+    cache = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((B, C), jnp.int32), train=False, decode=True,
+        prefill_lengths=jnp.zeros((B,), jnp.int32))["cache"]
+    out, pos = [], 0
+    while pos < S:
+        n = min(C, S - pos)
+        toks = np.zeros((B, C), np.int32)
+        toks[:, :n] = np.asarray(ids[:, pos:pos + n])
+        step, vars_out = model.apply(
+            {"params": params, "cache": cache}, jnp.asarray(toks),
+            train=False, decode=True,
+            position_offset=jnp.full((B,), pos, jnp.int32),
+            prefill_lengths=jnp.full((B,), n, jnp.int32),
+            mutable=["cache"])
+        cache = vars_out["cache"]
+        logits = step[0] if isinstance(step, tuple) else step
+        out.append(np.asarray(logits)[:, :n])
+        pos += n
+    return np.concatenate(out, axis=1)
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-4),
+                                       (jnp.bfloat16, 5e-2)])
+def test_gpt_chunked_prefill_matches_stepwise_and_full(dtype, tol):
+    model, params = _gpt(dtype=dtype, kv_cache_len=16)
+    ids = jnp.asarray(np.random.RandomState(11).randint(0, 61, (2, 13)))
+    full = np.asarray(model.apply({"params": params}, ids, train=False))
+    step = _gpt_decode_logits(model, params, ids)
+    chunk = _chunk_decode_logits(model, params, ids, 4)
+    np.testing.assert_allclose(chunk, step, rtol=tol, atol=tol)
+    np.testing.assert_allclose(chunk, full, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-4),
+                                       (jnp.bfloat16, 5e-2)])
+def test_bert_chunked_prefill_matches_stepwise_and_full(dtype, tol):
+    model, params = _bert(dtype=dtype, kv_cache_len=16)
+    ids = jnp.asarray(np.random.RandomState(12).randint(0, 60, (2, 13)))
+    full, _ = model.apply({"params": params}, ids, train=False, causal=True)
+    step = _bert_decode_logits(model, params, ids)
+    chunk = _chunk_decode_logits(model, params, ids, 4, bert=True)
+    np.testing.assert_allclose(chunk, step, rtol=tol, atol=tol)
+    np.testing.assert_allclose(chunk, np.asarray(full), rtol=tol, atol=tol)
+
+
+def test_gpt_chunked_prefill_across_ring_wrap():
+    """L=8 < S=13: chunk [pos 6..9] spans the wrap boundary (position 8
+    lands in slot 0, overwriting token 0 mid-chunk) — the pre-write chunk
+    attend must still give query 6 its full window. Reference: the
+    token-at-a-time sliding-window decode, which is exact by the ring
+    contract."""
+    model, params = _gpt(kv_cache_len=8)
+    ids = jnp.asarray(np.random.RandomState(13).randint(0, 61, (2, 13)))
+    step = _gpt_decode_logits(model, params, ids)
+    chunk = _chunk_decode_logits(model, params, ids, 4)
+    np.testing.assert_allclose(chunk, step, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_chunk_larger_than_ring_rejected():
+    model, params = _gpt(kv_cache_len=8)
+    with pytest.raises(ValueError, match="ring length"):
+        model.apply(
+            {"params": params}, jnp.zeros((1, 9), jnp.int32), train=False,
+            decode=True, position_offset=jnp.zeros((1,), jnp.int32),
+            prefill_lengths=jnp.full((1,), 9, jnp.int32),
+            mutable=["cache"])
+
+
+def test_kv_cache_dtype_bf16_decode_parity():
+    """`kv_cache_dtype=bf16` halves cache bytes; decode then matches the
+    full f32 forward at bf16 tolerance, chunked and token-at-a-time."""
+    model, params = _gpt(kv_cache_len=16, kv_cache_dtype=jnp.bfloat16)
+    cache = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((2, 1), jnp.int32), train=False, decode=True)["cache"]
+    assert jax.tree.leaves(cache)[0].dtype == jnp.bfloat16
+    ids = jnp.asarray(np.random.RandomState(14).randint(0, 61, (2, 13)))
+    full = np.asarray(model.apply({"params": params}, ids, train=False))
+    dec = _gpt_decode_logits(model, params, ids)
+    chunk = _chunk_decode_logits(model, params, ids, 4)
+    np.testing.assert_allclose(dec, full, rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(chunk, full, rtol=5e-2, atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
 # continuous-batching engine
 # ---------------------------------------------------------------------------
 
@@ -209,6 +305,165 @@ def test_engine_rejects_over_budget_and_empty_prompts():
         eng.submit([], 4)
 
 
+def _drain(eng, prompts, max_new=5, submit_ids=None, budget=300):
+    """Submit + tick to completion; returns ({rid: FinishedRequest}, ticks)."""
+    done = {}
+    pending = list(submit_ids or [])
+    ticks = 0
+    for _ in range(budget):
+        for fin in eng.tick():
+            done[fin.request_id] = fin
+            while pending and eng.free:
+                rid = pending.pop(0)
+                eng.submit(prompts[rid], max_new, request_id=rid)
+        ticks += 1
+        if len(done) == len(prompts):
+            return done, ticks
+        if eng.active == 0 and not pending:
+            break
+    return done, ticks
+
+
+def test_engine_chunked_prefill_matches_generate_staggered():
+    """The PR-11 invariant on the fast path: chunked prefill (C=4)
+    reproduces per-request `generate()` tokens exactly across staggered
+    arrivals and slot reuse — AND takes ceil(P/C) prefill ticks: the
+    P=13 request completes in ceil(13/4)+5 = 9 engine ticks instead of
+    13+5."""
+    model, params = _gpt(kv_cache_len=16)
+    rs = np.random.RandomState(21)
+    prompts = {i: list(rs.randint(0, 61, n))
+               for i, n in enumerate((13, 7, 5))}
+    refs = {i: list(np.asarray(
+        generate(model, params, jnp.asarray([p]), max_new_tokens=5)
+        [0, len(p):])) for i, p in prompts.items()}
+
+    e1 = DecodeEngine(model, params, slots=2)
+    e1.submit(prompts[0], 5, request_id=0)
+    e1.submit(prompts[1], 5, request_id=1)
+    d1, _ = _drain(e1, prompts, submit_ids=[2])
+
+    e4 = DecodeEngine(model, params, slots=2, prefill_chunk=4)
+    e4.submit(prompts[0], 5, request_id=0)
+    e4.submit(prompts[1], 5, request_id=1)
+    d4, _ = _drain(e4, prompts, submit_ids=[2])
+
+    # the tick consuming the last prompt token also samples token 1, so
+    # a P-prompt/D-token request takes ceil(P/C) + D - 1 ticks (chunked)
+    # vs P + D - 1 (token-at-a-time)
+    for i in prompts:
+        assert d1[i].tokens == refs[i]
+        assert d4[i].tokens == refs[i]       # fast path: same tokens...
+    assert d4[0].steps == -(-13 // 4) + 5 - 1  # ...in ceil(P/C)+D-1 ticks
+    assert d1[0].steps == 13 + 5 - 1
+    # per-phase accounting feeds the split admission estimates
+    assert d4[0].prefill_s > 0 and d4[0].decode_s > 0
+
+
+def test_engine_prefill_burst_budget_interleaves_decodes():
+    """A long-prompt arrival must not starve an in-flight decode: with
+    `prefill_burst=1` the engine alternates prefill and decode ticks, so
+    the decoding request keeps generating while the long prompt
+    prefills."""
+    model, params = _gpt(kv_cache_len=16)
+    rs = np.random.RandomState(22)
+    short, long_ = list(rs.randint(0, 61, 2)), list(rs.randint(0, 61, 13))
+    eng = DecodeEngine(model, params, slots=2, prefill_chunk=4,
+                       prefill_burst=1)
+    eng.submit(short, 8, request_id="short")
+    eng.tick()                      # short's prompt (2 toks <= one tick's
+    eng.tick()                      # worth) consumed; now decoding
+    assert eng._slots[0].prompt_remaining == 0
+    gen_before = len(eng._slots[0].generated)
+    eng.submit(long_, 2, request_id="long")
+    eng.tick()                      # prefill tick (streak 1)
+    assert eng._slots[1].fed == 4   # the chunk landed...
+    assert len(eng._slots[0].generated) == gen_before  # ...short frozen
+    eng.tick()                      # burst budget hit -> decode tick
+    assert len(eng._slots[0].generated) == gen_before + 1
+    # and the tokens still match the interleave-free reference
+    done, _ = _drain(eng, {"short": short, "long": long_})
+    want_short = list(np.asarray(generate(
+        model, params, jnp.asarray([short]), max_new_tokens=8)
+        [0, len(short):]))
+    want_long = list(np.asarray(generate(
+        model, params, jnp.asarray([long_]), max_new_tokens=2)
+        [0, len(long_):]))
+    assert done["short"].tokens == want_short
+    assert done["long"].tokens == want_long
+
+
+def test_engine_rejects_stochastic_sampler_and_oversize_chunk():
+    """The deterministic-generation contract is ASSERTED at construction:
+    the router's re-dispatch-after-kill correctness rests on greedy
+    argmax, so a stochastic sampler knob must fail loudly, not silently
+    break zero-drop."""
+    model, params = _gpt(kv_cache_len=8)
+    with pytest.raises(ValueError, match="greedy"):
+        DecodeEngine(model, params, sampler="temperature")
+    with pytest.raises(ValueError, match="ring length"):
+        DecodeEngine(model, params, prefill_chunk=9)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        DecodeEngine(model, params, prefill_chunk=0)
+
+
+def test_engine_phase_gauges_exported():
+    model, params = _gpt(kv_cache_len=16)
+    eng = DecodeEngine(model, params, slots=2, prefill_chunk=4)
+    eng.submit(list(range(9)), 2, request_id="r")
+    for _ in range(12):
+        eng.tick()
+        if eng.active == 0:
+            break
+    g = eng.phase_gauges()
+    for name in ("serve.prefill_ms_p50", "serve.prefill_ms_p99",
+                 "serve.decode_tick_ms_p50", "serve.decode_tick_ms_p99"):
+        assert name in g and g[name] > 0
+
+
+def test_engine_excludes_compile_tick_from_phase_accounting():
+    """Each program's FIRST execution is its XLA compile; attributing it
+    to the live slots would poison the admission controller's per-token
+    rates and shed deadline-bearing requests on an idle fleet."""
+    model, params = _gpt(kv_cache_len=16)
+    eng = DecodeEngine(model, params, slots=2, prefill_chunk=4)
+    eng.submit(list(range(9)), 2, request_id="r")
+    eng.tick()                                   # prefill compile tick
+    assert len(eng._prefill_tick_s) == 0
+    assert eng._slots[0].prefill_s == 0.0        # nothing attributed
+    eng.tick()                                   # warm prefill tick
+    assert len(eng._prefill_tick_s) == 1
+    assert eng._slots[0].prefill_s > 0.0
+
+
+@pytest.mark.parametrize("family", ["gpt", "bert"])
+def test_engine_ring_tp_decode_matches_dense(mesh, family):
+    """Ring-TP decode behind the engine's tp_mesh knob: the QKV/MLP
+    projections stream weight shards through the PR-8 ring
+    collective-matmul kernels (interpret mode on the emulated mesh) and
+    the engine reproduces the dense engine's tokens exactly. The dense
+    fallback (tp_mesh=None) is byte-identical to the pre-TP engine."""
+    if family == "gpt":
+        model, params = _gpt(kv_cache_len=16)
+    else:
+        model, params = _bert(kv_cache_len=16)
+    rs = np.random.RandomState(23)
+    prompts = {0: list(rs.randint(0, 60, 5)), 1: list(rs.randint(0, 60, 3))}
+
+    dense = DecodeEngine(model, params, slots=2, prefill_chunk=1)
+    for i, p in prompts.items():
+        dense.submit(p, 3, request_id=i)
+    want, _ = _drain(dense, prompts, max_new=3)
+
+    tp = DecodeEngine(model, params, slots=2, prefill_chunk=1,
+                      tp_mesh=mesh)
+    for i, p in prompts.items():
+        tp.submit(p, 3, request_id=i)
+    got, _ = _drain(tp, prompts, max_new=3)
+    for i in prompts:
+        assert got[i].tokens == want[i].tokens
+
+
 # ---------------------------------------------------------------------------
 # admission control
 # ---------------------------------------------------------------------------
@@ -239,6 +494,37 @@ def test_admission_capacity_scales_predicted_wait():
         adm.admit(1.5)                       # 2 deep x 1s / 1 slot = 2s
     adm.set_capacity(4)                      # fleet grew: 2s -> 0.5s
     adm.admit(1.5)
+
+
+def test_admission_split_phase_rates_spare_short_requests():
+    """THE satellite fix: one blended service EWMA lets a burst of long
+    prompts shed short decode-bound requests. With split per-token rates
+    the controller budgets a request as prefill_est(len) +
+    decode_est(max_tokens): a short request still fits its deadline even
+    while the blended average is inflated."""
+    adm = AdmissionController(max_depth=10, capacity=1)
+    # long-prompt burst: 10s requests dominated by prefill (1000 tokens
+    # at 10 ms/token), 10 decode tokens at 1 ms
+    for _ in range(4):
+        adm.admit(None)
+    for _ in range(4):
+        adm.complete(10.0, prefill_tokens=1000, prefill_s=9.99,
+                     decode_tokens=10, decode_s=0.01)
+    assert adm.service_time_s > 5.0          # blended EWMA is inflated
+    assert adm.prefill_rate_s == pytest.approx(0.00999, rel=1e-3)
+    assert adm.decode_rate_s == pytest.approx(0.001, rel=1e-3)
+    # empty queue, short decode-bound request (8-token prompt, 20 new):
+    # own estimate ~0.1s — a 0.5s deadline budget must ADMIT
+    adm.admit(0.5, prompt_tokens=8, max_new_tokens=20)
+    adm.complete(0.1, prefill_tokens=8, prefill_s=0.05,
+                 decode_tokens=20, decode_s=0.05)
+    # ...while a long-prompt request with the same budget is shed on its
+    # own shape (1000 x 10ms >> 0.5s), not on queue depth
+    with pytest.raises(SheddingError):
+        adm.admit(0.5, prompt_tokens=1000, max_new_tokens=10)
+    # legacy callers (no shape info) keep the original blended behavior
+    adm.admit(None)
+    assert adm.shed == 1
 
 
 def test_shed_retry_with_decorrelated_jitter():
@@ -599,6 +885,61 @@ def test_corrupt_resp_fault_fires_once():
     assert flipped != data and flipped[16:] == data[16:]
     assert inj.corrupt_payload(3, data) == data
     assert inj.pending == 0
+
+
+# ---------------------------------------------------------------------------
+# the serving tuner harness (scripts/serve_tune.py): search completes on
+# the emulated mesh, emits the SLO-gateable contract + the A/B fixture
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(420, method="signal")
+def test_serve_tune_harness_and_gates(tmp_path):
+    """Miniature `serve_tune.py` run: the ServeTuner searches a restricted
+    space against real closed-loop episodes, the summary passes
+    `bench_gate.py --slo` (throughput floor + p99 ceiling), and the
+    chunked:token A/B fixture gates green — chunking must actually win
+    on the emulated mesh."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    out = str(tmp_path / "serving")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "serve_tune.py"),
+         "--out", out, "--trials", "3", "--requests", "8", "--slots", "2",
+         "--chunk-bound", "1,4", "--no-flash", "--emulate", "1"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=360)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    with open(os.path.join(out, "summary.json")) as f:
+        summary = json.load(f)
+    assert summary["tuner"]["finished"]
+    assert summary["best"]["chunk"] >= 1
+    assert "CPU-emulated" in summary["caveat"]
+    gate = os.path.join(repo, "scripts", "bench_gate.py")
+    for args in (
+        [gate, "--run", os.path.join(out, "summary.json"),
+         "--slo", "requests_per_s=1", "--slo", "p99_latency_ms<=60000"],
+        [gate, "--run", os.path.join(out, "ab_reports.json"),
+         "--ab-methods", "chunked:token", "--tolerance", "0.2"],
+        # generous tolerance: this pins the --ab-objective latency PATH
+        # on a live fixture, not a perf claim (tiny episodes on a shared
+        # CPU box are wall-clock noisy; the perf claim lives in the
+        # archived perf/serving_r08 run)
+        [gate, "--run", os.path.join(out, "ab_reports_p99.json"),
+         "--ab-methods", "chunked:token", "--ab-objective", "latency",
+         "--tolerance", "1.0"],
+    ):
+        res = subprocess.run([sys.executable] + args, env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True,
+                             timeout=60)
+        assert res.returncode == 0, (args[2:], res.stdout[-1500:])
 
 
 # ---------------------------------------------------------------------------
