@@ -1,0 +1,24 @@
+"""dearlint — AST static analysis for the repo's hard-won invariants.
+
+`python -m dear_pytorch_tpu.analysis` machine-checks the bug classes
+this repo has shipped and fixed (docs/ANALYSIS.md has the rule table
+with each originating incident): file I/O under a lock, torn writes to
+the durable waist, device syncs on the step/tick hot paths, ungated
+telemetry, imports inside signal handlers, donation aliasing, and the
+two both-direction registries (``DEAR_*`` env vars <-> docs/ENV.md,
+counters <-> docs/OBSERVABILITY.md).
+
+Layout: `core` (scanner/pragmas/baseline/report), `callgraph`
+(reachability), `rules_host` / `rules_trace` / `rules_registry` (the
+rules), `cli` (the gate). Pure host tooling — stdlib only, never
+imported by any runtime module (tests/test_analysis.py enforces the
+import graph), so it costs the training and serving paths nothing.
+"""
+
+from dear_pytorch_tpu.analysis.core import (  # noqa: F401
+    Baseline, Finding, Module, Report, Rule, Scanner, default_paths,
+    repo_root, run_rules,
+)
+from dear_pytorch_tpu.analysis.cli import (  # noqa: F401
+    ALL_RULES, BASELINE_NAME, main, make_rules,
+)

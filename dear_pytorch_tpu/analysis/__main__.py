@@ -1,0 +1,7 @@
+"""Entry point: ``python -m dear_pytorch_tpu.analysis``."""
+
+import sys
+
+from dear_pytorch_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,74 @@
+"""Name-keyed call graph over the scanned tree, for reachability rules.
+
+The hot-path rules need "is this function reachable from the jitted
+step / decode-tick entry points" — a question a precise analyzer would
+answer with types and import resolution. This one is deliberately an
+OVER-approximation that errs toward flagging: a call edge exists from
+function F to every scanned function whose bare name matches the callee
+text (``foo(...)`` and ``anything.foo(...)`` both link to every ``foo``).
+False reachability is handled at the finding site (pragma / baseline);
+false UNreachability would silently rot the invariant, which is the
+failure mode this trades away.
+
+Functions are keyed ``relpath:Qual.Name``; nested functions (the repo's
+closure-heavy build style — ``build_train_step.<locals>.step`` et al.)
+are included under their lexical qualname.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from dear_pytorch_tpu.analysis.core import Module, Scanner
+
+__all__ = ["CallGraph"]
+
+
+class CallGraph:
+    def __init__(self, scanner: Scanner,
+                 module_filter=None):
+        #: bare name -> [function ids]
+        self.by_name: Dict[str, List[str]] = {}
+        #: function id -> set of callee bare names
+        self.calls: Dict[str, Set[str]] = {}
+        #: function id -> (Module, FunctionDef)
+        self.defs: Dict[str, tuple] = {}
+        for mod in scanner.modules:
+            if module_filter is not None and not module_filter(mod):
+                continue
+            self._index(mod)
+
+    def _index(self, mod: Module) -> None:
+        for node in mod.walk():
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            fid = f"{mod.relpath}:{mod.qualname(node)}.{node.name}"
+            self.defs[fid] = (mod, node)
+            self.by_name.setdefault(node.name, []).append(fid)
+            callees = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    fn = sub.func
+                    if isinstance(fn, ast.Name):
+                        callees.add(fn.id)
+                    elif isinstance(fn, ast.Attribute):
+                        callees.add(fn.attr)
+            self.calls[fid] = callees
+
+    def reachable_from(self, entry_names: Iterable[str]) -> Set[str]:
+        """Every function id reachable from any function whose bare
+        name is in ``entry_names`` (the entries themselves included)."""
+        queue = []
+        for name in entry_names:
+            queue.extend(self.by_name.get(name, []))
+        seen: Set[str] = set(queue)
+        while queue:
+            fid = queue.pop()
+            for callee in self.calls.get(fid, ()):
+                for nxt in self.by_name.get(callee, []):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        queue.append(nxt)
+        return seen

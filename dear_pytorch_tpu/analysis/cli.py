@@ -1,0 +1,202 @@
+"""`python -m dear_pytorch_tpu.analysis` — the dearlint CLI.
+
+Exit codes (bench_gate-style): 0 clean, 2 unbaselined findings or
+stale baseline entries, 1 internal/usage error.
+
+    python -m dear_pytorch_tpu.analysis                 # full gate
+    python -m dear_pytorch_tpu.analysis --changed       # pre-commit
+    python -m dear_pytorch_tpu.analysis --rules lock-held-io,atomic-write
+    python -m dear_pytorch_tpu.analysis --json          # machine output
+    python -m dear_pytorch_tpu.analysis --write-baseline  # accept all
+
+``--changed`` and explicit path arguments both restrict *reporting*
+(to files touched vs HEAD — staged, unstaged, untracked — or to the
+named files) while still parsing the whole standard tree, so
+cross-file rules (env registry, call-graph reachability) judge a line
+exactly as the full run would. Baseline staleness is not judged under
+either filter (a partial view cannot tell stale from out-of-scope),
+and a ``--rules`` subset only judges staleness for entries belonging
+to rules that actually ran.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional, Sequence, Set
+
+from dear_pytorch_tpu.analysis.core import (
+    Baseline, Report, Rule, default_paths, repo_root, run_rules,
+)
+from dear_pytorch_tpu.analysis.rules_host import (
+    AtomicWriteRule, BareExceptHotPathRule, LockHeldIORule,
+    SignalHandlerImportRule,
+)
+from dear_pytorch_tpu.analysis.rules_registry import (
+    CounterDocsRule, EnvRegistryRule,
+)
+from dear_pytorch_tpu.analysis.rules_trace import (
+    DonationAliasRule, HotPathSyncRule, UngatedTelemetryRule,
+)
+
+__all__ = ["ALL_RULES", "make_rules", "main", "changed_files",
+           "BASELINE_NAME"]
+
+#: the committed accepted-legacy findings, at the repo root next to the
+#: bench baseline
+BASELINE_NAME = "LINT_BASELINE.json"
+
+ALL_RULES = (
+    LockHeldIORule, AtomicWriteRule, HotPathSyncRule,
+    UngatedTelemetryRule, SignalHandlerImportRule, DonationAliasRule,
+    EnvRegistryRule, CounterDocsRule, BareExceptHotPathRule,
+)
+
+
+def make_rules(names: Optional[Sequence[str]] = None) -> List[Rule]:
+    by_name = {cls.name: cls for cls in ALL_RULES}
+    if names is None:
+        return [cls() for cls in ALL_RULES]
+    missing = sorted(set(names) - set(by_name))
+    if missing:
+        raise ValueError(
+            f"unknown rule(s): {', '.join(missing)} "
+            f"(known: {', '.join(sorted(by_name))})")
+    return [by_name[n]() for n in names]
+
+
+def changed_files(root: str, run=subprocess.run) -> Set[str]:
+    """Repo-relative .py files changed vs HEAD: staged + unstaged +
+    untracked (the pre-commit view)."""
+    out: Set[str] = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others",
+                  "--exclude-standard"]):
+        proc = run(args, cwd=root, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"`{' '.join(args)}` failed: {proc.stderr.strip()}")
+        out.update(ln.strip() for ln in proc.stdout.splitlines()
+                   if ln.strip().endswith(".py"))
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dear_pytorch_tpu.analysis",
+        description="dearlint: AST checks for the repo's hard-won "
+                    "invariants (docs/ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to REPORT on (the standard tree "
+                         "is always parsed so cross-file rules judge "
+                         "identically; default: report on everything)")
+    ap.add_argument("--rules", default=None,
+                    help="comma list of rule names (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline path (default: <repo>/{BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--changed", action="store_true",
+                    help="only report findings in files changed vs HEAD")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relpaths/docs/baseline "
+                         "(default: the checkout this module lives in; "
+                         "tests point it at fixture trees)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into the "
+                         "baseline (justifications left as TODO — "
+                         "fill them in before committing)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.name:24s} {cls.doc}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    try:
+        rules = make_rules(
+            [r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    try:
+        baseline = (Baseline() if args.no_baseline
+                    else Baseline.load(baseline_path))
+    except (ValueError, json.JSONDecodeError, KeyError) as e:
+        print(f"error: bad baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 1
+
+    # Explicit paths and --changed both FILTER REPORTING, never the
+    # parse set: cross-file rules (registries, callgraph reachability)
+    # must judge a line identically whether the whole tree or one file
+    # was asked about — a per-file invocation that re-ran the doc-side
+    # audits against one file's code would flood a clean file with
+    # spurious stale rows.
+    only: Optional[Set[str]] = None
+    if args.changed:
+        try:
+            only = changed_files(root)
+        except (RuntimeError, OSError) as e:
+            print(f"error: --changed needs git: {e}", file=sys.stderr)
+            return 1
+        if not only:
+            print("dearlint: no changed .py files")
+            return 0
+    paths = default_paths(root)
+    if args.paths:
+        from dear_pytorch_tpu.analysis.core import iter_python_files
+
+        requested = {
+            os.path.relpath(p, root).replace(os.sep, "/")
+            for p in iter_python_files(args.paths)}
+        only = requested if only is None else (only & requested)
+        # paths outside the standard scan set still get parsed
+        paths = paths + [p for p in args.paths
+                         if os.path.abspath(p) not in
+                         {os.path.abspath(d) for d in paths}]
+
+    report: Report = run_rules(paths, rules, baseline=baseline,
+                               root=root, only_files=only)
+
+    if args.write_baseline:
+        bl = Baseline(path=baseline_path)
+        bl.entries = dict(baseline.entries)
+        for f in report.unbaselined:
+            bl.entries.setdefault(
+                f.fingerprint, "TODO: justify or fix")
+        for fp in report.stale_baseline:
+            bl.entries.pop(fp, None)
+        bl.save()
+        print(f"dearlint: baseline written to {baseline_path} "
+              f"({len(bl.entries)} entries)")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        for f in report.unbaselined:
+            print(f.render())
+        for fp in report.stale_baseline:
+            print(f"{BASELINE_NAME}: stale baseline entry (nothing "
+                  f"matches): {fp}")
+        n_base = len(report.findings) - len(report.unbaselined)
+        print(f"dearlint: {report.files_scanned} files, "
+              f"{len(report.findings)} finding(s) "
+              f"({len(report.unbaselined)} unbaselined, "
+              f"{n_base} baselined), "
+              f"{len(report.stale_baseline)} stale baseline entr(ies)")
+    return 0 if report.clean else 2
+
+
+if __name__ == "__main__":  # pragma: no cover - module smoke entry
+    sys.exit(main())

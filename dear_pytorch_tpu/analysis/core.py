@@ -1,0 +1,372 @@
+"""dearlint core: one AST scanner, pluggable rules, pragmas, baseline.
+
+The framework is the repo's answer to a pattern in CHANGES.md: every
+review round keeps re-finding the same mechanically-detectable bug
+classes (file I/O under a lock, torn non-atomic writes to the durable
+waist, device syncs on the jitted hot path, ungated telemetry, imports
+inside signal handlers, donation aliasing). Each of those is now a
+`Rule` over a shared parsed view of the tree, run in tier-1, so the
+invariants live in CI instead of reviewer memory.
+
+Contracts:
+
+- **One scanner.** Every rule sees the same `Module` objects (source +
+  AST + pragma map), parsed once per run. Rules never re-read files, so
+  adding a rule costs one AST walk, not one tree walk.
+- **Pragmas.** ``# dearlint: disable=rule-a,rule-b`` on a line
+  suppresses those rules' findings anchored to that line (use it where
+  the violation is the point — e.g. a deliberate device sync the
+  surrounding comment already justifies). ``# dearlint:
+  disable-file=rule-a`` anywhere in a file suppresses the rule for the
+  whole file. ``disable=all`` works in both forms.
+- **Baseline.** `LINT_BASELINE.json` at the repo root carries accepted
+  legacy findings as line-number-independent fingerprints
+  (``rule:path:qualname:key``) with a one-line justification each. A
+  finding matching a baseline entry does not gate; a baseline entry
+  matching no finding is STALE and gates (exit 2) so the file cannot
+  rot — delete entries when the code they excuse is gone.
+- **Exit codes** (bench_gate-style): 0 clean, 2 unbaselined findings
+  or stale baseline entries, 1 internal/usage error.
+
+Pure host tooling: stdlib only, no jax at import time, and no runtime
+module may import this package (tests/test_analysis.py pins that with
+an import-graph assertion — the analyzer must cost the training and
+serving hot paths nothing, not even an import).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+__all__ = [
+    "Finding", "Module", "Rule", "Scanner", "Baseline", "Report",
+    "repo_root", "default_paths", "iter_python_files", "run_rules",
+    "enclosing_qualname", "attr_chain",
+]
+
+_PRAGMA_RE = re.compile(
+    r"#\s*dearlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_,\-\s]+)")
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source location.
+
+    ``key`` is the rule-specific stable token (a counter name, an env
+    var, the offending callee) that makes the fingerprint survive
+    unrelated edits: baselines match on ``rule:path:qualname:key``,
+    never on line numbers.
+    """
+
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    qualname: str      # enclosing 'Class.method' / function, '<module>'
+    key: str
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.qualname}:{self.key}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+                f"  ({self.qualname})")
+
+
+class Module:
+    """One parsed source file: text, AST, parent links, pragma map."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # parent links + enclosing-scope qualnames, computed once for
+        # every rule to share
+        self._qualname: Dict[int, str] = {}
+        self._annotate(self.tree, parent=None, scope=())
+        self.line_pragmas, self.file_pragmas = self._scan_pragmas(source)
+
+    def _annotate(self, node, parent, scope) -> None:
+        node._dearlint_parent = parent  # type: ignore[attr-defined]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            scope = scope + (node.name,)
+        self._qualname[id(node)] = ".".join(scope) or "<module>"
+        for child in ast.iter_child_nodes(node):
+            self._annotate(child, node, scope)
+
+    @staticmethod
+    def _scan_pragmas(source: str):
+        """Pragma maps via the tokenizer (never fooled by '#' inside
+        string literals): {line: {rules}} and the file-level rule set."""
+        line_pragmas: Dict[int, Set[str]] = {}
+        file_pragmas: Set[str] = set()
+        try:
+            import io
+
+            tokens = tokenize.generate_tokens(
+                io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _PRAGMA_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group(2).split(",")
+                         if r.strip()}
+                if m.group(1) == "disable-file":
+                    file_pragmas |= rules
+                else:
+                    line_pragmas.setdefault(
+                        tok.start[0], set()).update(rules)
+        except tokenize.TokenError:  # pragma: no cover - parse guard
+            pass
+        return line_pragmas, file_pragmas
+
+    def qualname(self, node) -> str:
+        """Enclosing scope name for ``node`` ('<module>' at top level)."""
+        return self._qualname.get(id(node), "<module>")
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if {"all", rule} & self.file_pragmas:
+            return True
+        at = self.line_pragmas.get(line, set())
+        return bool({"all", rule} & at)
+
+    def walk(self):
+        return ast.walk(self.tree)
+
+
+def enclosing_qualname(module: Module, node) -> str:
+    return module.qualname(node)
+
+
+def attr_chain(node) -> str:
+    """Dotted-name text of a Name/Attribute chain ('' when dynamic)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class Rule:
+    """Base class: subclass, set ``name``/``doc``, implement ``check``.
+
+    ``check(scanner)`` yields `Finding`s over the scanner's modules.
+    Rules that need cross-file context (call graphs, docs registries)
+    read it from the scanner — the scanner is the ONE source-walking
+    layer; rules never open files themselves except the docs they
+    audit.
+    """
+
+    name = "rule"
+    doc = ""
+
+    def check(self, scanner: "Scanner") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", "csrc", "node_modules"}
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    seen: Set[str] = set()  # overlapping path args parse a file once
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                ap = os.path.abspath(p)
+                if ap not in seen:
+                    seen.add(ap)
+                    out.append(ap)
+            continue
+        for dirpath, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    ap = os.path.abspath(os.path.join(dirpath, fn))
+                    if ap not in seen:
+                        seen.add(ap)
+                        out.append(ap)
+    return out
+
+
+def default_paths(root: Optional[str] = None) -> List[str]:
+    """What a bare CLI run scans: the runtime package, scripts/, the
+    launch helpers, and bench.py — everything that ships, nothing that
+    tests (tests plant deliberate violations as fixtures)."""
+    root = root or repo_root()
+    cands = [
+        os.path.join(root, "dear_pytorch_tpu"),
+        os.path.join(root, "scripts"),
+        os.path.join(root, "launch"),
+        os.path.join(root, "bench.py"),
+    ]
+    return [c for c in cands if os.path.exists(c)]
+
+
+class Scanner:
+    """Parse a file set once; hand every rule the same `Module` view."""
+
+    def __init__(self, paths: Sequence[str],
+                 root: Optional[str] = None):
+        self.root = os.path.abspath(root or repo_root())
+        self.modules: List[Module] = []
+        self.errors: List[Finding] = []
+        for path in iter_python_files(paths):
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                self.modules.append(Module(path, rel, src))
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                self.errors.append(Finding(
+                    rule="parse-error", path=rel, line=getattr(
+                        e, "lineno", 0) or 0, qualname="<module>",
+                    key="parse", message=f"unparsable: {e}"))
+
+    def module(self, relpath: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.relpath == relpath:
+                return m
+        return None
+
+    def run(self, rules: Sequence[Rule]) -> List[Finding]:
+        findings = list(self.errors)
+        for rule in rules:
+            for f in rule.check(self):
+                mod = self.module(f.path)
+                if mod is not None and mod.suppressed(rule.name, f.line):
+                    continue
+                findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+        return findings
+
+
+class Baseline:
+    """Committed accepted-legacy findings, matched by fingerprint.
+
+    File shape (one entry per accepted finding, justification
+    mandatory — the reviewer-facing 'why is this OK'):
+
+        {"findings": [
+          {"fingerprint": "lock-held-io:path.py:Cls.meth:os.replace",
+           "justification": "one line"}]}
+    """
+
+    def __init__(self, entries: Optional[Dict[str, str]] = None,
+                 path: Optional[str] = None):
+        self.path = path
+        self.entries: Dict[str, str] = dict(entries or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.isfile(path):
+            return cls(path=path)
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        entries = {}
+        for rec in doc.get("findings", []):
+            fp = rec["fingerprint"]
+            just = rec.get("justification", "")
+            if not just:
+                raise ValueError(
+                    f"baseline entry without a justification: {fp}")
+            entries[fp] = just
+        return cls(entries, path=path)
+
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self.path
+        assert path is not None
+        doc = {"findings": [
+            {"fingerprint": fp, "justification": just}
+            for fp, just in sorted(self.entries.items())]}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    def split(self, findings: Sequence[Finding],
+              active_rules: Optional[Set[str]] = None):
+        """(unbaselined findings, stale fingerprints). Staleness is
+        only judged for entries whose rule actually RAN this pass
+        (``active_rules``) — a ``--rules`` subset run is a partial view
+        and must neither gate on, nor (via --write-baseline) expire,
+        entries belonging to rules it never executed."""
+        fps = {f.fingerprint for f in findings}
+        fresh = [f for f in findings
+                 if f.fingerprint not in self.entries]
+        stale = sorted(
+            fp for fp in self.entries
+            if fp not in fps
+            and (active_rules is None
+                 or fp.split(":", 1)[0] in active_rules))
+        return fresh, stale
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]            # everything the rules produced
+    unbaselined: List[Finding]         # findings that gate
+    stale_baseline: List[str]          # baseline entries that gate
+    files_scanned: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.unbaselined and not self.stale_baseline
+
+    def to_dict(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "findings": [dataclasses.asdict(f) for f in self.findings],
+            "unbaselined": [f.fingerprint for f in self.unbaselined],
+            "stale_baseline": list(self.stale_baseline),
+            "clean": self.clean,
+        }
+
+
+def run_rules(paths: Sequence[str], rules: Sequence[Rule],
+              baseline: Optional[Baseline] = None,
+              root: Optional[str] = None,
+              only_files: Optional[Set[str]] = None) -> Report:
+    """Scan ``paths``, run ``rules``, fold in the baseline.
+
+    ``only_files`` (repo-relative paths) restricts which files'
+    findings are REPORTED without narrowing the parse set — cross-file
+    rules (env registry, call-graph reachability) always see the whole
+    tree, so ``--changed`` mode cannot produce different verdicts for
+    the same line than a full run.
+    """
+    scanner = Scanner(paths, root=root)
+    findings = scanner.run(rules)
+    if only_files is not None:
+        findings = [f for f in findings if f.path in only_files]
+    baseline = baseline or Baseline()
+    fresh, stale = baseline.split(
+        findings, active_rules={r.name for r in rules})
+    if only_files is not None:
+        stale = []  # a partial file view cannot judge staleness
+    return Report(findings=findings, unbaselined=fresh,
+                  stale_baseline=stale,
+                  files_scanned=len(scanner.modules))

@@ -1,0 +1,291 @@
+"""Host-side invariant rules: locks, durable writes, signal handlers.
+
+Each rule encodes a bug this repo actually shipped and fixed (the
+originating incident is named in docs/ANALYSIS.md's rule table); the
+checks are lexical AST patterns, deliberately simple enough to audit by
+eye, with pragmas/baseline for the deliberate exceptions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from dear_pytorch_tpu.analysis.core import (
+    Finding, Module, Rule, Scanner, attr_chain,
+)
+
+__all__ = [
+    "LockHeldIORule", "AtomicWriteRule", "SignalHandlerImportRule",
+    "BareExceptHotPathRule",
+]
+
+
+def _walk_no_nested_functions(node):
+    """Walk ``node``'s subtree without descending into nested function
+    definitions (a closure defined under a lock does not RUN under it)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+# -- lock-held-io ------------------------------------------------------------
+
+#: callee texts that hit the filesystem (or the objectstore waist, whose
+#: production backends are network round-trips)
+_IO_CHAINS = {
+    "os.replace", "os.rename", "os.link", "os.unlink", "os.remove",
+    "os.makedirs", "os.mkdir", "os.listdir", "os.walk",
+    "shutil.copyfile", "shutil.copytree", "shutil.rmtree", "shutil.move",
+}
+_IO_NAMES = {"open"}
+#: the objectstore waist (utils/objectstore.py) — any receiver counts:
+#: a store call under a lock blocks every other holder for a (remote)
+#: object round-trip
+_WAIST_METHODS = {
+    "put_bytes", "get_bytes", "put_file", "get_file",
+    "put_bytes_if_absent", "delete_prefix",
+}
+
+
+class LockHeldIORule(Rule):
+    """File/objectstore I/O lexically inside a ``with <lock>:`` body.
+
+    Originating bug: PR 11's router ``_dispatch`` wrote per-request
+    inbox files while holding the router lock, stalling the whole
+    client surface (submit/result/stats) for the disk-write duration of
+    a dispatch batch; the fix moved the write outside and re-acquired
+    to undo on failure. The rule flags the pattern everywhere: hold
+    locks for state transitions, never for I/O.
+    """
+
+    name = "lock-held-io"
+    doc = "file or objectstore I/O inside a `with <lock>:` body"
+
+    @staticmethod
+    def _is_lock_with(node) -> bool:
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            return False
+        for item in node.items:
+            chain = attr_chain(item.context_expr)
+            leaf = chain.rsplit(".", 1)[-1].lower() if chain else ""
+            if "lock" in leaf:
+                return True
+        return False
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        for mod in scanner.modules:
+            for node in mod.walk():
+                if not self._is_lock_with(node):
+                    continue
+                for sub in _walk_no_nested_functions(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    chain = attr_chain(sub.func)
+                    leaf = chain.rsplit(".", 1)[-1] if chain else ""
+                    hit: Optional[str] = None
+                    if chain in _IO_CHAINS or chain in _IO_NAMES:
+                        hit = chain
+                    elif leaf in _WAIST_METHODS:
+                        hit = leaf
+                    if hit is None:
+                        continue
+                    yield Finding(
+                        rule=self.name, path=mod.relpath,
+                        line=sub.lineno,
+                        qualname=mod.qualname(sub), key=hit,
+                        message=(f"`{hit}` called while holding a lock "
+                                 "— I/O under a lock serializes every "
+                                 "other holder for the I/O duration; "
+                                 "move it outside and re-acquire"))
+
+
+# -- atomic-write ------------------------------------------------------------
+
+#: the durable waist: modules whose on-disk artifacts other processes
+#: read concurrently (transports, object store, checkpoints, serving
+#: mailboxes, feedback log). A torn write here is a *protocol* bug.
+_WAIST_MODULES = (
+    "utils/objectstore.py", "utils/checkpoint.py",
+    "resilience/cluster.py", "resilience/membership.py",
+    "resilience/scale.py",
+    "serving/router.py", "serving/replica.py", "serving/weights.py",
+    "online/feedback.py", "online/publish.py",
+    "observability/export.py",
+)
+
+
+class AtomicWriteRule(Rule):
+    """Non-atomic writes in the transport/objectstore/checkpoint waist.
+
+    Originating bug: PR 12's manifest retry — a durable-log manifest
+    written with a plain ``open(path, "w")`` could be observed torn by
+    a concurrent reader mid-retry; the waist-wide fix is the
+    tmp + ``os.replace`` idiom (readers see the whole object or none).
+    The rule flags any write-mode ``open`` in a waist module whose path
+    is not a tmp staging name and whose enclosing function never calls
+    ``os.replace``. Exclusive-create ``os.open(..., O_EXCL)`` is the
+    other sanctioned idiom and is not flagged.
+    """
+
+    name = "atomic-write"
+    doc = "write-mode open in a durable-waist module without tmp+os.replace"
+
+    @staticmethod
+    def _write_mode(call: ast.Call) -> bool:
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        return (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str) and "w" in mode.value)
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        for mod in scanner.modules:
+            if not mod.relpath.endswith(_WAIST_MODULES):
+                continue
+            for fn in mod.walk():
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                has_replace = any(
+                    isinstance(n, ast.Call)
+                    and attr_chain(n.func) == "os.replace"
+                    for n in ast.walk(fn))
+                for sub in ast.walk(fn):
+                    if not (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Name)
+                            and sub.func.id == "open"
+                            and sub.args and self._write_mode(sub)):
+                        continue
+                    path_src = ast.unparse(sub.args[0])
+                    if "tmp" in path_src.lower():
+                        continue  # the staging half of the idiom
+                    if has_replace:
+                        continue  # idiom completed in this function
+                    yield Finding(
+                        rule=self.name, path=mod.relpath,
+                        line=sub.lineno,
+                        qualname=f"{mod.qualname(sub)}",
+                        key=path_src,
+                        message=(f"write to `{path_src}` without the "
+                                 "tmp+os.replace idiom — a concurrent "
+                                 "reader can observe a torn object"))
+
+
+# -- signal-handler-import ---------------------------------------------------
+
+
+class SignalHandlerImportRule(Rule):
+    """``import`` statements inside ``signal.signal``-registered handlers.
+
+    Originating bug: PR 5's preemption handler imported the membership
+    module inside the SIGTERM handler; an import in a signal handler
+    can deadlock on the interpreter import lock (or observe a
+    half-initialized module) when the signal lands mid-import. The fix
+    pre-binds everything at ``install()`` time — handlers may only call
+    pre-resolved functions.
+    """
+
+    name = "signal-handler-import"
+    doc = "import statement inside a signal.signal-registered handler"
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        for mod in scanner.modules:
+            handlers = set()
+            for node in mod.walk():
+                if (isinstance(node, ast.Call)
+                        and attr_chain(node.func) == "signal.signal"
+                        and len(node.args) >= 2):
+                    target = node.args[1]
+                    if isinstance(target, ast.Attribute):
+                        handlers.add(target.attr)
+                    elif isinstance(target, ast.Name):
+                        handlers.add(target.id)
+            if not handlers:
+                continue
+            for fn in mod.walk():
+                if not (isinstance(fn, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                        and fn.name in handlers):
+                    continue
+                for sub in ast.walk(fn):
+                    if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                        names = ", ".join(
+                            a.name for a in sub.names)
+                        yield Finding(
+                            rule=self.name, path=mod.relpath,
+                            line=sub.lineno,
+                            qualname=mod.qualname(sub),
+                            key=names,
+                            message=(f"`import {names}` inside signal "
+                                     f"handler `{fn.name}` — imports "
+                                     "can block on the import lock "
+                                     "mid-signal; pre-bind at install "
+                                     "time"))
+
+
+# -- bare-except-hot-path ----------------------------------------------------
+
+_SWALLOW_SCOPES = ("dear_pytorch_tpu/serving/", "dear_pytorch_tpu/online/")
+_SWALLOW_FILES = ("utils/guard.py",)
+
+
+class BareExceptHotPathRule(Rule):
+    """Silent exception swallowing in serving/guard step paths.
+
+    The serving and guarded-training loops survive on counters: every
+    swallowed failure must increment one (`serve.corrupt_responses`,
+    `guard.rollbacks`, ...) or the fleet debugs blind. The rule flags
+    ``except:`` / ``except (Base)Exception:`` handlers whose body takes
+    NO action at all — no raise, no call (counter bump, log, cleanup).
+    Narrow handlers (``except OSError: pass`` around an unlink) are the
+    sanctioned best-effort idiom and are not flagged.
+    """
+
+    name = "bare-except-hot-path"
+    doc = "action-free broad except handler in serving/guard paths"
+
+    @staticmethod
+    def _broad(handler: ast.ExceptHandler) -> Optional[str]:
+        t = handler.type
+        if t is None:
+            return "bare"
+        names = []
+        for node in ([t] if not isinstance(t, ast.Tuple) else t.elts):
+            chain = attr_chain(node)
+            names.append(chain.rsplit(".", 1)[-1])
+        for n in names:
+            if n in ("Exception", "BaseException"):
+                return n
+        return None
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        for mod in scanner.modules:
+            if not (mod.relpath.startswith(_SWALLOW_SCOPES)
+                    or mod.relpath.endswith(_SWALLOW_FILES)):
+                continue
+            for node in mod.walk():
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                caught = self._broad(node)
+                if caught is None:
+                    continue
+                acts = any(
+                    isinstance(n, (ast.Raise, ast.Call))
+                    for stmt in node.body for n in ast.walk(stmt))
+                if acts:
+                    continue
+                yield Finding(
+                    rule=self.name, path=mod.relpath, line=node.lineno,
+                    qualname=mod.qualname(node), key=caught,
+                    message=(f"`except {caught}` swallows the failure "
+                             "with no counter increment, log, or "
+                             "re-raise — hot-path errors must be "
+                             "observable"))

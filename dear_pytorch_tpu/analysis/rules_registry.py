@@ -1,0 +1,376 @@
+"""Registry audits: code <-> docs, both directions, on the one scanner.
+
+Two rules generalize the counter-audit idiom that used to live as an
+ad-hoc regex walk in tests/test_observability.py: a *registry* is a
+docs table that claims to enumerate everything the code does (counters
+emitted, env vars read), and the audit holds it in BOTH directions —
+code without a docs row gates, and a docs row without code gates — so
+neither side can rot (the `retry.attempts` incident: a counter
+documented before it was wired).
+
+Doc-table convention shared by both rules: markdown pipe tables; the
+audited tokens are backticked. `<placeholder>` segments (``dear.<leg>``,
+``DEAR_TUNE_<AXIS>``) normalize to ``*`` wildcards and match
+fnmatch-style.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from dear_pytorch_tpu.analysis.core import (
+    Finding, Rule, Scanner, attr_chain, repo_root,
+)
+
+__all__ = ["EnvRegistryRule", "CounterDocsRule"]
+
+_BACKTICK = re.compile(r"`([^`]+)`")
+
+
+def parse_doc_tables(path: str):
+    """Every markdown pipe table in ``path`` as
+    (header_cells, [(lineno, row_cells), ...]) — lineno is 1-based."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return []
+    tables = []
+    i = 0
+    while i < len(lines):
+        if not lines[i].lstrip().startswith("|"):
+            i += 1
+            continue
+        rows: List[Tuple[int, List[str]]] = []
+        while i < len(lines) and lines[i].lstrip().startswith("|"):
+            cells = [c.strip() for c in
+                     lines[i].strip().strip("|").split("|")]
+            rows.append((i + 1, cells))
+            i += 1
+        if len(rows) >= 2:
+            header = rows[0][1]
+            tables.append((header, rows[2:]))  # skip header + |---|
+    return tables
+
+
+# -- env-registry ------------------------------------------------------------
+
+_ENV_NAME = re.compile(r"^DEAR_[A-Z0-9_]*[A-Z0-9]$")
+_ENV_PREFIX = re.compile(r"^DEAR_[A-Z0-9_]*_$")
+
+
+class EnvRegistryRule(Rule):
+    """Every ``DEAR_*`` env read must have a row in docs/ENV.md — and
+    every row must correspond to a real read.
+
+    Code side: any string literal that IS a ``DEAR_*`` name (exact
+    match, anywhere in executable code) counts as a reference — that
+    deliberately catches every read form the tree uses: direct
+    ``os.environ.get("DEAR_X")``, helper wrappers
+    (``_env_float("DEAR_HEALTH_Z", 4.0)``), fallback tuples
+    (``for k in ("DEAR_LOCAL_RANK", "LOCAL_RANK", ...)``), named
+    module constants (``GRACE_ENV = "DEAR_PREEMPT_GRACE_S"``), and
+    launcher-side ``env["DEAR_X"] = ...`` exports. A
+    ``"DEAR_TUNE_"``-style trailing-underscore literal (the
+    ``.startswith`` restriction grammars) registers the whole prefix
+    family. Fully dynamic keys (``environ[k]``) are invisible to the
+    audit by design — route new knobs through a literal somewhere.
+
+    Doc side: the FIRST column of every table in docs/ENV.md; a
+    ``DEAR_TUNE_<AXIS>`` row documents the whole prefix family. Rows
+    containing the word "dynamic" document env vars whose names are
+    BUILT at runtime (the ``DEAR_<FIELD>`` DearConfig family) — they
+    are exempt from the stale-row check, since no literal read can
+    vouch for them, and the catch-all ``DEAR_<FIELD>`` pattern never
+    satisfies the forward direction (it would blanket-match every
+    name).
+    """
+
+    name = "env-registry"
+    doc = "DEAR_* env reads <-> docs/ENV.md registry, both directions"
+
+    def __init__(self, doc_relpath: str = "docs/ENV.md",
+                 root: Optional[str] = None):
+        self.doc_relpath = doc_relpath
+        self.root = root
+
+    # .. code side ..........................................................
+
+    @staticmethod
+    def _code_reads(scanner: Scanner):
+        """[(name_or_prefix_pattern, module, lineno, qualname)] — every
+        exact DEAR_* string literal (prose never full-matches a name,
+        so docstrings and messages fall out for free)."""
+        reads = []
+        for mod in scanner.modules:
+            for node in mod.walk():
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                key = None
+                if _ENV_NAME.match(node.value):
+                    key = node.value
+                elif _ENV_PREFIX.match(node.value):
+                    key = node.value + "*"
+                if key is None:
+                    continue
+                reads.append((key, mod, node.lineno,
+                              mod.qualname(node)))
+        return reads
+
+    # .. doc side ...........................................................
+
+    def _doc_entries(self, root: str):
+        """({literal: lineno}, {pattern: lineno}, {dynamic tokens})
+        from the registry doc."""
+        path = os.path.join(root, self.doc_relpath)
+        literals: Dict[str, int] = {}
+        patterns: Dict[str, int] = {}
+        dynamic = set()
+        for _header, rows in parse_doc_tables(path):
+            for lineno, cells in rows:
+                if not cells:
+                    continue
+                is_dyn = "dynamic" in " ".join(cells).lower()
+                for tok in _BACKTICK.findall(cells[0]):
+                    if not tok.startswith("DEAR_"):
+                        continue
+                    if "<" in tok:
+                        tok = re.sub(r"<[^>]*>", "*", tok)
+                        patterns.setdefault(tok, lineno)
+                    elif _ENV_NAME.match(tok):
+                        literals.setdefault(tok, lineno)
+                    else:
+                        continue
+                    if is_dyn:
+                        dynamic.add(tok)
+        return literals, patterns, dynamic
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        root = self.root or scanner.root
+        reads = self._code_reads(scanner)
+        doc_lit, doc_pat, doc_dyn = self._doc_entries(root)
+        # the catch-all family pattern documents, it never matches
+        match_pats = {p for p in doc_pat if p != "DEAR_*"}
+
+        def documented(key: str) -> bool:
+            if key.endswith("*"):
+                prefix = key[:-1]
+                return (key in doc_pat
+                        or any(p.startswith(prefix)
+                               for p in match_pats)
+                        or any(lit.startswith(prefix)
+                               for lit in doc_lit))
+            return (key in doc_lit
+                    or any(fnmatch.fnmatchcase(key, p)
+                           for p in match_pats))
+
+        seen = set()
+        for key, mod, lineno, qual in reads:
+            if documented(key) or (key, mod.relpath, qual) in seen:
+                continue
+            seen.add((key, mod.relpath, qual))
+            yield Finding(
+                rule=self.name, path=mod.relpath, line=lineno,
+                qualname=qual, key=key,
+                message=(f"env var `{key}` is read here but has no row "
+                         f"in {self.doc_relpath} — document the knob "
+                         "(name, default, effect)"))
+        code_lits = {k for k, *_ in reads if not k.endswith("*")}
+        code_pats = {k for k, *_ in reads if k.endswith("*")}
+        for lit, lineno in sorted(doc_lit.items()):
+            if (lit in doc_dyn or lit in code_lits
+                    or any(fnmatch.fnmatchcase(lit, p)
+                           for p in code_pats)):
+                continue
+            yield Finding(
+                rule=self.name, path=self.doc_relpath, line=lineno,
+                qualname="<doc>", key=lit,
+                message=(f"`{lit}` is documented in "
+                         f"{self.doc_relpath} but nothing reads it — "
+                         "stale row (the retry.attempts failure mode)"))
+        for pat, lineno in sorted(doc_pat.items()):
+            if (pat in doc_dyn or pat in code_pats
+                    or any(fnmatch.fnmatchcase(lit, pat)
+                           for lit in code_lits)):
+                continue
+            yield Finding(
+                rule=self.name, path=self.doc_relpath, line=lineno,
+                qualname="<doc>", key=pat,
+                message=(f"doc pattern `{pat}` matches no env read in "
+                         "code — stale row"))
+
+
+# -- counter-docs ------------------------------------------------------------
+
+_COUNTER_TOKEN = re.compile(
+    r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_<>]+)+$")
+_CODE_COUNTER = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_*]+)+$")
+
+
+class CounterDocsRule(Rule):
+    """docs/OBSERVABILITY.md counter tables <-> emitted counters, both
+    directions — the tests/test_observability.py audit, migrated onto
+    the shared scanner (the ad-hoc regex walk is deleted, not
+    duplicated).
+
+    Code side: every ``.count("name")`` literal in the runtime package
+    (AST, so docstring examples no longer need a no-dot filter — only
+    real call sites count); f-string templates normalize to ``*``
+    wildcards; the anomaly monitor's ``health.<kind>`` family expands
+    from its ``_raise`` call sites. Doc side: backticked tokens in
+    table columns whose header contains 'counter' (the events columns
+    share prefixes and must not be swept in), ``<leg>``-style segments
+    as wildcards; prose cells may backtick non-counter dotted tokens
+    (file names), so only tokens in a namespace the code actually emits
+    are held to the audit.
+    """
+
+    name = "counter-docs"
+    doc = "emitted counters <-> docs/OBSERVABILITY.md tables, both ways"
+
+    def __init__(self, doc_relpath: str = "docs/OBSERVABILITY.md",
+                 root: Optional[str] = None):
+        self.doc_relpath = doc_relpath
+        self.root = root
+
+    @staticmethod
+    def _fstring_pattern(arg: ast.JoinedStr) -> str:
+        parts = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+
+    def _code_counters(self, scanner: Scanner):
+        """({literal: site}, {pattern: site}); site = (mod, lineno,
+        qualname) of the first emitting call."""
+        literals: Dict[str, tuple] = {}
+        patterns: Dict[str, tuple] = {}
+        for mod in scanner.modules:
+            if not (mod.relpath.startswith("dear_pytorch_tpu/")
+                    and not mod.relpath.startswith(
+                        "dear_pytorch_tpu/analysis/")):
+                continue
+            is_anomaly = mod.relpath.endswith("observability/anomaly.py")
+            for node in mod.walk():
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                site = (mod, node.lineno, mod.qualname(node))
+                if is_anomaly and node.func.attr == "_raise":
+                    if (node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)):
+                        literals.setdefault(
+                            f"health.{node.args[0].value}", site)
+                    continue
+                if node.func.attr != "count" or not node.args:
+                    continue
+                arg = node.args[0]
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    name = arg.value
+                    if _CODE_COUNTER.match(name) and "." in name:
+                        literals.setdefault(name, site)
+                elif isinstance(arg, ast.JoinedStr):
+                    pat = self._fstring_pattern(arg)
+                    if _CODE_COUNTER.match(pat):
+                        patterns.setdefault(pat, site)
+        # the anomaly family is fully expanded from _raise sites; its
+        # templated emitter would otherwise double-report as health.*
+        patterns.pop("health.*", None)
+        return literals, patterns
+
+    def _doc_counters(self, root: str):
+        """({literal: lineno}, {pattern: lineno}) from counter columns."""
+        path = os.path.join(root, self.doc_relpath)
+        literals: Dict[str, int] = {}
+        patterns: Dict[str, int] = {}
+        for header, rows in parse_doc_tables(path):
+            cols = [j for j, h in enumerate(header)
+                    if "counter" in h.lower()]
+            if not cols:
+                continue
+            for lineno, cells in rows:
+                for j in cols:
+                    if j >= len(cells):
+                        continue
+                    for tok in _BACKTICK.findall(cells[j]):
+                        if not _COUNTER_TOKEN.match(tok):
+                            continue
+                        if "<" in tok:
+                            patterns.setdefault(
+                                re.sub(r"<[^>]*>", "*", tok), lineno)
+                        else:
+                            literals.setdefault(tok, lineno)
+        return literals, patterns
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        root = self.root or scanner.root
+        code_lit, code_pat = self._code_counters(scanner)
+        if not code_lit:
+            yield Finding(
+                rule=self.name, path="dear_pytorch_tpu", line=0,
+                qualname="<scanner>", key="<empty>",
+                message="code scan found no counters — scanner rot?")
+            return
+        doc_lit_all, doc_pat_all = self._doc_counters(root)
+        if not doc_lit_all and not doc_pat_all:
+            yield Finding(
+                rule=self.name, path=self.doc_relpath, line=0,
+                qualname="<doc>", key="<empty>",
+                message="doc parse found no counter tables — doc rot?")
+            return
+        # only namespaces the code emits are held to the audit
+        prefixes = {n.split(".", 1)[0]
+                    for n in (set(code_lit) | set(code_pat))}
+        doc_lit = {n: ln for n, ln in doc_lit_all.items()
+                   if n.split(".", 1)[0] in prefixes}
+        doc_pat = {n: ln for n, ln in doc_pat_all.items()
+                   if n.split(".", 1)[0] in prefixes}
+
+        def matches_any(name, pats):
+            return any(fnmatch.fnmatchcase(name, p) for p in pats)
+
+        for name, (mod, lineno, qual) in sorted(code_lit.items()):
+            if name in doc_lit or matches_any(name, doc_pat):
+                continue
+            yield Finding(
+                rule=self.name, path=mod.relpath, line=lineno,
+                qualname=qual, key=name,
+                message=(f"counter `{name}` is emitted here but missing "
+                         f"from {self.doc_relpath}'s counter tables"))
+        for pat, (mod, lineno, qual) in sorted(code_pat.items()):
+            if pat in doc_pat or any(
+                    fnmatch.fnmatchcase(d, pat) for d in doc_lit):
+                continue
+            yield Finding(
+                rule=self.name, path=mod.relpath, line=lineno,
+                qualname=qual, key=pat,
+                message=(f"templated counter `{pat}` has no doc entry "
+                         f"in {self.doc_relpath}"))
+        for name, lineno in sorted(doc_lit.items()):
+            if name in code_lit or matches_any(name, code_pat):
+                continue
+            yield Finding(
+                rule=self.name, path=self.doc_relpath, line=lineno,
+                qualname="<doc>", key=name,
+                message=(f"counter `{name}` is documented but never "
+                         "emitted in code (the retry.attempts "
+                         "incident)"))
+        for pat, lineno in sorted(doc_pat.items()):
+            if pat in code_pat or any(
+                    fnmatch.fnmatchcase(c, pat) for c in code_lit):
+                continue
+            yield Finding(
+                rule=self.name, path=self.doc_relpath, line=lineno,
+                qualname="<doc>", key=pat,
+                message=(f"doc counter pattern `{pat}` matches no "
+                         "emitted counter"))

@@ -1,0 +1,301 @@
+"""Trace-boundary rules: device syncs, telemetry gates, donation aliasing.
+
+These guard the host/trace boundary that DeAR's decoupled schedule
+depends on: the jitted step and decode-tick paths must stay free of
+hidden device syncs, telemetry must cost two lookups when disabled
+(the 1 µs budget `scripts/check_telemetry_overhead.py` enforces
+dynamically — this rule enforces the call-site SHAPE statically), and
+eager re-placement must never alias a buffer that donation will free.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from dear_pytorch_tpu.analysis.callgraph import CallGraph
+from dear_pytorch_tpu.analysis.core import (
+    Finding, Rule, Scanner, attr_chain,
+)
+from dear_pytorch_tpu.analysis.rules_host import _walk_no_nested_functions
+
+__all__ = ["HotPathSyncRule", "UngatedTelemetryRule", "DonationAliasRule"]
+
+
+def _runtime_module(mod) -> bool:
+    return (mod.relpath.startswith("dear_pytorch_tpu/")
+            and not mod.relpath.startswith("dear_pytorch_tpu/analysis/"))
+
+
+# -- hot-path-sync -----------------------------------------------------------
+
+#: bare names of the per-step entry points: the training step closures
+#: (`build_train_step.<locals>.step` across dear/tp/pp/sp), the serving
+#: engine's tick family, and everything they transitively call
+_ENTRY_NAMES = ("step", "tick", "_prefill_tick", "_decode_tick")
+
+#: callee chains that force a device->host transfer wherever they run
+_SYNC_CHAINS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "jax.device_get"}
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+#: float()/int() is only a sync when fed a device value; the heuristic
+#: flags conversions of loss/grad/logit/metric-named expressions and of
+#: jnp/jax call results, and ignores host-shaped ones (env parsing,
+#: clock math) — the precise set lives in pragmas, not cleverness
+_CONV_HINTS = ("loss", "grad", "logit", "metric")
+#: jax.* calls that answer from host state, never the device
+_HOST_JAX = {"jax.process_index", "jax.process_count",
+             "jax.device_count", "jax.local_device_count"}
+
+
+class HotPathSyncRule(Rule):
+    """Device syncs inside functions reachable from step/tick entries.
+
+    Originating budget: the 1 µs tracer-gate contract and the overlap
+    auditor's exposed-comm accounting both assume the host loop never
+    blocks on device values mid-step; a stray ``.item()`` or
+    ``np.asarray`` serializes dispatch against the device and shows up
+    as unexplained exposed time. Reachability is a bare-name
+    over-approximation (see `analysis.callgraph`) — deliberate syncs
+    (the engine tick materializing sampled tokens) carry pragmas.
+    """
+
+    name = "hot-path-sync"
+    doc = "device->host sync reachable from the step/decode-tick entries"
+
+    def _sync_key(self, call: ast.Call) -> Optional[str]:
+        chain = attr_chain(call.func)
+        if chain in _SYNC_CHAINS:
+            # an array literal is host data by construction, not a sync
+            if (call.args and isinstance(
+                    call.args[0], (ast.List, ast.Tuple, ast.ListComp,
+                                   ast.GeneratorExp))):
+                return None
+            return chain
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in _SYNC_ATTRS and not call.args:
+                recv = attr_chain(call.func.value) or "<expr>"
+                return f"{recv}.{call.func.attr}()"
+        if (isinstance(call.func, ast.Name)
+                and call.func.id in ("float", "int")
+                and len(call.args) == 1):
+            arg = call.args[0]
+            src = ast.unparse(arg)
+            low = src.lower()
+            if any(h in low for h in _CONV_HINTS):
+                return f"{call.func.id}({src[:40]})"
+            if (isinstance(arg, ast.Call)
+                    and attr_chain(arg.func).split(".", 1)[0]
+                    in ("jnp", "jax")
+                    and attr_chain(arg.func) not in _HOST_JAX):
+                return f"{call.func.id}({src[:40]})"
+        return None
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        graph = CallGraph(scanner, module_filter=_runtime_module)
+        reachable = graph.reachable_from(_ENTRY_NAMES)
+        for fid in sorted(reachable):
+            mod, fn = graph.defs[fid]
+            hits = {}  # (path, line) -> Finding; one per line, and a
+            # conversion wrapping a sync (`int(jax.device_get(x))`)
+            # reports once with the outer, most-specific key
+            for sub in _walk_no_nested_functions(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                key = self._sync_key(sub)
+                if key is None:
+                    continue
+                at = (mod.relpath, sub.lineno)
+                if at in hits and len(hits[at].key) >= len(key):
+                    continue
+                hits[at] = Finding(
+                    rule=self.name, path=mod.relpath, line=sub.lineno,
+                    qualname=mod.qualname(sub), key=key,
+                    message=(f"`{key}` syncs the host against the "
+                             f"device inside `{fn.name}` (reachable "
+                             "from a step/tick entry) — hoist it off "
+                             "the hot path or pragma a deliberate "
+                             "sync"))
+            yield from hits.values()
+
+
+# -- ungated-telemetry -------------------------------------------------------
+
+_TRACER_NAMES = {"tr", "tracer", "_tr"}
+_TRACER_ATTR_TAILS = (".tracer", "._tracer", "._tr")
+
+
+class UngatedTelemetryRule(Rule):
+    """`tracer.count`/`tracer.event` call sites outside the enabled gate.
+
+    The disabled-telemetry contract (docs/OBSERVABILITY.md, enforced
+    dynamically by `scripts/check_telemetry_overhead.py`) prices an
+    instrumented site at one `get_tracer()` lookup plus one `.enabled`
+    read. That only holds when call sites follow the idiom::
+
+        tr = get_tracer()
+        if tr.enabled:
+            tr.count("dear.steps")
+
+    An ungated ``tr.count(...)`` still works (NullTracer no-ops) but
+    pays a method call plus argument evaluation per step — exactly the
+    creep the 1 µs budget exists to stop. Early-return guards
+    (``if not tr.enabled: return`` before the call) also count as
+    gated.
+    """
+
+    name = "ungated-telemetry"
+    doc = "tracer.count/event call site not under an `.enabled` gate"
+
+    @staticmethod
+    def _is_tracer_receiver(func: ast.Attribute) -> bool:
+        v = func.value
+        if isinstance(v, ast.Name):
+            return v.id in _TRACER_NAMES
+        chain = attr_chain(v)
+        if chain and chain.endswith(_TRACER_ATTR_TAILS):
+            return True
+        if isinstance(v, ast.Call):
+            leaf = attr_chain(v.func).rsplit(".", 1)[-1]
+            return leaf == "get_tracer"
+        return False
+
+    @staticmethod
+    def _has_enabled(node) -> bool:
+        return any(isinstance(n, ast.Attribute) and n.attr == "enabled"
+                   for n in ast.walk(node))
+
+    def _gated(self, mod, call: ast.Call) -> bool:
+        # (a) an ancestor `if <...>.enabled:` — but only when the call
+        # sits on the branch that executes WITH telemetry on: the body
+        # of a positive test, or the orelse of a negated one. A call in
+        # `else:` of `if tr.enabled:` runs precisely when disabled —
+        # the exact creep this rule exists to stop.
+        node, prev = call, call
+        fn = None
+        while node is not None:
+            prev, node = node, getattr(node, "_dearlint_parent", None)
+            if isinstance(node, ast.If) and self._has_enabled(node.test):
+                negated = (isinstance(node.test, ast.UnaryOp)
+                           and isinstance(node.test.op, ast.Not))
+                in_body = any(prev is s for s in node.body)
+                in_orelse = any(prev is s for s in node.orelse)
+                if (in_body and not negated) or (in_orelse and negated):
+                    return True
+            if (fn is None and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef))):
+                fn = node
+        if fn is None:
+            return False
+        # (b) an earlier `if not <...>.enabled: return/continue/raise`
+        for stmt in ast.walk(fn):
+            if not (isinstance(stmt, ast.If)
+                    and stmt.lineno < call.lineno
+                    and isinstance(stmt.test, ast.UnaryOp)
+                    and isinstance(stmt.test.op, ast.Not)
+                    and self._has_enabled(stmt.test)):
+                continue
+            if any(isinstance(s, (ast.Return, ast.Continue, ast.Raise))
+                   for s in stmt.body):
+                return True
+        return False
+
+    @staticmethod
+    def _counter_key(call: ast.Call) -> str:
+        if not call.args:
+            return "<dynamic>"
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.JoinedStr):
+            parts = []
+            for v in arg.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                else:
+                    parts.append("*")
+            return "".join(parts)
+        return "<dynamic>"
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        for mod in scanner.modules:
+            if not _runtime_module(mod):
+                continue
+            if mod.relpath.endswith("observability/tracer.py"):
+                continue  # the tracer's own machinery defines the calls
+            for node in mod.walk():
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("count", "event")
+                        and self._is_tracer_receiver(node.func)):
+                    continue
+                if self._gated(mod, node):
+                    continue
+                key = self._counter_key(node)
+                yield Finding(
+                    rule=self.name, path=mod.relpath, line=node.lineno,
+                    qualname=mod.qualname(node),
+                    key=f"{node.func.attr}:{key}",
+                    message=(f"`{node.func.attr}(\"{key}\")` outside "
+                             "an `.enabled` gate — the disabled-"
+                             "telemetry contract is two lookups per "
+                             "site; wrap in `if tr.enabled:`"))
+
+
+# -- donation-alias ----------------------------------------------------------
+
+
+class DonationAliasRule(Rule):
+    """`device_put` onto an existing array's sharding without a copy.
+
+    Originating bug: PR 10's plan repack — ``jax.device_put(v,
+    ref.sharding)`` is a NO-OP returning the same underlying buffer
+    when the sharding already matches, and XLA:CPU eager slicing hands
+    back views; donating the assembled state then frees buffers other
+    live arrays still own ("Attempt to donate the same buffer twice",
+    heap corruption on the next step). The sanctioned idiom
+    deep-copies every leaf (``jax.tree.map(jnp.copy, out)``) before the
+    state reaches a donating step, so the rule flags
+    sharding-from-a-ref ``device_put`` in functions with no ``copy``
+    call anywhere. Constructed shardings (``NamedSharding(mesh, ...)``)
+    are not flagged — fresh placement cannot alias a live donated
+    buffer through the no-op path.
+    """
+
+    name = "donation-alias"
+    doc = "device_put onto a ref's .sharding with no defensive copy"
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        for mod in scanner.modules:
+            if not _runtime_module(mod):
+                continue
+            for fn in mod.walk():
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                has_copy = any(
+                    (isinstance(n, ast.Attribute) and n.attr == "copy")
+                    or (isinstance(n, ast.Call)
+                        and attr_chain(n.func).rsplit(".", 1)[-1]
+                        == "deepcopy")
+                    for n in ast.walk(fn))
+                if has_copy:
+                    continue
+                for sub in ast.walk(fn):
+                    if not (isinstance(sub, ast.Call)
+                            and attr_chain(sub.func).rsplit(
+                                ".", 1)[-1] == "device_put"
+                            and len(sub.args) >= 2
+                            and isinstance(sub.args[1], ast.Attribute)
+                            and sub.args[1].attr == "sharding"):
+                        continue
+                    src = ast.unparse(sub.args[0])[:60]
+                    yield Finding(
+                        rule=self.name, path=mod.relpath,
+                        line=sub.lineno, qualname=mod.qualname(sub),
+                        key=src,
+                        message=(f"`device_put({src}, <ref>.sharding)` "
+                                 "can alias its source when the "
+                                 "sharding already matches — a donating "
+                                 "step then double-frees; deep-copy "
+                                 "(`jnp.copy`) before donation"))

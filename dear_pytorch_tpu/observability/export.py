@@ -159,7 +159,6 @@ class PromFileExporter:
         self._raw_path = path
         self._path: Optional[str] = None
         self.namespace = namespace
-        self._lock = threading.Lock()
 
     @property
     def path(self) -> str:
@@ -194,12 +193,15 @@ class PromFileExporter:
             lines.append(f"# TYPE {pname} gauge")
             lines.append(f"{pname} {value:g}")
         body = "\n".join(lines) + "\n"
-        tmp = f"{self.path}.tmp"
-        with self._lock:
-            # atomic replace: a scraper never reads a half-written file
-            with open(tmp, "w") as f:
-                f.write(body)
-            os.replace(tmp, self.path)
+        # lock-free write: the tmp name is unique per writer thread, so
+        # concurrent exports never collide, and os.replace is atomic —
+        # a scraper sees some complete snapshot (last replace wins).
+        # Holding a lock across the write would serialize every exporter
+        # for the disk-write duration for nothing (dearlint:lock-held-io).
+        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, self.path)
 
     def close(self) -> None:
         pass

@@ -157,8 +157,15 @@ class FeedbackWriter:
         next_seg, next_seq = 0, 0
         try:
             keys = self.store.list(prefix)
-        except Exception:  # noqa: BLE001 — an unreachable store at boot
-            #               degrades to a fresh stream; commits will retry
+        except Exception as e:  # noqa: BLE001 — an unreachable store at
+            #               boot degrades to a fresh stream; commits will
+            #               retry. Logged, never silent: a writer that
+            #               restarts at seg 0 against a live stream is a
+            #               store-health symptom operators must see
+            logger.warning(
+                "feedback: tail scan of %s failed (%s); starting at "
+                "segment 0 — commits will retry against the store",
+                prefix, e)
             return 0, 0
         for key in keys:
             m = _SEG_RE.search(key)

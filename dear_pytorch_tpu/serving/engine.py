@@ -346,7 +346,9 @@ class DecodeEngine:
         t0 = time.monotonic()
         sampled, self._cache = self._prefill_step(
             self.params, self._cache, toks, pos, nvalid)
-        sampled = np.asarray(sampled)          # device sync: honest timing
+        # deliberate device sync — the tick must materialize this tick's
+        # samples before attributing latency (honest timing)
+        sampled = np.asarray(sampled)  # dearlint: disable=hot-path-sync
         dt = time.monotonic() - t0
         if not self._prefill_warm:             # the compile tick
             self._prefill_warm = True
@@ -390,7 +392,9 @@ class DecodeEngine:
             prefilling[b] = s.prompt_remaining > 0
         t0 = time.monotonic()
         logits, self._cache = self._step(self.params, self._cache, toks, pos)
-        logits = np.asarray(logits)[:, : self.vocab_size]
+        # deliberate device sync — materialize before attributing tick
+        # latency (honest timing)
+        logits = np.asarray(logits)[:, : self.vocab_size]  # dearlint: disable=hot-path-sync
         dt = time.monotonic() - t0
         if not self._decode_warm:              # the compile tick
             self._decode_warm = True
@@ -413,7 +417,9 @@ class DecodeEngine:
             else:
                 s.decode_s += dt
             if s.fed >= len(s.prompt):       # the prompt is consumed:
-                nxt = int(np.argmax(logits[b]))  # this tick's logits sample
+                # this tick's logits sample: host argmax over the
+                # ALREADY-materialized array above
+                nxt = int(np.argmax(logits[b]))  # dearlint: disable=hot-path-sync
                 s.generated.append(nxt)
                 done = (len(s.generated) >= s.max_new
                         or (s.eos_id is not None and nxt == s.eos_id))

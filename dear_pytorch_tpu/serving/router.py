@@ -280,17 +280,25 @@ class ReplicaRouter:
             ranks = []
         now_wall = time.time()
         tr = _telemetry.get_tracer()
+        # read every health file BEFORE taking the lock: per-replica file
+        # I/O under it would block the whole client surface for the scan
+        # duration (the _dispatch lesson, read side)
+        docs = {}
+        for rank in ranks:
+            path = os.path.join(self._replicas_dir, str(rank),
+                                "health.json")
+            try:
+                with open(path) as f:
+                    docs[rank] = json.load(f)
+            except (OSError, ValueError):
+                docs[rank] = None  # absent or mid-write; staleness will
+                #                    catch a replica that never writes again
         with self._lock:
             for rank in ranks:
                 rep = self._replicas.setdefault(rank, _Replica(rank))
-                path = os.path.join(self._replicas_dir, str(rank),
-                                    "health.json")
-                try:
-                    with open(path) as f:
-                        doc = json.load(f)
-                except (OSError, ValueError):
-                    continue  # absent or mid-write; staleness will catch
-                    #           a replica that never writes again
+                doc = docs.get(rank)
+                if doc is None:
+                    continue
                 incarnation = doc.get("incarnation")
                 version = doc.get("version")
                 if (rep.incarnation is not None

@@ -546,7 +546,9 @@ class GuardedTrainer:
         ) from last_exc
 
     def _check(self, metrics) -> bool:
-        loss = float(jax.device_get(metrics["loss"]))
+        # the guard's contract IS this per-step sync: divergence must be
+        # caught before the next donated step destroys the rollback state
+        loss = float(jax.device_get(metrics["loss"]))  # dearlint: disable=hot-path-sync
         self._last_loss = loss  # the run-health layer reuses the fetch
         return math.isfinite(loss)
 

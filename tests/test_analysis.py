@@ -1,0 +1,680 @@
+"""dearlint framework tests: one planted-violation fixture per rule
+(red) with a clean twin (green), pragma suppression, baseline
+add/expire semantics, registry audits in both directions, the CLI exit
+codes, ``--changed`` filtering, the import-graph isolation contract,
+and the zero-unbaselined-findings gate over the live package.
+
+Fixtures are written under tmp_path as a fake repo layout
+(``dear_pytorch_tpu/<area>/mod.py``) because several rules scope by
+relpath (waist modules, serving/, the runtime-package filter)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from dear_pytorch_tpu.analysis import (
+    ALL_RULES, BASELINE_NAME, Baseline, Scanner, main, make_rules,
+    repo_root, run_rules,
+)
+from dear_pytorch_tpu.analysis.cli import changed_files
+from dear_pytorch_tpu.analysis.rules_host import (
+    AtomicWriteRule, BareExceptHotPathRule, LockHeldIORule,
+    SignalHandlerImportRule,
+)
+from dear_pytorch_tpu.analysis.rules_registry import (
+    CounterDocsRule, EnvRegistryRule,
+)
+from dear_pytorch_tpu.analysis.rules_trace import (
+    DonationAliasRule, HotPathSyncRule, UngatedTelemetryRule,
+)
+
+REPO = repo_root()
+
+
+def _plant(tmp_path, relpath, src):
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+def _findings(tmp_path, rule, paths=("dear_pytorch_tpu",)):
+    scanner = Scanner([str(tmp_path / p) for p in paths],
+                      root=str(tmp_path))
+    return scanner.run([rule])
+
+
+# ---------------------------------------------------------------------------
+# one red fixture + one green twin per rule
+# ---------------------------------------------------------------------------
+
+
+def test_lock_held_io_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/red.py", """
+        import os
+
+        class R:
+            def flush(self):
+                with self._lock:
+                    with open(self.path, "w") as f:
+                        f.write("x")
+                    os.replace(self.path, self.path + ".1")
+                    self.store.put_bytes("k", b"v")
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/green.py", """
+        import os
+
+        class G:
+            def flush(self):
+                body = self.render()
+                with self._lock:
+                    self.dirty = False      # state transition only
+                with open(self.path + ".tmp", "w") as f:
+                    f.write(body)
+                os.replace(self.path + ".tmp", self.path)
+
+            def closure_is_fine(self):
+                with self._lock:
+                    def later():
+                        return open(self.path)  # runs outside the lock
+                    self.cb = later
+    """)
+    found = _findings(tmp_path, LockHeldIORule())
+    assert {(f.path, f.key) for f in found} == {
+        ("dear_pytorch_tpu/x/red.py", "open"),
+        ("dear_pytorch_tpu/x/red.py", "os.replace"),
+        ("dear_pytorch_tpu/x/red.py", "put_bytes"),
+    }
+
+
+def test_atomic_write_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/utils/objectstore.py", """
+        import os
+
+        def torn(path, data):
+            with open(path, "w") as f:     # RED: no tmp, no replace
+                f.write(data)
+
+        def atomic(path, data):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:      # green: the staging half
+                f.write(data)
+            os.replace(tmp, path)
+
+        def reader(path):
+            with open(path) as f:          # green: read mode
+                return f.read()
+    """)
+    # the same torn write OUTSIDE a waist module is not this rule's
+    # business (green twin by scope)
+    _plant(tmp_path, "dear_pytorch_tpu/models/misc.py", """
+        def torn(path, data):
+            with open(path, "w") as f:
+                f.write(data)
+    """)
+    found = _findings(tmp_path, AtomicWriteRule())
+    assert [(f.path, f.qualname, f.key) for f in found] == [
+        ("dear_pytorch_tpu/utils/objectstore.py", "torn", "path")]
+
+
+def test_hot_path_sync_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/red.py", """
+        import numpy as np
+
+        def _helper(metrics):
+            return float(metrics["loss"])      # RED: reachable from step
+
+        def step(state, batch):
+            out = run(state, batch)
+            host = np.asarray(out)             # RED: sync in the entry
+            return _helper(host)
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/green.py", """
+        import numpy as np
+
+        def offline_report(rows):
+            # green: not reachable from any step/tick entry
+            return np.asarray(rows).mean()
+
+        def step(state):
+            xs = np.asarray([1, 2, 3])         # green: literal host data
+            n = int(jax.process_index())       # green: host-side jax
+            return xs, n
+    """)
+    found = _findings(tmp_path, HotPathSyncRule())
+    assert {(f.path, f.qualname, f.key) for f in found} == {
+        ("dear_pytorch_tpu/x/red.py", "_helper", "float(metrics['loss'])"),
+        ("dear_pytorch_tpu/x/red.py", "step", "np.asarray"),
+    }
+
+
+def test_ungated_telemetry_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/red.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.steps")                    # RED
+            get_tracer().event("x.rebuilt", n=1)   # RED: chained
+
+        def wrong_branch():
+            tr = get_tracer()
+            if tr.enabled:
+                pass
+            else:
+                tr.count("x.disabled_path")        # RED: runs when OFF
+            if not tr.enabled:
+                tr.count("x.negated_body")         # RED: runs when OFF
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/green.py", """
+        def gated():
+            tr = get_tracer()
+            if tr.enabled:
+                tr.count("x.steps")
+                tr.event("x.rebuilt", n=1)
+
+        def early_return():
+            tr = get_tracer()
+            if not tr.enabled:
+                return run()
+            tr.count("x.steps")
+            return run()
+
+        def negated_orelse():
+            tr = get_tracer()
+            if not tr.enabled:
+                pass
+            else:
+                tr.count("x.on_path")   # green: executes only when ON
+    """)
+    found = _findings(tmp_path, UngatedTelemetryRule())
+    assert {(f.path, f.key) for f in found} == {
+        ("dear_pytorch_tpu/x/red.py", "count:x.steps"),
+        ("dear_pytorch_tpu/x/red.py", "event:x.rebuilt"),
+        ("dear_pytorch_tpu/x/red.py", "count:x.disabled_path"),
+        ("dear_pytorch_tpu/x/red.py", "count:x.negated_body"),
+    }
+
+
+def test_signal_handler_import_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/red.py", """
+        import signal
+
+        class H:
+            def _on_signal(self, signum, frame):
+                from dear_pytorch_tpu.resilience import membership  # RED
+                self.epoch = membership.current_epoch()
+
+            def install(self):
+                signal.signal(signal.SIGTERM, self._on_signal)
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/green.py", """
+        import signal
+
+        class H:
+            def _on_signal(self, signum, frame):
+                self.flag = True               # green: pre-bound only
+
+            def install(self):
+                from dear_pytorch_tpu.resilience import membership
+                self._epoch_fn = membership.current_epoch
+                signal.signal(signal.SIGTERM, self._on_signal)
+
+        def not_a_handler():
+            import os                          # green: never registered
+            return os
+    """)
+    found = _findings(tmp_path, SignalHandlerImportRule())
+    assert [(f.path, f.qualname) for f in found] == [
+        ("dear_pytorch_tpu/x/red.py", "H._on_signal")]
+
+
+def test_donation_alias_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/red.py", """
+        import jax
+
+        def repack(state, fresh):
+            leaves = [jax.device_put(v, ref.sharding)       # RED
+                      for v, ref in zip(state, fresh)]
+            return leaves
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/green.py", """
+        import jax
+        import jax.numpy as jnp
+
+        def repack(state, fresh):
+            leaves = [jax.device_put(v, ref.sharding)
+                      for v, ref in zip(state, fresh)]
+            return jax.tree.map(jnp.copy, leaves)   # defensive copy
+
+        def place(x, mesh):
+            s = jax.sharding.NamedSharding(mesh, jax.P())
+            return jax.device_put(x, s)             # constructed sharding
+    """)
+    found = _findings(tmp_path, DonationAliasRule())
+    assert [(f.path, f.qualname) for f in found] == [
+        ("dear_pytorch_tpu/x/red.py", "repack")]
+
+
+def test_bare_except_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/serving/red.py", """
+        def loop():
+            try:
+                run()
+            except Exception:
+                pass                       # RED: swallowed, unobservable
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/serving/green.py", """
+        import os
+
+        def loop(tr):
+            try:
+                run()
+            except Exception:
+                tr.count("serve.errors")   # green: counted
+            try:
+                os.unlink("x")
+            except OSError:
+                pass                       # green: narrow best-effort
+    """)
+    # same swallow outside serving/guard scope: not this rule's business
+    _plant(tmp_path, "dear_pytorch_tpu/models/red.py", """
+        def loop():
+            try:
+                run()
+            except Exception:
+                pass
+    """)
+    found = _findings(tmp_path, BareExceptHotPathRule())
+    assert [(f.path, f.key) for f in found] == [
+        ("dear_pytorch_tpu/serving/red.py", "Exception")]
+
+
+def test_env_registry_both_directions(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/mod.py", """
+        import os
+
+        UNDOC = os.environ.get("DEAR_UNDOCUMENTED_KNOB")       # RED
+        DOCD = os.environ.get("DEAR_DOCUMENTED_KNOB", "1")
+        HELPER_ENV = "DEAR_HELPER_READ"                        # RED
+        PREFIXED = [k for k in os.environ
+                    if k.startswith("DEAR_FAMILY_")]           # prefix
+    """)
+    doc = tmp_path / "docs" / "ENV.md"
+    doc.parent.mkdir(parents=True)
+    doc.write_text(textwrap.dedent("""
+        | variable | effect |
+        |---|---|
+        | `DEAR_DOCUMENTED_KNOB` | documented and read: green |
+        | `DEAR_FAMILY_<AXIS>` | documents the whole prefix family |
+        | `DEAR_STALE_KNOB` | RED: nothing reads this |
+        | `DEAR_BUILT_AT_RUNTIME` | (dynamic) name built at runtime |
+    """))
+    found = _findings(tmp_path, EnvRegistryRule())
+    assert {(f.path, f.key) for f in found} == {
+        ("dear_pytorch_tpu/x/mod.py", "DEAR_UNDOCUMENTED_KNOB"),
+        ("dear_pytorch_tpu/x/mod.py", "DEAR_HELPER_READ"),
+        ("docs/ENV.md", "DEAR_STALE_KNOB"),
+    }
+
+
+def test_counter_docs_both_directions(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/mod.py", """
+        def hot(tr, leg):
+            if tr.enabled:
+                tr.count("x.documented")
+                tr.count("x.undocumented")          # RED
+                tr.count(f"x.{leg}_bytes", 4)       # documented as <leg>
+                tr.count(f"x.{leg}_drops")          # RED: no doc pattern
+    """)
+    doc = tmp_path / "docs" / "OBSERVABILITY.md"
+    doc.parent.mkdir(parents=True)
+    doc.write_text(textwrap.dedent("""
+        | source | counters |
+        |---|---|
+        | x | `x.documented`, `x.<leg>_bytes` |
+        | x | `x.stale` |
+        | other namespace | `foreign.counter` is NOT held to the audit |
+    """))
+    rule = CounterDocsRule()
+    found = _findings(tmp_path, rule)
+    assert {(f.path, f.key) for f in found} == {
+        ("dear_pytorch_tpu/x/mod.py", "x.undocumented"),
+        ("dear_pytorch_tpu/x/mod.py", "x.*_drops"),
+        ("docs/OBSERVABILITY.md", "x.stale"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# pragmas, baseline, report plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_line_and_file_suppression(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/line.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.a")  # dearlint: disable=ungated-telemetry
+            tr.count("x.b")  # dearlint: disable=some-other-rule
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/file.py", """
+        # dearlint: disable-file=ungated-telemetry
+        def hot():
+            tr = get_tracer()
+            tr.count("x.c")
+            tr.count("x.d")
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/all.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.e")  # dearlint: disable=all
+    """)
+    found = _findings(tmp_path, UngatedTelemetryRule())
+    assert [(f.path, f.key) for f in found] == [
+        ("dear_pytorch_tpu/x/line.py", "count:x.b")]
+
+
+def test_pragma_inside_string_is_not_a_pragma(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/s.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.a # dearlint: disable=ungated-telemetry")
+    """)
+    found = _findings(tmp_path, UngatedTelemetryRule())
+    assert len(found) == 1  # the fake pragma lives in the literal
+
+
+def test_baseline_add_expire_and_justification(tmp_path):
+    mod = """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.a")
+    """
+    _plant(tmp_path, "dear_pytorch_tpu/x/mod.py", mod)
+    rule = UngatedTelemetryRule()
+    fp = _findings(tmp_path, rule)[0].fingerprint
+    assert fp == ("ungated-telemetry:dear_pytorch_tpu/x/mod.py:hot:"
+                  "count:x.a")
+
+    # accepted finding: does not gate; report still carries it
+    bl = Baseline({fp: "cold path, deliberate"})
+    rep = run_rules([str(tmp_path / "dear_pytorch_tpu")], [rule],
+                    baseline=bl, root=str(tmp_path))
+    assert rep.clean and len(rep.findings) == 1
+
+    # fingerprints survive unrelated edits (lines shift, same code)
+    _plant(tmp_path, "dear_pytorch_tpu/x/mod.py",
+           "# a new leading comment\n# another\n" + textwrap.dedent(mod))
+    rep = run_rules([str(tmp_path / "dear_pytorch_tpu")], [rule],
+                    baseline=bl, root=str(tmp_path))
+    assert rep.clean
+
+    # the violation is fixed -> the entry is STALE and gates
+    _plant(tmp_path, "dear_pytorch_tpu/x/mod.py", """
+        def hot():
+            tr = get_tracer()
+            if tr.enabled:
+                tr.count("x.a")
+    """)
+    rep = run_rules([str(tmp_path / "dear_pytorch_tpu")], [rule],
+                    baseline=bl, root=str(tmp_path))
+    assert not rep.clean and rep.stale_baseline == [fp]
+
+    # a justification is mandatory on disk
+    p = tmp_path / "bl.json"
+    p.write_text(json.dumps({"findings": [{"fingerprint": fp}]}))
+    with pytest.raises(ValueError, match="justification"):
+        Baseline.load(str(p))
+    # round-trip keeps entries
+    bl.save(str(p))
+    assert Baseline.load(str(p)).entries == bl.entries
+
+
+def test_changed_mode_filters_reporting_not_parsing(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/touched.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.a")
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/untouched.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.b")
+    """)
+    rule = UngatedTelemetryRule()
+    rep = run_rules([str(tmp_path / "dear_pytorch_tpu")], [rule],
+                    root=str(tmp_path),
+                    only_files={"dear_pytorch_tpu/x/touched.py"})
+    assert [f.path for f in rep.unbaselined] == [
+        "dear_pytorch_tpu/x/touched.py"]
+    # a partial view never judges baseline staleness
+    bl = Baseline({"ungated-telemetry:gone.py:f:count:x.z": "old"})
+    rep = run_rules([str(tmp_path / "dear_pytorch_tpu")], [rule],
+                    baseline=bl, root=str(tmp_path),
+                    only_files={"dear_pytorch_tpu/x/touched.py"})
+    assert rep.stale_baseline == []
+
+
+def test_rules_subset_never_judges_foreign_baseline_entries(tmp_path):
+    """A --rules subset run is a partial view: entries belonging to
+    rules that did not run must neither gate as stale nor be expired
+    by --write-baseline (the justified-entry-erasure regression)."""
+    _plant(tmp_path, "dear_pytorch_tpu/x/mod.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.a")
+    """)
+    foreign = "hot-path-sync:dear_pytorch_tpu/y.py:f:np.asarray"
+    bl = Baseline({foreign: "host data"})
+    rep = run_rules([str(tmp_path / "dear_pytorch_tpu")],
+                    make_rules(["ungated-telemetry"]),
+                    baseline=bl, root=str(tmp_path))
+    assert rep.stale_baseline == []          # hot-path-sync never ran
+    rep = run_rules([str(tmp_path / "dear_pytorch_tpu")],
+                    make_rules(["ungated-telemetry", "hot-path-sync"]),
+                    baseline=bl, root=str(tmp_path))
+    assert rep.stale_baseline == [foreign]   # now it did — stale gates
+
+
+def test_cli_explicit_paths_filter_reporting_not_parsing(tmp_path,
+                                                         capsys):
+    """Naming one clean file must not flood it with cross-file
+    registry findings: the whole standard tree is parsed, the named
+    files only filter what is reported."""
+    _plant(tmp_path, "dear_pytorch_tpu/x/clean.py", """
+        import os
+
+        KNOB = os.environ.get("DEAR_FIXTURE_KNOB")
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/dirty.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.a")
+    """)
+    doc = tmp_path / "docs" / "ENV.md"
+    doc.parent.mkdir(parents=True)
+    doc.write_text("| variable | effect |\n|---|---|\n"
+                   "| `DEAR_FIXTURE_KNOB` | documented |\n")
+    base = ["--root", str(tmp_path), "--no-baseline"]
+    # the clean file alone: env-registry judges it against the SAME
+    # full-tree view -> clean, exit 0 (not a storm of stale doc rows)
+    assert main([str(tmp_path / "dear_pytorch_tpu/x/clean.py")]
+                + base) == 0
+    # the dirty file alone still reds
+    assert main([str(tmp_path / "dear_pytorch_tpu/x/dirty.py")]
+                + base) == 2
+    capsys.readouterr()
+
+
+def test_changed_files_parses_git_output():
+    calls = []
+
+    class _P:
+        returncode = 0
+        stderr = ""
+
+        def __init__(self, out):
+            self.stdout = out
+
+    def fake_run(args, **kw):
+        calls.append(args)
+        if "diff" in args:
+            return _P("dear_pytorch_tpu/a.py\ndocs/ENV.md\n")
+        return _P("tests/new_test.py\n")
+
+    out = changed_files("/nowhere", run=fake_run)
+    assert out == {"dear_pytorch_tpu/a.py", "tests/new_test.py"}
+    assert len(calls) == 2
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/bad.py", "def broken(:\n")
+    rep = run_rules([str(tmp_path / "dear_pytorch_tpu")],
+                    make_rules(["ungated-telemetry"]),
+                    root=str(tmp_path))
+    assert not rep.clean
+    assert rep.unbaselined[0].rule == "parse-error"
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_listing(tmp_path, capsys):
+    # clean scan of an empty dir -> 0
+    (tmp_path / "empty").mkdir()
+    assert main([str(tmp_path / "empty"), "--root", str(tmp_path),
+                 "--rules", "ungated-telemetry", "--no-baseline"]) == 0
+    # unbaselined finding -> 2
+    _plant(tmp_path, "dear_pytorch_tpu/x/mod.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.a")
+    """)
+    assert main([str(tmp_path / "dear_pytorch_tpu"),
+                 "--root", str(tmp_path),
+                 "--rules", "ungated-telemetry", "--no-baseline"]) == 2
+    # unknown rule -> 1 (usage error)
+    assert main(["--rules", "nonesuch"]) == 1
+    # --list-rules names every registered rule
+    capsys.readouterr()
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for cls in ALL_RULES:
+        assert cls.name in out
+    # --json is machine-parseable and carries the verdict
+    assert main([str(tmp_path / "dear_pytorch_tpu"),
+                 "--root", str(tmp_path),
+                 "--rules", "ungated-telemetry", "--no-baseline",
+                 "--json"]) == 2
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["clean"] is False and len(doc["unbaselined"]) == 1
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    _plant(tmp_path, "dear_pytorch_tpu/x/mod.py", """
+        def hot():
+            tr = get_tracer()
+            tr.count("x.a")
+    """)
+    bl_path = str(tmp_path / "bl.json")
+    args = [str(tmp_path / "dear_pytorch_tpu"), "--root", str(tmp_path),
+            "--rules", "ungated-telemetry", "--baseline", bl_path]
+    assert main(args) == 2
+    assert main(args + ["--write-baseline"]) == 0
+    doc = json.loads(open(bl_path).read())
+    assert doc["findings"][0]["justification"].startswith("TODO")
+    capsys.readouterr()
+    assert main(args) == 0  # accepted now
+
+
+# ---------------------------------------------------------------------------
+# the live-tree gate + isolation contract
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_under_committed_baseline():
+    """THE tier-1 gate: zero unbaselined findings and zero stale
+    baseline entries over the live package, scripts, launch helpers,
+    and bench.py — i.e. `python -m dear_pytorch_tpu.analysis` exits 0."""
+    from dear_pytorch_tpu.analysis.core import default_paths
+
+    baseline = Baseline.load(os.path.join(REPO, BASELINE_NAME))
+    rep = run_rules(default_paths(), make_rules(), baseline=baseline)
+    assert rep.files_scanned > 50, "scan set collapsed — path rot?"
+    msgs = [f.render() for f in rep.unbaselined]
+    assert not msgs, "unbaselined dearlint findings:\n" + "\n".join(msgs)
+    assert not rep.stale_baseline, (
+        "stale LINT_BASELINE.json entries (fix shipped — delete them):\n"
+        + "\n".join(rep.stale_baseline))
+
+
+def test_analysis_never_imported_by_runtime_modules():
+    """Import-graph isolation: the analyzer is host tooling; if any
+    runtime module imported it, it would ride into the training/serving
+    processes (and its cost would stop being zero). Checked statically
+    over every import statement in the runtime package."""
+    import ast as pyast
+
+    offenders = []
+    pkg = os.path.join(REPO, "dear_pytorch_tpu")
+    scanner = Scanner([pkg], root=REPO)
+    for mod in scanner.modules:
+        if mod.relpath.startswith("dear_pytorch_tpu/analysis/"):
+            continue
+        for node in mod.walk():
+            names = []
+            if isinstance(node, pyast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, pyast.ImportFrom):
+                names = [node.module or ""]
+            if any(n.startswith("dear_pytorch_tpu.analysis")
+                   for n in names):
+                offenders.append(f"{mod.relpath}:{node.lineno}")
+    assert not offenders, (
+        f"runtime modules import the analysis suite: {offenders}")
+
+
+def test_analysis_package_is_jax_free():
+    """The suite must load without jax (check_telemetry_overhead's
+    'pure host tooling' contract): no analysis module may import jax,
+    numpy, or any runtime subsystem at module level."""
+    import ast as pyast
+
+    pkg = os.path.join(REPO, "dear_pytorch_tpu", "analysis")
+    scanner = Scanner([pkg], root=REPO)
+    banned = ("jax", "numpy", "flax", "optax")
+    offenders = []
+    for mod in scanner.modules:
+        for node in mod.walk():
+            names = []
+            if isinstance(node, pyast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, pyast.ImportFrom):
+                names = [node.module or ""]
+            for n in names:
+                root = n.split(".", 1)[0]
+                if root in banned:
+                    offenders.append(f"{mod.relpath}:{node.lineno}:{n}")
+                if (n.startswith("dear_pytorch_tpu")
+                        and not n.startswith("dear_pytorch_tpu.analysis")):
+                    offenders.append(f"{mod.relpath}:{node.lineno}:{n}")
+    assert not offenders, f"analysis imports runtime deps: {offenders}"
+
+
+def test_overhead_script_reports_analysis_clean(capsys):
+    """The telemetry-overhead harness now also asserts the analyzer
+    stayed out of the measured process (analysis_imported=false feeds
+    its ok verdict)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_overhead_analysis_probe",
+        os.path.join(REPO, "scripts", "check_telemetry_overhead.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--iters", "200"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["analysis_imported"] is False

@@ -479,69 +479,8 @@ def test_overhead_script_fast_and_green(capsys):
 
 
 # ---------------------------------------------------------------------------
-# docs <-> code counter audit
+# docs <-> code counter audit — now a dearlint rule on the shared scanner
 # ---------------------------------------------------------------------------
-
-
-def _doc_counter_names():
-    """Counter names from docs/OBSERVABILITY.md — ONLY the cells of table
-    columns whose header contains 'counter' (the events columns share
-    prefixes and must not be swept in)."""
-    import os
-    import re
-
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
-    lines = open(path).read().splitlines()
-    names = set()
-    i = 0
-    while i < len(lines):
-        if not lines[i].lstrip().startswith("|"):
-            i += 1
-            continue
-        table = []
-        while i < len(lines) and lines[i].lstrip().startswith("|"):
-            table.append([c.strip() for c in
-                          lines[i].strip().strip("|").split("|")])
-            i += 1
-        header = table[0]
-        cols = [j for j, h in enumerate(header)
-                if "counter" in h.lower()]
-        for row in table[2:]:            # skip header + |---| separator
-            for j in cols:
-                if j < len(row):
-                    names |= set(re.findall(r"`([^`]+)`", row[j]))
-    token = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_<>]+)+$")
-    return {n for n in names if token.fullmatch(n)}
-
-
-def _code_counter_names():
-    """Counter names actually emitted: every ``.count("...")`` literal in
-    the package, f-string templates normalized to wildcard patterns, and
-    the anomaly monitor's ``health.<kind>`` family expanded from its
-    `_raise` call sites."""
-    import glob
-    import os
-    import re
-
-    pkg = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "dear_pytorch_tpu")
-    literals, patterns = set(), set()
-    for path in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True):
-        src = open(path).read()
-        for f_flag, name in re.findall(
-                r"\.count\(\s*(f?)\"([^\"]+)\"", src):
-            if "." not in name:
-                continue  # docstring toy examples ('steps', 'rs_bytes')
-            if f_flag:
-                patterns.add(re.sub(r"\{[^}]+\}", "*", name))
-            else:
-                literals.add(name)
-        if path.endswith("anomaly.py"):
-            kinds = set(re.findall(r"_raise\(\s*\n?\s*\"(\w+)\"", src))
-            literals |= {f"health.{k}" for k in kinds}
-            patterns.discard("health.*")
-    return literals, patterns
 
 
 def test_counter_docs_in_sync():
@@ -549,50 +488,18 @@ def test_counter_docs_in_sync():
     counter the code emits must be documented, and every documented
     counter must exist in code — in both directions, so the tables can't
     rot (the `retry.attempts` incident: a counter documented before it
-    was wired)."""
-    import fnmatch
-    import re
+    was wired). The audit itself lives in the static-analysis suite
+    (`analysis.rules_registry.CounterDocsRule`, docs/ANALYSIS.md) so the
+    repo has ONE source-walking layer; this test drives that rule over
+    the live tree and keeps the historical assertion surface."""
+    from dear_pytorch_tpu.analysis.core import Scanner, repo_root
+    from dear_pytorch_tpu.analysis.rules_registry import CounterDocsRule
 
-    code_literals, code_patterns = _code_counter_names()
-    assert code_literals, "code scanner found no counters — scanner rot?"
-    # prose in the counter cells may backtick non-counter dotted tokens
-    # (file names like reports.json); only tokens in a subsystem namespace
-    # the code actually emits are held to the audit
-    prefixes = {n.split(".", 1)[0]
-                for n in code_literals | code_patterns}
-    doc = {n for n in _doc_counter_names()
-           if n.split(".", 1)[0] in prefixes}
-    assert doc, "doc parser found no counter tables — parser rot?"
-    doc_literals = {n for n in doc if "<" not in n}
-    # '<leg>'-style segments normalize to one '*' wildcard
-    doc_patterns = {re.sub(r"<[^>]*>", "*", n) for n in doc if "<" in n}
+    import os
 
-    def matches_any(name, pats):
-        return any(fnmatch.fnmatchcase(name, p) for p in pats)
-
-    undocumented = {
-        n for n in code_literals
-        if n not in doc_literals and not matches_any(n, doc_patterns)}
-    assert not undocumented, (
-        f"counters emitted in code but missing from docs/OBSERVABILITY.md "
-        f"counter tables: {sorted(undocumented)}")
-    undocumented_pats = {
-        p for p in code_patterns
-        if p not in doc_patterns and not any(
-            fnmatch.fnmatchcase(d, p) for d in doc_literals)}
-    assert not undocumented_pats, (
-        f"templated counters in code with no doc entry: "
-        f"{sorted(undocumented_pats)}")
-    stale = {
-        n for n in doc_literals
-        if n not in code_literals and not matches_any(n, code_patterns)}
-    assert not stale, (
-        f"counters documented in docs/OBSERVABILITY.md but never emitted "
-        f"in code: {sorted(stale)}")
-    stale_pats = {
-        p for p in doc_patterns
-        if p not in code_patterns and not any(
-            fnmatch.fnmatchcase(c, p) for c in code_literals)}
-    assert not stale_pats, (
-        f"doc counter patterns matching no code counter: "
-        f"{sorted(stale_pats)}")
+    scanner = Scanner([os.path.join(repo_root(), "dear_pytorch_tpu")])
+    findings = list(CounterDocsRule().check(scanner))
+    # scanner-rot sentinels surface as findings too — an empty result
+    # really means "both sides parsed and agree"
+    assert not findings, "counter <-> docs audit violations:\n" + "\n".join(
+        f.render() for f in findings)
