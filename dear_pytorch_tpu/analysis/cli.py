@@ -39,7 +39,8 @@ from dear_pytorch_tpu.analysis.rules_registry import (
     CounterDocsRule, EnvRegistryRule,
 )
 from dear_pytorch_tpu.analysis.rules_trace import (
-    DonationAliasRule, HotPathSyncRule, UngatedTelemetryRule,
+    DcnBlockingRule, DonationAliasRule, HotPathSyncRule,
+    UngatedTelemetryRule,
 )
 
 __all__ = ["ALL_RULES", "make_rules", "main", "changed_files",
@@ -53,6 +54,7 @@ ALL_RULES = (
     LockHeldIORule, AtomicWriteRule, HotPathSyncRule,
     UngatedTelemetryRule, SignalHandlerImportRule, DonationAliasRule,
     EnvRegistryRule, CounterDocsRule, BareExceptHotPathRule,
+    DcnBlockingRule,
 )
 
 

@@ -19,7 +19,8 @@ from dear_pytorch_tpu.analysis.core import (
 )
 from dear_pytorch_tpu.analysis.rules_host import _walk_no_nested_functions
 
-__all__ = ["HotPathSyncRule", "UngatedTelemetryRule", "DonationAliasRule"]
+__all__ = ["HotPathSyncRule", "UngatedTelemetryRule", "DonationAliasRule",
+           "DcnBlockingRule"]
 
 
 def _runtime_module(mod) -> bool:
@@ -240,6 +241,97 @@ class UngatedTelemetryRule(Rule):
                              "an `.enabled` gate — the disabled-"
                              "telemetry contract is two lookups per "
                              "site; wrap in `if tr.enabled:`"))
+
+
+# -- dcn-blocking ------------------------------------------------------------
+
+#: methods that BLOCK on a remote peer (polling get, lockstep exchange,
+#: barrier) — at DCN/coordination latency, not disk latency
+_TRANSPORT_BLOCKING = {"get", "exchange", "exchange_scalar", "barrier"}
+
+
+class DcnBlockingRule(Rule):
+    """Blocking cross-slice/host transport calls under a lock or on the
+    step hot path.
+
+    Originating incident: PR 11's router wrote per-request files while
+    holding the router lock (`lock-held-io`); the multi-slice arc raises
+    the stakes — a transport ``get``/``exchange`` blocks for up to a
+    PEER DEADLINE (seconds of DCN latency, not microseconds of disk), so
+    one held under a lock serializes every other holder for a peer's
+    worst case, and one reachable from a step/tick entry is a
+    synchronization point that must be deliberate. The decoupled
+    schedule's OWN exchange legs (`comm.dcn.DcnExchanger`, the guard's
+    coordinated health sync) are exactly such deliberate points — they
+    are deadline-bounded by design and carried in the BASELINE with
+    one-line justifications, so any NEW blocking call site gates until
+    it is justified too. Receiver filter: attribute chains mentioning
+    ``transport``/``dcn``."""
+
+    name = "dcn-blocking"
+    doc = ("blocking cross-slice/host transport call under a lock or "
+           "on the step hot path")
+
+    @staticmethod
+    def _blocking_key(call: ast.Call) -> Optional[str]:
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _TRANSPORT_BLOCKING):
+            return None
+        recv = attr_chain(call.func.value) or ""
+        low = recv.lower()
+        if "transport" in low or "dcn" in low:
+            return f"{recv}.{call.func.attr}"
+        return None
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        from dear_pytorch_tpu.analysis.rules_host import LockHeldIORule
+
+        hits = {}  # (path, line) -> Finding
+        # (a) lexically under a lock — the router incident at DCN latency
+        for mod in scanner.modules:
+            if not _runtime_module(mod):
+                continue
+            for node in mod.walk():
+                if not LockHeldIORule._is_lock_with(node):
+                    continue
+                for sub in _walk_no_nested_functions(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    key = self._blocking_key(sub)
+                    if key is None:
+                        continue
+                    hits[(mod.relpath, sub.lineno)] = Finding(
+                        rule=self.name, path=mod.relpath,
+                        line=sub.lineno, qualname=mod.qualname(sub),
+                        key=key,
+                        message=(f"`{key}` blocks on a remote peer "
+                                 "while holding a lock — every other "
+                                 "holder stalls for the peer deadline; "
+                                 "move the transport call outside"))
+        # (b) reachable from the step/tick entries — a blocking peer
+        # rendezvous on the hot path must be a deliberate, baselined
+        # synchronization point
+        graph = CallGraph(scanner, module_filter=_runtime_module)
+        for fid in sorted(graph.reachable_from(_ENTRY_NAMES)):
+            mod, fn = graph.defs[fid]
+            for sub in _walk_no_nested_functions(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                key = self._blocking_key(sub)
+                if key is None:
+                    continue
+                at = (mod.relpath, sub.lineno)
+                if at in hits:
+                    continue
+                hits[at] = Finding(
+                    rule=self.name, path=mod.relpath, line=sub.lineno,
+                    qualname=mod.qualname(sub), key=key,
+                    message=(f"`{key}` blocks on a remote peer inside "
+                             f"`{fn.name}` (reachable from a step/tick "
+                             "entry) — a hot-path transport rendezvous "
+                             "must be deliberate: justify it in the "
+                             "baseline or hoist it off the step"))
+        yield from hits.values()
 
 
 # -- donation-alias ----------------------------------------------------------

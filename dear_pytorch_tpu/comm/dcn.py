@@ -1,0 +1,425 @@
+"""Host-level cross-slice gradient exchange: the DCN leg of the
+hierarchical (multi-slice) DeAR schedule.
+
+A multi-slice TPU pod has two interconnect levels with α-β constants
+orders of magnitude apart: ICI inside a slice, DCN between slices.
+FlexLink (arxiv 2510.15882) aggregates such heterogeneous links instead
+of serializing on the slowest; the DeAR-native port (arxiv 2302.12445)
+is a **two-level decoupled schedule**: per-bucket reduce-scatter /
+all-gather over the intra-slice ICI axis stays inside the jitted step
+(`parallel.build_train_step(mode='dear', dcn=...)`), while the
+cross-slice averaging of the reduced partials runs here — on the host,
+over a `resilience.cluster`-style KV transport — between the backward
+program and the optimizer-update program.
+
+Why host-level: cross-slice traffic is DCN traffic, driven by the hosts
+(this is also the only shape this container can emulate — multiprocess
+XLA collectives are unavailable on CPU, the documented `mp_worker.py`
+limitation — so every rank keeps its single-process intra-slice mesh
+and the slice boundary is a process boundary, exactly like production).
+
+The exchange protocol, per training step:
+
+  1. every slice PUBLISHES its bucket partials (the intra-slice
+     reduce-scatter means, already divided by the ICI world) under
+     epoch-scoped, step-scoped keys, split into ``partition_mb`` chunks
+     (`ops.fusion.chunk_bounds` — the per-level bucket partition, so the
+     DCN level pipelines at its own message size independent of the ICI
+     bucket threshold);
+  2. it FETCHES the other slices' chunks with a one-ahead prefetch
+     thread — the fetch of chunk j+1 is in flight while chunk j is
+     decoded and accumulated, and the whole fetch phase overlaps the
+     peers' still-running publishes (the decoupled-allreduce overlap,
+     at the DCN level);
+  3. the mean over the LIVE slice set is returned — membership is a
+     parameter, not a constant: `set_slices` renormalizes the exchange
+     after an elastic slice loss or rejoin (``dcn.renorms``), so
+     degraded-mode training on the survivors needs no recompilation
+     (the jitted programs never see the slice count).
+
+Every rank of a slice publishes the same keys with bit-identical bytes
+(deterministic SPMD emulation; atomic replace makes the race benign), so
+the exchange survives the death of any subset of a slice's ranks — the
+membership layer (`resilience.membership`, slice-granular) decides when
+the slice itself is gone. A dead slice surfaces here as `DcnPeerTimeout`
+from the fetch (budgeted by ``DEAR_DCN_TIMEOUT_SECS``, deliberately
+shorter than the cluster health deadline so the step fails fast and the
+guard's coordinated recovery — not the transport — handles it).
+
+Fault hooks (`resilience.inject`): ``dcn_slow@N:SECS`` arms a persistent
+per-exchange latency (a congested or degraded DCN link — a straggler
+slice), ``dcn_drop@N`` suppresses one exchange's outbound publish (a
+transient partition; peers time out, the guard rolls everyone back, the
+replay re-publishes). Both are slice-targetable (``:sK``).
+
+Telemetry: ``dcn.exchanges`` / ``dcn.bytes`` / ``dcn.chunks`` /
+``dcn.peer_timeouts`` / ``dcn.renorms`` counters, plus per-fetch
+``(bytes, seconds)`` samples (`samples`) feeding the link-aware α-β fit
+(`observability.overlap.fit_dcn` → the plan tuner's per-level cost
+model).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.ops import fusion as F
+
+__all__ = [
+    "DcnError", "DcnPeerTimeout", "DcnExchanger", "DCN_TIMEOUT_ENV",
+]
+
+#: Deadline for fetching ONE remote slice's chunk. Sized below the
+#: cluster health deadline on purpose: a dead slice must fail the step
+#: (and hand recovery to the guard's membership machinery) before the
+#: health sync itself would have timed out.
+DCN_TIMEOUT_ENV = "DEAR_DCN_TIMEOUT_SECS"
+_DEFAULT_TIMEOUT_S = 20.0
+
+
+class DcnError(RuntimeError):
+    """Base class for cross-slice exchange failures."""
+
+
+class DcnPeerTimeout(DcnError):
+    """A remote slice never published its partial within the deadline —
+    the slice is dead, partitioned, or dropped its publish (fault). The
+    guard treats this as an ordinary step error: coordinated rollback,
+    then the membership layer decides whether the slice is gone."""
+
+
+def _encode(arr: np.ndarray) -> str:
+    """Text-safe framing for KV transports that store strings (the
+    FileTransport contract): one JSON header line + base64 payload. A
+    production DCN transport would move raw bytes (gRPC/RDMA); the
+    framing is an emulation-substrate cost, stated here once."""
+    header = json.dumps({"dtype": str(arr.dtype), "n": int(arr.size)})
+    return header + "\n" + base64.b64encode(
+        np.ascontiguousarray(arr).tobytes()).decode("ascii")
+
+
+def _decode(text: str) -> np.ndarray:
+    head, _, body = text.partition("\n")
+    meta = json.loads(head)
+    raw = base64.b64decode(body)
+    return np.frombuffer(raw, dtype=np.dtype(meta["dtype"]),
+                         count=int(meta["n"]))
+
+
+class DcnExchanger:
+    """Chunked, prefetch-overlapped cross-slice averaging over a host KV
+    transport (see the module docstring for the protocol).
+
+    Args:
+      transport: a `resilience.cluster` transport (``set``/``get``/
+        ``delete``, optionally ``prune_prefix``) or a ``"file:<dir>"``
+        spec resolved to a `FileTransport`.
+      local_slices: slice ids THIS process computes (one per worker rank
+        in the multi-process fleet; several in single-process nested-mesh
+        emulation).
+      slices: ALL live slice ids (the cross-slice reduction set).
+      partition_mb: per-level bucket partition — the DCN message size
+        (`ops.fusion.chunk_bounds`); a `PlanSpace` searched axis.
+      injector: optional `resilience.inject.FaultInjector` for the
+        ``dcn_slow``/``dcn_drop`` fault kinds.
+    """
+
+    def __init__(
+        self,
+        transport,
+        *,
+        local_slices: Sequence[int],
+        slices: Sequence[int],
+        partition_mb: float = 4.0,
+        timeout_s: Optional[float] = None,
+        namespace: str = "dcn",
+        injector=None,
+        sample_cap: int = 256,
+    ):
+        if isinstance(transport, str) and transport.startswith("file:"):
+            from dear_pytorch_tpu.resilience.cluster import FileTransport
+
+            transport = FileTransport(transport[len("file:"):])
+        self._transport = transport
+        self.local_slices: Tuple[int, ...] = tuple(
+            sorted(int(s) for s in local_slices))
+        if not self.local_slices:
+            raise ValueError("local_slices must name at least one slice")
+        self.slices: Tuple[int, ...] = tuple(sorted(int(s) for s in slices))
+        if not set(self.local_slices) <= set(self.slices):
+            raise ValueError(
+                f"local slices {self.local_slices} not in the live set "
+                f"{self.slices}")
+        self.partition_mb = float(partition_mb)
+        if timeout_s is None:
+            timeout_s = float(os.environ.get(DCN_TIMEOUT_ENV, "")
+                              or _DEFAULT_TIMEOUT_S)
+        self.timeout_s = float(timeout_s)
+        self._ns = f"deardcn/{namespace}"
+        self.epoch = 0
+        self.injector = injector
+        self.exchanges = 0           # the fault clock (1-based per call)
+        self._published: List[Tuple[int, List[str]]] = []  # (step, keys)
+        self._stale_epochs: List[int] = []
+        self._samples: List[Tuple[float, float]] = []
+        self._sample_cap = int(sample_cap)
+
+    # -- membership ---------------------------------------------------------
+
+    @property
+    def num_slices(self) -> int:
+        return len(self.slices)
+
+    def set_slices(self, slices: Sequence[int],
+                   *, epoch: Optional[int] = None) -> None:
+        """Renormalize the cross-slice reduction to a NEW live slice set
+        (elastic slice loss / rejoin). Key namespaces are epoch-scoped, so
+        pre-transition partials can never be averaged into post-transition
+        steps; the superseded epoch's subtree is GC'd DEFERRED (after the
+        first completed exchange at the new epoch — a slow peer may still
+        be reading it mid-transition, the `membership._commit` lesson)."""
+        new = tuple(sorted(int(s) for s in slices))
+        live_local = tuple(s for s in self.local_slices if s in new)
+        if not live_local:
+            raise ValueError(
+                f"renormalizing to {new} would drop every local slice "
+                f"{self.local_slices} — an evicted slice exits for "
+                "relaunch instead of exchanging")
+        old_epoch = self.epoch
+        changed = new != self.slices
+        if epoch is not None and int(epoch) != self.epoch:
+            self.epoch = int(epoch)
+            self._stale_epochs.append(old_epoch)
+            self._published = []
+        self.slices = new
+        if changed:
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                tr.count("dcn.renorms")
+                tr.event("dcn.renorm", slices=",".join(map(str, new)),
+                         epoch=self.epoch)
+
+    # -- the exchange -------------------------------------------------------
+
+    def _key(self, step: int, bucket: int, chunk: int, sid: int) -> str:
+        return (f"{self._ns}/e{self.epoch}/s{step}/b{bucket}/c{chunk}/"
+                f"{sid}")
+
+    def _gc(self, step: int) -> None:
+        """Prune this host's own keys two steps back (every peer that
+        reached step ``step`` has fetched step ``step-2``: fetching step
+        s-1 required every slice's s-1 publish, which follows its s-2
+        fetch), plus any superseded epoch subtrees."""
+        keep = {step, step - 1}
+        still = []
+        for s, keys in self._published:
+            if s in keep:
+                still.append((s, keys))
+                continue
+            for k in keys:
+                self._transport.delete(k)
+        self._published = still
+        if self._stale_epochs:
+            prune = getattr(self._transport, "prune_prefix", None)
+            if prune is not None:
+                for e in self._stale_epochs:
+                    prune(f"{self._ns}/e{e}")
+            self._stale_epochs = []
+
+    def exchange(
+        self,
+        step: int,
+        per_slice_bufs: Dict[int, List[np.ndarray]],
+        scalars: Optional[Dict[int, float]] = None,
+        *,
+        partition_mb: Optional[float] = None,
+    ) -> Tuple[List[np.ndarray], Optional[float]]:
+        """One cross-slice averaging round for training step ``step``.
+
+        ``per_slice_bufs[sid]`` is the list of per-bucket partials this
+        host computed for its local slice ``sid`` (each a flat array of
+        the bucket's padded size — the intra-slice reduce-scatter mean,
+        gathered back over ICI by the caller); ``scalars[sid]`` an
+        optional per-slice scalar (the slice-local loss) averaged along
+        the same path. Returns ``(means, scalar_mean)`` where ``means``
+        is the per-bucket mean over every LIVE slice, in float32.
+
+        Replay-safe: rollbacks re-publish byte-identical values under the
+        same keys (atomic replace), and membership transitions move the
+        epoch scope, so a replayed step can never consume a stale world's
+        partial.
+        """
+        self.exchanges += 1
+        n = self.exchanges
+        part = self.partition_mb if partition_mb is None else partition_mb
+        drop = False
+        if self.injector is not None:
+            drop = self.injector.dcn_drop_due(n)
+            slow = self.injector.dcn_slow_s_for(n)
+            if slow > 0.0:
+                time.sleep(slow)
+        live_local = [s for s in self.local_slices if s in self.slices]
+        remote = [s for s in self.slices if s not in self.local_slices]
+        tr = _telemetry.get_tracer()
+
+        # 1. publish every local slice's chunks (atomic per chunk)
+        published: List[str] = []
+        bytes_out = 0
+        nbuf = len(per_slice_bufs[live_local[0]])
+        bounds = [
+            F.chunk_bounds(
+                int(per_slice_bufs[live_local[0]][g].size),
+                per_slice_bufs[live_local[0]][g].dtype.itemsize, part)
+            for g in range(nbuf)
+        ]
+        if not drop:
+            for sid in live_local:
+                bufs = per_slice_bufs[sid]
+                for g, buf in enumerate(bufs):
+                    flat = np.asarray(buf).reshape(-1)
+                    for j, (lo, hi) in enumerate(bounds[g]):
+                        key = self._key(step, g, j, sid)
+                        self._transport.set(key, _encode(flat[lo:hi]))
+                        published.append(key)
+                        bytes_out += (hi - lo) * flat.dtype.itemsize
+                if scalars is not None:
+                    key = self._key(step, -1, 0, sid)
+                    self._transport.set(
+                        key, json.dumps({"scalar": float(scalars[sid])}))
+                    published.append(key)
+            self._published.append((step, published))
+
+        # 2. fetch remote chunks with a one-ahead prefetch: the next get
+        # is in flight on a worker thread while this one is decoded and
+        # staged (and the whole phase overlaps the peers' publishes).
+        # Contributions are STAGED per slice and summed afterwards in
+        # sorted-slice order: float addition is not associative, and
+        # ranks on different slices see different local/remote splits —
+        # accumulate-as-fetched would give each rank a bitwise-different
+        # mean and trip the guard's desync sentinel on a healthy fleet.
+        contrib: Dict[int, List[np.ndarray]] = {
+            sid: [np.asarray(per_slice_bufs[sid][g],
+                             np.float32).reshape(-1)
+                  for g in range(nbuf)]
+            for sid in live_local
+        }
+        scalar_contrib: Dict[int, float] = (
+            {sid: float(scalars[sid]) for sid in live_local}
+            if scalars is not None else {})
+        for sid in remote:
+            contrib[sid] = [
+                np.zeros((int(per_slice_bufs[live_local[0]][g].size),),
+                         np.float32)
+                for g in range(nbuf)
+            ]
+        fetch_list: List[Tuple[int, int, int]] = [
+            (sid, g, j)
+            for sid in remote
+            for g in range(nbuf)
+            for j in range(len(bounds[g]))
+        ]
+        if scalars is not None:
+            fetch_list += [(sid, -1, 0) for sid in remote]
+
+        def _get(sid: int, g: int, j: int) -> Tuple[str, float]:
+            t0 = time.monotonic()
+            val = self._transport.get(self._key(step, g, j, sid),
+                                      self.timeout_s)
+            return val, time.monotonic() - t0
+
+        bytes_in = 0
+        pending: Optional[threading.Thread] = None
+        slot: List = [None, None]  # (value | exception, (sid, g, j))
+
+        def _spawn(item):
+            def work():
+                try:
+                    slot[0] = _get(*item)
+                except BaseException as exc:  # re-raised on the caller
+                    slot[0] = exc
+                slot[1] = item
+            t = threading.Thread(target=work, daemon=True,
+                                 name="dear-dcn-prefetch")
+            t.start()
+            return t
+
+        try:
+            for i, item in enumerate(fetch_list):
+                if pending is None:
+                    pending = _spawn(item)
+                pending.join()
+                got, at = slot[0], slot[1]
+                pending = (_spawn(fetch_list[i + 1])
+                           if i + 1 < len(fetch_list) else None)
+                if isinstance(got, BaseException):
+                    self._raise_fetch(got, at, tr)
+                val, secs = got
+                sid, g, j = at
+                if g < 0:
+                    scalar_contrib[sid] = float(json.loads(val)["scalar"])
+                    bytes_in += len(val)
+                else:
+                    lo, hi = bounds[g][j]
+                    decoded = _decode(val)
+                    contrib[sid][g][lo:hi] = decoded.astype(np.float32)
+                    # samples and byte counters record the RAW payload
+                    # size: the α-β fit's β must be seconds-per-payload-
+                    # byte, the unit `plan_comm_accounting` prices 'dcn'
+                    # rows in — recording the base64-framed text length
+                    # would skew β by the ~4/3 framing overhead (an
+                    # emulation-substrate cost, not a link property)
+                    if len(self._samples) < self._sample_cap:
+                        self._samples.append((float(decoded.nbytes), secs))
+                    bytes_in += int(decoded.nbytes)
+        finally:
+            # a failed round must not leave a prefetch thread publishing
+            # into the slot after we re-raise (daemon thread: best-effort)
+            pending = None
+
+        world = float(len(self.slices))
+        order = sorted(contrib)     # identical on every rank
+        means = [
+            sum(contrib[sid][g] for sid in order) / world
+            for g in range(nbuf)
+        ]
+        scalar_mean = (
+            sum(scalar_contrib[sid] for sid in order) / world
+            if scalars is not None else None)
+        if tr.enabled:
+            tr.count("dcn.exchanges")
+            tr.count("dcn.bytes", bytes_out + bytes_in)
+            tr.count("dcn.chunks", sum(len(b) for b in bounds))
+        self._gc(step)
+        return means, scalar_mean
+
+    def _raise_fetch(self, exc: BaseException, at, tr) -> None:
+        from dear_pytorch_tpu.resilience.cluster import PeerTimeout
+
+        sid, g, j = at
+        if isinstance(exc, PeerTimeout):
+            if tr.enabled:
+                tr.count("dcn.peer_timeouts")
+                tr.event("dcn.peer_timeout", slice=sid, bucket=g,
+                         chunk=j, epoch=self.epoch)
+            raise DcnPeerTimeout(
+                f"slice {sid} never published bucket {g} chunk {j} "
+                f"(epoch {self.epoch}) within {self.timeout_s:.1f}s — "
+                "dead slice, partition, or dropped publish") from exc
+        raise exc
+
+    # -- link fit -----------------------------------------------------------
+
+    def samples(self) -> List[Tuple[float, float]]:
+        """Per-remote-chunk ``(bytes, seconds)`` fetch timings — the raw
+        material for the DCN-level α-β fit (`overlap.fit_dcn`). Noisy by
+        construction (the first fetch of a step also pays peer skew);
+        the least-squares fit absorbs that as α."""
+        return list(self._samples)

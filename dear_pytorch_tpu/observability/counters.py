@@ -64,12 +64,18 @@ class BucketCommRow:
     """One bucket's per-step communication, one row per collective leg."""
 
     bucket: int
-    leg: str                 # 'reduce_scatter' | 'all_gather' | ...
+    leg: str                 # 'reduce_scatter' | 'all_gather' | 'dcn' | ...
     tensors: int             # parameters fused into this bucket
     elements: int            # unpadded element count
     padded_elements: int
     payload_bytes: int       # padded_size × itemsize of the comm dtype
     wire_bytes: float        # ring estimate of per-device interconnect bytes
+    #: number of point-to-point transfers this leg issues per step —
+    #: 1 for in-program collectives (their per-round α is modeled from
+    #: ``world`` in `overlap.predict_leg_times`); for the host-level
+    #: 'dcn' leg it is ``ceil(payload/partition) × (num_slices-1)``,
+    #: the per-message α count of the chunked cross-slice exchange
+    messages: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,6 +146,8 @@ def plan_comm_accounting(
     gather_itemsize: Optional[int] = None,
     compressor: Optional[str] = None,
     density: float = 1.0,
+    num_slices: int = 1,
+    dcn_partition_mb: Optional[float] = None,
 ) -> CommAccounting:
     """Static communication accounting for ``plan`` under ``mode``.
 
@@ -154,6 +162,18 @@ def plan_comm_accounting(
     instead of moving 1/world ring chunks. At ``world=1`` every wire
     estimate is 0 — the collectives are local copies, which is also what
     the compiled program contains.
+
+    ``num_slices > 1`` accounts the HIERARCHICAL (multi-slice) dear
+    schedule: the in-program legs above run over the intra-slice axis
+    (``plan.world`` is the ICI world), and every bucket additionally
+    crosses the slice boundary once per step on the host-level DCN leg —
+    each slice publishes its reduced partial (``payload`` bytes out) and
+    fetches the other ``num_slices-1`` partials, in
+    ``dcn_partition_mb``-sized chunks (`ops.fusion.chunk_bounds` — the
+    per-level bucket partition). The row's ``wire_bytes`` is the
+    per-slice total moved (out + in) and ``messages`` the per-message α
+    count, which `overlap.predict_leg_times` prices with the DCN-level
+    α-β fit when one is given (link-aware, FlexLink-style).
     """
     if mode not in MODE_LEGS:
         raise ValueError(f"mode must be one of {sorted(MODE_LEGS)}, "
@@ -193,6 +213,26 @@ def plan_comm_accounting(
                 padded_elements=b.padded_size,
                 payload_bytes=payload,
                 wire_bytes=wire,
+            ))
+        if num_slices > 1:
+            # the cross-slice gradient exchange travels in the BUFFER
+            # dtype (the host leg averages reduced f32 partials; see
+            # comm/dcn.py) — price it at the leaf itemsize, not the
+            # intra-slice comm_dtype
+            dcn_itemsize = (np.dtype(plan.leaves[0].dtype).itemsize
+                            if plan.leaves else 4)
+            payload = b.padded_size * dcn_itemsize
+            chunks = len(F.chunk_bounds(
+                b.padded_size, dcn_itemsize, dcn_partition_mb))
+            rows.append(BucketCommRow(
+                bucket=b.index,
+                leg="dcn",
+                tensors=len(b.leaf_ids),
+                elements=b.size,
+                padded_elements=b.padded_size,
+                payload_bytes=payload,
+                wire_bytes=float(payload * num_slices),  # 1 out + (S-1) in
+                messages=chunks * (num_slices - 1),
             ))
     return CommAccounting(mode=mode, world=plan.world,
                           num_buckets=plan.num_buckets, rows=tuple(rows))

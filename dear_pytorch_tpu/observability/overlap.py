@@ -90,17 +90,32 @@ class OverlapReport:
 
 
 def predict_leg_times(
-    acct: CTR.CommAccounting, alpha: float, beta: float
+    acct: CTR.CommAccounting, alpha: float, beta: float,
+    *, dcn_alpha: Optional[float] = None, dcn_beta: Optional[float] = None,
 ) -> list[float]:
     """Predicted unoverlapped seconds for each accounting row, consistent
     with `perf_model.allgather_perf_model`: ring legs cost
     ``(world-1)·α + β·wire_bytes`` (RS and AG each run world-1 rounds of
     1/world of the payload; all-reduce's wire bytes already carry the 2×),
-    root legs (reduce/broadcast) cost ``α + β·payload``."""
+    root legs (reduce/broadcast) cost ``α + β·payload``.
+
+    The hierarchical schedule's 'dcn' rows (cross-slice host exchange,
+    ``num_slices > 1`` accounting) are priced LINK-AWARE with their own
+    (``dcn_alpha``, ``dcn_beta``) fit — the FlexLink point: ICI and DCN
+    are different links with α-β gaps of orders of magnitude, so one fit
+    cannot cost both levels. They cost ``messages·α_dcn + β_dcn·wire``
+    (``messages`` already counts chunks × peer slices). When no DCN fit
+    is given those rows fall back to the intra-slice fit — stated
+    behavior for callers without a measured DCN profile, not an
+    endorsement."""
     w = acct.world
+    a_d = alpha if dcn_alpha is None else float(dcn_alpha)
+    b_d = beta if dcn_beta is None else float(dcn_beta)
     times = []
     for row in acct.rows:
-        if w <= 1:
+        if row.leg == "dcn":
+            times.append(row.messages * a_d + b_d * row.wire_bytes)
+        elif w <= 1:
             times.append(0.0)
         elif row.leg in ("reduce_scatter", "all_gather"):
             times.append((w - 1) * alpha + beta * row.wire_bytes)
@@ -313,3 +328,23 @@ def fit_interconnect(mesh, *, sizes: Optional[Sequence[int]] = None,
     per_round = [t / max(w - 1, 1) for t in times]
     round_bytes = [s / w for s in sizes_bytes]
     return perf_model.fit_alpha_beta(round_bytes, per_round)
+
+
+def fit_dcn(samples: Sequence[tuple[float, float]],
+            *, min_samples: int = 4) -> tuple[float, float]:
+    """(α, β) for the cross-slice DCN level from the exchanger's own
+    per-fetch timing samples (`comm.dcn.DcnExchanger.samples` —
+    ``(bytes, seconds)`` per remote chunk fetch). The per-level half of
+    the link-aware fit: `fit_interconnect` measures the intra-slice ICI
+    level with a live collective sweep, this one reuses the transfer
+    timings the training run already paid for. Raises ``ValueError``
+    below ``min_samples`` — a one-point fit would hand the cost model a
+    degenerate β and silently mis-prune."""
+    pts = [(float(b), float(t)) for b, t in samples
+           if t > 0 and b >= 0]
+    if len(pts) < int(min_samples):
+        raise ValueError(
+            f"DCN fit needs >= {min_samples} (bytes, secs) samples, got "
+            f"{len(pts)} — run more exchanges or set DEAR_TUNE_FIT_DCN "
+            "to an explicit 'alpha,beta'")
+    return perf_model.fit_alpha_beta(*zip(*pts))

@@ -288,6 +288,27 @@ def plan_by_groups(
     return _build_plan(specs, groups, world, treedef)
 
 
+def chunk_bounds(
+    n_elements: int, itemsize: int, partition_mb: Optional[float]
+) -> list[tuple[int, int]]:
+    """Element ranges ``[(start, stop), ...]`` splitting a flat buffer of
+    ``n_elements`` into chunks of at most ``partition_mb`` megabytes (at
+    ``itemsize`` bytes per element). The ONE bucket-partition rule shared
+    by every per-level splitter — the 'bytescheduler' chunked reductions
+    (`parallel/dear.py`), the cross-slice DCN exchange
+    (`comm.dcn.DcnExchanger`), and the static accounting that prices both
+    (`observability.counters.plan_comm_accounting`) — so chunk counts can
+    never drift between the schedule, the transport, and the cost model.
+    ``partition_mb=None`` (or <= 0) means one chunk."""
+    if n_elements <= 0:
+        return []
+    if partition_mb is None or partition_mb <= 0:
+        return [(0, int(n_elements))]
+    per = max(int(float(partition_mb) * 2**20) // int(itemsize), 1)
+    return [(i, min(i + per, int(n_elements)))
+            for i in range(0, int(n_elements), per)]
+
+
 def make_plan(
     params,
     world: int,

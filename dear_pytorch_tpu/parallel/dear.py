@@ -96,6 +96,7 @@ from typing import Any, Callable, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from dear_pytorch_tpu.comm import backend
@@ -161,6 +162,10 @@ class TrainStep(NamedTuple):
     #: the cross-iteration AG-under-forward pipelining DeAR promises
     #: materializes inside a single program instead of across dispatches.
     multi_step: Callable[[int], Callable] = None
+    #: the `comm.dcn.DcnExchanger` of a hierarchical (multi-slice) step —
+    #: None on single-level schedules. Elastic transitions renormalize the
+    #: cross-slice leg through it (``dcn.set_slices``).
+    dcn: Any = None
 
 
 def _opt_bucket_specs(axis_name: str, bucket_padded: int, opt_state_leaf):
@@ -211,6 +216,8 @@ def build_train_step(
     gather_dtype=None,
     clip_norm: Optional[float] = None,
     remat: Optional[str] = None,
+    dcn=None,
+    dcn_slice_axis: str = "slice",
 ) -> TrainStep:
     """Build the jitted DeAR (or baseline) data-parallel train step.
 
@@ -280,9 +287,15 @@ def build_train_step(
         default "shard every leaf's dim 0 over axis_name" input layout —
         required for dp×sp, where the batch dim shards over 'dp' and the
         sequence dim over 'sp'.
-      partition_mb: 'bytescheduler' mode's chunk size (MB of the comm
-        dtype; the reference's ``--partition`` /
-        ``BYTESCHEDULER_PARTITION``). Ignored by other modes.
+      partition_mb: the per-level bucket partition. In 'bytescheduler'
+        mode, the chunk size of the in-program partitioned reductions
+        (MB of the comm dtype; the reference's ``--partition`` /
+        ``BYTESCHEDULER_PARTITION``). On the hierarchical schedule
+        (``dcn=``), the CROSS-SLICE message size: each bucket's reduced
+        partial crosses the DCN in chunks of this many MB
+        (`ops.fusion.chunk_bounds`), independent of the intra-slice
+        bucket threshold — a `tuning.planspace.PlanSpace` searched axis.
+        Ignored by other modes.
       accum_steps: gradient accumulation. The per-device batch splits into
         ``accum_steps`` microbatches along every leaf's leading axis
         (scanned sequentially), gradients average across microbatches, and
@@ -317,6 +330,30 @@ def build_train_step(
       donate: donate the state argument so buffers are updated in place.
       opt_spec_fn: optional ``(bucket_index, state_leaf) -> PartitionSpec``
         override for optimizer-state sharding (see `_opt_bucket_specs`).
+      dcn: a `comm.dcn.DcnExchanger` — turns ``mode='dear'`` into the
+        HIERARCHICAL two-level schedule on a nested mesh: the per-bucket
+        reduce-scatter / all-gather run over the intra-slice ``axis_name``
+        (ICI) inside the jitted programs, and the cross-slice averaging of
+        the reduced partials runs between them on the host, over the
+        exchanger's DCN transport (chunked at ``partition_mb``, the
+        per-level bucket partition). The step becomes two compiled
+        programs — backward (grads per slice) and update — with the DCN
+        leg in between; neither program depends on the slice count, so an
+        elastic slice loss/rejoin renormalizes via
+        ``dcn.set_slices(...)`` with NO recompilation. The mesh must
+        carry a ``dcn_slice_axis`` axis of size ``len(dcn.local_slices)``
+        (1 on a one-slice-per-process fleet; >1 when one process emulates
+        several slices); the ZeRO shard degree is the INTRA-slice world.
+        Rejected combinations (loudly, at build): every mode but 'dear'
+        ('dear-fused' rings would span the DCN boundary — their
+        remote-copy device ids are single-mesh axis indices), gradient
+        compression, ``clip_norm`` (a global norm needs a cross-slice
+        reduction inside the step), ``model_state_template`` (BN stats
+        would sync intra-slice only and silently diverge across slices),
+        ``has_aux``, ``exclude_parts``, and ``mean_axes != axis_name``.
+        ``multi_step`` is unavailable (the host leg cannot ride a scan).
+      dcn_slice_axis: mesh axis name enumerating this host's LOCAL slices
+        (only with ``dcn``).
     """
     if mode not in MODES:
         raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
@@ -354,6 +391,18 @@ def build_train_step(
     sharded = mode in ("dear", "dear-fused", "fsdp")
     fused = mode == "dear-fused"
     excl = frozenset(exclude_parts)
+    if dcn is not None and fused:
+        # checked BEFORE the generic dear-fused mesh guards: the caller
+        # asked for a ring spanning the DCN boundary, and that — not the
+        # nested mesh shape it implies — is the actionable error
+        raise ValueError(
+            "multislice (dcn=) cannot ride mode='dear-fused': the "
+            "Pallas ring kernels address devices by single-mesh axis "
+            "index and a ring spanning the DCN boundary would issue "
+            "remote copies to devices outside this slice's ICI mesh "
+            "— use mode='dear' (hierarchical RS+AG over ICI + host "
+            "DCN exchange)"
+        )
     if fused:
         if len(axes) != 1:
             raise ValueError(
@@ -450,10 +499,73 @@ def build_train_step(
             "compressor (reference wfbp/dopt.py:769: mc applies on the "
             "sparse path only)"
         )
+    if dcn is not None:
+        # the remaining multi-slice guards, PR-8 style: reject loudly at
+        # plan-build rather than silently degrading to a single-level
+        # schedule (dear-fused was rejected above, pre-mesh-shape checks)
+        if mode != "dear":
+            raise ValueError(
+                "the hierarchical (dcn=) schedule is the two-level "
+                f"decoupled 'dear' mode; got mode={mode!r}"
+            )
+        if compressed:
+            raise ValueError(
+                "gradient compression on the hierarchical schedule is "
+                "unsupported: the cross-slice leg averages DENSE reduced "
+                "partials on the host — compress-on-DCN is a named "
+                "follow-up, not a silent fallback"
+            )
+        if clip_norm is not None:
+            raise ValueError(
+                "clip_norm needs the GLOBAL gradient norm, which crosses "
+                "the slice boundary inside the step — unsupported with "
+                "dcn= (the host leg averages per-bucket partials only)"
+            )
+        if has_model_state:
+            raise ValueError(
+                "model_state (BatchNorm stats etc.) syncs over the "
+                "intra-slice axes only and would silently diverge across "
+                "slices — unsupported with dcn="
+            )
+        if has_aux:
+            raise ValueError(
+                "has_aux is unsupported with dcn=: only the loss travels "
+                "the cross-slice scalar path"
+            )
+        if exclude_parts:
+            raise ValueError(
+                "exclude_parts ablations assume the single-level "
+                "schedule; unsupported with dcn="
+            )
+        if mean_axes != axes:
+            raise ValueError(
+                "mean_axes != axis_name is unsupported with dcn=: the "
+                "intra-slice legs average over every local axis and the "
+                "host leg averages over slices"
+            )
+        if dcn_slice_axis in axes:
+            raise ValueError(
+                f"dcn_slice_axis {dcn_slice_axis!r} must not be a "
+                "reduction axis: the cross-slice exchange owns it"
+            )
+        n_local = len(dcn.local_slices)
+        if (dcn_slice_axis not in mesh.shape
+                or mesh.shape[dcn_slice_axis] != n_local):
+            raise ValueError(
+                f"the nested mesh needs axis {dcn_slice_axis!r} of size "
+                f"{n_local} (one row per LOCAL slice "
+                f"{dcn.local_slices}); mesh has {dict(mesh.shape)}"
+            )
 
     # ---- per-device step body (runs inside shard_map) ----------------------
+    # Split into two halves so the single-program schedules compose them
+    # into one jitted step (`device_step`, graph unchanged) while the
+    # hierarchical schedule jits them as SEPARATE programs with the
+    # host-level cross-slice exchange in between: `_fwd_bwd` ends at the
+    # intra-slice-reduced bucket gradients, `_apply` starts at the
+    # optimizer update.
 
-    def device_step(state: DearState, batch):
+    def _fwd_bwd(state: DearState, batch):
         idx = lax.axis_index(axis_name)
 
         def cast_shard(s):
@@ -492,9 +604,18 @@ def build_train_step(
         else:
             params = F.unpack_all(list(state.buffers), plan)
         if rng_seed is not None:
+            if dcn is not None:
+                # fold a GLOBALLY unique device index: devices at the
+                # same ICI position on different slices must not share
+                # dropout streams
+                rng_idx = (
+                    jnp.asarray(dcn.local_slices, jnp.int32)[
+                        lax.axis_index(dcn_slice_axis)] * world + idx)
+            else:
+                rng_idx = idx
             step_rng = jax.random.fold_in(
                 jax.random.fold_in(jax.random.PRNGKey(rng_seed), state.step),
-                idx,
+                rng_idx,
             )
             extra_args: tuple = (step_rng,)
         else:
@@ -740,10 +861,10 @@ def build_train_step(
                 # into one op (the compiler has its own bucketer), which
                 # would silently undo the partitioning — RS/AG pairs are not
                 # combined, so the per-chunk schedule survives compilation.
-                part = max(int(partition_mb * 2**20) // gbuf.dtype.itemsize, 1)
                 pieces = [
-                    C.all_reduce_rsag(gbuf[i:i + part], axis_name)
-                    for i in range(0, b.padded_size, part)
+                    C.all_reduce_rsag(gbuf[lo:hi], axis_name)
+                    for lo, hi in F.chunk_bounds(
+                        b.padded_size, gbuf.dtype.itemsize, partition_mb)
                 ]
                 grad = jnp.concatenate(pieces).astype(
                     state.buffers[g].dtype
@@ -759,7 +880,11 @@ def build_train_step(
                 ) / mean_world
             bucket_grads.append(grad)
 
-        metrics = {"loss": lax.pmean(loss, axis_name)}
+        return (bucket_grads, loss, aux, new_model_state,
+                tuple(new_comp) if compressed else state.comp_state)
+
+    def _apply(state: DearState, bucket_grads, metrics, new_model_state,
+               new_comp):
         if clip_norm is not None:
             sumsq = sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -806,6 +931,7 @@ def build_train_step(
                 b = plan.buckets[g]
                 starts = jnp.asarray(b.offsets, jnp.int32)
                 if sharded:
+                    idx = lax.axis_index(axis_name)
                     pos = idx * b.shard_size + jnp.arange(
                         b.shard_size, dtype=jnp.int32
                     )
@@ -828,14 +954,20 @@ def build_train_step(
                 )
             new_buffers.append(new_p)
             new_opt.append(new_o)
-        if aux is not None:
-            metrics["aux"] = lax.pmean(aux, axis_name)
         next_state = DearState(
             tuple(new_buffers), tuple(new_opt), state.step + 1,
-            new_model_state,
-            tuple(new_comp) if compressed else state.comp_state,
+            new_model_state, new_comp,
         )
         return next_state, metrics
+
+    def device_step(state: DearState, batch):
+        bucket_grads, loss, aux, new_model_state, new_comp = _fwd_bwd(
+            state, batch)
+        metrics = {"loss": lax.pmean(loss, axis_name)}
+        if aux is not None:
+            metrics["aux"] = lax.pmean(aux, axis_name)
+        return _apply(state, bucket_grads, metrics, new_model_state,
+                      new_comp)
 
     # ---- shard_map wiring --------------------------------------------------
 
@@ -873,6 +1005,12 @@ def build_train_step(
     def _batch_specs(batch):
         if batch_spec_fn is not None:
             return batch_spec_fn(batch)
+        if dcn is not None:
+            # nested mesh: the global batch shards over local slices AND
+            # the intra-slice axis jointly (each slice sees its data
+            # shard; each ICI device its sub-shard)
+            return jax.tree.map(
+                lambda _: jax.P((dcn_slice_axis,) + axes), batch)
         return jax.tree.map(lambda _: jax.P(axis_name), batch)
 
     def init(params, model_state=None) -> DearState:
@@ -944,6 +1082,11 @@ def build_train_step(
                          if gather_dtype is not None else None),
         compressor=comp.name if compressed else None,
         density=density,
+        # hierarchical: account the cross-slice host leg at the BUILD
+        # slice count (elastic renorms change the live set at runtime;
+        # the static accounting states the full-membership schedule)
+        num_slices=(dcn.num_slices if dcn is not None else 1),
+        dcn_partition_mb=(partition_mb if dcn is not None else None),
     )
     _leg_bytes = {
         leg: _acct.leg_bytes_per_step(leg)
@@ -990,9 +1133,98 @@ def build_train_step(
             _compiled[key] = fn
         return fn
 
+    # ---- hierarchical (multi-slice) two-program step -----------------------
+    # Backward program -> host DCN exchange -> update program. The jitted
+    # halves never see the slice count, so elastic slice transitions
+    # renormalize via `dcn.set_slices` with no recompile.
+
+    _compiled_hg: dict = {}
+    _compiled_ha: dict = {}
+
+    def _hier_device_grads(state: DearState, batch):
+        bucket_grads, loss, _aux, _nms, _ncomp = _fwd_bwd(state, batch)
+        # aux / model state / compressor state are inert here — the dcn
+        # build guards rejected every combination that would produce them
+        return (tuple(bucket_grads),
+                lax.pmean(loss, axis_name).reshape(1))
+
+    def _hier_grads_jitted(state: DearState, batch):
+        key = jax.tree.structure((state, batch))
+        fn = _compiled_hg.get(key)
+        if fn is None:
+            state_specs = _state_specs(state)
+            mapped = jax.shard_map(
+                _hier_device_grads,
+                mesh=mesh,
+                in_specs=(state_specs, _batch_specs(batch)),
+                out_specs=(
+                    tuple(jax.P((dcn_slice_axis,) + axes)
+                          for _ in plan.buckets),
+                    jax.P(dcn_slice_axis),
+                ),
+                check_vma=False,
+            )
+            fn = jax.jit(mapped)
+            _compiled_hg[key] = fn
+        return fn
+
+    def _hier_device_apply(state: DearState, reduced, loss_g):
+        grads = [r.astype(state.buffers[g].dtype)
+                 for g, r in enumerate(reduced)]
+        metrics = {"loss": loss_g}
+        return _apply(state, grads, metrics, state.model_state,
+                      state.comp_state)
+
+    def _hier_apply_jitted(state: DearState, reduced, loss_g):
+        key = jax.tree.structure((state, reduced))
+        fn = _compiled_ha.get(key)
+        if fn is None:
+            state_specs = _state_specs(state)
+            mapped = jax.shard_map(
+                _hier_device_apply,
+                mesh=mesh,
+                in_specs=(
+                    state_specs,
+                    tuple(jax.P(axis_name) for _ in plan.buckets),
+                    jax.P(),
+                ),
+                out_specs=(state_specs, jax.P()),
+                check_vma=False,
+            )
+            fn = jax.jit(mapped, donate_argnums=(0,) if donate else ())
+            _compiled_ha[key] = fn
+        return fn
+
+    def _hier_step(state: DearState, batch):
+        padded = [b.padded_size for b in plan.buckets]
+        grads_g, loss_sl = _hier_grads_jitted(state, batch)(state, batch)
+        # the host leg is the synchronization point of this schedule: the
+        # step number keys the exchange and the partials are its payload,
+        # so these transfers are the leg itself, not a stray sync
+        step_no = int(np.asarray(jax.device_get(state.step)))
+        host = [np.asarray(jax.device_get(g)) for g in grads_g]
+        losses = np.asarray(jax.device_get(loss_sl),
+                            np.float64).reshape(-1)
+        per_slice = {
+            sid: [host[g][k * padded[g]:(k + 1) * padded[g]]
+                  for g in range(len(padded))]
+            for k, sid in enumerate(dcn.local_slices)
+        }
+        scalars = {sid: float(losses[k])
+                   for k, sid in enumerate(dcn.local_slices)}
+        means, loss_mean = dcn.exchange(step_no, per_slice, scalars,
+                                        partition_mb=partition_mb)
+        sh = jax.sharding.NamedSharding(mesh, jax.P(axis_name))
+        reduced = tuple(jax.device_put(m, sh) for m in means)
+        loss_dev = jnp.float32(loss_mean)
+        return _hier_apply_jitted(state, reduced, loss_dev)(
+            state, reduced, loss_dev)
+
     def step(state: DearState, batch):
         tr = _telemetry.get_tracer()
         if not tr.enabled:
+            if dcn is not None:
+                return _hier_step(state, batch)
             return _jitted(state, batch)(state, batch)
         tr.count("dear.steps")
         for leg, nbytes in _leg_bytes.items():
@@ -1004,9 +1236,16 @@ def build_train_step(
             tr.count("kernel.fused_rs_launches", plan.num_buckets)
             tr.count("kernel.ring_ag_launches", plan.num_buckets)
         with tr.span("dear.step", mode=mode):
+            if dcn is not None:
+                return _hier_step(state, batch)
             return _jitted(state, batch)(state, batch)
 
     def lower(state: DearState, batch):
+        if dcn is not None:
+            # the backward program is the schedule's compute body (the
+            # update program is a per-bucket elementwise epilogue); MFU
+            # accounting and HLO audits read this one
+            return _hier_grads_jitted(state, batch).lower(state, batch)
         return _jitted(state, batch).lower(state, batch)
 
     _multi_compiled: dict = {}
@@ -1018,6 +1257,11 @@ def build_train_step(
         overlap to the scheduler. The jitted fn is cached per ``n`` so a
         training loop calling ``ts.multi_step(8)(state, batch)`` repeatedly
         does not retrace."""
+        if dcn is not None:
+            raise ValueError(
+                "multi_step is unavailable on the hierarchical (dcn=) "
+                "schedule: the cross-slice exchange is a host-level leg "
+                "and cannot ride inside a compiled lax.scan")
         cached = _multi_compiled.get(n)
         if cached is not None:
             return cached
@@ -1049,4 +1293,4 @@ def build_train_step(
 
     return TrainStep(init=init, step=step, gather_params=gather_params,
                      plan=plan, mesh=mesh, lower=lower,
-                     multi_step=multi_step)
+                     multi_step=multi_step, dcn=dcn)

@@ -41,6 +41,18 @@ Fault kinds (all fire exactly once per scheduled entry):
                     once producer retry), so the reader's seq-based dedup
                     must absorb it (`online.feedback` drives
                     `duplicate_feedback` per append)
+  ``dcn_slow``      cross-slice transport only: from the Nth DCN
+                    exchange ON, sleep ``arg`` seconds before every
+                    exchange (fires once; the latency persists) — a
+                    congested or degraded DCN link, i.e. a straggler
+                    SLICE (`comm.dcn.DcnExchanger` drives
+                    `dcn_slow_s_for` per exchange)
+  ``dcn_drop``      cross-slice transport only: the Nth DCN exchange
+                    suppresses its outbound publish once (a transient
+                    partition / lost message) — the peer slices' fetches
+                    time out, the guard rolls every slice back in
+                    lockstep, and the replay re-publishes
+                    (`dcn_drop_due` per exchange)
 
 Enable from the environment — ``DEAR_FAULTS="nan@6,exc@9,hang@12:0.5,
 ckpt_corrupt@15,preempt@18"`` — or construct a `FaultInjector` in code and
@@ -54,6 +66,14 @@ ranks *skip* the fault (recorded in ``FaultInjector.skipped``, never
 ``fired``). Arg and rank compose: ``hang@12:0.5:r1``. This is what makes
 the coordinated recovery paths (`resilience.cluster`) testable: one rank
 fails, every rank must recover identically.
+
+**Slice targeting** (multi-slice chaos): ``:sK`` fires the fault on
+every rank of slice ``K`` only — ``DEAR_FAULTS="dcn_slow@3:0.05:s0"``
+turns slice 0 into a straggler while the other slices' schedules drain
+the entry as ``skipped``. ``own_slice`` resolves from the elastic env
+contract (``DEAR_ELASTIC_RANK // DEAR_ELASTIC_RANKS_PER_SLICE``) unless
+passed explicitly; ``:rN`` and ``:sK`` are mutually exclusive in one
+spec (a rank already implies its slice).
 """
 
 from __future__ import annotations
@@ -74,7 +94,8 @@ logger = logging.getLogger("dear_pytorch_tpu")
 FAULT_ENV = "DEAR_FAULTS"
 
 KINDS = ("nan", "exc", "hang", "slow", "ckpt_corrupt", "preempt",
-         "corrupt_resp", "torn_seg", "dup_feedback")
+         "corrupt_resp", "torn_seg", "dup_feedback", "dcn_slow",
+         "dcn_drop")
 
 __all__ = [
     "FAULT_ENV", "KINDS", "Fault", "InjectedFault", "FaultInjector",
@@ -89,14 +110,17 @@ class InjectedFault(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class Fault:
     """One scheduled fault: ``kind`` fires at trainer step ``step``
-    (1-based, counting attempted steps); ``arg`` is kind-specific
-    (``hang`` seconds; unused otherwise); ``rank`` restricts the fault to
-    one process index (None = every rank)."""
+    (1-based, counting attempted steps; DCN kinds count exchanges);
+    ``arg`` is kind-specific (``hang``/``slow``/``dcn_slow`` seconds;
+    unused otherwise); ``rank`` restricts the fault to one process index
+    and ``slice_id`` to every rank of one slice (None = untargeted;
+    mutually exclusive)."""
 
     kind: str
     step: int
     arg: float = 0.0
     rank: Optional[int] = None
+    slice_id: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -109,10 +133,18 @@ class Fault:
         if self.rank is not None and self.rank < 0:
             raise ValueError(
                 f"fault rank must be a process index >= 0, got {self.rank}")
+        if self.slice_id is not None and self.slice_id < 0:
+            raise ValueError(
+                f"fault slice must be a slice id >= 0, got {self.slice_id}")
+        if self.rank is not None and self.slice_id is not None:
+            raise ValueError(
+                "a fault targets a rank OR a slice, not both "
+                "(a rank already implies its slice)")
 
 
-_SPEC_FORMAT = ("use kind@step[:arg][:rRANK], e.g. 'nan@6', 'hang@12:0.5' "
-                "or rank-targeted 'nan@6:r1,exc@9:r0'")
+_SPEC_FORMAT = ("use kind@step[:arg][:rRANK|:sSLICE], e.g. 'nan@6', "
+                "'hang@12:0.5', rank-targeted 'nan@6:r1,exc@9:r0', or "
+                "slice-targeted 'dcn_slow@3:0.05:s0'")
 
 
 def parse_faults(spec: str) -> Tuple[Fault, ...]:
@@ -134,7 +166,7 @@ def parse_faults(spec: str) -> Tuple[Fault, ...]:
             raise ValueError(
                 f"{FAULT_ENV}: bad fault spec {part!r}: {exc}"
             ) from None
-        arg, rank = 0.0, None
+        arg, rank, slice_id = 0.0, None, None
         for tok in toks:
             if tok[:1] in ("r", "R"):
                 if not tok[1:].isdigit():
@@ -149,14 +181,30 @@ def parse_faults(spec: str) -> Tuple[Fault, ...]:
                     )
                 rank = int(tok[1:])
                 continue
+            if tok[:1] in ("s", "S"):
+                if not tok[1:].isdigit():
+                    raise ValueError(
+                        f"{FAULT_ENV}: bad slice spec {tok!r} in "
+                        f"{part!r}: a slice is 's' + a slice id "
+                        f"({_SPEC_FORMAT})"
+                    )
+                if slice_id is not None:
+                    raise ValueError(
+                        f"{FAULT_ENV}: duplicate slice spec in {part!r} "
+                        f"({_SPEC_FORMAT})"
+                    )
+                slice_id = int(tok[1:])
+                continue
             try:
                 arg = float(tok)
             except ValueError:
                 raise ValueError(
                     f"{FAULT_ENV}: bad fault spec {part!r}: {tok!r} is "
-                    f"neither a float arg nor an rRANK ({_SPEC_FORMAT})"
+                    f"neither a float arg, an rRANK, nor an sSLICE "
+                    f"({_SPEC_FORMAT})"
                 ) from None
-        out.append(Fault(kind=kind, step=step, arg=arg, rank=rank))
+        out.append(Fault(kind=kind, step=step, arg=arg, rank=rank,
+                         slice_id=slice_id))
     return tuple(out)
 
 
@@ -229,16 +277,21 @@ class FaultInjector:
     """
 
     def __init__(self, faults: Sequence[Fault] = (), *,
-                 kill: bool = True, own_rank: Optional[int] = None):
+                 kill: bool = True, own_rank: Optional[int] = None,
+                 own_slice: Optional[int] = None):
         self._by_step: Dict[int, List[Fault]] = {}
         for f in faults:
             self._by_step.setdefault(int(f.step), []).append(f)
         self.fired: List[Fault] = []
-        self.skipped: List[Fault] = []  # rank-targeted, not this rank
+        self.skipped: List[Fault] = []  # rank/slice-targeted, not here
         #: persistent per-step latency armed by ``slow`` faults (additive
         #: when several fire); every later `before_step` sleeps this long
         self.slow_s: float = 0.0
+        #: persistent per-DCN-exchange latency armed by ``dcn_slow``
+        #: faults (the straggler-slice analog of ``slow_s``)
+        self.dcn_slow_s: float = 0.0
         self._own_rank = own_rank
+        self._own_slice = own_slice
         # kill=False turns ``preempt`` into a no-op marker (tests that
         # assert scheduling without installing a SIGTERM handler)
         self._kill = kill
@@ -250,6 +303,19 @@ class FaultInjector:
 
             self._own_rank = jax.process_index()
         return self._own_rank
+
+    @property
+    def own_slice(self) -> Optional[int]:
+        """This process's slice id (None outside slice-granular fleets):
+        explicit construction wins; otherwise the elastic env contract —
+        ``DEAR_ELASTIC_RANK // DEAR_ELASTIC_RANKS_PER_SLICE``."""
+        if self._own_slice is None:
+            rank = os.environ.get("DEAR_ELASTIC_RANK", "").strip()
+            rps = os.environ.get(
+                "DEAR_ELASTIC_RANKS_PER_SLICE", "").strip()
+            if rank and rps and int(rps) > 0:
+                self._own_slice = int(rank) // int(rps)
+        return self._own_slice
 
     @classmethod
     def from_env(cls, env: Optional[str] = None) -> Optional["FaultInjector"]:
@@ -290,21 +356,27 @@ class FaultInjector:
             self._by_step[int(step)] = remaining
         else:
             del self._by_step[int(step)]
-        # rank-targeted faults are consumed everywhere but fire only on
-        # their rank — every process's schedule drains at the same steps
+        # rank/slice-targeted faults are consumed everywhere but fire
+        # only on their target — every process's schedule drains at the
+        # same steps
         taken, skipped = [], []
         for f in matched:
-            if f.rank is None or f.rank == self.own_rank:
-                taken.append(f)
-            else:
+            if f.rank is not None and f.rank != self.own_rank:
                 skipped.append(f)
+            elif f.slice_id is not None and f.slice_id != self.own_slice:
+                skipped.append(f)
+            else:
+                taken.append(f)
         self.fired.extend(taken)
         self.skipped.extend(skipped)
         tr = _telemetry.get_tracer()
         for f in skipped:
-            logger.info("inject: %s at step %d targets rank %d "
-                        "(this is rank %d); skipped",
-                        f.kind, step, f.rank, self.own_rank)
+            logger.info("inject: %s at step %d targets %s "
+                        "(this is rank %d, slice %s); skipped",
+                        f.kind, step,
+                        (f"rank {f.rank}" if f.rank is not None
+                         else f"slice {f.slice_id}"),
+                        self.own_rank, self.own_slice)
         for f in taken:
             logger.warning("inject: firing %s at step %d", f.kind, step)
             if tr.enabled:
@@ -380,6 +452,26 @@ class FaultInjector:
         an at-least-once producer retry the reader's monotonic-seq dedup
         must absorb exactly-once (``online.dedup_hits``)."""
         return bool(self._take(append_no, ("dup_feedback",)))
+
+    def dcn_slow_s_for(self, exchange_no: int) -> float:
+        """Persistent cross-slice latency due at this DCN exchange (the
+        exchanger's exchange counter is the clock): a due ``dcn_slow``
+        fault ARMS ``dcn_slow_s`` once — a congested DCN link is a
+        condition, not a hiccup — and every later exchange on this
+        process sleeps that long before fetching. Slice-target it
+        (``dcn_slow@3:0.05:s0``) to make one slice the straggler."""
+        for f in self._take(exchange_no, ("dcn_slow",)):
+            self.dcn_slow_s += max(float(f.arg), 0.0)
+        return self.dcn_slow_s
+
+    def dcn_drop_due(self, exchange_no: int) -> bool:
+        """True when a due ``dcn_drop`` fault fires for this DCN
+        exchange — the exchanger then suppresses its outbound publish
+        once (a transient partition). What must survive is the FLEET:
+        peer fetches time out into `comm.dcn.DcnPeerTimeout`, the guard
+        rolls every slice back in lockstep, and the replayed exchange
+        publishes normally (the fault fired exactly once)."""
+        return bool(self._take(exchange_no, ("dcn_drop",)))
 
     def corrupt_payload(self, step: int, data: bytes) -> bytes:
         """Apply a due ``corrupt_resp`` fault to an outbound response

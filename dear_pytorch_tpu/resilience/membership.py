@@ -95,7 +95,7 @@ logger = logging.getLogger("dear_pytorch_tpu")
 __all__ = [
     "ElasticCluster", "ElasticVerdict", "MembershipView", "EvictedError",
     "current_epoch", "ELASTIC_DIR_ENV", "ELASTIC_RANK_ENV",
-    "ELASTIC_WORLD_ENV", "ELASTIC_REJOIN_ENV",
+    "ELASTIC_WORLD_ENV", "ELASTIC_REJOIN_ENV", "ELASTIC_RPS_ENV",
 ]
 
 #: The launch/supervisor rejoin env contract (`launch/supervisor.py`
@@ -106,6 +106,12 @@ ELASTIC_RANK_ENV = "DEAR_ELASTIC_RANK"    # stable rank id (falls back to
 ELASTIC_WORLD_ENV = "DEAR_ELASTIC_WORLD"  # initial world size (falls back
 #                                           to JAX_NUM_PROCESSES)
 ELASTIC_REJOIN_ENV = "DEAR_ELASTIC_REJOIN"  # "1" on a relaunched rank
+#: Slice granularity: when set (to the rank count per slice), the fleet's
+#: FAILURE UNIT is the slice — rank ids are slice-aligned
+#: (``slice_of(r) = r // ranks_per_slice``, the supervisor contract), a
+#: rank loss widens to its whole slice (one membership event, not N), and
+#: admission waits for complete slices.
+ELASTIC_RPS_ENV = "DEAR_ELASTIC_RANKS_PER_SLICE"
 
 #: How long a relaunched rank waits for its admission ack. Admission only
 #: happens at a member health sync, and the fleet may be mid-reconfig or
@@ -133,7 +139,30 @@ class MembershipView(NamedTuple):
     rank: int                  # my stable rank id
     index: int                 # my position in ``members`` — the data
     #                            shard slot `runtime.pipeline.reshard` uses
+    #                            on rank-granular fleets
     world: int                 # len(members)
+    #: live slice ids (slice-granular fleets only; () otherwise)
+    slices: Tuple[int, ...] = ()
+    #: this rank's slice id (None on rank-granular fleets)
+    slice_id: Optional[int] = None
+
+    @property
+    def data_shard(self) -> int:
+        """The data-parallel shard slot. On a slice-granular fleet the
+        ranks of one slice are lockstep replicas of the SAME shard (the
+        slice is the data-parallel unit — its intra-slice mesh computes
+        one model replica), so the slot is the slice's position among
+        the live slices; rank-granular fleets keep the member position.
+        `utils.guard.GuardedTrainer._reshard_pipeline` reads this."""
+        if self.slice_id is not None and self.slices:
+            return self.slices.index(self.slice_id)
+        return self.index
+
+    @property
+    def data_world(self) -> int:
+        """Companion to `data_shard`: live slices on a slice-granular
+        fleet, the member count otherwise."""
+        return len(self.slices) if self.slices else self.world
 
 
 class ElasticVerdict(NamedTuple):
@@ -215,8 +244,18 @@ class ElasticCluster:
         namespace: str = "elastic",
         max_candidates: int = 16,
         joining: bool = False,
+        ranks_per_slice: Optional[int] = None,
     ):
         global _live_cluster
+        if ranks_per_slice is not None and int(ranks_per_slice) < 1:
+            raise ValueError(
+                f"ranks_per_slice must be >= 1, got {ranks_per_slice}")
+        #: slice granularity (`ELASTIC_RPS_ENV`): the failure unit. Rank
+        #: ids are slice-aligned by contract —
+        #: ``slice_of(r) = r // ranks_per_slice`` — so a relaunched or
+        #: scaled-up rank keeps its slice without any extra state.
+        self.ranks_per_slice = (None if ranks_per_slice is None
+                                else int(ranks_per_slice))
         if members is None:
             if world is None:
                 raise ValueError("pass world=N or an explicit members list")
@@ -283,6 +322,9 @@ class ElasticCluster:
                     or os.environ["JAX_NUM_PROCESSES"])
         kw = dict(rank=rank, world=world,
                   transport=FileTransport(root))
+        rps = os.environ.get(ELASTIC_RPS_ENV, "").strip()
+        if rps:
+            kw["ranks_per_slice"] = int(rps)
         if rank >= world:
             # a scale-up spawn: the supervisor handed out a rank id beyond
             # the initial world — this process can only be a joiner
@@ -316,10 +358,50 @@ class ElasticCluster:
     def leader(self) -> int:
         return self.members[0]
 
+    # -- slice granularity ---------------------------------------------------
+
+    def slice_of(self, rank: int) -> Optional[int]:
+        """The slice a rank belongs to (None on rank-granular fleets).
+        Pure id arithmetic — the supervisor's slice-aligned rank-id
+        contract — so it holds for ranks that died, relaunched, or have
+        never existed yet."""
+        if self.ranks_per_slice is None:
+            return None
+        return int(rank) // self.ranks_per_slice
+
+    @property
+    def slices(self) -> Tuple[int, ...]:
+        """Live slice ids (sorted; () on rank-granular fleets)."""
+        if self.ranks_per_slice is None:
+            return ()
+        return tuple(sorted({self.slice_of(m) for m in self.members}))
+
+    def slice_ranks(self, sid: int) -> Tuple[int, ...]:
+        """Every rank id of slice ``sid`` under the alignment contract
+        (members or not — admission gating needs the full roster)."""
+        rps = self.ranks_per_slice
+        if rps is None:
+            raise ValueError("rank-granular cluster has no slices")
+        return tuple(range(int(sid) * rps, (int(sid) + 1) * rps))
+
+    def _closure_members(self, ranks) -> set:
+        """Widen a rank set to whole slices over the CURRENT members —
+        the slice-granular failure unit: one lost rank breaks its
+        slice's ICI mesh, so the membership removes (or drains) the
+        slice as ONE event instead of N rank-death events. Identity on
+        rank-granular clusters."""
+        dead = {int(r) for r in ranks} & set(self.members)
+        if self.ranks_per_slice is None or not dead:
+            return dead
+        dead_slices = {self.slice_of(r) for r in dead}
+        return {m for m in self.members
+                if self.slice_of(m) in dead_slices}
+
     def view(self) -> MembershipView:
         return MembershipView(epoch=self.epoch, members=self.members,
                               rank=self.rank, index=self.index,
-                              world=self.world)
+                              world=self.world, slices=self.slices,
+                              slice_id=self.slice_of(self.rank))
 
     # -- the member exchange -------------------------------------------------
 
@@ -414,12 +496,15 @@ class ElasticCluster:
         survivor that missed a commit ack and widened past an already
         committed epoch — finds the record and raises `EvictedError`
         instead of forking the membership."""
-        dead_set = {int(d) for d in dead} & set(self.members)
+        dead_set = self._closure_members(dead)
         if not dead_set:
             raise ValueError(f"no current member in dead={dead!r}")
         if self.rank in dead_set:
             raise EvictedError(
-                f"rank {self.rank} is in its own dead set {sorted(dead_set)}")
+                f"rank {self.rank} is in its own dead set {sorted(dead_set)}"
+                + ("" if self.ranks_per_slice is None else
+                   " (slice closure: a lost rank takes its whole slice's "
+                   "ICI mesh with it — exiting for relaunch+rejoin)"))
         target = self.epoch + 1
         tr = _telemetry.get_tracer()
         survivors: Tuple[int, ...] = ()
@@ -435,6 +520,10 @@ class ElasticCluster:
             union = set(dead_set) | set(missing)
             for v in props.values():
                 union |= set(json.loads(v))
+            # slice closure keeps every round's proposal slice-shaped, so
+            # survivors whose detectors saw different SUBSETS of a dying
+            # slice still converge on the same (whole-slice) dead set
+            union = self._closure_members(union)
             if self.rank in union:
                 raise EvictedError(
                     f"rank {self.rank} was declared dead during the epoch-"
@@ -478,6 +567,11 @@ class ElasticCluster:
             tr.event("cluster.reconfig", epoch=target,
                      members=",".join(map(str, survivors)),
                      lost=",".join(map(str, sorted(dead_set))))
+            if self.ranks_per_slice is not None:
+                lost_slices = sorted({self.slice_of(r) for r in dead_set})
+                tr.count("cluster.slice_losses", len(lost_slices))
+                tr.event("cluster.slice_loss", epoch=target,
+                         slices=",".join(map(str, lost_slices)))
         logger.critical(
             "elastic: membership epoch %d COMMITTED — members %s (lost %s)",
             target, list(survivors), sorted(dead_set))
@@ -507,6 +601,19 @@ class ElasticCluster:
                 "added": sorted(int(r) for r in delta.get("added", ())),
                 "removed": sorted(int(r) for r in delta.get("removed", ())),
             }
+            if self.ranks_per_slice is not None:
+                # slice-shaped delta: on slice-granular fleets every
+                # shrink is slice-closed and every admission slice-gated,
+                # so the rank deltas partition into whole slices — the
+                # capacity history replays at SLICE granularity from the
+                # records alone (an external pool manager thinks in
+                # slices, not ranks)
+                record["delta"]["slices"] = {
+                    "added": sorted({self.slice_of(r)
+                                     for r in delta.get("added", ())}),
+                    "removed": sorted({self.slice_of(r)
+                                       for r in delta.get("removed", ())}),
+                }
         mine = json.dumps(record, sort_keys=True)
         decide = getattr(self._transport, "decide_once", None)
         if decide is not None:
@@ -617,6 +724,27 @@ class ElasticCluster:
         the guard passes its cadence (``steps_seen``) so the rejoiner
         re-enters lockstep at the right attempt count."""
         cands = sorted(int(r) for r in reqs if int(r) not in self.members)
+        if self.ranks_per_slice is not None and cands:
+            # slice-gated admission: a slice trains only when its ICI
+            # mesh is whole, so a PARTIAL slice's requests are DEFERRED
+            # (left in the store, re-polled next sync) until every rank
+            # of the slice is present — the relaunched slice then
+            # readmits as ONE membership event at one epoch barrier
+            have = set(cands) | set(self.members)
+            ready: List[int] = []
+            for sid in sorted({self.slice_of(r) for r in cands}):
+                need = set(self.slice_ranks(sid))
+                if need <= have:
+                    ready.extend(r for r in cands
+                                 if self.slice_of(r) == sid)
+                else:
+                    logger.warning(
+                        "elastic: deferring admission of slice %d — "
+                        "rank(s) %s requested but %s not yet back",
+                        sid, sorted(r for r in cands
+                                    if self.slice_of(r) == sid),
+                        sorted(need - have))
+            cands = sorted(ready)
         if not cands:
             return ()
         new_members = tuple(sorted(set(self.members) | set(cands)))
@@ -668,6 +796,11 @@ class ElasticCluster:
                 tr.event("cluster.scale_up", epoch=new_epoch,
                          ranks=",".join(map(str, fresh)),
                          world=len(new_members))
+            if self.ranks_per_slice is not None:
+                back = sorted({self.slice_of(r) for r in cands})
+                tr.count("cluster.slice_rejoins", len(back))
+                tr.event("cluster.slice_rejoin", epoch=new_epoch,
+                         slices=",".join(map(str, back)))
             tr.event("cluster.admit", epoch=new_epoch,
                      admitted=",".join(map(str, cands)))
         try:
@@ -776,12 +909,30 @@ class ElasticCluster:
                 ok=False, unhealthy_ranks=(), desync=False,
                 any_preempted=False, fingerprints=(),
                 epoch=view.epoch, members=view.members,
-                reconfigured=True, lost=tuple(lost))
+                reconfigured=True,
+                # report the COMMITTED removal (the slice closure may be
+                # wider than the observed-missing seed)
+                lost=tuple(m for m in members0
+                           if m not in view.members))
         unhealthy, fps, desync, any_pre = evaluate_health_views(
             self.members, views, step=step,
             scope=f"elastic (epoch {epoch0})")
-        drains = tuple(r for r, v in zip(members0, views)
-                       if v.get("drain"))
+        announced = tuple(r for r, v in zip(members0, views)
+                          if v.get("drain"))
+        drains = announced
+        if announced and self.ranks_per_slice is not None:
+            # a spot reclaim anywhere in a slice takes the whole slice's
+            # ICI mesh: the planned shrink removes the slice as one unit
+            drains = tuple(sorted(self._closure_members(announced)))
+            if self.rank in drains and self.rank not in announced:
+                # a slice-mate of the drainer holds no preemption signal
+                # (and no grace window): exit for relaunch and come back
+                # through rejoin when the slice is re-provisioned
+                raise EvictedError(
+                    f"rank {self.rank}'s slice "
+                    f"{self.slice_of(self.rank)} is draining (rank(s) "
+                    f"{sorted(announced)} hold the preemption signal) — "
+                    "exiting for relaunch+rejoin with the slice")
         if drains and self.rank in drains:
             # I announced the drain: the survivors commit the shrink
             # among themselves (I am the dead set); my remaining duties

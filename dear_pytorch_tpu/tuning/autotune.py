@@ -319,6 +319,7 @@ class AutoTuner:
                     "strategy='plan' searches the dear/dear-fused "
                     f"schedule family; start from one of those, not "
                     f"mode={base_mode!r}")
+            _dcn = self._build_kwargs.get("dcn")
             if space is not None:
                 self.space = space
             else:
@@ -327,6 +328,11 @@ class AutoTuner:
                 # when the caller kept the default
                 ov = ({"threshold_bound": tuple(bound)}
                       if tuple(bound) != (1.0, 256.0) else {})
+                if _dcn is not None:
+                    # hierarchical build: the space searches the
+                    # per-level bucket partition too, and multislice-
+                    # illegal combos become infeasible arms
+                    ov["num_slices"] = _dcn.num_slices
                 self.space = PS.PlanSpace.from_env(**ov)
             base_comp = self._build_kwargs.pop("compressor", None)
             base_density = self._build_kwargs.pop("density", 1.0)
@@ -341,6 +347,8 @@ class AutoTuner:
                 gather_dtype=PS.dtype_token(
                     self._build_kwargs.pop("gather_dtype", None)),
                 remat=self._build_kwargs.pop("remat", None),
+                partition_mb=(self._build_kwargs.pop("partition_mb", None)
+                              if _dcn is not None else None),
             )
             kw = {} if clock is None else {"clock": clock}
             self.planner = PS.PlanTuner(
@@ -419,16 +427,50 @@ class AutoTuner:
         """(Re)build the planner's analytic cost model for the CURRENT
         world — called at construction and after every elastic rescale
         (the α-β fit survives; the plans must be rebuilt for the new
-        shard sizes)."""
+        shard sizes). On hierarchical builds the model is LINK-AWARE:
+        the cross-slice 'dcn' rows are priced with their own fit —
+        ``DEAR_TUNE_FIT_DCN="alpha,beta"`` explicit, or
+        ``DEAR_TUNE_FIT_DCN=1`` to least-squares it from the live
+        exchanger's per-fetch timing samples (`overlap.fit_dcn`)."""
         if self.planner is None or self._alpha_beta is None:
             return
+        import os as _os
+
         from dear_pytorch_tpu.tuning import planspace as PS
 
         world = self.ts.plan.world
         template = self._template
+        kw = {}
+        dcn = self._build_kwargs.get("dcn")
+        if dcn is not None:
+            kw["num_slices"] = dcn.num_slices
+            ab = getattr(self, "_dcn_alpha_beta", None)
+            if ab is None:
+                raw = _os.environ.get("DEAR_TUNE_FIT_DCN", "").strip()
+                if "," in raw:
+                    a, b = raw.split(",")
+                    ab = (float(a), float(b))
+                elif raw.lower() in ("1", "true", "yes", "on"):
+                    from dear_pytorch_tpu.observability import (
+                        overlap as OV,
+                    )
+
+                    try:
+                        ab = OV.fit_dcn(dcn.samples())
+                        self._log(
+                            f"autotune: DCN link fit alpha={ab[0]:.3e}s "
+                            f"beta={ab[1]:.3e}s/B "
+                            f"({len(dcn.samples())} samples)")
+                    except ValueError as exc:
+                        logger.warning(
+                            "autotune: DCN link fit unavailable (%s); "
+                            "dcn rows priced at the ICI fit", exc)
+                self._dcn_alpha_beta = ab
+            if ab is not None:
+                kw["dcn_alpha"], kw["dcn_beta"] = ab
         self.planner.cost_model = PS.CostModel(
             lambda thr: F.make_plan(template, world, threshold_mb=thr),
-            *self._alpha_beta,
+            *self._alpha_beta, **kw,
         )
 
     def _rebuild(self, state, *, force: bool = False, **plan_kwargs):
@@ -526,6 +568,19 @@ class AutoTuner:
         world = int(getattr(view, "world", view))
         epoch = int(getattr(view, "epoch", 0) or 0)
         old_ts = self.ts
+        dcn = self._build_kwargs.get("dcn")
+        if dcn is not None:
+            # hierarchical schedule: the ICI axis is not elastic — the
+            # plan world (intra-slice shard degree) and the mesh are
+            # FIXED. A slice-granular membership transition renormalizes
+            # the cross-slice leg (no recompile) and restamps the plan
+            # epoch so checkpoint fingerprints stay coherent.
+            world = old_ts.plan.world
+            if mesh is None:
+                mesh = old_ts.mesh
+            slices = tuple(getattr(view, "slices", ()) or ())
+            if slices:
+                dcn.set_slices(slices, epoch=epoch)
         if world == old_ts.plan.world and epoch == old_ts.plan.epoch:
             return state
         tr = _telemetry.get_tracer()

@@ -112,12 +112,18 @@ class PlanConfig:
     comm_dtype: Optional[str] = None
     gather_dtype: Optional[str] = None
     remat: Optional[str] = None     # None | 'full'
+    #: per-level bucket partition: the cross-slice DCN message size of
+    #: the hierarchical schedule (None = the build default). A searched
+    #: axis only on multi-slice spaces (`PlanSpace(num_slices > 1)`);
+    #: the intra-slice level keeps ``threshold_mb`` as ITS bucket size —
+    #: two levels, two independently searched granularities.
+    partition_mb: Optional[float] = None
 
     def key(self) -> tuple:
         """Categorical identity (the bandit arm) — everything but the
         continuous threshold."""
         return (self.mode, self.compressor, self.comm_dtype,
-                self.gather_dtype, self.remat)
+                self.gather_dtype, self.remat, self.partition_mb)
 
     def describe(self) -> str:
         parts = [f"{self.mode}", f"thr={self.threshold_mb:.3g}MB"]
@@ -131,6 +137,8 @@ class PlanConfig:
             parts.append(f"gather={self.gather_dtype}")
         if self.remat:
             parts.append(f"remat={self.remat}")
+        if self.partition_mb is not None:
+            parts.append(f"dcn={self.partition_mb:.3g}MB")
         return "/".join(parts)
 
     def to_dict(self) -> dict:
@@ -139,7 +147,7 @@ class PlanConfig:
     def build_kwargs(self) -> dict:
         """kwargs for `parallel.build_train_step` (jnp dtypes resolved
         lazily so the module itself stays jax-free)."""
-        return dict(
+        kw = dict(
             threshold_mb=float(self.threshold_mb),
             mode=self.mode,
             compressor=self.compressor,
@@ -148,6 +156,9 @@ class PlanConfig:
             gather_dtype=_jnp_dtype(self.gather_dtype),
             remat=self.remat,
         )
+        if self.partition_mb is not None:
+            kw["partition_mb"] = float(self.partition_mb)
+        return kw
 
 
 @dataclasses.dataclass(frozen=True)
@@ -182,6 +193,8 @@ class PlanSpace:
         gather_dtypes: Sequence[Optional[str]] = (None, "bf16"),
         remats: Sequence[Optional[str]] = (None, "full"),
         density: float = 0.01,
+        num_slices: int = 1,
+        partition_mbs: Sequence[Optional[float]] = (None,),
     ):
         if not threshold_bound[1] > threshold_bound[0] > 0:
             raise ValueError(f"bad threshold bound {threshold_bound}")
@@ -202,6 +215,23 @@ class PlanSpace:
             if r not in (None, "full"):
                 raise ValueError(f"bad remat choice {r!r}")
         self.density = float(density)
+        #: topology: >1 = the hierarchical (multi-slice) schedule; the
+        #: per-level bucket partition (DCN message size) then becomes a
+        #: searched axis and DCN-illegal combos become infeasible arms
+        self.num_slices = int(num_slices)
+        if self.num_slices < 1:
+            raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+        self.partition_mbs = tuple(
+            None if p in (None, "none") else float(p)
+            for p in partition_mbs)
+        for p in self.partition_mbs:
+            if p is not None and p <= 0:
+                raise ValueError(f"bad partition_mb choice {p!r}")
+        if self.num_slices == 1 and any(
+                p is not None for p in self.partition_mbs):
+            raise ValueError(
+                "partition_mb is the cross-slice (DCN) message size — a "
+                "searched axis only on multi-slice spaces (num_slices>1)")
 
     @classmethod
     def from_env(cls, **overrides) -> "PlanSpace":
@@ -240,6 +270,12 @@ class PlanSpace:
         if os.environ.get("DEAR_TUNE_BOUND"):
             lo, hi = os.environ["DEAR_TUNE_BOUND"].split(",")
             kw["threshold_bound"] = (float(lo), float(hi))
+        if os.environ.get("DEAR_TUNE_SLICES"):
+            kw["num_slices"] = int(os.environ["DEAR_TUNE_SLICES"])
+        v = _list("DEAR_TUNE_PARTITION")
+        if v is not None:
+            kw["partition_mbs"] = tuple(
+                None if p is None else float(p) for p in v)
         kw.update(overrides)
         return cls(**kw)
 
@@ -253,7 +289,7 @@ class PlanSpace:
         return PlanConfig(threshold_mb=0.5 * sum(self.threshold_bound))
 
     def axes(self) -> tuple[Axis, ...]:
-        return (
+        out = (
             Axis("threshold_mb", "continuous", bound=self.threshold_bound),
             Axis("mode", "categorical", choices=self.modes),
             Axis("compressor", "categorical", choices=self.compressors),
@@ -261,6 +297,10 @@ class PlanSpace:
             Axis("gather_dtype", "categorical", choices=self.gather_dtypes),
             Axis("remat", "categorical", choices=self.remats),
         )
+        if self.num_slices > 1:
+            out += (Axis("partition_mb", "categorical",
+                         choices=self.partition_mbs),)
+        return out
 
     def feasible(self, config: PlanConfig) -> Optional[str]:
         """None when the combination can build, else the reason it cannot
@@ -272,6 +312,18 @@ class PlanSpace:
         if config.compressor is not None and config.comm_dtype is not None:
             return ("the compressed wire format already owns the gradient "
                     "leg; comm_dtype is dead weight under a compressor")
+        if self.num_slices > 1:
+            if config.mode == "dear-fused":
+                return ("multislice x dear-fused: the Pallas rings "
+                        "address a single flat mesh axis — a ring "
+                        "spanning the DCN boundary cannot build "
+                        "(parallel.build_train_step rejects it)")
+            if config.compressor is not None:
+                return ("multislice x compression: the cross-slice leg "
+                        "averages dense partials on the host")
+        elif config.partition_mb is not None:
+            return ("partition_mb is the cross-slice (DCN) message size; "
+                    "it needs a multi-slice space (num_slices>1)")
         return None
 
     def configs(self, threshold_mb: Optional[float] = None
@@ -281,19 +333,23 @@ class PlanSpace:
         thr = (float(threshold_mb) if threshold_mb is not None
                else 0.5 * (self.threshold_bound[0]
                            + self.threshold_bound[1]))
+        parts = (self.partition_mbs if self.num_slices > 1 else (None,))
         out = []
         for mode in self.modes:
             for comp in self.compressors:
                 for cd in self.comm_dtypes:
                     for gd in self.gather_dtypes:
                         for rm in self.remats:
-                            cfg = PlanConfig(
-                                threshold_mb=thr, mode=mode,
-                                compressor=comp, density=self.density,
-                                comm_dtype=cd, gather_dtype=gd, remat=rm,
-                            )
-                            if self.feasible(cfg) is None:
-                                out.append(cfg)
+                            for pm in parts:
+                                cfg = PlanConfig(
+                                    threshold_mb=thr, mode=mode,
+                                    compressor=comp,
+                                    density=self.density,
+                                    comm_dtype=cd, gather_dtype=gd,
+                                    remat=rm, partition_mb=pm,
+                                )
+                                if self.feasible(cfg) is None:
+                                    out.append(cfg)
         return out
 
 
@@ -320,11 +376,24 @@ class CostModel:
     """
 
     def __init__(self, plan_fn: Callable[[float], Any], alpha: float,
-                 beta: float, *, remat_factor: float = 1.3):
+                 beta: float, *, remat_factor: float = 1.3,
+                 num_slices: int = 1,
+                 dcn_alpha: Optional[float] = None,
+                 dcn_beta: Optional[float] = None):
         self._plan_fn = plan_fn      # threshold_mb -> FusionPlan
         self.alpha = float(alpha)
         self.beta = float(beta)
         self.remat_factor = float(remat_factor)
+        #: multi-slice pricing: the 'dcn' accounting rows (cross-slice
+        #: host exchange, chunked at each config's ``partition_mb``) are
+        #: costed with their OWN link fit — ICI and DCN α-β constants
+        #: differ by orders of magnitude, so one fit cannot rank a
+        #: partition/threshold trade across levels (the FlexLink point).
+        #: With no DCN fit the rows fall back to the intra-slice fit
+        #: (`overlap.predict_leg_times` states the same behavior).
+        self.num_slices = int(num_slices)
+        self.dcn_alpha = None if dcn_alpha is None else float(dcn_alpha)
+        self.dcn_beta = None if dcn_beta is None else float(dcn_beta)
         self._plans: dict = {}
         self._obs: list[tuple[float, float]] = []   # (comm_pred, measured)
 
@@ -345,8 +414,12 @@ class CostModel:
             comm_itemsize=_DTYPE_ITEMSIZE[config.comm_dtype],
             gather_itemsize=_DTYPE_ITEMSIZE[config.gather_dtype],
             compressor=config.compressor, density=config.density,
+            num_slices=self.num_slices,
+            dcn_partition_mb=config.partition_mb,
         )
-        return float(sum(OV.predict_leg_times(acct, self.alpha, self.beta)))
+        return float(sum(OV.predict_leg_times(
+            acct, self.alpha, self.beta,
+            dcn_alpha=self.dcn_alpha, dcn_beta=self.dcn_beta)))
 
     def observe(self, config: PlanConfig, measured_s: float) -> None:
         if measured_s > 0 and math.isfinite(measured_s):

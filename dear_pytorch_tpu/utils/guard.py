@@ -98,6 +98,15 @@ from dear_pytorch_tpu.utils import checkpoint as ckpt
 logger = logging.getLogger("dear_pytorch_tpu")
 
 
+def _is_dcn_error(exc: BaseException) -> bool:
+    """Is this a cross-slice (host DCN leg) failure? Lazy import: the
+    guard must not pull the hierarchical machinery into single-level
+    runs; the isinstance check caches the class after first use."""
+    from dear_pytorch_tpu.comm.dcn import DcnError
+
+    return isinstance(exc, DcnError)
+
+
 class DivergenceError(RuntimeError):
     """Raised when training diverges and no checkpoint exists to restore."""
 
@@ -279,18 +288,23 @@ class GuardedTrainer:
 
     def _reshard_pipeline(self) -> None:
         """Reassign this rank's data slice after a committed membership
-        transition (shard slot = position in the new member list)."""
+        transition. The shard slot is the view's ``data_shard`` — the
+        member position on rank-granular fleets, the SLICE position on
+        slice-granular ones (a slice's ranks are lockstep replicas of
+        one shard; see `resilience.membership.MembershipView`)."""
         self._pending_reshard = False
         view_fn = getattr(self._coordinator, "view", None)
         if self._pipeline is None or view_fn is None:
             return
         view = view_fn()
+        shard = getattr(view, "data_shard", view.index)
+        world = getattr(view, "data_world", view.world)
         try:
-            self._pipeline.reshard(view.index, view.world, epoch=view.epoch)
+            self._pipeline.reshard(shard, world, epoch=view.epoch)
         except Exception as exc:
             logger.error(
                 "guard: pipeline reshard to %d/%d (epoch %d) failed: %s",
-                view.index, view.world, view.epoch, exc)
+                shard, world, view.epoch, exc)
 
     def _restore_step(self, step: int):
         """Restore one step into the live plan's layout; a checkpoint
@@ -649,7 +663,26 @@ class GuardedTrainer:
             new_state, metrics, is_ckpt, is_check, healthy = \
                 self._attempt(state, batch, tr)
         except (FloatingPointError, RuntimeError) as exc:
-            if self._coordinated:
+            if self._coordinated and dispatched and _is_dcn_error(exc):
+                # hierarchical schedule: the CROSS-SLICE leg failed (dead
+                # slice, DCN partition, dropped publish). Unlike a failure
+                # inside a dispatched SPMD program, the host-level leg
+                # leaves no cross-process collective in flight — the
+                # intra-slice program completed on this process — so the
+                # rank can stay in lockstep by deferring straight to the
+                # coordinated sync as UNHEALTHY. No re-attempt: retrying
+                # would burn another full peer deadline against a slice
+                # the membership layer is about to remove.
+                if tr.enabled:
+                    tr.count("guard.step_errors")
+                    tr.event("guard.step_error", error=type(exc).__name__)
+                logger.error(
+                    "guard: cross-slice (DCN) leg failed: %s — deferring "
+                    "to the coordinated health sync", exc)
+                self._pending_error = exc
+                healthy, new_state, metrics, error = False, None, None, exc
+                is_ckpt, is_check = False, True
+            elif self._coordinated:
                 # coordinated multi-host: a LOCAL failure must not fork
                 # the SPMD program. An exception raised BEFORE the step
                 # dispatched (injected faults, host-side input bugs) lets

@@ -66,6 +66,11 @@ ELASTIC_DIR_ENV = "DEAR_ELASTIC_DIR"
 ELASTIC_RANK_ENV = "DEAR_ELASTIC_RANK"
 ELASTIC_WORLD_ENV = "DEAR_ELASTIC_WORLD"
 ELASTIC_REJOIN_ENV = "DEAR_ELASTIC_REJOIN"
+#: slice-granular fleets: rank ids are SLICE-ALIGNED by contract
+#: (``slice = rank // ranks_per_slice``) — the supervisor exports the
+#: value so `resilience.membership.ElasticCluster.from_env` widens
+#: failures to whole slices, and mints scale-up ids on slice boundaries
+ELASTIC_RPS_ENV = "DEAR_ELASTIC_RANKS_PER_SLICE"
 
 
 def _import_scale():
@@ -94,12 +99,20 @@ class ElasticSupervisor:
         relaunch_window_s: Optional[float] = None,
         relaunch_delay_s: float = 0.5,
         policy=None,
+        ranks_per_slice: Optional[int] = None,
         log=lambda s: print(s, file=sys.stderr, flush=True),
     ):
         if nprocs < 1:
             raise ValueError(f"nprocs must be >= 1, got {nprocs}")
         if not command:
             raise ValueError("empty worker command")
+        if ranks_per_slice is not None:
+            ranks_per_slice = int(ranks_per_slice)
+            if ranks_per_slice < 1 or nprocs % ranks_per_slice:
+                raise ValueError(
+                    f"nprocs={nprocs} must be a whole number of slices "
+                    f"of {ranks_per_slice} ranks")
+        self.ranks_per_slice = ranks_per_slice
         self.nprocs = int(nprocs)
         self.command = list(command)
         self.elastic_dir = os.path.abspath(elastic_dir)
@@ -129,6 +142,8 @@ class ElasticSupervisor:
         env[ELASTIC_DIR_ENV] = self.elastic_dir
         env[ELASTIC_RANK_ENV] = str(rank)
         env[ELASTIC_WORLD_ENV] = str(self.nprocs)
+        if self.ranks_per_slice is not None:
+            env[ELASTIC_RPS_ENV] = str(self.ranks_per_slice)
         if rejoin:
             env[ELASTIC_REJOIN_ENV] = "1"
         else:
@@ -204,6 +219,11 @@ class ElasticSupervisor:
             if self._backfill:
                 rank = self._backfill.pop(0)
             else:
+                # dense minting keeps the slice-aligned rank-id contract
+                # (slice = rank // ranks_per_slice) by construction: ids
+                # are consecutive from a whole-number-of-slices initial
+                # world (validated above), so a fresh slice always starts
+                # exactly on a slice boundary
                 rank = max(self._ever_ranks) + 1
             self.events.append(("scale_up", rank))
             self._spawn(rank, rejoin=True)
@@ -334,6 +354,12 @@ def main(argv=None) -> int:
                          "legacy lifetime cap (a long-running service "
                          "should always set this)")
     ap.add_argument("--relaunch-delay", type=float, default=0.5)
+    ap.add_argument("--ranks-per-slice", type=int, default=None,
+                    help="slice-granular fleet: rank ids are "
+                         "slice-aligned (slice = rank // N), failures "
+                         "widen to whole slices, scale-ups mint "
+                         "slice-boundary ids (exported as "
+                         "DEAR_ELASTIC_RANKS_PER_SLICE)")
     ap.add_argument("--capacity-file", default=None,
                     help="watched capacity-hint JSON (spot-pool stand-in); "
                          "enables the ScalePolicy loop "
@@ -361,6 +387,7 @@ def main(argv=None) -> int:
         relaunch_window_s=args.relaunch_window,
         relaunch_delay_s=args.relaunch_delay,
         policy=policy,
+        ranks_per_slice=args.ranks_per_slice,
     ).start()
     try:
         return sup.wait(args.deadline)

@@ -926,7 +926,6 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
     phases, exactly as an external operator would."""
     import subprocess
     import tempfile
-    import time
 
     workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_auto_")
     elastic_dir = os.path.join(workdir, "elastic")
@@ -965,41 +964,22 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
         max_relaunches=2, relaunch_window_s=120.0, policy=policy,
     ).start()
 
-    decided_dir = os.path.join(elastic_dir, "dearel", "elastic", "decided")
+    decided = CC.decided_reader(elastic_dir)
+    phase = [0]
 
-    def decided(n):
-        try:
-            with open(os.path.join(decided_dir, f"e{n}")) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
-
-    t0 = time.monotonic()
-    deadline = t0 + 420.0
-    phase, rc = 0, None
-    while True:
-        alive = sup.poll()
-        now = time.monotonic()
-        if not alive:
-            break
-        if now >= deadline:
-            sup.kill_all()
-            rc = 124
-            break
-        if phase == 0 and _newest_remote_store(remote_root)[0] is not None:
+    def _phases():
+        if (phase[0] == 0
+                and _newest_remote_store(remote_root)[0] is not None):
             # the fleet is streaming checkpoints: capacity-UP hint
             write_capacity({"target_world": 3})
-            phase = 1
-        elif phase == 1 and decided(3) is not None:
+            phase[0] = 1
+        elif phase[0] == 1 and decided(3) is not None:
             # scale-up (e1), SIGKILL shrink (e2), and rejoin (e3) all
             # committed: now the spot-style drain of rank 0
             write_capacity({"target_world": 3, "drain": [drain_rank]})
-            phase = 2
-        time.sleep(0.1)
-    elapsed_s = time.monotonic() - t0
-    if rc is None:
-        bad = {r: c for r, c in sup._final_rc.items() if c != 0}
-        rc = 1 if bad else 0
+            phase[0] = 2
+
+    rc, elapsed_s = CC.run_fleet(sup, deadline_s=420.0, on_poll=_phases)
 
     failures: list[str] = []
     _check(rc == 0, f"supervisor fleet exits clean (got rc={rc})", failures)
@@ -1032,15 +1012,7 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
            f"epoch-5 record commits the full world ({rec5})", failures)
 
     # newest verdict per rank (churned ranks write one per life)
-    lives: dict[int, list] = {}
-    for name in sorted(os.listdir(workdir)):
-        if not (name.startswith("verdict_rank") and name.endswith(".json")):
-            continue
-        with open(os.path.join(workdir, name)) as f:
-            v = json.load(f)
-        lives.setdefault(int(v["rank"]), []).append(
-            (os.path.getmtime(os.path.join(workdir, name)), v))
-    finals = {r: sorted(vs)[-1][1] for r, vs in lives.items()}
+    lives, finals = CC.collect_verdicts(workdir)
     summary = {"passed": False, "workdir": workdir, "rc": rc,
                "elapsed_s": round(elapsed_s, 1),
                "policy_decisions": kinds, "finals": finals,
@@ -1071,7 +1043,7 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
     # original members, and as cluster.scale_ups on at least one of them
     merged: dict = {}
     for vs in lives.values():
-        for _t, v in vs:
+        for v in vs:
             for k, n in v.get("counters", {}).items():
                 merged[k] = merged.get(k, 0) + n
     _check(merged.get("cluster.scale_ups", 0) >= 1,
@@ -1085,12 +1057,12 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
     _check(merged.get("ckpt.uploads", 0) >= 3,
            f"checkpoint streaming uploaded throughout "
            f"(ckpt.uploads={merged.get('ckpt.uploads', 0)})", failures)
-    fresh_life = [v for vs in lives.values() for _t, v in vs
+    fresh_life = [v for vs in lives.values() for v in vs
                   if v.get("scale_up_join")]
     _check(bool(fresh_life),
            "the brand-new rank hydrated from the remote tier and joined "
            "with no sidecar epoch", failures)
-    drained_life = [v for vs in lives.values() for _t, v in vs
+    drained_life = [v for vs in lives.values() for v in vs
                     if v.get("drained")]
     _check(len(drained_life) == 1
            and drained_life[0]["rank"] == drain_rank
@@ -1146,6 +1118,364 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
         "cold": cold_verdict,
         "merged_counters": {k: v for k, v in sorted(merged.items())
                             if k.startswith(("cluster.", "ckpt."))},
+        "failures": failures,
+    })
+    return summary
+
+
+# -- the multi-slice storm -----------------------------------------------------
+
+
+def run_worker_multislice(checkpoint_every: int, workdir: str) -> dict:
+    """One rank of the MULTISLICE storm: a 2-slice x 4-rank fleet where
+    every rank trains the HIERARCHICAL schedule — per-bucket RS+AG over
+    its local 2-device ICI mesh inside the jitted step, cross-slice
+    gradient averaging over the shared `FileTransport` DCN exchanger
+    between the backward and update programs (`comm.dcn`). The four
+    ranks of a slice are lockstep replicas of that slice's data shard;
+    membership is SLICE-granular (``DEAR_ELASTIC_RANKS_PER_SLICE``, the
+    supervisor contract). The scheduled victim slice SIGKILLs all its
+    ranks at one attempt; survivors must commit exactly ONE shrink
+    epoch, renormalize the DCN leg, and train degraded; the relaunched
+    slice hydrates from the remote tier and readmits as one epoch at
+    the barrier. A slice-targeted ``dcn_slow`` fault turns the
+    surviving slice into a straggler the fleet must tolerate."""
+    import json
+
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    os.environ["DEAR_CKPT_SHARED"] = "0"
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(2, scrub_env=True)
+
+    import jax
+    import numpy as np
+
+    from dear_pytorch_tpu.comm.dcn import DcnExchanger
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.resilience import membership as M
+    from dear_pytorch_tpu.resilience.cluster import FileTransport
+    from dear_pytorch_tpu.resilience.inject import (
+        FaultInjector, parse_faults,
+    )
+    from dear_pytorch_tpu.runtime import build as RB
+    from dear_pytorch_tpu.runtime import pipeline as P
+    from dear_pytorch_tpu.tuning.autotune import AutoTuner
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    EH = _load_harness()
+    cluster = M.ElasticCluster.from_env(max_candidates=256)
+    rejoining = M.ElasticCluster.rejoining_by_env()
+    rank, my_slice = cluster.rank, cluster.slice_of(cluster.rank)
+    ks, ka = os.environ["DEAR_CHAOS_MULTI_KILL"].split(":")
+    kill_slice, kill_at = int(ks), int(ka)
+    target_epoch = int(os.environ.get("DEAR_CHAOS_MULTI_EPOCHS", "2"))
+    post = int(os.environ.get("DEAR_CHAOS_MULTI_POST", "3"))
+    remote_root = os.environ["DEAR_CHAOS_REMOTE"]
+    ckpt_dir = os.path.join(workdir, f"rank{rank}", "ckpts")
+    tracer = T.get_tracer()
+
+    injector = None
+    if os.environ.get("DEAR_FAULTS", "").strip():
+        injector = FaultInjector(
+            parse_faults(os.environ["DEAR_FAULTS"]),
+            own_rank=rank, own_slice=my_slice)
+    # a rejoiner's exchanger starts at the INITIAL view; admission hands
+    # it the committed slice set through AutoTuner.rescale (reenter)
+    dcn = DcnExchanger(
+        FileTransport(os.path.join(workdir, "dcn")),
+        local_slices=(my_slice,), slices=cluster.slices,
+        partition_mb=0.0005, injector=injector)
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:2]).reshape(1, 2), ("slice", "ici"))
+    tuner = AutoTuner(
+        _loss_fn, params, strategy="bo", threshold_mb=0.0008,
+        interval=10**9, mesh=mesh, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        axis_name="ici", dcn=dcn, dcn_slice_axis="slice",
+    )
+    view0 = cluster.view()
+    spec = P.SyntheticSpec((
+        P.Field("x", (8, 12), RB.KIND_NORMAL_F32, 0.0, 1.0),
+    ))
+    pipe = P.NumpyPipeline(spec, seed=123, shard=view0.data_shard,
+                           num_shards=view0.data_world)
+    store = LocalObjectStore(os.path.join(remote_root, f"rank{rank}"))
+    streamer = ckpt.CheckpointStreamer(
+        ckpt_dir, store, upload_every=1, pin_last=4)
+    guard = GuardedTrainer(
+        tuner.ts, ckpt_dir, params,
+        check_every=1, checkpoint_every=checkpoint_every, max_keep=1000,
+        max_recoveries=12, coordinator=cluster, pipeline=pipe,
+        streamer=streamer,
+    )
+    base_hook = EH.attach_elastic(guard, tuner)
+    transitions = []
+
+    def on_change(view):
+        base_hook(view)
+        transitions.append({"epoch": view.epoch,
+                            "slices": list(view.slices),
+                            "steps_seen": guard.steps_seen})
+    guard.on_membership_change = on_change
+    rollback_steps = []
+    guard.on_rollback = lambda c, at: rollback_steps.append(at)
+
+    def batch_at(i):
+        # the GLOBAL batch, deterministically sliced to the CURRENT live
+        # slice set: this slice's rows shard over its ICI axis, and
+        # degraded mode (one live slice) trains the full batch
+        x, y = _data(jax.random.PRNGKey(100 + i), n=8)
+        view = cluster.view()
+        per = 8 // max(view.data_world, 1)
+        k = view.data_shard
+        return (x[k * per:(k + 1) * per], y[k * per:(k + 1) * per])
+
+    resumed_at = last_epoch = None
+    if rejoining:
+        hydrate, _ = _newest_remote_store(remote_root, skip_rank=rank)
+        state, resumed_at, last_epoch = EH.reenter(
+            cluster, tuner, guard, ckpt_dir, hydrate_store=hydrate)
+    else:
+        state = tuner.init(params)
+
+    kill = ((rank, 0, kill_at - 1) if my_slice == kill_slice
+            else (-1, 0, 0))
+    state, m = EH.run_autoscale_loop(
+        cluster, guard, pipe, state, batch_at,
+        rejoining=rejoining, target_epoch=target_epoch, post=post,
+        kill=kill, deadline_s=420.0)
+    streamer.flush(20.0)
+    streamer.close()
+    counters = tracer.counters()
+    verdict = {
+        "rank": rank,
+        "slice": my_slice,
+        "pid": os.getpid(),
+        "rejoined": bool(rejoining),
+        "epoch": cluster.epoch,
+        "members": list(cluster.members),
+        "slices": list(cluster.slices),
+        "transitions": transitions,
+        "resumed_at": resumed_at,
+        "last_epoch": last_epoch,
+        "rollback_steps": rollback_steps,
+        "final_step": int(jax.device_get(state.step)),
+        "final_loss": float(m.get("loss", float("nan"))),
+        "steps_seen": guard.steps_seen,
+        "plan_world": guard.ts.plan.world,
+        "plan_epoch": guard.ts.plan.epoch,
+        "pipe_shard": [pipe.shard, pipe.num_shards],
+        "dcn_slices": list(dcn.slices),
+        "dcn_samples": len(dcn.samples()),
+        "uploaded": sorted(streamer.uploaded),
+        "upload_failed": sorted(streamer.failed),
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("cluster.", "guard.", "pipeline.",
+                                      "autotune.", "ckpt.", "dcn.",
+                                      "faults."))},
+    }
+    # the lockstep verdict is itself a member-scoped collective
+    views = cluster.exchange("chaos.verdict", json.dumps(
+        [verdict["final_step"], round(verdict["final_loss"], 9),
+         verdict["epoch"], verdict["slices"]]))
+    verdict["lockstep"] = all(
+        json.loads(v) == json.loads(views[0]) for v in views)
+    path = os.path.join(workdir, f"verdict_rank{rank}.{os.getpid()}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(verdict, f)
+    os.replace(path + ".tmp", path)
+    print(f"CHAOS_MULTI rank={rank} " + json.dumps(verdict), flush=True)
+    return verdict
+
+
+def run_multislice(checkpoint_every: int, workdir: str | None) -> dict:
+    """Parent of the multislice storm — the hierarchical-training
+    acceptance gate (ROADMAP item 2, robustness half). A 2-slice x
+    4-rank supervised fleet trains the two-level RS+AG(ICI) + DCN
+    schedule while streaming checkpoints to per-rank object stores;
+    then:
+
+      1. the whole of slice 1 is SIGKILLed at one attempt — the gate
+         asserts it commits as exactly ONE membership epoch (e1, signed
+         slice-shaped delta ``slices.removed == [1]``), never as 4
+         rank-death events;
+      2. the surviving slice renormalizes the cross-slice leg
+         (``dcn.renorms``) and keeps training DEGRADED — steps must
+         advance between the shrink and the rejoin — while a
+         slice-targeted ``dcn_slow`` fault makes it a straggler;
+      3. the supervisor's per-rank relaunches come back through the
+         SLICE-GATED admission: all four ranks readmit as ONE epoch
+         (e2, ``slices.added == [1]``) at the barrier, hydrated from
+         the remote tier;
+      4. the fleet finishes in lockstep at full membership with zero
+         loss of progress past the newest uploaded checkpoint.
+
+    The parent stays jax-free and sequences off the durable decision
+    records, exactly as an external slice-pool operator would."""
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_multi_")
+    elastic_dir = os.path.join(workdir, "elastic")
+    remote_root = os.path.join(workdir, "remote")
+    os.makedirs(remote_root, exist_ok=True)
+    sup_mod = CC.load_supervisor()
+
+    nslices, rps = 2, 4
+    nprocs = nslices * rps
+    kill_slice, kill_at, target_epoch, post = 1, 5, 2, 3
+    victims = list(range(kill_slice * rps, (kill_slice + 1) * rps))
+    env = dict(os.environ)
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_CHAOS_MULTI_KILL"] = f"{kill_slice}:{kill_at}"
+    env["DEAR_CHAOS_MULTI_EPOCHS"] = str(target_epoch)
+    env["DEAR_CHAOS_MULTI_POST"] = str(post)
+    env["DEAR_CHAOS_REMOTE"] = remote_root
+    # the straggler-slice fault: slice 0 (the SURVIVOR) gets a armed
+    # 30ms DCN latency from its 6th exchange on — degraded-mode and
+    # post-rejoin training must absorb it
+    env["DEAR_FAULTS"] = "dcn_slow@6:0.03:s0"
+    # a dead slice must fail the step (and hand recovery to membership)
+    # well before the health sync deadline would expire
+    env["DEAR_DCN_TIMEOUT_SECS"] = "20"
+    env.setdefault("DEAR_CLUSTER_TIMEOUT_SECS", "45")
+    sup = sup_mod.ElasticSupervisor(
+        nprocs,
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--multislice", "--checkpoint-every", str(checkpoint_every),
+         "--workdir", workdir],
+        elastic_dir=elastic_dir, env=env,
+        max_relaunches=1, relaunch_window_s=300.0,
+        ranks_per_slice=rps,
+    ).start()
+
+    decided = CC.decided_reader(elastic_dir)
+    rc, elapsed_s = CC.run_fleet(sup, deadline_s=540.0)
+
+    failures: list[str] = []
+    _check(rc == 0, f"supervisor fleet exits clean (got rc={rc})",
+           failures)
+    _check(all(sup.relaunches.get(r, 0) == 1 for r in victims)
+           and all(sup.relaunches.get(r, 0) == 0 for r in range(rps)),
+           f"exactly the killed slice's ranks were relaunched "
+           f"({sup.relaunches})", failures)
+
+    # the slice-shaped signed deltas ARE the capacity story: one shrink
+    # epoch for the whole slice loss, one admission epoch for the whole
+    # slice rejoin, nothing else
+    rec1, rec2, rec3 = decided(1), decided(2), decided(3)
+    _check(isinstance(rec1, dict)
+           and rec1.get("delta", {}).get("removed") == victims
+           and rec1.get("delta", {}).get("slices")
+           == {"added": [], "removed": [kill_slice]},
+           f"e1 commits the WHOLE slice loss as one membership event "
+           f"(got {rec1})", failures)
+    _check(isinstance(rec2, dict)
+           and rec2.get("delta", {}).get("added") == victims
+           and rec2.get("delta", {}).get("slices")
+           == {"added": [kill_slice], "removed": []}
+           and rec2.get("members") == list(range(nprocs)),
+           f"e2 readmits the relaunched slice as one epoch at full "
+           f"membership (got {rec2})", failures)
+    _check(rec3 is None,
+           f"no spurious membership epochs past e{target_epoch} "
+           f"(e3 = {rec3})", failures)
+
+    # newest verdict per rank (the killed slice writes one per life)
+    _lives, finals = CC.collect_verdicts(workdir)
+    summary = {"passed": False, "workdir": workdir, "rc": rc,
+               "elapsed_s": round(elapsed_s, 1), "finals": finals,
+               "failures": failures}
+    if sorted(finals) != list(range(nprocs)):
+        failures.append(f"expected final verdicts from ranks 0-"
+                        f"{nprocs - 1}, got {sorted(finals)}")
+        return summary
+
+    expect_restore = (kill_at - 1) - (kill_at - 1) % checkpoint_every
+    for r, v in sorted(finals.items()):
+        _check(v["epoch"] == target_epoch
+               and v["members"] == list(range(nprocs))
+               and v["slices"] == [0, 1],
+               f"rank {r} ends at epoch {target_epoch}, both slices "
+               f"live (epoch {v['epoch']}, slices {v['slices']})",
+               failures)
+        _check(v.get("lockstep"), f"rank {r} finished in lockstep",
+               failures)
+        _check(v["plan_world"] == 2 and v["plan_epoch"] == target_epoch,
+               f"rank {r}'s plan keeps the FIXED intra-slice world and "
+               f"the committed epoch (world {v['plan_world']}, epoch "
+               f"{v['plan_epoch']})", failures)
+        _check(v["pipe_shard"][1] == nslices
+               and v["pipe_shard"][0] == v["slice"],
+               f"rank {r} pipeline sharded at SLICE granularity "
+               f"({v['pipe_shard']})", failures)
+        _check(v["dcn_slices"] == [0, 1],
+               f"rank {r}'s DCN leg ends renormalized to both slices "
+               f"({v['dcn_slices']})", failures)
+        _check(v["counters"].get("dcn.exchanges", 0) > 0,
+               f"rank {r} exchanged gradients over the DCN leg",
+               failures)
+        _check(bool(v["uploaded"]) and not v["upload_failed"],
+               f"rank {r} streamed checkpoints to its remote tier "
+               f"({v['uploaded']}, failed {v['upload_failed']})",
+               failures)
+    survivors = [v for r, v in finals.items() if r not in victims]
+    for v in survivors:
+        c = v["counters"]
+        _check(c.get("cluster.slice_losses", 0) == 1
+               and c.get("cluster.slice_rejoins", 0) == 1
+               and c.get("cluster.reconfigs", 0) == 1,
+               f"rank {v['rank']} saw exactly one slice loss and one "
+               f"slice rejoin ({c})", failures)
+        _check(c.get("dcn.renorms", 0) >= 2,
+               f"rank {v['rank']} renormalized the DCN leg at both "
+               f"transitions (dcn.renorms={c.get('dcn.renorms', 0)})",
+               failures)
+        _check(bool(v["rollback_steps"])
+               and min(v["rollback_steps"]) >= expect_restore,
+               f"rank {v['rank']} rollbacks never went past the newest "
+               f"common checkpoint {expect_restore} "
+               f"({v['rollback_steps']})", failures)
+        shrink = [t for t in v["transitions"]
+                  if t["slices"] == [1 - kill_slice]]
+        rejoin = [t for t in v["transitions"] if t["slices"] == [0, 1]]
+        _check(bool(shrink) and bool(rejoin)
+               and rejoin[0]["steps_seen"] > shrink[0]["steps_seen"],
+               f"rank {v['rank']} trained DEGRADED on the surviving "
+               f"slice between shrink and rejoin "
+               f"({v['transitions']})", failures)
+    rejoined = [v for r, v in finals.items() if r in victims]
+    _check(all(v["rejoined"] for v in rejoined),
+           "every relaunched rank of the lost slice came back through "
+           "rejoin", failures)
+    # the straggler fault landed on the surviving slice only
+    slow_fired = sum(v["counters"].get("faults.injected", 0)
+                     for v in survivors)
+    _check(slow_fired == rps,
+           f"dcn_slow fired on every surviving-slice rank "
+           f"(faults.injected={slow_fired}, want {rps})", failures)
+
+    # zero loss of progress past the newest uploaded checkpoint
+    _, newest_uploaded = _newest_remote_store(remote_root)
+    final_step = finals[0]["final_step"]
+    _check(newest_uploaded is not None
+           and final_step >= newest_uploaded,
+           f"final step {final_step} >= newest uploaded checkpoint "
+           f"{newest_uploaded} (zero loss past the remote tier)",
+           failures)
+
+    summary.update({
+        "passed": not failures,
+        "newest_uploaded": newest_uploaded,
         "failures": failures,
     })
     return summary
@@ -2351,6 +2681,16 @@ def main(argv=None) -> int:
                          "3 ranks, SIGKILL shrink + relaunch, spot-drain "
                          "planned shrink + backfill, steps/hour SLO gate, "
                          "and a cold start from the remote checkpoint tier")
+    ap.add_argument("--multislice", action="store_true",
+                    help="multi-slice hierarchical-training storm: a "
+                         "2-slice x 4-rank fleet trains RS+AG over ICI "
+                         "with a host-level DCN cross-slice exchange; "
+                         "one WHOLE slice is SIGKILLed (must commit as "
+                         "exactly one membership epoch), survivors "
+                         "train degraded with the DCN leg renormalized "
+                         "under a slice-targeted slow-link fault, and "
+                         "the relaunched slice readmits as one epoch — "
+                         "zero loss of progress past the newest upload")
     ap.add_argument("--serve", action="store_true",
                     help="serving storm: a supervised replica fleet "
                          "absorbs an overload burst (shed+retry), a "
@@ -2413,6 +2753,19 @@ def main(argv=None) -> int:
         return 0 if summary["passed"] else 1
     if args.worker and args.cold_start:
         summary = run_cold_start(workdir=args.workdir)
+        return 0 if summary["passed"] else 1
+    if args.worker and args.multislice:
+        # one multislice rank: the verdict file is the output
+        run_worker_multislice(
+            checkpoint_every=args.checkpoint_every, workdir=args.workdir)
+        return 0
+    if args.multislice:
+        summary = run_multislice(checkpoint_every=args.checkpoint_every,
+                                 workdir=args.workdir)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "finals"}))
+        print("CHAOS CHECK " + ("PASSED" if summary["passed"]
+                                else "FAILED"))
         return 0 if summary["passed"] else 1
     if args.worker and args.autoscale:
         # one autoscale rank: the verdict file is the output

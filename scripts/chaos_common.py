@@ -59,6 +59,73 @@ def load_bench_gate():
                        os.path.join(REPO, "scripts", "bench_gate.py"))
 
 
+def decided_reader(elastic_dir: str, ns: str = "elastic"):
+    """``fn(n) -> parsed durable decision record e{n}`` (None when
+    absent/torn) — the jax-free phase-sequencing surface every storm
+    parent watches, exactly as an external operator would (the signed
+    world-delta commits under ``{dir}/dearel/{ns}/decided/e*``)."""
+    base = os.path.join(elastic_dir, "dearel", ns, "decided")
+
+    def decided(n: int):
+        try:
+            with open(os.path.join(base, f"e{int(n)}")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+    return decided
+
+
+def run_fleet(sup, *, deadline_s: float, poll_s: float = 0.1,
+              on_poll: Callable[[], None] = None):
+    """Supervise a storm fleet to completion: reap/relaunch via
+    ``sup.poll()`` until every rank exits, killing everything at the
+    deadline. Returns ``(rc, elapsed_s)`` — rc 124 on deadline, else 1
+    iff any rank's FINAL run exited nonzero. ``on_poll`` runs each
+    iteration (the storm parents' phase machines)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    deadline = t0 + float(deadline_s)
+    rc = None
+    while True:
+        alive = sup.poll()
+        if not alive:
+            break
+        if _time.monotonic() >= deadline:
+            sup.kill_all()
+            rc = 124
+            break
+        if on_poll is not None:
+            on_poll()
+        _time.sleep(poll_s)
+    if rc is None:
+        bad = {r: c for r, c in sup._final_rc.items() if c != 0}
+        rc = 1 if bad else 0
+    return rc, _time.monotonic() - t0
+
+
+def collect_verdicts(workdir: str):
+    """``(lives, finals)``: every ``verdict_rank*.json`` under
+    ``workdir`` grouped per rank in (mtime, filename) order — churned
+    ranks write one verdict per LIFE; ``finals`` maps each rank to its
+    newest. The filename tie-break keeps two same-mtime files orderable
+    (dicts do not compare)."""
+    lives: dict = {}
+    for name in sorted(os.listdir(workdir)):
+        if not (name.startswith("verdict_rank")
+                and name.endswith(".json")):
+            continue
+        path = os.path.join(workdir, name)
+        with open(path) as f:
+            v = json.load(f)
+        lives.setdefault(int(v["rank"]), []).append(
+            (os.path.getmtime(path), name, v))
+    for vs in lives.values():
+        vs.sort(key=lambda t: t[:2])
+    lives = {r: [v for _t, _n, v in vs] for r, vs in lives.items()}
+    return lives, {r: vs[-1] for r, vs in lives.items()}
+
+
 def capacity_writer(path: str) -> Callable[[dict], None]:
     """Atomic JSON writes to the `ScalePolicy` capacity file (the env
     contract standing in for a spot-pool API)."""

@@ -27,7 +27,8 @@ from dear_pytorch_tpu.analysis.rules_registry import (
     CounterDocsRule, EnvRegistryRule,
 )
 from dear_pytorch_tpu.analysis.rules_trace import (
-    DonationAliasRule, HotPathSyncRule, UngatedTelemetryRule,
+    DcnBlockingRule, DonationAliasRule, HotPathSyncRule,
+    UngatedTelemetryRule,
 )
 
 REPO = repo_root()
@@ -288,6 +289,42 @@ def test_bare_except_red_and_green(tmp_path):
     found = _findings(tmp_path, BareExceptHotPathRule())
     assert [(f.path, f.key) for f in found] == [
         ("dear_pytorch_tpu/serving/red.py", "Exception")]
+
+
+def test_dcn_blocking_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/red.py", """
+        class R:
+            def publish(self):
+                with self._lock:
+                    self._transport.get("k", 5.0)   # RED: peer wait
+                    #                                 under a lock
+
+            def _fetch(self):
+                return self.dcn.exchange(0, {})     # RED: reachable
+
+            def step(self, state, batch):
+                return self._fetch()
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/green.py", """
+        class G:
+            def publish(self):
+                val = self._transport.get("k", 5.0)  # green: no lock,
+                with self._lock:                     # not on a hot path
+                    self.cache = val
+
+            def offline_audit(self):
+                # green: not reachable from any step/tick entry
+                return self._transport.get("k", 5.0)
+
+            def step(self, state):
+                return self.cfg.get("mode")          # green: dict get,
+                #                                      not a transport
+    """)
+    found = _findings(tmp_path, DcnBlockingRule())
+    assert {(f.path, f.qualname, f.key) for f in found} == {
+        ("dear_pytorch_tpu/x/red.py", "R.publish", "self._transport.get"),
+        ("dear_pytorch_tpu/x/red.py", "R._fetch", "self.dcn.exchange"),
+    }
 
 
 def test_env_registry_both_directions(tmp_path):

@@ -145,7 +145,10 @@ def test_concurrent_failure_during_reconfig_widens():
     for v in out:
         assert v.reconfigured and v.epoch == 1
         assert v.members == (0, 1)
-        assert v.lost == (3,)  # the sync loss; 2 was absorbed mid-reconfig
+        # the verdict reports the COMMITTED removal: the sync loss (3)
+        # plus the mid-reconfig absorption (2) — everything this epoch
+        # actually dropped, which is also what slice closure needs
+        assert v.lost == (2, 3)
     assert ms[0].members == (0, 1) and ms[0].epoch == 1
 
 

@@ -1,0 +1,626 @@
+"""Multi-slice hierarchical training: the two-level DeAR schedule
+(RS+AG over ICI + host-level DCN cross-slice exchange, `comm.dcn` +
+`parallel.build_train_step(dcn=...)`), slice-granular elastic membership
+(`resilience.membership` with ``ranks_per_slice``), the slice-targetable
+DCN fault kinds, the multislice plan-space axes, and the nested-mesh
+reshard/repack determinism the elastic transitions rely on.
+
+The ISSUE-15 acceptance numerics live here (`test_hier_matches_flat_dear`
+pins the hierarchical schedule against flat ``dear`` on the same
+8-device world at dtype tolerance); the end-to-end acceptance storm is
+`scripts/chaos_check.py --multislice`, driven in tier-1 by
+``test_chaos_check_multislice_storm`` at the bottom.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dear_pytorch_tpu.comm.dcn import (
+    DcnExchanger, DcnPeerTimeout,
+)
+from dear_pytorch_tpu.ops import fusion as F
+from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+from dear_pytorch_tpu.parallel import dear as D
+from dear_pytorch_tpu.resilience import cluster as CL
+from dear_pytorch_tpu.resilience import membership as M
+from dear_pytorch_tpu.resilience.inject import (
+    Fault, FaultInjector, parse_faults,
+)
+from dear_pytorch_tpu.runtime import build as RB
+from dear_pytorch_tpu.runtime import pipeline as P
+
+
+def _mlp_params(key):
+    k1, k2 = jax.random.split(key)
+    return {"w1": jax.random.normal(k1, (12, 16)) * 0.1,
+            "b1": jnp.zeros((16,)),
+            "w2": jax.random.normal(k2, (16, 4)) * 0.1,
+            "b2": jnp.zeros((4,))}
+
+
+def _loss_fn(p, batch):
+    h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
+    return jnp.mean(jnp.square(h @ p["w2"] + p["b2"]))
+
+
+def _hier_pair(*, gather_dtype=None, comm_dtype=None, partition_mb=0.0001,
+               threshold_mb=0.0002):
+    """(flat ts, hier ts, exchanger): same optimizer/init on the same
+    8-device world — flat 1x8 vs nested 2 slices x 4 ICI."""
+    params = _mlp_params(jax.random.PRNGKey(0))
+    devs = np.asarray(jax.devices())
+    flat = D.build_train_step(
+        _loss_fn, params, mesh=jax.sharding.Mesh(devs, ("dp",)),
+        axis_name="dp", threshold_mb=threshold_mb, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        gather_dtype=gather_dtype, comm_dtype=comm_dtype)
+    dcn = DcnExchanger(CL.LocalTransport(), local_slices=(0, 1),
+                       slices=(0, 1), partition_mb=partition_mb)
+    hier = D.build_train_step(
+        _loss_fn, params,
+        mesh=jax.sharding.Mesh(devs.reshape(2, 4), ("slice", "ici")),
+        axis_name="ici", threshold_mb=threshold_mb, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+        gather_dtype=gather_dtype, comm_dtype=comm_dtype,
+        dcn=dcn, dcn_slice_axis="slice", partition_mb=partition_mb)
+    return params, flat, hier, dcn
+
+
+# -- the acceptance numerics: hierarchical == flat dear -----------------------
+
+
+def test_hier_matches_flat_dear():
+    """ISSUE-15 acceptance: per-bucket RS+AG over the intra-slice axis
+    plus the host DCN averaging reproduces flat `dear` on the same fixed
+    8-device world at dtype tolerance, multi-step, parameters included.
+    """
+    params, flat, hier, dcn = _hier_pair()
+    assert hier.plan.world == 4 and flat.plan.world == 8  # ZeRO degrees
+    sf, sh = flat.init(params), hier.init(params)
+    batch = {"x": jax.random.normal(jax.random.PRNGKey(7), (16, 12))}
+    for i in range(5):
+        sf, mf = flat.step(sf, batch)
+        sh, mh = hier.step(sh, batch)
+        assert abs(float(mf["loss"]) - float(mh["loss"])) < 1e-5, i
+    pf = jax.device_get(flat.gather_params(sf))
+    ph = jax.device_get(hier.gather_params(sh))
+    for k in pf:
+        np.testing.assert_allclose(pf[k], ph[k], atol=2e-6, rtol=2e-6)
+    assert dcn.exchanges == 5
+
+
+def test_hier_matches_flat_dear_bf16_gather():
+    """The gather-dtype wire cast composes with the hierarchical split
+    the same way it does with flat dear (bf16 tolerance)."""
+    params, flat, hier, _ = _hier_pair(gather_dtype=jnp.bfloat16)
+    sf, sh = flat.init(params), hier.init(params)
+    batch = {"x": jax.random.normal(jax.random.PRNGKey(3), (16, 12))}
+    for _ in range(3):
+        sf, mf = flat.step(sf, batch)
+        sh, mh = hier.step(sh, batch)
+        assert abs(float(mf["loss"]) - float(mh["loss"])) < 2e-2
+    pf = jax.device_get(flat.gather_params(sf))
+    ph = jax.device_get(hier.gather_params(sh))
+    for k in pf:
+        np.testing.assert_allclose(pf[k], ph[k], atol=5e-2, rtol=5e-2)
+
+
+def test_hier_build_guards():
+    """Every multislice-illegal combination is rejected loudly at
+    plan-build (PR-8 guard style), and multi_step refuses to scan the
+    host leg."""
+    params = _mlp_params(jax.random.PRNGKey(0))
+    devs = np.asarray(jax.devices())
+    mesh = jax.sharding.Mesh(devs.reshape(2, 4), ("slice", "ici"))
+    dcn = DcnExchanger(CL.LocalTransport(), local_slices=(0, 1),
+                       slices=(0, 1))
+    kw = dict(mesh=mesh, axis_name="ici", dcn=dcn,
+              dcn_slice_axis="slice", threshold_mb=0.0002, donate=False)
+
+    with pytest.raises(ValueError, match="DCN boundary"):
+        D.build_train_step(_loss_fn, params, mode="dear-fused", **kw)
+    with pytest.raises(ValueError, match="compression"):
+        D.build_train_step(_loss_fn, params, compressor="eftopk",
+                           density=0.1, **kw)
+    with pytest.raises(ValueError, match="clip_norm"):
+        D.build_train_step(_loss_fn, params, clip_norm=1.0, **kw)
+    with pytest.raises(ValueError, match="model_state"):
+        D.build_train_step(_loss_fn, params,
+                           model_state_template={"n": jnp.zeros(())}, **kw)
+    with pytest.raises(ValueError, match="has_aux"):
+        D.build_train_step(_loss_fn, params, has_aux=True, **kw)
+    with pytest.raises(ValueError, match="hierarchical"):
+        D.build_train_step(_loss_fn, params, mode="allreduce", **kw)
+    # the mesh must carry the slice axis, sized to the LOCAL slices
+    with pytest.raises(ValueError, match="nested mesh"):
+        D.build_train_step(
+            _loss_fn, params, dcn=dcn, dcn_slice_axis="slice",
+            axis_name="dp", threshold_mb=0.0002, donate=False,
+            mesh=jax.sharding.Mesh(devs, ("dp",)))
+    ts = D.build_train_step(_loss_fn, params, **kw)
+    with pytest.raises(ValueError, match="multi_step"):
+        ts.multi_step(4)
+
+
+# -- the exchanger ------------------------------------------------------------
+
+
+def _run2(fa, fb, join_s=30):
+    out, err = [None, None], [None, None]
+
+    def w(i, f):
+        try:
+            out[i] = f()
+        except BaseException as exc:  # noqa: BLE001 - asserted below
+            err[i] = exc
+    ts = [threading.Thread(target=w, args=(i, f))
+          for i, f in enumerate((fa, fb))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join_s)
+    return out, err
+
+
+def test_dcn_remote_roundtrip_bitwise_identical():
+    """Two single-slice hosts exchange over one shared transport: both
+    compute the same mean, BITWISE identical (sorted-slice accumulation
+    — different local/remote splits must not change float order), with
+    per-fetch timing samples recorded for the link fit."""
+    tr = CL.LocalTransport()
+    ex0 = DcnExchanger(tr, local_slices=(0,), slices=(0, 1),
+                       partition_mb=0.00002, timeout_s=10.0)
+    ex1 = DcnExchanger(tr, local_slices=(1,), slices=(0, 1),
+                       partition_mb=0.00002, timeout_s=10.0)
+    rng = np.random.default_rng(0)
+    b0 = [rng.normal(size=24).astype(np.float32),
+          rng.normal(size=8).astype(np.float32)]
+    b1 = [rng.normal(size=24).astype(np.float32),
+          rng.normal(size=8).astype(np.float32)]
+    out, err = _run2(
+        lambda: ex0.exchange(0, {0: b0}, {0: 1.25}),
+        lambda: ex1.exchange(0, {1: b1}, {1: 0.75}))
+    assert not any(err), err
+    (m0, s0), (m1, s1) = out
+    for g in range(2):
+        np.testing.assert_array_equal(m0[g], m1[g])
+        np.testing.assert_allclose(m0[g], (b0[g] + b1[g]) / 2.0,
+                                   rtol=1e-6)
+    assert s0 == s1 == 1.0
+    assert ex0.samples() and ex1.samples()
+    # several chunks per bucket at this partition (24 f32 = 96B > 84B)
+    assert all(b >= 0 for b, t in ex0.samples())
+
+
+def test_dcn_renorm_and_timeout(tmp_path):
+    """A renormalized (degraded) exchanger averages over the live set
+    only with NO peer traffic; a dead remote slice raises DcnPeerTimeout
+    within the deadline."""
+    tr = CL.FileTransport(str(tmp_path))
+    ex0 = DcnExchanger(tr, local_slices=(0,), slices=(0, 1),
+                       timeout_s=0.3)
+    ex0.set_slices((0,), epoch=1)
+    buf = [np.ones(8, np.float32) * 3.0]
+    means, sm = ex0.exchange(0, {0: buf}, {0: 2.0})
+    np.testing.assert_allclose(means[0], buf[0])
+    assert sm == 2.0
+    # back at full membership with nobody home on slice 1: timeout
+    ex0.set_slices((0, 1), epoch=2)
+    t0 = time.monotonic()
+    with pytest.raises(DcnPeerTimeout):
+        ex0.exchange(1, {0: buf}, {0: 2.0})
+    assert time.monotonic() - t0 < 5.0
+    with pytest.raises(ValueError, match="local slice"):
+        ex0.set_slices((1,), epoch=3)
+
+
+def test_dcn_drop_and_slow_faults():
+    """dcn_drop suppresses one outbound publish (the peer's fetch times
+    out; the replay publishes); dcn_slow arms a persistent latency."""
+    tr = CL.LocalTransport()
+    inj = FaultInjector(parse_faults("dcn_drop@1:s0,dcn_slow@2:0.05:s0"),
+                        own_rank=0, own_slice=0)
+    ex0 = DcnExchanger(tr, local_slices=(0,), slices=(0, 1),
+                       timeout_s=0.4, injector=inj)
+    ex1 = DcnExchanger(tr, local_slices=(1,), slices=(0, 1),
+                       timeout_s=0.4)
+    b = [np.ones(4, np.float32)]
+    out, err = _run2(
+        lambda: ex0.exchange(0, {0: b}),   # publish dropped
+        lambda: ex1.exchange(0, {1: b}))
+    # slice 0 still FETCHED slice 1's publish fine; slice 1 timed out
+    assert err[0] is None or isinstance(err[0], DcnPeerTimeout)
+    assert isinstance(err[1], DcnPeerTimeout)
+    # the replay (same step) re-publishes: both sides converge
+    t0 = time.monotonic()
+    out, err = _run2(
+        lambda: ex0.exchange(0, {0: b}),
+        lambda: ex1.exchange(0, {1: b}))
+    assert not any(err), err
+    assert time.monotonic() - t0 >= 0.05   # the armed straggler latency
+    assert inj.dcn_slow_s == 0.05
+
+
+def test_slice_fault_grammar():
+    fs = parse_faults("dcn_slow@3:0.5:s1,nan@6:r2")
+    assert fs[0].slice_id == 1 and fs[0].rank is None
+    assert fs[1].rank == 2 and fs[1].slice_id is None
+    with pytest.raises(ValueError, match="rank OR a slice"):
+        Fault(kind="nan", step=1, rank=0, slice_id=0)
+    with pytest.raises(ValueError, match="sSLICE"):
+        parse_faults("nan@6:sx")
+    # own_slice resolves from the elastic env contract
+    inj = FaultInjector(parse_faults("exc@1:s1"))
+    os.environ["DEAR_ELASTIC_RANK"] = "5"
+    os.environ["DEAR_ELASTIC_RANKS_PER_SLICE"] = "4"
+    try:
+        assert inj.own_slice == 1
+    finally:
+        del os.environ["DEAR_ELASTIC_RANK"]
+        del os.environ["DEAR_ELASTIC_RANKS_PER_SLICE"]
+
+
+# -- slice-granular membership ------------------------------------------------
+
+
+def _make_members(n, *, rps, timeout_s=1.0):
+    tr = CL.LocalTransport(n)
+    return tr, [
+        M.ElasticCluster(rank=r, members=range(n), transport=tr,
+                         timeout_s=timeout_s, ranks_per_slice=rps)
+        for r in range(n)
+    ]
+
+
+def _threads(fns, join_s=60):
+    res, errs = [None] * len(fns), [None] * len(fns)
+
+    def w(i):
+        try:
+            res[i] = fns[i]()
+        except BaseException as exc:  # noqa: BLE001
+            errs[i] = exc
+    ts = [threading.Thread(target=w, args=(i,)) for i in range(len(fns))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join_s)
+    return res, errs
+
+
+def test_whole_slice_loss_commits_one_epoch():
+    """Both ranks of slice 1 vanish: the survivors commit EXACTLY one
+    membership epoch removing the whole slice, with a slice-shaped
+    signed delta in the durable decision record."""
+    tr, ms = _make_members(4, rps=2, timeout_s=0.5)
+    out, errs = _threads([
+        (lambda c=ms[0]: c.health_check(True, step=3)),
+        (lambda c=ms[1]: c.health_check(True, step=3)),
+    ])
+    assert not any(errs), errs
+    for v in out:
+        assert v.reconfigured and v.epoch == 1
+        assert v.members == (0, 1) and v.lost == (2, 3)
+    assert ms[0].slices == (0,)
+    rec = json.loads(tr.get(f"{ms[0]._ns}/decided/e1", 0.1))
+    assert rec["delta"]["removed"] == [2, 3]
+    assert rec["delta"]["slices"] == {"added": [], "removed": [1]}
+    # exactly one epoch: no e2 was ever decided
+    with pytest.raises(CL.PeerTimeout):
+        tr.get(f"{ms[0]._ns}/decided/e2", 0.05)
+
+
+def test_partial_slice_loss_widens_to_the_slice():
+    """One rank of slice 1 dies; its live slice-mate is widened into the
+    dead set (the slice's ICI mesh is broken) and self-evicts for
+    relaunch+rejoin, while the surviving slice commits one epoch."""
+    tr, ms = _make_members(4, rps=2, timeout_s=0.5)
+    out, errs = _threads([
+        (lambda c=ms[0]: c.health_check(True, step=3)),
+        (lambda c=ms[1]: c.health_check(True, step=3)),
+        (lambda c=ms[2]: c.health_check(True, step=3)),  # slice-mate of 3
+    ])
+    assert errs[0] is None and errs[1] is None
+    assert isinstance(errs[2], M.EvictedError)
+    for v in out[:2]:
+        assert v.epoch == 1 and v.members == (0, 1) and v.lost == (2, 3)
+
+
+def test_slice_gated_admission_defers_partial_slice():
+    """A relaunched slice readmits only when COMPLETE: a lone rank's
+    request is deferred (left in the store), and the full slice lands as
+    ONE admission epoch at the barrier."""
+    tr, ms = _make_members(4, rps=2, timeout_s=1.0)
+    _threads([
+        (lambda c=ms[0]: c.health_check(True, step=1)),
+        (lambda c=ms[1]: c.health_check(True, step=1)),
+    ])
+    assert ms[0].epoch == 1 and ms[0].members == (0, 1)
+    # rank 2 alone requests: deferred, request NOT consumed
+    tr.set(f"{ms[0]._ns}/rejoin/req/2",
+           json.dumps({"rank": 2, "last_epoch": 0, "nonce": "aa"}))
+    out, errs = _threads([
+        (lambda c=ms[0]: c.health_check(True, step=2)),
+        (lambda c=ms[1]: c.health_check(True, step=2)),
+    ])
+    assert not any(errs), errs
+    assert all(not v.membership_changed and v.admitted == () for v in out)
+    assert ms[0].epoch == 1
+    assert tr.get(f"{ms[0]._ns}/rejoin/req/2", 0.05)  # still pending
+    # rank 3 joins the request set: the whole slice admits as ONE epoch
+    tr.set(f"{ms[0]._ns}/rejoin/req/3",
+           json.dumps({"rank": 3, "last_epoch": 0, "nonce": "bb"}))
+    rejoiners = [
+        M.ElasticCluster(rank=r, members=range(4), transport=tr,
+                         timeout_s=1.0, ranks_per_slice=2)
+        for r in (2, 3)
+    ]
+
+    def _rejoin(c, nonce):
+        ack = json.loads(tr.get(f"{c._ns}/rejoin/ack/{c.rank}/{nonce}",
+                                10.0))
+        c._commit(int(ack["epoch"]), ack["members"])
+        c.exchange("admit.barrier", "{}")
+        return c.view()
+
+    out, errs = _threads([
+        (lambda c=ms[0]: c.health_check(True, step=3)),
+        (lambda c=ms[1]: c.health_check(True, step=3)),
+        (lambda c=rejoiners[0]: _rejoin(c, "aa")),
+        (lambda c=rejoiners[1]: _rejoin(c, "bb")),
+    ])
+    assert not any(errs), errs
+    assert out[0].admitted == (2, 3) and out[0].epoch == 2
+    rec = json.loads(tr.get(f"{ms[0]._ns}/decided/e2", 0.1))
+    assert rec["delta"]["slices"] == {"added": [1], "removed": []}
+    assert out[2].slices == (0, 1) and out[2].slice_id == 1
+
+
+def test_view_slice_data_shard():
+    """Slice-granular views expose the SLICE as the data-parallel slot:
+    a slice's ranks are replicas of one shard."""
+    _, ms = _make_members(4, rps=2)
+    v = ms[3].view()
+    assert v.slices == (0, 1) and v.slice_id == 1
+    assert v.data_shard == 1 and v.data_world == 2
+    assert v.index == 3 and v.world == 4   # rank-granular fields intact
+    # rank-granular views keep member-position sharding
+    rv = M.MembershipView(epoch=0, members=(0, 1), rank=1, index=1,
+                          world=2)
+    assert rv.data_shard == 1 and rv.data_world == 2
+
+
+def test_slice_drain_closure():
+    """A spot SIGTERM on ONE rank of a slice drains the whole slice: the
+    announcing rank self-drains cleanly, its slice-mate exits for
+    relaunch (EvictedError), the other slice commits one planned-shrink
+    epoch."""
+    tr, ms = _make_members(4, rps=2, timeout_s=1.0)
+    out, errs = _threads([
+        (lambda c=ms[0]: c.health_check(True, step=5)),
+        (lambda c=ms[1]: c.health_check(True, step=5)),
+        (lambda c=ms[2]: c.health_check(True, step=5)),  # slice-mate
+        (lambda c=ms[3]: c.health_check(True, step=5, draining=True)),
+    ])
+    assert errs[0] is None and errs[1] is None and errs[3] is None
+    assert isinstance(errs[2], M.EvictedError)
+    assert out[3].self_draining and out[3].drained == (2, 3)
+    for v in out[:2]:
+        assert v.reconfigured and v.members == (0, 1) and v.epoch == 1
+
+
+# -- satellite: nested-mesh reshard/repack determinism ------------------------
+
+
+def test_pipeline_reshard_slice_delta_determinism():
+    """`reshard()` across a SLICE-COUNT change (2 -> 1 -> 2 data shards,
+    arriving as single membership events, never N rank events) is a pure
+    function of (seed, epoch, shard, world): two consumers with
+    DIFFERENT histories that derive the same slice assignment land on
+    bitwise-identical streams — what lets every surviving (or
+    rejoining) rank of a slice reshard independently, no coordination.
+    """
+    spec = P.SyntheticSpec((
+        P.Field("x", (8, 4), RB.KIND_NORMAL_F32, 0.0, 1.0),
+    ))
+
+    def batches(pipe, n=3):
+        return [np.asarray(pipe.next()["x"]) for _ in range(n)]
+
+    # survivor A consumed 3 batches pre-shrink, survivor B consumed 5 —
+    # after the SAME slice-delta reshard their streams must agree
+    a = P.NumpyPipeline(spec, seed=9, shard=0, num_shards=2)
+    b = P.NumpyPipeline(spec, seed=9, shard=0, num_shards=2)
+    batches(a, 3)
+    batches(b, 5)
+    a.reshard(0, 1, epoch=1)             # slice loss: one event, 2 -> 1
+    b.reshard(0, 1, epoch=1)
+    for xa, xb in zip(batches(a), batches(b)):
+        np.testing.assert_array_equal(xa, xb)
+    # the rejoining slice's consumer (fresh process, zero history)
+    # derives the identical full-membership stream as the survivor
+    a.reshard(1, 2, epoch=2)             # slice rejoin: 1 -> 2, slot 1
+    c = P.NumpyPipeline(spec, seed=9, shard=1, num_shards=2)
+    c.reshard(1, 2, epoch=2)
+    for xa, xc in zip(batches(a), batches(c)):
+        np.testing.assert_array_equal(xa, xc)
+    # and a different epoch is a DIFFERENT stream (no stale replay)
+    d = P.NumpyPipeline(spec, seed=9, shard=1, num_shards=2)
+    d.reshard(1, 2, epoch=3)
+    assert not np.array_equal(batches(a, 1)[0], batches(d, 1)[0])
+
+
+def test_repack_comp_state_across_slice_delta_world_change():
+    """`_repack_comp_state` with a world change arriving as ONE
+    slice-shaped delta (8 -> 4: half the world in one event) keeps the
+    error-feedback mass invariant: sum(rows)/world — the residuals'
+    contribution to the mean gradient — is exactly preserved."""
+    from dear_pytorch_tpu.tuning.autotune import _repack_comp_state
+
+    tmpl = {"a": np.zeros((40,), np.float32),
+            "b": np.zeros((24,), np.float32)}
+    old_plan = F.make_plan(tmpl, 8, threshold_mb=0.0001)
+    new_plan = F.rescale_plan(old_plan, 4, epoch=1)
+    rng = np.random.default_rng(5)
+    old = tuple(
+        jnp.asarray(rng.normal(size=(8, b.padded_size)).astype(np.float32))
+        for b in old_plan.buckets)
+    fresh = tuple(
+        jnp.zeros((4, b.padded_size), jnp.float32)
+        for b in new_plan.buckets)
+    out = _repack_comp_state(old, fresh, old_plan, new_plan)
+    # mass per PARAMETER element, not per padded slot (padding moved)
+    def mass(entries, plan, world):
+        leaves = {}
+        for bi, e in enumerate(entries):
+            arr = np.asarray(e)
+            total = arr.sum(axis=0) / world
+            for lid, piece in F.unpack_bucket(
+                    jnp.asarray(total), plan, bi).items():
+                leaves[lid] = np.asarray(piece)
+        return leaves
+
+    m_old = mass(old, old_plan, 8)
+    m_new = mass(out, new_plan, 4)
+    for lid in m_old:
+        np.testing.assert_allclose(m_new[lid], m_old[lid], atol=1e-6)
+
+
+# -- satellite: the multislice plan space -------------------------------------
+
+
+def test_planspace_multislice_axes_and_guards():
+    from dear_pytorch_tpu.tuning.planspace import (
+        CostModel, PlanConfig, PlanSpace,
+    )
+
+    sp = PlanSpace(num_slices=2, partition_mbs=(None, 1.0, 4.0))
+    names = [a.name for a in sp.axes()]
+    assert "partition_mb" in names
+    # illegal combos rejected loudly, PR-8 guard style
+    assert "DCN" in (sp.feasible(PlanConfig(mode="dear-fused")) or "")
+    assert sp.feasible(PlanConfig(compressor="qint8")) is not None
+    assert PlanSpace().feasible(PlanConfig(partition_mb=2.0)) is not None
+    with pytest.raises(ValueError, match="multi-slice"):
+        PlanSpace(partition_mbs=(1.0,))
+    cfgs = sp.configs(8.0)
+    assert {c.partition_mb for c in cfgs} == {None, 1.0, 4.0}
+    assert all(c.mode == "dear" and c.compressor is None for c in cfgs)
+    # and the BUILD guard agrees with the space's feasibility rule
+    params = _mlp_params(jax.random.PRNGKey(0))
+    devs = np.asarray(jax.devices())
+    dcn = DcnExchanger(CL.LocalTransport(), local_slices=(0, 1),
+                       slices=(0, 1))
+    with pytest.raises(ValueError, match="DCN"):
+        D.build_train_step(
+            _loss_fn, params, mode="dear-fused", dcn=dcn,
+            dcn_slice_axis="slice", axis_name="ici", donate=False,
+            mesh=jax.sharding.Mesh(devs.reshape(2, 4), ("slice", "ici")))
+
+    # link-aware pricing: a slower-alpha DCN fit separates partition
+    # arms (more chunks -> more per-message cost), and the same config
+    # under one blind fit would not
+    tmpl = {"w": np.zeros((4096,), np.float32)}
+    cm = CostModel(lambda thr: F.make_plan(tmpl, 2, threshold_mb=thr),
+                   1e-6, 1e-9, num_slices=2,
+                   dcn_alpha=1e-3, dcn_beta=1e-8)
+    fine = cm.comm(PlanConfig(threshold_mb=8.0, partition_mb=0.001))
+    coarse = cm.comm(PlanConfig(threshold_mb=8.0, partition_mb=None))
+    assert fine > coarse
+
+
+def test_accounting_dcn_leg_rows():
+    from dear_pytorch_tpu.observability import counters as CTR
+    from dear_pytorch_tpu.observability.overlap import predict_leg_times
+
+    tmpl = {"w": np.zeros((1024,), np.float32)}
+    plan = F.make_plan(tmpl, 4, threshold_mb=0.001)
+    acct = CTR.plan_comm_accounting(plan, num_slices=3,
+                                    dcn_partition_mb=0.001)
+    dcn_rows = [r for r in acct.rows if r.leg == "dcn"]
+    assert len(dcn_rows) == plan.num_buckets
+    for r in dcn_rows:
+        chunks = len(F.chunk_bounds(r.padded_elements, 4, 0.001))
+        assert r.messages == chunks * 2          # (num_slices - 1)
+        assert r.wire_bytes == r.payload_bytes * 3
+    # link-aware pricing prices dcn rows with the dcn fit
+    t_ici = predict_leg_times(acct, 1e-6, 1e-9)
+    t_dcn = predict_leg_times(acct, 1e-6, 1e-9, dcn_alpha=1e-3,
+                              dcn_beta=1e-7)
+    for row, a, b in zip(acct.rows, t_ici, t_dcn):
+        assert (b > a) == (row.leg == "dcn")
+
+
+def test_chunk_bounds_contract():
+    assert F.chunk_bounds(10, 4, None) == [(0, 10)]
+    assert F.chunk_bounds(0, 4, 1.0) == []
+    per = int(0.001 * 2**20) // 4
+    bounds = F.chunk_bounds(per * 2 + 3, 4, 0.001)
+    assert bounds[0] == (0, per) and bounds[-1][1] == per * 2 + 3
+    assert all(hi - lo <= per for lo, hi in bounds)
+
+
+# -- the supervisor's slice contract ------------------------------------------
+
+
+def test_supervisor_slice_aligned_scale_up(tmp_path, monkeypatch):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "sup", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "launch", "supervisor.py"))
+    sup_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sup_mod)
+    with pytest.raises(ValueError, match="whole number of slices"):
+        sup_mod.ElasticSupervisor(6, ["true"], elastic_dir=str(tmp_path),
+                                  ranks_per_slice=4)
+    sup = sup_mod.ElasticSupervisor(8, ["true"],
+                                    elastic_dir=str(tmp_path),
+                                    ranks_per_slice=4)
+    spawned = []
+    monkeypatch.setattr(sup, "_spawn",
+                        lambda rank, rejoin: spawned.append(rank)
+                        or sup._ever_ranks.add(rank))
+    # fresh slice ids mint on slice boundaries (8..11), never mid-group
+    assert sup.scale_up(2) == [8, 9]
+    assert sup.scale_up(1) == [10]
+
+
+# -- the acceptance storm -----------------------------------------------------
+
+
+@pytest.mark.timeout(640, method="signal")
+def test_chaos_check_multislice_storm(tmp_path):
+    """scripts/chaos_check.py --multislice: the ISSUE-15 acceptance gate.
+    A 2-slice x 4-rank supervised fleet trains the hierarchical RS+AG
+    (ICI) + DCN schedule; the whole of slice 1 is SIGKILLed mid-step and
+    must commit as EXACTLY ONE membership epoch (slice-shaped signed
+    delta); the survivors renormalize the cross-slice leg and train
+    degraded under a slice-targeted dcn_slow straggler fault; the
+    relaunched slice hydrates from the remote tier and readmits through
+    the slice-gated admission as one epoch; the fleet finishes in
+    lockstep at full membership with zero loss of progress past the
+    newest uploaded checkpoint. All coordination over `FileTransport`;
+    no `jax.distributed`."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "chaos_check.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, "--multislice", "--checkpoint-every",
+         "2", "--workdir", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]

@@ -209,7 +209,7 @@ def test_plan_tuner_fatal_infeasible_retires_arm():
     tuner.mark_infeasible(bad, revert_to=PlanConfig(), fatal=True,
                           why="build raised ValueError")
     assert tuner.current == PlanConfig()
-    assert ("dear-fused", None, None, None, None) in tuner._dead
+    assert bad.key() in tuner._dead
     assert tracer.counts["tune.infeasible"] == 1
     # a build failure costs milliseconds, not a measurement window: the
     # arm retirement must NOT consume a trial from the search budget
@@ -423,7 +423,7 @@ def test_diverging_trial_reverts_without_guard_rollback(
         assert guard.recoveries == 0
         # the bad arm carries only dominated (penalty) observations —
         # 10x the worst feasible measurement, never a real timing
-        nan_key = ("dear", "nan8", None, None, None)
+        nan_key = ("dear", "nan8", None, None, None, None)
         nan_obs = at.planner._obs.get(nan_key, [])
         assert nan_obs, "the bad arm was never penalized"
         worst_feasible = max(at.planner._feasible_ys)
