@@ -38,6 +38,7 @@ from dear_pytorch_tpu.analysis.rules_host import (
 from dear_pytorch_tpu.analysis.rules_registry import (
     CounterDocsRule, EnvRegistryRule,
 )
+from dear_pytorch_tpu.analysis.rules_sim import SimDeterminismRule
 from dear_pytorch_tpu.analysis.rules_trace import (
     DcnBlockingRule, DonationAliasRule, HotPathSyncRule,
     UngatedTelemetryRule,
@@ -54,7 +55,7 @@ ALL_RULES = (
     LockHeldIORule, AtomicWriteRule, HotPathSyncRule,
     UngatedTelemetryRule, SignalHandlerImportRule, DonationAliasRule,
     EnvRegistryRule, CounterDocsRule, BareExceptHotPathRule,
-    DcnBlockingRule,
+    DcnBlockingRule, SimDeterminismRule,
 )
 
 

@@ -1,0 +1,124 @@
+"""Simulator-determinism rule: no wall clock, no unseeded randomness.
+
+dearsim's contract (docs/SIM.md) is that identical ``(inputs, seed)``
+produce byte-identical artifacts: the bench/serving gates replay
+simulated runs the way they replay recorded ones, and a sim result
+that varies with the host clock or the process RNG cannot be diffed,
+cached, or bisected. The virtual clock (`SimTransport.now_s`,
+`VirtualClock`) is the ONLY time source the event model may read, and
+every RNG must be constructed from an explicit seed.
+
+The rule is scoped to ``dear_pytorch_tpu/observability/sim.py`` alone
+— tests and scripts measure real wall time *around* the sim (the storm
+budget assertion is the point), and the rest of the tree legitimately
+reads clocks. What gates inside sim.py:
+
+- wall-clock reads: ``time.time/monotonic/perf_counter[_ns]/sleep``,
+  ``datetime.now/utcnow/today``;
+- ambient-entropy identifiers: ``uuid.uuid1/3/4/5``, ``os.urandom``,
+  anything under ``secrets.``, ``random.SystemRandom``;
+- unseeded RNGs: zero-argument ``random.Random()`` /
+  ``np.random.default_rng()``, and any call on the *module-level*
+  ``random.*`` surface (those draw from the shared process RNG).
+
+Seeded constructors (``random.Random(seed)``, ``default_rng(seed)``)
+and real-time waits on threading primitives (``Event.wait(t)``,
+``thread.join(t)``, used by the virtual transport's wedge-healer) are
+allowed: the former are the contract, the latter only bound how long
+the host waits for simulated time to advance, never what it reads.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from dear_pytorch_tpu.analysis.core import (
+    Finding, Rule, Scanner, attr_chain,
+)
+
+__all__ = ["SimDeterminismRule"]
+
+#: the one module the determinism contract covers
+_SIM_RELPATH = "dear_pytorch_tpu/observability/sim.py"
+
+#: callee chains that read the host clock or calendar
+_WALL_CLOCK = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.sleep",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "date.today", "datetime.date.today",
+}
+
+#: callee chains that mint ambient entropy regardless of arguments
+_ENTROPY = {
+    "uuid.uuid1", "uuid.uuid3", "uuid.uuid4", "uuid.uuid5",
+    "os.urandom",
+    "random.SystemRandom",
+}
+
+#: RNG constructors that are fine seeded, gating when zero-argument
+_SEEDABLE_CTORS = {
+    "random.Random",
+    "np.random.default_rng", "numpy.random.default_rng",
+}
+
+
+class SimDeterminismRule(Rule):
+    """Wall-clock reads / unseeded RNG inside the dearsim event model.
+
+    Originating contract: ``simulate_training``/``simulate_serving``/
+    ``run_membership_storm`` must be pure functions of (inputs, seed)
+    so sim_check can gate simulated artifacts against recorded ones
+    and so a resumed/replayed run reproduces the original exactly.
+    One ``time.monotonic()`` in the DES loop silently re-couples the
+    "virtual seconds are free" property to host scheduling jitter.
+    """
+
+    name = "sim-determinism"
+    doc = ("no wall-clock read or unseeded RNG inside "
+           "observability/sim.py (virtual clock + explicit seeds only)")
+
+    def _violation(self, call: ast.Call) -> Optional[str]:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        if chain in _WALL_CLOCK:
+            return f"wall-clock read `{chain}()`"
+        if chain in _ENTROPY or chain.startswith("secrets."):
+            return f"ambient entropy `{chain}()`"
+        if chain in _SEEDABLE_CTORS:
+            if not call.args and not any(
+                    kw.arg in ("seed", "x") for kw in call.keywords):
+                return (f"unseeded RNG `{chain}()` — pass an explicit "
+                        f"seed")
+            return None
+        # module-level random.* functions (random.random, random.gauss,
+        # random.shuffle, ...) draw from the shared process-global RNG;
+        # instance methods on a seeded `rng` local don't match because
+        # their chain starts with the receiver name, not `random.`
+        if chain.startswith("random.") and chain.count(".") == 1:
+            return (f"process-global RNG `{chain}()` — use a seeded "
+                    f"`random.Random(seed)` instance")
+        return None
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        mod = scanner.module(_SIM_RELPATH)
+        if mod is None:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            why = self._violation(node)
+            if why is None:
+                continue
+            yield Finding(
+                rule=self.name, path=mod.relpath, line=node.lineno,
+                qualname=mod.qualname(node), key=attr_chain(node.func),
+                message=f"{why} breaks the (inputs, seed) -> artifact "
+                        f"determinism contract",
+            )

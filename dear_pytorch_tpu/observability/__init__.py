@@ -62,6 +62,17 @@ _LAZY = {
     "plan_comm_accounting": "counters",
     "audit_train_step": "overlap",
     "OverlapReport": "overlap",
+    # the α-β cost waist + its serializable fits (stdlib-only module,
+    # but kept lazy for symmetry — nothing hot-path needs it)
+    "CostModel": "costmodel",
+    "ServeCostModel": "costmodel",
+    "LinkFit": "costmodel",
+    "Calibration": "costmodel",
+    "load_calibration": "costmodel",
+    # the fleet-scale discrete-event simulator (docs/SIM.md)
+    "simulate_training": "sim",
+    "simulate_serving": "sim",
+    "SimTopology": "sim",
     # run-health layer
     "FlightRecorder": "flight",
     "NullFlightRecorder": "flight",

@@ -1,0 +1,355 @@
+"""The one α-β cost-model waist: every analytic price in the repo.
+
+Before this module the α-β machinery was scattered: `CostModel` and
+`ServeCostModel` lived in `tuning/planspace.py`, the ICI sweep
+normalization was inlined in `overlap.fit_interconnect`, and the DCN fit
+in `overlap.fit_dcn`. The simulator (`observability/sim.py`) needs all
+of them — it prices every event off the same constants the live tuners
+prune with — so they now live here behind one waist, with re-export
+shims at their old import paths (`tuning.planspace.CostModel`,
+`overlap.fit_dcn`, ...) so existing callers are unchanged.
+
+Contracts preserved from the old homes:
+
+* stdlib-only at module level. `tuning/planspace.py` is loaded
+  STANDALONE (importlib, no package) by
+  `scripts/check_telemetry_overhead.py` under a "no jax" contract, and
+  it re-exports these classes — so this file must execute without the
+  `dear_pytorch_tpu` package. Heavy imports (`counters`, `perf_model`)
+  stay lazy inside methods, exactly as they were in planspace.
+* `CostModel`/`ServeCostModel` calibration soundness: the floor must
+  UNDERestimate (minimum-residual compute, scale capped at 1) — see the
+  class docstrings; the bf16-trial incident is recorded there.
+
+New here: `LinkFit`/`Calibration` make the fits JSON-serializable so
+offline consumers (the simulator's ``--calibration perf/...`` flag)
+load a recorded (α, β) pair instead of re-measuring hardware. The JSON
+grammar accepts both the flat shape ``{"alpha": ..., "beta": ...}`` and
+the two-level shape ``{"ici": {...}, "dcn": {...}}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any, Callable, Optional, Sequence
+
+__all__ = [
+    "CostModel", "ServeCostModel", "LinkFit", "Calibration",
+    "load_calibration", "fit_allgather_sweep", "fit_dcn",
+    "DTYPE_ITEMSIZE",
+]
+
+#: wire itemsize per comm/gather dtype token (None = keep f32) — shared
+#: with `tuning.planspace._DTYPE_ITEMSIZE` (planspace aliases this one).
+DTYPE_ITEMSIZE = {None: 4, "bf16": 2, "f16": 2}
+
+
+# ---------------------------------------------------------------------------
+# serializable fits
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkFit:
+    """One (α, β) link fit: seconds per message plus seconds per byte.
+
+    ``source`` records provenance ("measured", "env", "file", "default")
+    and ``nsamples`` how many points backed the fit — both are carried
+    into dumps so a simulated report can say what its prices rest on."""
+
+    alpha: float
+    beta: float
+    source: str = "measured"
+    nsamples: int = 0
+
+    def to_dict(self) -> dict:
+        return {"alpha": self.alpha, "beta": self.beta,
+                "source": self.source, "nsamples": self.nsamples}
+
+    @classmethod
+    def from_dict(cls, d: dict, *, source: str = "file") -> "LinkFit":
+        return cls(alpha=float(d["alpha"]), beta=float(d["beta"]),
+                   source=str(d.get("source", source)),
+                   nsamples=int(d.get("nsamples", 0)))
+
+
+@dataclasses.dataclass(frozen=True)
+class Calibration:
+    """The per-level link fits one topology needs: intra-slice ICI and
+    (multi-slice only) cross-slice DCN. ICI and DCN α-β constants differ
+    by orders of magnitude — one fit cannot price both levels (the
+    FlexLink point, and why `CostModel` takes ``dcn_alpha/dcn_beta``
+    separately)."""
+
+    ici: LinkFit
+    dcn: Optional[LinkFit] = None
+
+    def to_dict(self) -> dict:
+        d: dict = {"ici": self.ici.to_dict()}
+        if self.dcn is not None:
+            d["dcn"] = self.dcn.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Calibration":
+        if "ici" not in d and "alpha" in d:
+            # flat legacy shape: one fit, assumed intra-slice
+            return cls(ici=LinkFit.from_dict(d))
+        dcn = d.get("dcn")
+        return cls(ici=LinkFit.from_dict(d["ici"]),
+                   dcn=None if dcn is None else LinkFit.from_dict(dcn))
+
+    def dump(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+
+def load_calibration(source) -> Calibration:
+    """`Calibration` from a dict, a JSON file path, or a JSON string.
+
+    File contents may be either calibration shape, or a whole perf
+    artifact that EMBEDS one under a ``"calibration"`` key — so
+    ``--calibration perf/tuning_r07.json`` works on archived rounds
+    without extracting the fit by hand."""
+    if isinstance(source, Calibration):
+        return source
+    if isinstance(source, dict):
+        d = source
+    else:
+        text = str(source)
+        if text.lstrip().startswith("{"):
+            d = json.loads(text)
+        else:
+            with open(text, encoding="utf-8") as f:
+                d = json.load(f)
+    if "calibration" in d and isinstance(d["calibration"], dict):
+        d = d["calibration"]
+    return Calibration.from_dict(d)
+
+
+# ---------------------------------------------------------------------------
+# fit plumbing (the math halves of overlap.fit_interconnect / fit_dcn)
+# ---------------------------------------------------------------------------
+
+
+def fit_allgather_sweep(world: int, sizes_bytes: Sequence[float],
+                        times_s: Sequence[float]) -> tuple[float, float]:
+    """(α, β) from a whole-collective ring all-gather sweep — the
+    normalization half of `overlap.fit_interconnect` (which owns the live
+    measurement): whole-collective times become the per-round α-β form
+    the leg model consumes, ``t_leg = (w-1)·α + β·wire ≈ measured``."""
+    from dear_pytorch_tpu.utils import perf_model
+
+    w = max(int(world), 1)
+    per_round = [t / max(w - 1, 1) for t in times_s]
+    round_bytes = [s / w for s in sizes_bytes]
+    return perf_model.fit_alpha_beta(round_bytes, per_round)
+
+
+def fit_dcn(samples: Sequence[tuple[float, float]],
+            *, min_samples: int = 4) -> tuple[float, float]:
+    """(α, β) for the cross-slice DCN level from the exchanger's own
+    per-fetch timing samples (`comm.dcn.DcnExchanger.samples` —
+    ``(bytes, seconds)`` per remote chunk fetch). The per-level half of
+    the link-aware fit: `fit_allgather_sweep` normalizes the intra-slice
+    ICI sweep, this one reuses the transfer timings the training run
+    already paid for. Raises ``ValueError`` below ``min_samples`` — a
+    one-point fit would hand the cost model a degenerate β and silently
+    mis-prune."""
+    from dear_pytorch_tpu.utils import perf_model
+
+    pts = [(float(b), float(t)) for b, t in samples
+           if t > 0 and b >= 0]
+    if len(pts) < int(min_samples):
+        raise ValueError(
+            f"DCN fit needs >= {min_samples} (bytes, secs) samples, got "
+            f"{len(pts)} — run more exchanges or set DEAR_TUNE_FIT_DCN "
+            "to an explicit 'alpha,beta'")
+    return perf_model.fit_alpha_beta(*zip(*pts))
+
+
+# ---------------------------------------------------------------------------
+# cost model: the overlap auditor's exposed-comm estimate as a trial pruner
+# ---------------------------------------------------------------------------
+
+
+class CostModel:
+    """Analytic per-config step-time floor from the α-β interconnect fit.
+
+    ``comm(config)`` prices the config's collective legs via
+    `counters.plan_comm_accounting` (compression ratios and wire dtypes
+    included) x `overlap.predict_leg_times`. Because the raw α-β fit
+    systematically overestimates in-program collectives (dispatch overhead
+    the compiled step amortizes — `overlap.audit_train_step` documents
+    this on CPU emulation), the model calibrates one multiplicative scale
+    from live measurements: ``scale = min(measured / comm_pred)`` over
+    observed configs, capped at 1. The pruning floor is the ideal-overlap
+    bound ``max(compute_est, scale x comm_pred)`` where ``compute_est`` is
+    the median of ``measured − scale x comm_pred`` over observations
+    (remat='full' scales it by ``remat_factor``). Sound up to the stated
+    assumption that the fit's error is a config-independent factor.
+    """
+
+    def __init__(self, plan_fn: Callable[[float], Any], alpha: float,
+                 beta: float, *, remat_factor: float = 1.3,
+                 num_slices: int = 1,
+                 dcn_alpha: Optional[float] = None,
+                 dcn_beta: Optional[float] = None):
+        self._plan_fn = plan_fn      # threshold_mb -> FusionPlan
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.remat_factor = float(remat_factor)
+        #: multi-slice pricing: the 'dcn' accounting rows (cross-slice
+        #: host exchange, chunked at each config's ``partition_mb``) are
+        #: costed with their OWN link fit — ICI and DCN α-β constants
+        #: differ by orders of magnitude, so one fit cannot rank a
+        #: partition/threshold trade across levels (the FlexLink point).
+        #: With no DCN fit the rows fall back to the intra-slice fit
+        #: (`overlap.predict_leg_times` states the same behavior).
+        self.num_slices = int(num_slices)
+        self.dcn_alpha = None if dcn_alpha is None else float(dcn_alpha)
+        self.dcn_beta = None if dcn_beta is None else float(dcn_beta)
+        self._plans: dict = {}
+        self._obs: list[tuple[float, float]] = []   # (comm_pred, measured)
+
+    def _plan(self, threshold_mb: float):
+        key = round(float(threshold_mb), 3)
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = self._plans[key] = self._plan_fn(key)
+        return plan
+
+    def comm(self, config) -> float:
+        """Uncalibrated unoverlapped comm seconds for one config."""
+        from dear_pytorch_tpu.observability import counters as CTR
+        from dear_pytorch_tpu.observability import overlap as OV
+
+        acct = CTR.plan_comm_accounting(
+            self._plan(config.threshold_mb), mode=config.mode,
+            comm_itemsize=DTYPE_ITEMSIZE[config.comm_dtype],
+            gather_itemsize=DTYPE_ITEMSIZE[config.gather_dtype],
+            compressor=config.compressor, density=config.density,
+            num_slices=self.num_slices,
+            dcn_partition_mb=config.partition_mb,
+        )
+        return float(sum(OV.predict_leg_times(
+            acct, self.alpha, self.beta,
+            dcn_alpha=self.dcn_alpha, dcn_beta=self.dcn_beta)))
+
+    def observe(self, config, measured_s: float) -> None:
+        if measured_s > 0 and math.isfinite(measured_s):
+            self._obs.append((self.comm(config), float(measured_s)))
+
+    @property
+    def _scale(self) -> float:
+        ratios = [m / c for c, m in self._obs if c > 0]
+        return min(min(ratios), 1.0) if ratios else 1.0
+
+    @property
+    def compute_est(self) -> Optional[float]:
+        """LOWER bound on the config-independent compute: the MINIMUM
+        residual over observations. A config whose slowness is compute
+        the model cannot see (e.g. software-emulated bf16 casts on CPU)
+        would drag any averaged estimate up and prune arms that are
+        genuinely cheap (observed: one 17s/step bf16 trial set a median
+        compute above every arm's bar and retired the whole space) —
+        pruning soundness needs the floor to UNDERestimate, never over."""
+        if not self._obs:
+            return None
+        s = self._scale
+        return min(max(m - s * c, 0.0) for c, m in self._obs)
+
+    def floor(self, config) -> Optional[float]:
+        """Ideal-overlap step-time floor, or None before any calibration
+        observation exists (never prune blind)."""
+        compute = self.compute_est
+        if compute is None:
+            return None
+        if config.remat == "full":
+            compute = compute * self.remat_factor
+        return max(compute, self._scale * self.comm(config))
+
+
+# ---------------------------------------------------------------------------
+# serve cost model: the α-β request-latency floor for ServeConfigs
+# ---------------------------------------------------------------------------
+
+
+class ServeCostModel:
+    """Analytic per-request latency floor for `ServeConfig`s — the α-β
+    serve-cost model that lets the tuner prune serving arms before they
+    burn a live closed-loop episode.
+
+    The request model: a P-token prompt + D generated tokens costs
+    ``ceil(P/C) + D`` engine ticks; ring-TP decode adds per-tick ring
+    transport priced by the α-β interconnect fit — each of the
+    ``n_projections`` ring collective-matmuls per tick moves the weight's
+    non-local rows: ``(W-1) x α latency + (W-1)/W x weight_bytes x β``.
+    Mirroring `CostModel`'s soundness rule, the per-tick compute base is
+    calibrated from live episodes as the MINIMUM residual rate (an
+    underestimate — pruning must never retire a genuinely cheap arm),
+    and `floor` returns None before any calibration exists (never prune
+    blind).
+    """
+
+    def __init__(self, *, prompt_tokens: float, decode_tokens: float,
+                 alpha: float = 0.0, beta: float = 0.0, world: int = 1,
+                 weight_bytes: float = 0.0, n_projections: int = 0):
+        self.prompt_tokens = float(prompt_tokens)
+        self.decode_tokens = float(decode_tokens)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.world = max(int(world), 1)
+        self.weight_bytes = float(weight_bytes)
+        self.n_projections = int(n_projections)
+        self._obs: list[tuple[float, float, float]] = []  # (ticks, comm, y)
+
+    def ticks(self, config) -> float:
+        """Engine ticks to serve the model request under ``config``."""
+        return (math.ceil(self.prompt_tokens / config.chunk)
+                + self.decode_tokens)
+
+    def _comm_per_tick(self, config) -> float:
+        if not config.tp_decode or self.world < 2:
+            return 0.0
+        w = self.world
+        per_ring = (w - 1) * self.alpha \
+            + (w - 1) / w * self.weight_bytes * self.beta
+        return self.n_projections * per_ring
+
+    def comm(self, config) -> float:
+        """Analytic sweep price: per-request ring-transport seconds, with
+        a tick-count epsilon so equal-comm (dense) arms order
+        fewest-ticks-first."""
+        return (self.ticks(config) * self._comm_per_tick(config)
+                + 1e-9 * self.ticks(config))
+
+    def observe(self, config, measured_s: float) -> None:
+        if measured_s > 0 and math.isfinite(measured_s):
+            self._obs.append((self.ticks(config), self.comm(config),
+                              float(measured_s)))
+
+    @property
+    def _scale(self) -> float:
+        ratios = [y / c for t, c, y in self._obs if c > 1e-6]
+        return min(min(ratios), 1.0) if ratios else 1.0
+
+    @property
+    def tick_rate_est(self) -> Optional[float]:
+        """LOWER bound on the per-tick compute cost: minimum residual
+        rate over observations (`CostModel.compute_est` rationale)."""
+        if not self._obs:
+            return None
+        s = self._scale
+        return min(max(y - s * c, 0.0) / t for t, c, y in self._obs if t)
+
+    def floor(self, config) -> Optional[float]:
+        rate = self.tick_rate_est
+        if rate is None:
+            return None
+        return (rate * self.ticks(config)
+                + self._scale * self.ticks(config)
+                * self._comm_per_tick(config))

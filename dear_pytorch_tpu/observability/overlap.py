@@ -317,34 +317,25 @@ def fit_interconnect(mesh, *, sizes: Optional[Sequence[int]] = None,
     report entry point stays interactive on the CPU emulation."""
     from dear_pytorch_tpu.utils.profiling import CommunicationProfiler
 
+    from dear_pytorch_tpu.observability import costmodel as CM
+
     prof = CommunicationProfiler(mesh, collective="all_gather")
     if sizes is None:
         sizes = [2 ** k for k in range(12, 19, 2)]
     sizes_bytes, times = prof.benchmark(sizes=sizes, repeats=repeats,
                                         warmup=warmup)
-    # normalize the whole-collective times to the per-round α-β form the
-    # leg model consumes: t_leg = (w-1)·α + β·wire ≈ measured total
-    w = prof.mesh.shape[prof.axis_name]
-    per_round = [t / max(w - 1, 1) for t in times]
-    round_bytes = [s / w for s in sizes_bytes]
-    return perf_model.fit_alpha_beta(round_bytes, per_round)
+    # normalization (whole-collective times -> the per-round α-β form
+    # the leg model consumes) lives in the costmodel waist so offline
+    # consumers (the simulator) fit recorded sweeps identically
+    return CM.fit_allgather_sweep(prof.mesh.shape[prof.axis_name],
+                                  sizes_bytes, times)
 
 
 def fit_dcn(samples: Sequence[tuple[float, float]],
             *, min_samples: int = 4) -> tuple[float, float]:
-    """(α, β) for the cross-slice DCN level from the exchanger's own
-    per-fetch timing samples (`comm.dcn.DcnExchanger.samples` —
-    ``(bytes, seconds)`` per remote chunk fetch). The per-level half of
-    the link-aware fit: `fit_interconnect` measures the intra-slice ICI
-    level with a live collective sweep, this one reuses the transfer
-    timings the training run already paid for. Raises ``ValueError``
-    below ``min_samples`` — a one-point fit would hand the cost model a
-    degenerate β and silently mis-prune."""
-    pts = [(float(b), float(t)) for b, t in samples
-           if t > 0 and b >= 0]
-    if len(pts) < int(min_samples):
-        raise ValueError(
-            f"DCN fit needs >= {min_samples} (bytes, secs) samples, got "
-            f"{len(pts)} — run more exchanges or set DEAR_TUNE_FIT_DCN "
-            "to an explicit 'alpha,beta'")
-    return perf_model.fit_alpha_beta(*zip(*pts))
+    """(α, β) for the cross-slice DCN level — moved to
+    `costmodel.fit_dcn` (the one α-β waist); this shim keeps the
+    historical `overlap.fit_dcn` import path working unchanged."""
+    from dear_pytorch_tpu.observability import costmodel as CM
+
+    return CM.fit_dcn(samples, min_samples=min_samples)

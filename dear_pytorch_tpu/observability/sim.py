@@ -1,0 +1,1326 @@
+"""dearsim: fleet-scale discrete-event simulation on the α-β cost model.
+
+Every arc of this repo hits the same container ceiling: one CPU device,
+interpret-mode Pallas, a file-KV DCN — so the deepest questions
+(multi-slice partition splits, replica-count/autoscaling policy,
+1000-rank membership storms) cannot be answered live. This module
+composes the parts that ARE calibrated — the per-bucket accounting
+(`counters.plan_comm_accounting`), the α-β link fits
+(`costmodel.LinkFit`/`Calibration`), the tick-based serve model
+(`costmodel.ServeCostModel`), and the real `ElasticCluster` membership
+protocol — into one deterministic discrete-event simulator
+(docs/SIM.md):
+
+* `simulate_training` replays a `FusionPlan` + schedule mode against a
+  declarative `SimTopology` (slices × chips, heterogeneous per-link
+  ICI/DCN α-β) and emits the SAME artifact shape the live auditor emits
+  (`overlap.OverlapReport.to_dict()`), plus step-time quantiles.
+* `simulate_serving` replays a seeded traffic trace against a replica
+  fleet (router + per-replica slot queues + optional autoscaler) and
+  emits `scripts/serve_tune.py`-shaped episode metrics plus
+  `bench_gate`-shaped A/B cells.
+* `SimTransport` runs the UNMODIFIED `resilience.membership` protocol
+  on virtual time: `run_membership_storm` resolves a 1000-rank /
+  8-slice slice-loss storm to lockstep in seconds of wall time
+  (`scripts/sim_check.py` gates on it).
+* `tune_plan_sim` / `tune_serve_sim` / `tune_fleet_sim` drive the real
+  `PlanTuner`/`ServeTuner` machinery with a virtual clock and simulated
+  measurements — the `sim` backend the tuning layer gains here.
+
+Wire-byte PARITY is by construction: every simulated event is priced
+from the rows `plan_comm_accounting` emits, never from a re-derived
+formula (tests/test_sim.py asserts identity for every mode ×
+compressor × partition combo). Pricing follows
+`overlap.predict_leg_times` exactly, except that on a heterogeneous
+topology each leg is priced per link fit and the MAX is taken — a
+synchronous ring runs at its slowest link's rate (the FlexLink lens).
+
+Determinism contract (machine-checked by dearlint's `sim-determinism`
+rule): this module reads no wall clock and draws no unseeded
+randomness — all time is simulated, all RNG flows from an explicit
+seed (`DEAR_SIM_SEED`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import json
+import math
+import os
+import random
+import statistics
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.observability.costmodel import (
+    Calibration, LinkFit, load_calibration,
+)
+
+__all__ = [
+    "SimTopology", "load_topology", "synthetic_plan",
+    "simulate_training", "simulate_serving", "TrafficTrace",
+    "SimTransport", "run_membership_storm",
+    "VirtualClock", "tune_plan_sim", "tune_serve_sim", "tune_fleet_sim",
+    "FleetConfig", "FleetSpace", "FleetTuner",
+]
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else int(default)
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    return float(raw) if raw else float(default)
+
+
+#: every knob reads through these literals (docs/ENV.md, env-registry)
+SEED_ENV = "DEAR_SIM_SEED"
+STEPS_ENV = "DEAR_SIM_STEPS"
+JITTER_ENV = "DEAR_SIM_JITTER"
+STORM_TIMEOUT_ENV = "DEAR_SIM_STORM_TIMEOUT_S"
+QUANTUM_ENV = "DEAR_SIM_QUANTUM_S"
+
+
+def default_seed() -> int:
+    return _env_int(SEED_ENV, 0)
+
+
+def default_steps() -> int:
+    return _env_int(STEPS_ENV, 32)
+
+
+def default_jitter() -> float:
+    return _env_float(JITTER_ENV, 0.03)
+
+
+# ---------------------------------------------------------------------------
+# declarative topology
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SimTopology:
+    """A fleet the simulator can price: ``num_slices`` slices of
+    ``chips_per_slice`` chips, an intra-slice ICI fit, an optional
+    cross-slice DCN fit, and per-slice heterogeneous overrides (a slow
+    slice models a degraded ICI mesh; a slow DCN override models an
+    oversubscribed inter-slice path). ``replicas`` sizes the serving
+    fleet. JSON grammar in docs/SIM.md."""
+
+    num_slices: int = 1
+    chips_per_slice: int = 8
+    ici: LinkFit = LinkFit(alpha=1e-5, beta=1.0 / 40e9, source="default")
+    dcn: Optional[LinkFit] = None
+    ici_overrides: Tuple[Tuple[int, LinkFit], ...] = ()
+    dcn_overrides: Tuple[Tuple[int, LinkFit], ...] = ()
+    replicas: int = 1
+
+    @property
+    def world(self) -> int:
+        return self.num_slices * self.chips_per_slice
+
+    def ici_fits(self) -> List[LinkFit]:
+        """One fit per slice (override or default) — the per-link α-β
+        set a synchronous intra-slice ring must respect."""
+        over = dict(self.ici_overrides)
+        return [over.get(s, self.ici) for s in range(self.num_slices)]
+
+    def dcn_fits(self) -> List[LinkFit]:
+        base = self.dcn if self.dcn is not None else self.ici
+        over = dict(self.dcn_overrides)
+        return [over.get(s, base) for s in range(self.num_slices)]
+
+    def to_dict(self) -> dict:
+        d = {
+            "slices": self.num_slices,
+            "chips_per_slice": self.chips_per_slice,
+            "replicas": self.replicas,
+            "ici": self.ici.to_dict(),
+        }
+        if self.dcn is not None:
+            d["dcn"] = self.dcn.to_dict()
+        if self.ici_overrides:
+            d["ici_overrides"] = {str(s): f.to_dict()
+                                  for s, f in self.ici_overrides}
+        if self.dcn_overrides:
+            d["dcn_overrides"] = {str(s): f.to_dict()
+                                  for s, f in self.dcn_overrides}
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SimTopology":
+        def fits(key):
+            return tuple(sorted(
+                (int(s), LinkFit.from_dict(f))
+                for s, f in (d.get(key) or {}).items()))
+
+        dcn = d.get("dcn")
+        return cls(
+            num_slices=int(d.get("slices", d.get("num_slices", 1))),
+            chips_per_slice=int(d.get("chips_per_slice", 8)),
+            ici=(LinkFit.from_dict(d["ici"]) if "ici" in d
+                 else cls.__dataclass_fields__["ici"].default),
+            dcn=None if dcn is None else LinkFit.from_dict(dcn),
+            ici_overrides=fits("ici_overrides"),
+            dcn_overrides=fits("dcn_overrides"),
+            replicas=int(d.get("replicas", 1)),
+        )
+
+    @classmethod
+    def from_calibration(cls, calib: Calibration, *, num_slices: int = 1,
+                         chips_per_slice: int = 8,
+                         replicas: int = 1) -> "SimTopology":
+        return cls(num_slices=num_slices, chips_per_slice=chips_per_slice,
+                   ici=calib.ici, dcn=calib.dcn, replicas=replicas)
+
+
+def load_topology(source) -> SimTopology:
+    """`SimTopology` from a dict, JSON file path, or JSON string."""
+    if isinstance(source, SimTopology):
+        return source
+    if isinstance(source, dict):
+        return SimTopology.from_dict(source)
+    text = str(source)
+    if text.lstrip().startswith("{"):
+        return SimTopology.from_dict(json.loads(text))
+    with open(text, encoding="utf-8") as f:
+        return SimTopology.from_dict(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# leg pricing: predict_leg_times semantics, per heterogeneous link
+# ---------------------------------------------------------------------------
+
+
+def _price_row(row, world: int, fit: LinkFit) -> float:
+    """One accounting row under one link fit — the exact
+    `overlap.predict_leg_times` arithmetic (parity is load-bearing:
+    tests/test_sim.py pins it)."""
+    if row.leg == "dcn":
+        return row.messages * fit.alpha + fit.beta * row.wire_bytes
+    if world <= 1:
+        return 0.0
+    if row.leg in ("reduce_scatter", "all_gather"):
+        return (world - 1) * fit.alpha + fit.beta * row.wire_bytes
+    if row.leg == "all_reduce":
+        return 2 * (world - 1) * fit.alpha + fit.beta * row.wire_bytes
+    return fit.alpha + fit.beta * row.payload_bytes  # reduce / broadcast
+
+
+def _price_row_topo(row, topo: SimTopology,
+                    world: Optional[int] = None) -> float:
+    """Max over participating links: a synchronous collective moves at
+    its slowest link (the FlexLink heterogeneity lens). ``world`` is the
+    ACCOUNTING's ring size (`acct.world` — the convention
+    `predict_leg_times` uses; its dcn rows already carry the
+    cross-slice extra); 'dcn' rows ride the DCN fits."""
+    w = topo.world if world is None else int(world)
+    if row.leg == "dcn":
+        return max(_price_row(row, w, f) for f in topo.dcn_fits())
+    return max(_price_row(row, w, f) for f in topo.ici_fits())
+
+
+# ---------------------------------------------------------------------------
+# synthetic plans (CLI-side: simulate models without building params)
+# ---------------------------------------------------------------------------
+
+
+def synthetic_plan(layer_sizes: Sequence[int], world: int,
+                   *, threshold_mb: float = 4.0, dtype: str = "float32"):
+    """A `FusionPlan` built from raw layer element counts — no arrays,
+    no model: the offline entry point (`--layers 1000000,250000,...`).
+    Greedy same-threshold bucketing as `fusion.plan_by_threshold`, with
+    each bucket padded to a multiple of ``world`` (shard rule)."""
+    from dear_pytorch_tpu.ops import fusion as F
+    import numpy as np
+
+    itemsize = np.dtype(dtype).itemsize
+    thr_elems = max(int(float(threshold_mb) * 2**20 / itemsize), 1)
+    leaves = [
+        F.LeafSpec(name=f"layer{i}/w", layer=i, shape=(int(n),),
+                   dtype=dtype, size=int(n))
+        for i, n in enumerate(layer_sizes)
+    ]
+    buckets: List[Any] = []
+    cur: List[int] = []
+    cur_size = 0
+
+    def flush():
+        nonlocal cur, cur_size
+        if not cur:
+            return
+        offsets, off = [], 0
+        for lid in cur:
+            offsets.append(off)
+            off += leaves[lid].size
+        padded = int(math.ceil(off / world) * world) if world > 1 else off
+        buckets.append(F.Bucket(
+            index=len(buckets), leaf_ids=tuple(cur),
+            offsets=tuple(offsets), size=off, padded_size=padded,
+            shard_size=padded // max(world, 1)))
+        cur, cur_size = [], 0
+
+    for leaf in leaves:
+        if cur and cur_size + leaf.size > thr_elems:
+            flush()
+        cur.append(leaf.layer)
+        cur_size += leaf.size
+    flush()
+    return F.FusionPlan(buckets=tuple(buckets), leaves=tuple(leaves),
+                        world=int(world), treedef=None)
+
+
+# ---------------------------------------------------------------------------
+# training DES
+# ---------------------------------------------------------------------------
+
+#: modes whose parameter all-gather is DECOUPLED into the next forward
+#: window (the DeAR schedule); fsdp-family gathers block the forward.
+_DECOUPLED_AG = ("dear", "dear-fused")
+
+
+def simulate_training(
+    plan,
+    topo: SimTopology,
+    *,
+    mode: str = "dear",
+    compute_time_s: float = 0.030,
+    fwd_frac: float = 1.0 / 3.0,
+    comm_itemsize: int = 4,
+    gather_itemsize: Optional[int] = None,
+    compressor: Optional[str] = None,
+    density: float = 1.0,
+    partition_mb: Optional[float] = None,
+    steps: Optional[int] = None,
+    jitter: Optional[float] = None,
+    seed: Optional[int] = None,
+) -> dict:
+    """Replay one (plan, mode, topology) combination: a discrete-event
+    schedule of per-bucket collective legs against the backward/forward
+    compute windows, repeated ``steps`` times with seeded multiplicative
+    jitter for quantiles.
+
+    Event model (docs/SIM.md states the caveats): backward emits bucket
+    gradients in reverse bucket order at size-weighted offsets through
+    the backward window; gradient legs serialize FIFO on one ICI
+    resource and hide under remaining backward compute; 'dcn' rows
+    chain after their bucket's gradient leg on a separate DCN resource
+    (host-driven — they hide under either window); parameter gathers
+    hide under the NEXT step's forward window for decoupled modes
+    (`_DECOUPLED_AG`) and are fully exposed for fsdp-family modes (the
+    forward blocks on gathered weights — exactly the dependency DeAR
+    removes). Step time = compute + Σ exposed.
+
+    Returns ``{"report": <OverlapReport.to_dict() shape>, "quantiles":
+    {...}, "step_time_s": mean, ...}`` so `report.py` renders simulated
+    runs like live ones."""
+    from dear_pytorch_tpu.observability import counters as CTR
+    from dear_pytorch_tpu.observability import overlap as OV
+
+    steps = default_steps() if steps is None else int(steps)
+    jitter = default_jitter() if jitter is None else float(jitter)
+    seed = default_seed() if seed is None else int(seed)
+    rng = random.Random(seed)
+
+    acct = CTR.plan_comm_accounting(
+        plan, mode=mode, comm_itemsize=comm_itemsize,
+        gather_itemsize=gather_itemsize, compressor=compressor,
+        density=density, num_slices=topo.num_slices,
+        dcn_partition_mb=partition_mb)
+
+    grad_legs = ("reduce_scatter", "all_reduce", "reduce")
+    param_legs = ("all_gather", "broadcast")
+    decoupled = mode in _DECOUPLED_AG
+    nb = max(acct.num_buckets, 1)
+    bwd = float(compute_time_s) * (1.0 - float(fwd_frac))
+    fwd = float(compute_time_s) * float(fwd_frac)
+
+    # bucket readiness: reverse bucket order, cumulative-size-weighted
+    sizes = {b.index: max(b.padded_size, 1) for b in plan.buckets}
+    order = sorted(sizes, reverse=True)
+    total = sum(sizes.values()) or 1
+    ready = {}
+    acc = 0
+    for bi in order:
+        acc += sizes[bi]
+        ready[bi] = bwd * acc / total
+
+    def one_step(scale: float) -> tuple[float, dict]:
+        """One simulated step at compute scale ``scale``; returns
+        (step_seconds, per-row (hidden, exposed) timings)."""
+        b, f = bwd * scale, fwd * scale
+        ici_free = 0.0
+        dcn_free = 0.0
+        grad_done = {}
+        rows_t = {}
+        # phase 1: gradient legs + chained dcn rows, backward window.
+        # FIFO on the ICI resource in READINESS order (reverse bucket
+        # index — the backward emits late layers' gradients first).
+        grad_rows = sorted(
+            (r for r in acct.rows if r.leg in grad_legs),
+            key=lambda r: ready.get(r.bucket, 0.0))
+        for row in grad_rows:
+            t = _price_row_topo(row, topo, acct.world)
+            start = max(ready.get(row.bucket, 0.0) * scale, ici_free)
+            end = start + t
+            ici_free = end
+            grad_done[row.bucket] = end
+            hidden = max(0.0, min(end, b) - start)
+            rows_t[id(row)] = (hidden, t - hidden)
+        dcn_rows = sorted(
+            (r for r in acct.rows if r.leg == "dcn"),
+            key=lambda r: grad_done.get(r.bucket, 0.0))
+        for row in dcn_rows:
+            t = _price_row_topo(row, topo, acct.world)
+            start = max(grad_done.get(row.bucket, 0.0), dcn_free)
+            end = start + t
+            dcn_free = end
+            hidden = max(0.0, min(end, b + f) - start)
+            rows_t[id(row)] = (hidden, t - hidden)
+        # phase 2: parameter legs — next-forward window (decoupled) or
+        # fully exposed (fsdp-family: forward blocks on the weights)
+        ici_free = max(ici_free, b)
+        for row in acct.rows:
+            if row.leg not in param_legs:
+                continue
+            t = _price_row_topo(row, topo, acct.world)
+            start = max(b, ici_free)
+            end = start + t
+            ici_free = end
+            if decoupled or row.leg == "broadcast":
+                hidden = max(0.0, min(end, b + f) - start)
+            else:
+                hidden = 0.0
+            rows_t[id(row)] = (hidden, t - hidden)
+        exposed = sum(e for _, e in rows_t.values())
+        return (b + f + exposed, rows_t)
+
+    samples = []
+    base_rows = None
+    for k in range(max(steps, 1)):
+        scale = max(1.0 + rng.gauss(0.0, jitter), 0.05) if jitter else 1.0
+        t, rows_t = one_step(scale)
+        samples.append(t)
+        if k == 0 or (jitter == 0.0):
+            base_rows = rows_t
+    # the reported per-leg split comes from the UNJITTERED schedule
+    if jitter:
+        _, base_rows = one_step(1.0)
+
+    comm = sum(_price_row_topo(r, topo, acct.world) for r in acct.rows)
+    legs = tuple(
+        OV.BucketLegReport(
+            bucket=row.bucket, leg=row.leg,
+            payload_bytes=row.payload_bytes, wire_bytes=row.wire_bytes,
+            pred_time_s=_price_row_topo(row, topo, acct.world),
+            exposed_s=base_rows[id(row)][1],
+            hidden_s=base_rows[id(row)][0],
+        ) for row in acct.rows)
+    measured = statistics.fmean(samples)
+    serial = compute_time_s + comm
+    ideal = max(compute_time_s, comm)
+    eff = None
+    if serial > ideal:
+        eff = min(max((serial - measured) / (serial - ideal), 0.0), 1.0)
+    report = OV.OverlapReport(
+        mode=mode, world=topo.world, num_buckets=nb,
+        alpha=topo.ici.alpha, beta=topo.ici.beta,
+        compute_time_s=float(compute_time_s), comm_time_s=comm,
+        measured_step_s=measured, ideal_step_s=ideal,
+        serial_step_s=serial,
+        exposed_comm_s=sum(leg.exposed_s for leg in legs),
+        hidden_comm_s=sum(leg.hidden_s for leg in legs),
+        overlap_efficiency=eff, flops_per_step=None, legs=legs,
+        model_note="simulated (dearsim) — α-β event model, not hardware")
+    qs = _quantiles(samples)
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("sim.train_runs")
+        tr.event("sim.train_run", mode=mode, world=topo.world,
+                 steps=steps, step_time_us=int(measured * 1e6))
+    return {
+        "report": report.to_dict(),
+        "quantiles": qs,
+        "step_time_s": measured,
+        "steps": steps,
+        "seed": seed,
+        "wire_bytes_per_step": acct.wire_bytes_per_step,
+        "payload_bytes_per_step": acct.payload_bytes_per_step,
+        "topology": topo.to_dict(),
+    }
+
+
+def _quantiles(samples: Sequence[float]) -> dict:
+    xs = sorted(samples)
+    if len(xs) == 1:
+        return {"p50": xs[0], "p90": xs[0], "p99": xs[0],
+                "mean": xs[0], "n": 1}
+
+    def q(p):
+        i = min(int(p * (len(xs) - 1)), len(xs) - 1)
+        return xs[i]
+
+    return {"p50": q(0.50), "p90": q(0.90), "p99": q(0.99),
+            "mean": statistics.fmean(xs), "n": len(xs)}
+
+
+# ---------------------------------------------------------------------------
+# serving DES: replica fleet under a traffic trace
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficTrace:
+    """Arrivals for the serving simulator: ``(t_s, prompt, decode)``
+    tuples. `poisson` synthesizes one from a seeded RNG (exponential
+    interarrivals — the standard open-loop load model)."""
+
+    requests: Tuple[Tuple[float, int, int], ...]
+
+    @classmethod
+    def poisson(cls, *, rps: float, duration_s: float, prompt_tokens: int,
+                decode_tokens: int, seed: Optional[int] = None,
+                ) -> "TrafficTrace":
+        rng = random.Random(default_seed() if seed is None else seed)
+        t, out = 0.0, []
+        while t < duration_s:
+            t += rng.expovariate(rps)
+            if t >= duration_s:
+                break
+            out.append((t, prompt_tokens, decode_tokens))
+        return cls(requests=tuple(out))
+
+
+def _tick_time_s(topo: SimTopology, *, tick_base_s: float,
+                 tp_decode: bool, weight_bytes: float,
+                 n_projections: int) -> float:
+    """Per-engine-tick seconds: compute base + ring-TP transport priced
+    exactly as `costmodel.ServeCostModel._comm_per_tick` (same formula,
+    worst link)."""
+    if not tp_decode:
+        return tick_base_s
+    w = topo.chips_per_slice
+    if w < 2:
+        return tick_base_s
+    per_ring = max((w - 1) * f.alpha + (w - 1) / w * weight_bytes * f.beta
+                   for f in topo.ici_fits())
+    return tick_base_s + n_projections * per_ring
+
+
+def simulate_serving(
+    topo: SimTopology,
+    trace: TrafficTrace,
+    *,
+    prefill_chunk: int = 4,
+    slots: int = 4,
+    tp_decode: bool = False,
+    tick_base_s: float = 1e-3,
+    weight_bytes: float = 0.0,
+    n_projections: int = 0,
+    replicas: Optional[int] = None,
+    autoscale: Optional[dict] = None,
+) -> dict:
+    """Replay ``trace`` against a fleet of ``replicas`` engines, each
+    with ``slots`` concurrent request slots. Requests cost
+    ``ceil(P/C) + D`` ticks (the `ServeCostModel` request model); the
+    router sends each arrival to the least-loaded replica; an optional
+    ``autoscale`` policy ``{"min": .., "max": .., "up_q": ..,
+    "down_q": .., "interval_s": .., "provision_s": ..}`` grows the
+    fleet when per-replica backlog exceeds ``up_q`` and shrinks it
+    below ``down_q``. Emits `serve_tune`-shaped episode metrics."""
+    replicas = topo.replicas if replicas is None else int(replicas)
+    replicas = max(replicas, 1)
+    chunk = max(int(prefill_chunk), 1)
+    tick = _tick_time_s(topo, tick_base_s=float(tick_base_s),
+                        tp_decode=tp_decode,
+                        weight_bytes=float(weight_bytes),
+                        n_projections=int(n_projections))
+    pol = dict(autoscale or {})
+    nmax = int(pol.get("max", replicas))
+    nmin = int(pol.get("min", replicas))
+
+    # replica state: active count + FIFO backlog per replica
+    active = [0] * nmax
+    backlog: List[List[Tuple[float, float]]] = [[] for _ in range(nmax)]
+    live = [i < replicas for i in range(nmax)]
+    latencies: List[float] = []
+    total_ticks = 0
+    events: List[Tuple[float, int, int, float]] = []  # (t, kind, rep, t0)
+    _ARRIVE, _DONE, _SCALE = 0, 1, 2
+    for (t, p, d) in trace.requests:
+        svc = (math.ceil(p / chunk) + d) * tick
+        total_ticks += math.ceil(p / chunk) + d
+        heapq.heappush(events, (t, _ARRIVE, -1, svc))
+    if pol:
+        heapq.heappush(events,
+                       (float(pol.get("interval_s", 1.0)), _SCALE, -1, 0.0))
+    scale_log: List[Tuple[float, int]] = [(0.0, replicas)]
+    now = 0.0
+
+    def start_one(rep: int, t0: float, svc: float, now: float):
+        active[rep] += 1
+        heapq.heappush(events, (now + svc, _DONE, rep, t0))
+
+    while events:
+        now, kind, rep, arg = heapq.heappop(events)
+        if kind == _ARRIVE:
+            cand = [i for i in range(nmax) if live[i]]
+            rep = min(cand, key=lambda i: active[i] + len(backlog[i]))
+            if active[rep] < slots:
+                start_one(rep, now, arg, now)
+            else:
+                backlog[rep].append((now, arg))
+        elif kind == _DONE:
+            active[rep] -= 1
+            latencies.append(now - arg)
+            if backlog[rep]:
+                t0, svc = backlog[rep].pop(0)
+                start_one(rep, t0, svc, now)
+        elif kind == _SCALE:
+            n = sum(live)
+            load = sum(len(b) for b in backlog) / max(n, 1)
+            if load > float(pol.get("up_q", 4.0)) and n < nmax:
+                # provision lag: the new replica serves after a delay
+                idx = live.index(False)
+                live[idx] = True
+                scale_log.append((now + float(pol.get("provision_s", 0.0)),
+                                  n + 1))
+            elif load < float(pol.get("down_q", 0.5)) and n > nmin:
+                idx = max(i for i in range(nmax) if live[i])
+                if active[idx] == 0 and not backlog[idx]:
+                    live[idx] = False
+                    scale_log.append((now, n - 1))
+            if any(active) or any(backlog):
+                heapq.heappush(
+                    events,
+                    (now + float(pol.get("interval_s", 1.0)), _SCALE,
+                     -1, 0.0))
+    wall = now if trace.requests else 0.0
+    qs = _quantiles(latencies) if latencies else {
+        "p50": 0.0, "p90": 0.0, "p99": 0.0, "mean": 0.0, "n": 0}
+    mean_replicas = (statistics.fmean(n for _, n in scale_log)
+                     if scale_log else replicas)
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("sim.serve_runs")
+        tr.count("sim.requests", len(latencies))
+        tr.event("sim.serve_run", replicas=replicas,
+                 requests=len(latencies), p99_us=int(qs["p99"] * 1e6))
+    return {
+        "p50_s": qs["p50"], "p99_s": qs["p99"],
+        "requests": len(latencies),
+        "requests_per_s": (len(latencies) / wall) if wall > 0 else 0.0,
+        "ticks": total_ticks,
+        "wall_s": wall,
+        "replicas": replicas,
+        "mean_replicas": mean_replicas,
+        "scale_events": len(scale_log) - 1,
+        "ab_cell": [round((len(latencies) / wall) if wall else 0.0, 3),
+                    0.0],
+    }
+
+
+# ---------------------------------------------------------------------------
+# SimTransport: the membership protocol on virtual time
+# ---------------------------------------------------------------------------
+
+_MISS = object()
+
+
+class _Waiter:
+    __slots__ = ("key", "deadline", "event", "done")
+
+    def __init__(self, key, deadline):
+        self.key = key
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.done = False
+
+
+class SimTransport:
+    """`cluster.LocalTransport` semantics with VIRTUAL timeouts: a
+    `get` that would block parks the calling actor; when every attached
+    actor is parked, the clock jumps to the earliest pending deadline
+    and the expired waiters raise `PeerTimeout` — a 1000-rank detection
+    window that would burn 5 real seconds per dead peer resolves in
+    microseconds of wall time.
+
+    Actor accounting is explicit: each simulated rank (thread) wraps
+    its life in `attach()`/`detach()`; the all-parked condition is
+    ``len(waiters) == nlive``. Deadlines are quantized to
+    ``quantum_s`` buckets so the ±ms skew of 875 survivors' budgets
+    coalesces into ONE advance per timeout wave instead of 875.
+    Sub-``min_park_s`` timeouts (the leader's rejoin-probe polls) never
+    park: the key is either present or the probe fails now."""
+
+    def __init__(self, *, quantum_s: Optional[float] = None,
+                 min_park_s: float = 0.2):
+        self._store: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._now = 0.0
+        self._nlive = 0
+        self._waiters: List[_Waiter] = []
+        self._kwait: Dict[str, List[_Waiter]] = {}
+        self._quantum = (_env_float(QUANTUM_ENV, 1.0)
+                         if quantum_s is None else float(quantum_s))
+        self._min_park = float(min_park_s)
+        self.advances = 0
+        from dear_pytorch_tpu.resilience.cluster import PeerTimeout
+        self._PeerTimeout = PeerTimeout
+
+    # -- virtual clock ------------------------------------------------------
+
+    @property
+    def now_s(self) -> float:
+        return self._now
+
+    def _quantize(self, t: float) -> float:
+        q = self._quantum
+        return math.ceil(t / q) * q if q > 0 else t
+
+    # -- actor lifecycle ----------------------------------------------------
+
+    def attach(self, n: int = 1) -> None:
+        with self._lock:
+            self._nlive += int(n)
+
+    def detach(self) -> None:
+        with self._lock:
+            self._nlive -= 1
+            self._maybe_advance_locked()
+
+    def _maybe_advance_locked(self) -> None:
+        if self._nlive <= 0 or len(self._waiters) < self._nlive:
+            return
+        pending = [w for w in self._waiters if not w.done]
+        if not pending:
+            return
+        self._now = max(self._now, min(w.deadline for w in pending))
+        self.advances += 1
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count("sim.clock_advances")
+        for w in pending:
+            if w.deadline <= self._now:
+                self._remove_locked(w)
+                w.event.set()
+
+    def _remove_locked(self, w: _Waiter) -> None:
+        if w.done:
+            return
+        w.done = True
+        self._waiters.remove(w)
+        lst = self._kwait.get(w.key)
+        if lst is not None:
+            try:
+                lst.remove(w)
+            except ValueError:
+                pass
+            if not lst:
+                self._kwait.pop(w.key, None)
+
+    # -- KV surface (LocalTransport-compatible) -----------------------------
+
+    def set(self, key: str, value: str) -> None:
+        self._store[key] = value           # GIL-atomic publish
+        if key in self._kwait:             # wake only this key's waiters
+            with self._lock:
+                for w in list(self._kwait.get(key, ())):
+                    self._remove_locked(w)
+                    w.event.set()
+
+    def get(self, key: str, timeout_s: float) -> str:
+        v = self._store.get(key, _MISS)    # lock-free fast path
+        if v is not _MISS:
+            return v
+        t = float(timeout_s)
+        if t <= self._min_park:
+            v = self._store.get(key, _MISS)
+            if v is not _MISS:
+                return v
+            raise self._PeerTimeout(
+                f"no peer published {key!r} within {t:.2f}s (sim poll)")
+        with self._lock:
+            v = self._store.get(key, _MISS)
+            if v is not _MISS:
+                return v
+            w = _Waiter(key, self._quantize(self._now + t))
+            self._waiters.append(w)
+            self._kwait.setdefault(key, []).append(w)
+            self._maybe_advance_locked()
+        while True:
+            # the 1s real-time poll is a wedge-healer only: virtual
+            # progress always arrives via set()/advance wakes
+            w.event.wait(1.0)
+            with self._lock:
+                v = self._store.get(key, _MISS)
+                if v is not _MISS:
+                    self._remove_locked(w)
+                    return v
+                if w.done or self._now >= w.deadline:
+                    self._remove_locked(w)
+                    break
+                self._maybe_advance_locked()
+        raise self._PeerTimeout(
+            f"no peer published {key!r} within {t:.1f}s "
+            f"(virtual t={self._now:.1f})")
+
+    def sleep(self, dt_s: float) -> None:
+        """Park this actor for ``dt_s`` VIRTUAL seconds (the storm
+        harness's check-interval pacing)."""
+        with self._lock:
+            w = _Waiter(None, self._quantize(self._now + float(dt_s)))
+            self._waiters.append(w)
+            self._maybe_advance_locked()
+        while True:
+            w.event.wait(1.0)
+            with self._lock:
+                if w.done:
+                    return
+                if self._now >= w.deadline:
+                    self._remove_locked(w)
+                    return
+                self._maybe_advance_locked()
+
+    def delete(self, key: str) -> None:
+        self._store.pop(key, None)
+
+    def decide_once(self, key: str, value: str) -> str:
+        with self._lock:
+            won = self._store.setdefault(key, value)
+            for w in list(self._kwait.get(key, ())):
+                self._remove_locked(w)
+                w.event.set()
+            return won
+
+    def _keys_snapshot(self) -> List[str]:
+        for _ in range(8):
+            try:
+                return list(self._store)
+            except RuntimeError:      # resized mid-iteration; retry
+                continue
+        with self._lock:
+            return list(self._store)
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        base = prefix.rstrip("/") + "/"
+        return sorted({k[len(base):].split("/", 1)[0]
+                       for k in self._keys_snapshot()
+                       if k.startswith(base)})
+
+    def prune_prefix(self, prefix: str) -> None:
+        base = prefix.rstrip("/") + "/"
+        for k in self._keys_snapshot():
+            if k.startswith(base) or k == prefix:
+                self._store.pop(k, None)
+
+    def peek(self, key: str) -> Optional[str]:
+        return self._store.get(key)
+
+
+def run_membership_storm(
+    *,
+    world: int = 1000,
+    ranks_per_slice: int = 125,
+    kill_slice: int = 1,
+    timeout_s: Optional[float] = None,
+    interval_s: float = 1.0,
+    max_syncs: int = 12,
+    quiet: bool = True,
+) -> dict:
+    """A slice-loss storm against the REAL `ElasticCluster` protocol on
+    a `SimTransport`: the killed slice's ranks never arrive, the
+    survivors detect the hole, commit the shrink epoch (decided/e1),
+    the relaunched slice rejoins through slice-gated admission
+    (decided/e2), and every rank proves lockstep with one final member
+    exchange. Decision-record sequence shape-matches the live
+    ``--multislice`` chaos gate (`scripts/chaos_check.py`): e1 removes
+    exactly the victim slice, e2 adds it back, e3 never exists.
+
+    Wall-clock cost is thread bookkeeping only — virtual detection
+    windows cost nothing (`SimTransport`). `scripts/sim_check.py` gates
+    world=1000 at < 60 s on one core."""
+    import logging
+
+    from dear_pytorch_tpu.resilience.membership import (
+        ElasticCluster, EvictedError,
+    )
+
+    mem_logger = logging.getLogger("dear_pytorch_tpu")
+    prior_level = mem_logger.level
+    if quiet:
+        # a 1000-rank storm emits thousands of per-rank commit lines;
+        # the harness's structured result is the record of truth
+        mem_logger.setLevel(logging.CRITICAL + 1)
+
+    if timeout_s is None:
+        # `_gather` budgets each key against REAL monotonic time, so the
+        # virtual timeout must also cover the real seconds a full-world
+        # exchange burns on this host (875 ranks x 1000 keys of Python
+        # per sync). Virtual seconds are free — size generously.
+        timeout_s = _env_float(STORM_TIMEOUT_ENV, max(5.0, world / 2.0))
+    if world % ranks_per_slice:
+        raise ValueError(f"world {world} not a multiple of "
+                         f"ranks_per_slice {ranks_per_slice}")
+    num_slices = world // ranks_per_slice
+    if not 0 <= kill_slice < num_slices:
+        raise ValueError(f"kill_slice {kill_slice} out of range "
+                         f"0..{num_slices - 1}")
+    victims = tuple(range(kill_slice * ranks_per_slice,
+                          (kill_slice + 1) * ranks_per_slice))
+    survivors = tuple(r for r in range(world) if r not in victims)
+    st = SimTransport()
+    ns = "dearel/elastic"
+    results: Dict[int, dict] = {}
+    errors: Dict[int, str] = {}
+    lock = threading.Lock()
+
+    def record(rank, **kw):
+        with lock:
+            results[rank] = kw
+
+    def finish(cluster, rank, step):
+        """Lockstep proof, rank-local: reaching here means this rank
+        COMPLETED the admit-epoch barrier (`admit.barrier` is a
+        full-member exchange at the admitted epoch — survivors run it
+        inside `admit`, rejoiners inside `rejoin`; a single absent
+        member fails it with PeerTimeout). The driver cross-checks that
+        all ``world`` ranks recorded the same epoch."""
+        record(rank, epoch=cluster.epoch, world=cluster.world,
+               step=int(step),
+               lockstep=(cluster.world == world and cluster.epoch >= 2))
+
+    def survivor_main(rank):
+        try:
+            c = ElasticCluster(rank=rank, world=world, transport=st,
+                               timeout_s=timeout_s,
+                               ranks_per_slice=ranks_per_slice)
+            for sync in range(max_syncs):
+                v = c.health_check(True, fingerprint="sim", step=sync)
+                if len(v.members) == world and v.epoch >= 2:
+                    finish(c, rank, sync)
+                    return
+                st.sleep(interval_s)
+            record(rank, error=f"no lockstep after {max_syncs} syncs")
+        except EvictedError as exc:
+            with lock:
+                errors[rank] = f"evicted: {exc}"
+        except Exception as exc:  # surfaced in the result, not swallowed
+            with lock:
+                errors[rank] = f"{type(exc).__name__}: {exc}"
+        finally:
+            st.detach()
+
+    def rejoiner_main(rank):
+        try:
+            c = ElasticCluster(rank=rank, world=world, transport=st,
+                               timeout_s=timeout_s,
+                               ranks_per_slice=ranks_per_slice)
+            view, _ctx = c.rejoin(last_epoch=0,
+                                  timeout_s=max(20 * timeout_s, 120.0))
+            if view.world == world and view.epoch >= 2:
+                finish(c, rank, 0)
+            else:
+                record(rank, error=f"rejoined into epoch {view.epoch} "
+                                   f"world {view.world}")
+        except Exception as exc:
+            with lock:
+                errors[rank] = f"{type(exc).__name__}: {exc}"
+        finally:
+            st.detach()
+
+    threads = []
+    st.attach(len(survivors) + 1)          # survivors + this driver
+    for r in survivors:
+        th = threading.Thread(target=survivor_main, args=(r,),
+                              name=f"simrank-{r}", daemon=True)
+        threads.append(th)
+        th.start()
+    # the driver is the supervisor: wait for the shrink commit, then
+    # relaunch the dead slice. Its deadline (1e9) is far beyond every
+    # rank's, so a driver-side PeerTimeout means every thread already
+    # exited — fall through and report the diagnostics.
+    try:
+        st.get(f"{ns}/decided/e1", 1e9)
+        st.attach(len(victims))
+        for r in victims:
+            th = threading.Thread(target=rejoiner_main, args=(r,),
+                                  name=f"simrank-{r}", daemon=True)
+            threads.append(th)
+            th.start()
+        st.get(f"{ns}/decided/e2", 1e9)
+    except st._PeerTimeout:
+        pass
+    st.detach()                            # driver out of the actor count
+    for th in threads:
+        th.join(timeout=120.0)
+    alive = [th.name for th in threads if th.is_alive()]
+    mem_logger.setLevel(prior_level)
+
+    def rec(epoch):
+        raw = st.peek(f"{ns}/decided/e{epoch}")
+        return None if raw is None else json.loads(raw)
+
+    e1, e2, e3 = rec(1), rec(2), rec(3)
+    lockstep = (not alive and not errors
+                and len(results) == world
+                and all(r.get("lockstep") for r in results.values())
+                and len({r.get("epoch") for r in results.values()}) == 1)
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("sim.storm_runs")
+        tr.event("sim.storm", world=world, kill_slice=kill_slice,
+                 lockstep=lockstep, advances=st.advances)
+    return {
+        "world": world,
+        "ranks_per_slice": ranks_per_slice,
+        "kill_slice": kill_slice,
+        "victims": list(victims),
+        "records": {"e1": e1, "e2": e2, "e3": e3},
+        "lockstep": lockstep,
+        "virtual_s": st.now_s,
+        "clock_advances": st.advances,
+        "errors": dict(sorted(errors.items())[:8]),
+        "stuck_threads": alive[:8],
+    }
+
+
+# ---------------------------------------------------------------------------
+# tuner sim backends
+# ---------------------------------------------------------------------------
+
+
+class VirtualClock:
+    """A `time.perf_counter`-shaped callable over simulated seconds —
+    the `clock=` a `PlanTuner` needs to run its measurement windows
+    offline."""
+
+    def __init__(self, start_s: float = 0.0):
+        self.now_s = float(start_s)
+
+    def advance(self, dt_s: float) -> None:
+        self.now_s += float(dt_s)
+
+    def __call__(self) -> float:
+        return self.now_s
+
+
+def tune_plan_sim(
+    space,
+    plan_fn: Callable[[float], Any],
+    topo: SimTopology,
+    *,
+    compute_time_s: float = 0.030,
+    max_trials: int = 12,
+    interval: int = 5,
+    budget_steps: int = 2000,
+    seed: Optional[int] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> dict:
+    """Run the real `PlanTuner` search entirely offline: every
+    simulated training step advances a `VirtualClock` by the current
+    config's simulated step time, so multi-slice ``partition_mb``
+    splits (and every other axis) become searchable without hardware.
+    Returns the adopted config + the virtual trajectory."""
+    from dear_pytorch_tpu.tuning.planspace import PlanTuner
+
+    seed = default_seed() if seed is None else int(seed)
+    clock = VirtualClock()
+    tuner = PlanTuner(space, max_trials=max_trials, interval=interval,
+                      log=log, clock=clock, seed=seed)
+    cache: Dict[tuple, float] = {}
+
+    def step_time(cfg) -> float:
+        key = (cfg.key(), round(float(getattr(cfg, "threshold_mb", 0.0)),
+                                3))
+        t = cache.get(key)
+        if t is None:
+            res = simulate_training(
+                plan_fn(cfg.threshold_mb), topo, mode=cfg.mode,
+                compute_time_s=compute_time_s,
+                comm_itemsize=2 if cfg.comm_dtype else 4,
+                gather_itemsize=2 if cfg.gather_dtype else 4,
+                compressor=cfg.compressor, density=cfg.density,
+                partition_mb=cfg.partition_mb,
+                steps=1, jitter=0.0, seed=seed)
+            t = cache[key] = res["step_time_s"]
+        return t
+
+    steps = 0
+    while not tuner.finished and steps < budget_steps:
+        clock.advance(step_time(tuner.current))
+        switched = tuner.step()
+        if switched is not None:
+            tuner.notify_rebuild()
+        steps += 1
+    best = tuner.current
+    return {
+        "best": best.to_dict(),
+        "virtual_steps": steps,
+        "virtual_s": clock.now_s,
+        "finished": tuner.finished,
+        "best_step_time_s": step_time(best),
+    }
+
+
+def tune_serve_sim(
+    space,
+    topo: SimTopology,
+    trace: TrafficTrace,
+    *,
+    tick_base_s: float = 1e-3,
+    weight_bytes: float = 0.0,
+    n_projections: int = 0,
+    max_trials: int = 8,
+    seed: Optional[int] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> dict:
+    """Drive the real `ServeTuner` with simulated episodes: each trial
+    replays ``trace`` under the candidate `ServeConfig` and books the
+    simulated p99 — the closed-loop storm harness without the storm."""
+    from dear_pytorch_tpu.tuning.planspace import ServeTuner
+
+    seed = default_seed() if seed is None else int(seed)
+    tuner = ServeTuner(space, max_trials=max_trials, log=log, seed=seed)
+    episodes = {}
+    while not tuner.finished:
+        cfg = tuner.current
+        ep = simulate_serving(
+            topo, trace, prefill_chunk=cfg.chunk, slots=cfg.slots,
+            tp_decode=cfg.tp_decode, tick_base_s=tick_base_s,
+            weight_bytes=weight_bytes, n_projections=n_projections,
+            replicas=1)
+        episodes[cfg.describe()] = ep
+        tuner.observe(ep["p99_s"])
+    best = tuner.current
+    return {"best": best.to_dict(), "episodes": episodes,
+            "best_p99_s": episodes.get(best.describe(), {}).get("p99_s")}
+
+
+# -- the fleet axis: replica count + autoscale policy, PlanTuner-shaped -----
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """One point of the fleet space (hashable, JSON-safe): replica
+    count × autoscaling on/off × the continuous backlog threshold the
+    autoscaler scales up at (per-arm BO refines it)."""
+
+    up_threshold: float = 4.0
+    replicas: int = 1
+    autoscale: bool = False
+
+    def key(self) -> tuple:
+        return (self.replicas, self.autoscale)
+
+    def describe(self) -> str:
+        base = f"R={self.replicas}"
+        if self.autoscale:
+            base += f"/auto@{self.up_threshold:.1f}"
+        return base
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FleetSpace:
+    """Replica-count / autoscale search space with the same tuner-facing
+    interface as `PlanSpace`/`ServeSpace` (`configs` / `feasible` /
+    `cont_bound` / `default_config`) so the `PlanTuner` sweep/prune/BO
+    machinery drives it unchanged (`FleetTuner`)."""
+
+    def __init__(self, *, replicas: Sequence[int] = (1, 2, 4),
+                 autoscale: Sequence[bool] = (False, True),
+                 threshold_bound: tuple[float, float] = (1.0, 16.0),
+                 max_replicas: int = 16):
+        self.replicas = tuple(int(r) for r in replicas)
+        if any(r < 1 for r in self.replicas):
+            raise ValueError(f"bad replicas axis {replicas}")
+        self.autoscale = tuple(bool(a) for a in autoscale)
+        self.threshold_bound = (float(threshold_bound[0]),
+                                float(threshold_bound[1]))
+        self.max_replicas = int(max_replicas)
+
+    @property
+    def cont_bound(self) -> tuple[float, float]:
+        return self.threshold_bound
+
+    def default_config(self) -> FleetConfig:
+        return FleetConfig(
+            up_threshold=0.5 * sum(self.threshold_bound),
+            replicas=self.replicas[0], autoscale=False)
+
+    def feasible(self, config: FleetConfig) -> Optional[str]:
+        if config.replicas > self.max_replicas:
+            return (f"{config.replicas} replicas exceeds the pool cap "
+                    f"{self.max_replicas}")
+        return None
+
+    def configs(self, thr: Optional[float] = None) -> List[FleetConfig]:
+        t = (float(thr) if thr is not None
+             else 0.5 * sum(self.threshold_bound))
+        out = []
+        for r in self.replicas:
+            for a in self.autoscale:
+                cfg = FleetConfig(up_threshold=t, replicas=r, autoscale=a)
+                if self.feasible(cfg) is None:
+                    out.append(cfg)
+        return out
+
+
+def _serve_tuner_cls():
+    from dear_pytorch_tpu.tuning.planspace import ServeTuner
+
+    class FleetTuner(ServeTuner):
+        """`ServeTuner`'s episode protocol over the fleet axes — the
+        continuous field is the autoscaler's backlog threshold."""
+
+        CONT_FIELD = "up_threshold"
+
+    return FleetTuner
+
+
+def tune_fleet_sim(
+    space: FleetSpace,
+    topo: SimTopology,
+    trace: TrafficTrace,
+    *,
+    prefill_chunk: int = 4,
+    slots: int = 4,
+    tick_base_s: float = 1e-3,
+    cost_per_replica_s: float = 0.0,
+    max_trials: int = 8,
+    seed: Optional[int] = None,
+    log: Callable[[str], None] = lambda s: None,
+) -> dict:
+    """Search replica count + autoscaling policy offline: each episode
+    replays ``trace`` against the candidate fleet; the objective is
+    simulated p99 plus ``cost_per_replica_s × mean_replicas`` (the
+    latency/capacity trade an operator actually tunes)."""
+    seed = default_seed() if seed is None else int(seed)
+    tuner = _serve_tuner_cls()(space, max_trials=max_trials, log=log,
+                               seed=seed)
+    episodes = {}
+    while not tuner.finished:
+        cfg = tuner.current
+        pol = None
+        if cfg.autoscale:
+            pol = {"min": 1, "max": space.max_replicas,
+                   "up_q": cfg.up_threshold, "down_q": 0.5,
+                   "interval_s": 0.25}
+        ep = simulate_serving(
+            topo, trace, prefill_chunk=prefill_chunk, slots=slots,
+            tick_base_s=tick_base_s, replicas=cfg.replicas,
+            autoscale=pol)
+        y = ep["p99_s"] + cost_per_replica_s * ep["mean_replicas"]
+        episodes[cfg.describe()] = dict(ep, objective=y)
+        tuner.observe(y)
+    best = tuner.current
+    return {"best": best.to_dict(), "episodes": episodes,
+            "best_objective": episodes.get(best.describe(),
+                                           {}).get("objective")}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _parse_layers(raw: str) -> List[int]:
+    return [int(x) for x in raw.split(",") if x.strip()]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m dear_pytorch_tpu.observability.sim",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--topology", default=None,
+                    help="topology JSON (file path or inline)")
+    ap.add_argument("--calibration", default=None,
+                    help="α-β calibration JSON (file path or inline; "
+                         "e.g. a perf/ artifact embedding one)")
+    ap.add_argument("--seed", type=int, default=None)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="replay a schedule mode")
+    t.add_argument("--mode", default="dear")
+    t.add_argument("--layers", default="1000000,250000,250000,1000000",
+                   help="comma-separated layer element counts")
+    t.add_argument("--threshold-mb", type=float, default=4.0)
+    t.add_argument("--partition-mb", type=float, default=None)
+    t.add_argument("--compute-ms", type=float, default=30.0)
+    t.add_argument("--steps", type=int, default=None)
+
+    s = sub.add_parser("serve", help="replay a serving fleet")
+    s.add_argument("--rps", type=float, default=500.0)
+    s.add_argument("--duration-s", type=float, default=2.0)
+    s.add_argument("--prompt", type=int, default=16)
+    s.add_argument("--decode", type=int, default=4)
+    s.add_argument("--chunk", type=int, default=4)
+    s.add_argument("--slots", type=int, default=4)
+    s.add_argument("--replicas", type=int, default=None)
+    s.add_argument("--tick-ms", type=float, default=1.0)
+
+    m = sub.add_parser("storm", help="membership storm on SimTransport")
+    m.add_argument("--world", type=int, default=1000)
+    m.add_argument("--ranks-per-slice", type=int, default=125)
+    m.add_argument("--kill-slice", type=int, default=1)
+    m.add_argument("--timeout-s", type=float, default=None)
+
+    f = sub.add_parser("tune-fleet", help="replica/autoscale search")
+    f.add_argument("--rps", type=float, default=800.0)
+    f.add_argument("--duration-s", type=float, default=2.0)
+    f.add_argument("--prompt", type=int, default=16)
+    f.add_argument("--decode", type=int, default=4)
+    f.add_argument("--max-trials", type=int, default=8)
+    f.add_argument("--cost-per-replica-s", type=float, default=0.0)
+
+    args = ap.parse_args(argv)
+    topo = SimTopology()
+    if args.calibration:
+        calib = load_calibration(args.calibration)
+        topo = SimTopology.from_calibration(calib)
+    if args.topology:
+        topo = load_topology(args.topology)
+    seed = default_seed() if args.seed is None else args.seed
+
+    if args.cmd == "train":
+        plan = synthetic_plan(_parse_layers(args.layers),
+                              topo.chips_per_slice,
+                              threshold_mb=args.threshold_mb)
+        out = simulate_training(
+            plan, topo, mode=args.mode,
+            compute_time_s=args.compute_ms * 1e-3,
+            partition_mb=args.partition_mb, steps=args.steps, seed=seed)
+    elif args.cmd == "serve":
+        trace = TrafficTrace.poisson(
+            rps=args.rps, duration_s=args.duration_s,
+            prompt_tokens=args.prompt, decode_tokens=args.decode,
+            seed=seed)
+        out = simulate_serving(
+            topo, trace, prefill_chunk=args.chunk, slots=args.slots,
+            replicas=args.replicas, tick_base_s=args.tick_ms * 1e-3)
+    elif args.cmd == "storm":
+        out = run_membership_storm(
+            world=args.world, ranks_per_slice=args.ranks_per_slice,
+            kill_slice=args.kill_slice, timeout_s=args.timeout_s)
+    else:  # tune-fleet
+        trace = TrafficTrace.poisson(
+            rps=args.rps, duration_s=args.duration_s,
+            prompt_tokens=args.prompt, decode_tokens=args.decode,
+            seed=seed)
+        out = tune_fleet_sim(
+            FleetSpace(), topo, trace, max_trials=args.max_trials,
+            cost_per_replica_s=args.cost_per_replica_s, seed=seed)
+    print(json.dumps(out, indent=2, sort_keys=True, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

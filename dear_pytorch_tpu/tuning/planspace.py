@@ -354,105 +354,45 @@ class PlanSpace:
 
 
 # ---------------------------------------------------------------------------
-# cost model: the overlap auditor's exposed-comm estimate as a trial pruner
+# cost models: re-exported from the observability/costmodel.py waist
 # ---------------------------------------------------------------------------
 
 
-class CostModel:
-    """Analytic per-config step-time floor from the α-β interconnect fit.
+def _costmodel_module():
+    """The `observability.costmodel` waist, loadable BOTH ways this file
+    is: as the package module (normal imports) and STANDALONE —
+    `scripts/check_telemetry_overhead.py` loads planspace.py via
+    importlib with no package import under a "no jax" contract, and
+    costmodel.py keeps the same stdlib-only-at-module-level bar, so a
+    plain path-load works there too."""
+    import importlib.util
+    import sys
 
-    ``comm(config)`` prices the config's collective legs via
-    `counters.plan_comm_accounting` (compression ratios and wire dtypes
-    included) x `overlap.predict_leg_times`. Because the raw α-β fit
-    systematically overestimates in-program collectives (dispatch overhead
-    the compiled step amortizes — `overlap.audit_train_step` documents
-    this on CPU emulation), the model calibrates one multiplicative scale
-    from live measurements: ``scale = min(measured / comm_pred)`` over
-    observed configs, capped at 1. The pruning floor is the ideal-overlap
-    bound ``max(compute_est, scale x comm_pred)`` where ``compute_est`` is
-    the median of ``measured − scale x comm_pred`` over observations
-    (remat='full' scales it by ``remat_factor``). Sound up to the stated
-    assumption that the fit's error is a config-independent factor.
-    """
+    mod = sys.modules.get("dear_pytorch_tpu.observability.costmodel")
+    if mod is not None:
+        return mod
+    if "dear_pytorch_tpu" in sys.modules:
+        from dear_pytorch_tpu.observability import costmodel
+        return costmodel
+    name = "_planspace_costmodel"
+    mod = sys.modules.get(name)
+    if mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "observability", "costmodel.py")
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod  # register BEFORE exec (dataclasses)
+        spec.loader.exec_module(mod)
+    return mod
 
-    def __init__(self, plan_fn: Callable[[float], Any], alpha: float,
-                 beta: float, *, remat_factor: float = 1.3,
-                 num_slices: int = 1,
-                 dcn_alpha: Optional[float] = None,
-                 dcn_beta: Optional[float] = None):
-        self._plan_fn = plan_fn      # threshold_mb -> FusionPlan
-        self.alpha = float(alpha)
-        self.beta = float(beta)
-        self.remat_factor = float(remat_factor)
-        #: multi-slice pricing: the 'dcn' accounting rows (cross-slice
-        #: host exchange, chunked at each config's ``partition_mb``) are
-        #: costed with their OWN link fit — ICI and DCN α-β constants
-        #: differ by orders of magnitude, so one fit cannot rank a
-        #: partition/threshold trade across levels (the FlexLink point).
-        #: With no DCN fit the rows fall back to the intra-slice fit
-        #: (`overlap.predict_leg_times` states the same behavior).
-        self.num_slices = int(num_slices)
-        self.dcn_alpha = None if dcn_alpha is None else float(dcn_alpha)
-        self.dcn_beta = None if dcn_beta is None else float(dcn_beta)
-        self._plans: dict = {}
-        self._obs: list[tuple[float, float]] = []   # (comm_pred, measured)
 
-    def _plan(self, threshold_mb: float):
-        key = round(float(threshold_mb), 3)
-        plan = self._plans.get(key)
-        if plan is None:
-            plan = self._plans[key] = self._plan_fn(key)
-        return plan
+_CM = _costmodel_module()
 
-    def comm(self, config: PlanConfig) -> float:
-        """Uncalibrated unoverlapped comm seconds for one config."""
-        from dear_pytorch_tpu.observability import counters as CTR
-        from dear_pytorch_tpu.observability import overlap as OV
-
-        acct = CTR.plan_comm_accounting(
-            self._plan(config.threshold_mb), mode=config.mode,
-            comm_itemsize=_DTYPE_ITEMSIZE[config.comm_dtype],
-            gather_itemsize=_DTYPE_ITEMSIZE[config.gather_dtype],
-            compressor=config.compressor, density=config.density,
-            num_slices=self.num_slices,
-            dcn_partition_mb=config.partition_mb,
-        )
-        return float(sum(OV.predict_leg_times(
-            acct, self.alpha, self.beta,
-            dcn_alpha=self.dcn_alpha, dcn_beta=self.dcn_beta)))
-
-    def observe(self, config: PlanConfig, measured_s: float) -> None:
-        if measured_s > 0 and math.isfinite(measured_s):
-            self._obs.append((self.comm(config), float(measured_s)))
-
-    @property
-    def _scale(self) -> float:
-        ratios = [m / c for c, m in self._obs if c > 0]
-        return min(min(ratios), 1.0) if ratios else 1.0
-
-    @property
-    def compute_est(self) -> Optional[float]:
-        """LOWER bound on the config-independent compute: the MINIMUM
-        residual over observations. A config whose slowness is compute
-        the model cannot see (e.g. software-emulated bf16 casts on CPU)
-        would drag any averaged estimate up and prune arms that are
-        genuinely cheap (observed: one 17s/step bf16 trial set a median
-        compute above every arm's bar and retired the whole space) —
-        pruning soundness needs the floor to UNDERestimate, never over."""
-        if not self._obs:
-            return None
-        s = self._scale
-        return min(max(m - s * c, 0.0) for c, m in self._obs)
-
-    def floor(self, config: PlanConfig) -> Optional[float]:
-        """Ideal-overlap step-time floor, or None before any calibration
-        observation exists (never prune blind)."""
-        compute = self.compute_est
-        if compute is None:
-            return None
-        if config.remat == "full":
-            compute = compute * self.remat_factor
-        return max(compute, self._scale * self.comm(config))
+#: `CostModel` and `ServeCostModel` moved to
+#: `observability/costmodel.py` (one α-β waist, shared with the
+#: simulator); these aliases keep every existing
+#: `tuning.planspace.CostModel` / `.ServeCostModel` caller unchanged.
+CostModel = _CM.CostModel
 
 
 # ---------------------------------------------------------------------------
@@ -1025,81 +965,10 @@ class ServeSpace:
         return out
 
 
-class ServeCostModel:
-    """Analytic per-request latency floor for `ServeConfig`s — the α-β
-    serve-cost model that lets the tuner prune serving arms before they
-    burn a live closed-loop episode.
-
-    The request model: a P-token prompt + D generated tokens costs
-    ``ceil(P/C) + D`` engine ticks; ring-TP decode adds per-tick ring
-    transport priced by the α-β interconnect fit — each of the
-    ``n_projections`` ring collective-matmuls per tick moves the weight's
-    non-local rows: ``(W-1) x α latency + (W-1)/W x weight_bytes x β``.
-    Mirroring `CostModel`'s soundness rule, the per-tick compute base is
-    calibrated from live episodes as the MINIMUM residual rate (an
-    underestimate — pruning must never retire a genuinely cheap arm),
-    and `floor` returns None before any calibration exists (never prune
-    blind).
-    """
-
-    def __init__(self, *, prompt_tokens: float, decode_tokens: float,
-                 alpha: float = 0.0, beta: float = 0.0, world: int = 1,
-                 weight_bytes: float = 0.0, n_projections: int = 0):
-        self.prompt_tokens = float(prompt_tokens)
-        self.decode_tokens = float(decode_tokens)
-        self.alpha = float(alpha)
-        self.beta = float(beta)
-        self.world = max(int(world), 1)
-        self.weight_bytes = float(weight_bytes)
-        self.n_projections = int(n_projections)
-        self._obs: list[tuple[float, float, float]] = []  # (ticks, comm, y)
-
-    def ticks(self, config: ServeConfig) -> float:
-        """Engine ticks to serve the model request under ``config``."""
-        return (math.ceil(self.prompt_tokens / config.chunk)
-                + self.decode_tokens)
-
-    def _comm_per_tick(self, config: ServeConfig) -> float:
-        if not config.tp_decode or self.world < 2:
-            return 0.0
-        w = self.world
-        per_ring = (w - 1) * self.alpha \
-            + (w - 1) / w * self.weight_bytes * self.beta
-        return self.n_projections * per_ring
-
-    def comm(self, config: ServeConfig) -> float:
-        """Analytic sweep price: per-request ring-transport seconds, with
-        a tick-count epsilon so equal-comm (dense) arms order
-        fewest-ticks-first."""
-        return (self.ticks(config) * self._comm_per_tick(config)
-                + 1e-9 * self.ticks(config))
-
-    def observe(self, config: ServeConfig, measured_s: float) -> None:
-        if measured_s > 0 and math.isfinite(measured_s):
-            self._obs.append((self.ticks(config), self.comm(config),
-                              float(measured_s)))
-
-    @property
-    def _scale(self) -> float:
-        ratios = [y / c for t, c, y in self._obs if c > 1e-6]
-        return min(min(ratios), 1.0) if ratios else 1.0
-
-    @property
-    def tick_rate_est(self) -> Optional[float]:
-        """LOWER bound on the per-tick compute cost: minimum residual
-        rate over observations (`CostModel.compute_est` rationale)."""
-        if not self._obs:
-            return None
-        s = self._scale
-        return min(max(y - s * c, 0.0) / t for t, c, y in self._obs if t)
-
-    def floor(self, config: ServeConfig) -> Optional[float]:
-        rate = self.tick_rate_est
-        if rate is None:
-            return None
-        return (rate * self.ticks(config)
-                + self._scale * self.ticks(config)
-                * self._comm_per_tick(config))
+#: `ServeCostModel` lives in `observability/costmodel.py` next to
+#: `CostModel` (same calibration soundness rules, same simulator
+#: consumer) — re-exported here for its historical import path.
+ServeCostModel = _CM.ServeCostModel
 
 
 class ServeTuner(PlanTuner):

@@ -59,10 +59,16 @@ def main(argv=None) -> int:
                 if m == "dear_pytorch_tpu.analysis"
                 or m.startswith("dear_pytorch_tpu.analysis.")}
 
+    def _sim_modules():
+        return {m for m in sys.modules
+                if m == "dear_pytorch_tpu.observability.sim"
+                or m.startswith("dear_pytorch_tpu.observability.sim.")}
+
     # snapshot before the telemetry machinery loads (the test harness
     # may legitimately have the analyzer imported already — what must
     # be zero is what the HOT-PATH machinery itself drags in)
     analysis_pre = _analysis_modules()
+    sim_pre = _sim_modules()
 
     # Load tracer.py standalone (importlib, not the package): importing
     # dear_pytorch_tpu.observability would execute the package __init__
@@ -249,9 +255,14 @@ def main(argv=None) -> int:
     # zero analysis modules — its hot-path cost is zero imports, zero
     # bytes.
     analysis_loaded = bool(_analysis_modules() - analysis_pre)
+    # Same contract for the simulator (dearsim is offline tooling: 875
+    # threads, event heaps, a virtual-time transport — none of it may
+    # ride along when the hot-path gates load)
+    sim_loaded = bool(_sim_modules() - sim_pre)
 
     out = {
         "analysis_imported": analysis_loaded,
+        "sim_imported": sim_loaded,
         "baseline_ns_per_call": round(baseline_ns, 1),
         "disabled_ns_per_call": round(disabled_ns, 1),
         "enabled_ns_per_call": round(enabled_ns, 1),
@@ -271,6 +282,7 @@ def main(argv=None) -> int:
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
         "ok": (not analysis_loaded
+               and not sim_loaded
                and disabled_ns <= args.budget_ns
                and fl_disabled_ns <= args.budget_ns
                and k_disabled_ns <= args.budget_ns

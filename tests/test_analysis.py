@@ -26,6 +26,7 @@ from dear_pytorch_tpu.analysis.rules_host import (
 from dear_pytorch_tpu.analysis.rules_registry import (
     CounterDocsRule, EnvRegistryRule,
 )
+from dear_pytorch_tpu.analysis.rules_sim import SimDeterminismRule
 from dear_pytorch_tpu.analysis.rules_trace import (
     DcnBlockingRule, DonationAliasRule, HotPathSyncRule,
     UngatedTelemetryRule,
@@ -325,6 +326,56 @@ def test_dcn_blocking_red_and_green(tmp_path):
         ("dear_pytorch_tpu/x/red.py", "R.publish", "self._transport.get"),
         ("dear_pytorch_tpu/x/red.py", "R._fetch", "self.dcn.exchange"),
     }
+
+
+def test_sim_determinism_red_and_green(tmp_path):
+    # the rule is scoped to the one module carrying the determinism
+    # contract — fixtures plant the fake sim.py at that exact relpath
+    _plant(tmp_path, "dear_pytorch_tpu/observability/sim.py", """
+        import random
+        import time
+
+        def jittered(seed):
+            rng = random.Random(seed)            # green: seeded
+            arrivals = random.Random(x=seed)     # green: seeded kwarg
+            t0 = time.monotonic()                # RED: wall clock
+            time.sleep(0.01)                     # RED: wall clock
+            bad = random.Random()                # RED: unseeded
+            v = random.gauss(0.0, 1.0)           # RED: process-global
+            return rng.gauss(0.0, v)             # green: instance call
+
+        def healer(ev, thread):
+            ev.wait(1.0)                         # green: bounded wait
+            thread.join(0.2)                     # green: bounded join
+    """)
+    # the identical violations OUTSIDE sim.py are other code's
+    # business, not this rule's (green twin by scope)
+    _plant(tmp_path, "dear_pytorch_tpu/observability/other.py", """
+        import random
+        import time
+
+        def bench():
+            return time.monotonic(), random.random()
+    """)
+    found = _findings(tmp_path, SimDeterminismRule())
+    assert {(f.path, f.qualname, f.key) for f in found} == {
+        ("dear_pytorch_tpu/observability/sim.py", "jittered",
+         "time.monotonic"),
+        ("dear_pytorch_tpu/observability/sim.py", "jittered",
+         "time.sleep"),
+        ("dear_pytorch_tpu/observability/sim.py", "jittered",
+         "random.Random"),
+        ("dear_pytorch_tpu/observability/sim.py", "jittered",
+         "random.gauss"),
+    }
+
+
+def test_sim_determinism_live_module_clean():
+    # the shipping simulator itself must satisfy its own contract
+    scanner = Scanner(
+        [os.path.join(REPO, "dear_pytorch_tpu", "observability",
+                      "sim.py")], root=REPO)
+    assert scanner.run([SimDeterminismRule()]) == []
 
 
 def test_env_registry_both_directions(tmp_path):
