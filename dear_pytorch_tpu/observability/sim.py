@@ -61,6 +61,7 @@ from dear_pytorch_tpu.observability.costmodel import (
 __all__ = [
     "SimTopology", "load_topology", "synthetic_plan",
     "simulate_training", "simulate_serving", "TrafficTrace",
+    "phase_ticks_from_admission",
     "SimTransport", "run_membership_storm",
     "VirtualClock", "tune_plan_sim", "tune_serve_sim", "tune_fleet_sim",
     "FleetConfig", "FleetSpace", "FleetTuner",
@@ -511,6 +512,22 @@ def _tick_time_s(topo: SimTopology, *, tick_base_s: float,
     return tick_base_s + n_projections * per_ring
 
 
+def phase_ticks_from_admission(admission, prefill_chunk: int,
+                               ) -> Tuple[float, float]:
+    """Convert a live `serving.admission.AdmissionController`'s learned
+    per-token phase rates into the sim's per-tick seconds: a prefill
+    tick processes ``prefill_chunk`` prompt tokens, a decode tick one
+    token. Returns ``(prefill_tick_s, decode_tick_s)`` (0.0 for a phase
+    the controller has not observed yet — callers should fall back to
+    the blended tick). This is the ROADMAP item-3 headroom fix: the sim
+    prices the two phases at their *measured* rates instead of one
+    blended tick, which is what makes chunked-prefill A/B deltas from
+    recorded `serve_tune` episodes reproducible in simulation."""
+    pr = float(getattr(admission, "prefill_rate_s", 0.0) or 0.0)
+    dr = float(getattr(admission, "decode_rate_s", 0.0) or 0.0)
+    return pr * max(int(prefill_chunk), 1), dr
+
+
 def simulate_serving(
     topo: SimTopology,
     trace: TrafficTrace,
@@ -523,6 +540,8 @@ def simulate_serving(
     n_projections: int = 0,
     replicas: Optional[int] = None,
     autoscale: Optional[dict] = None,
+    prefill_tick_s: Optional[float] = None,
+    decode_tick_s: Optional[float] = None,
 ) -> dict:
     """Replay ``trace`` against a fleet of ``replicas`` engines, each
     with ``slots`` concurrent request slots. Requests cost
@@ -531,7 +550,14 @@ def simulate_serving(
     ``autoscale`` policy ``{"min": .., "max": .., "up_q": ..,
     "down_q": .., "interval_s": .., "provision_s": ..}`` grows the
     fleet when per-replica backlog exceeds ``up_q`` and shrinks it
-    below ``down_q``. Emits `serve_tune`-shaped episode metrics."""
+    below ``down_q``. Emits `serve_tune`-shaped episode metrics.
+
+    ``prefill_tick_s`` / ``decode_tick_s`` price the two phases
+    separately (seconds per prefill tick of ``prefill_chunk`` tokens /
+    per decode tick of one token) — feed them from a recorded
+    admission controller via `phase_ticks_from_admission`. Either left
+    None falls back to the blended `_tick_time_s` tick, so existing
+    callers are unchanged."""
     replicas = topo.replicas if replicas is None else int(replicas)
     replicas = max(replicas, 1)
     chunk = max(int(prefill_chunk), 1)
@@ -539,6 +565,8 @@ def simulate_serving(
                         tp_decode=tp_decode,
                         weight_bytes=float(weight_bytes),
                         n_projections=int(n_projections))
+    pt = tick if not prefill_tick_s else float(prefill_tick_s)
+    dt = tick if not decode_tick_s else float(decode_tick_s)
     pol = dict(autoscale or {})
     nmax = int(pol.get("max", replicas))
     nmin = int(pol.get("min", replicas))
@@ -552,7 +580,7 @@ def simulate_serving(
     events: List[Tuple[float, int, int, float]] = []  # (t, kind, rep, t0)
     _ARRIVE, _DONE, _SCALE = 0, 1, 2
     for (t, p, d) in trace.requests:
-        svc = (math.ceil(p / chunk) + d) * tick
+        svc = math.ceil(p / chunk) * pt + d * dt
         total_ticks += math.ceil(p / chunk) + d
         heapq.heappush(events, (t, _ARRIVE, -1, svc))
     if pol:

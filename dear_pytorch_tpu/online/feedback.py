@@ -72,11 +72,17 @@ from dear_pytorch_tpu.resilience.retry import RetryError, retry_call
 logger = logging.getLogger("dear_pytorch_tpu")
 
 __all__ = ["FeedbackWriter", "FeedbackReader", "Cursor", "record_digest",
+           "shard_of", "compact_segments", "poison_records",
            "STREAM_PREFIX"]
 
 STREAM_PREFIX = "feedback"
 
 _SEG_RE = re.compile(r"seg_(\d{8})\.(jsonl|json)$")
+
+#: per-writer compaction marker (see `compact_segments`): its presence
+#: IS the commit that segments strictly below ``below`` are gone, and
+#: its ledger fields are what a replay of them would have produced
+COMPACT_BASENAME = "COMPACTED.json"
 
 
 def _seg_payload_key(stream: str, writer: str, seg: int) -> str:
@@ -85,6 +91,23 @@ def _seg_payload_key(stream: str, writer: str, seg: int) -> str:
 
 def _seg_manifest_key(stream: str, writer: str, seg: int) -> str:
     return f"{STREAM_PREFIX}/{stream}/{writer}/seg_{seg:08d}.json"
+
+
+def _compact_key(stream: str, writer: str) -> str:
+    return f"{STREAM_PREFIX}/{stream}/{writer}/{COMPACT_BASENAME}"
+
+
+def shard_of(writer: str, num_shards: int) -> int:
+    """Stable writer→shard assignment for partitioned ingest: a sha256
+    of the writer id mod the shard count — identical on every process
+    regardless of PYTHONHASHSEED, hash randomization, or member order,
+    which is what lets `online.ingest.FeedbackIngest.reshard`
+    redistribute cursor ownership across a world change with no state
+    transfer (every rank derives the same new assignment)."""
+    if num_shards <= 1:
+        return 0
+    h = hashlib.sha256(writer.encode()).digest()
+    return int.from_bytes(h[:8], "big") % int(num_shards)
 
 
 def record_digest(writer: str, seq: int) -> int:
@@ -138,6 +161,7 @@ class FeedbackWriter:
         self._flushes = 0          # injector step clock (torn_seg)
         self._last_committed: Optional[dict] = None
         self._dup_pending = False
+        self._poisoning = False    # reentrancy guard (poison_feedback)
         # plain-int accounting (works with telemetry disabled)
         self.appended = 0
         self.committed = 0
@@ -188,12 +212,14 @@ class FeedbackWriter:
         into the caller: a full buffer drops the NEW record and counts
         ``online.append_drops``. Returns False on a drop."""
         self._appends += 1
-        if (self.injector is not None
-                and self.injector.duplicate_feedback(self._appends)):
-            # an at-least-once producer retry: re-append the last
-            # COMMITTED record verbatim (same uid/seq) — the reader's
-            # dedup, not the writer, must absorb it
-            self._dup_pending = True
+        burst = 0
+        if self.injector is not None and not self._poisoning:
+            if self.injector.duplicate_feedback(self._appends):
+                # an at-least-once producer retry: re-append the last
+                # COMMITTED record verbatim (same uid/seq) — the reader's
+                # dedup, not the writer, must absorb it
+                self._dup_pending = True
+            burst = self.injector.poison_burst(self._appends)
         with self._lock:
             if len(self._buffer) >= self.max_buffer:
                 self.append_drops += 1
@@ -218,6 +244,20 @@ class FeedbackWriter:
             tr.count("online.records_appended")
         if full:
             self._wake.set()
+        if burst:
+            # adversarial clients modeled through the REAL append path:
+            # poison records are stamped, committed, and ledger-accounted
+            # like any other record — the quality gate above the reader,
+            # not the log, is what keeps them out of training
+            self._poisoning = True
+            try:
+                for rec in poison_records(burst):
+                    self.append(rec)
+            finally:
+                self._poisoning = False
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                tr.count("online.poison_injected", burst)
         return True
 
     @property
@@ -346,21 +386,41 @@ class FeedbackWriter:
 @dataclasses.dataclass
 class _WriterPos:
     """One writer's read position: next segment to open, line offset
-    into it, the dedup high-water seq, and consumed count."""
+    into it, the dedup high-water seq, and consumed count — plus the
+    per-writer slice of every roll-up the `Cursor` totals (checksum,
+    dedup, torn, dropped). Per-writer roll-ups are what make the cursor
+    *partitionable*: a shard that owns a subset of writers advances only
+    their fields, and any rank can recompute the exact union totals from
+    a merged per-writer table (`Cursor.recompute_rollups`) — the
+    mass-preservation idiom, applied to the data-plane ledger."""
 
     seg: int = 0
     off: int = 0
     max_seq: int = -1
     consumed: int = 0
+    checksum: int = 0   # sum of record_digest() mod 2**64, this writer
+    dedup: int = 0
+    torn: int = 0
+    dropped: int = 0    # committed-but-corrupt records walked past
 
     def to_dict(self) -> dict:
         return {"seg": self.seg, "off": self.off,
-                "max_seq": self.max_seq, "consumed": self.consumed}
+                "max_seq": self.max_seq, "consumed": self.consumed,
+                "checksum": str(self.checksum),  # > 2**53: as string
+                "dedup": self.dedup, "torn": self.torn,
+                "dropped": self.dropped}
 
     @classmethod
     def from_dict(cls, d: dict) -> "_WriterPos":
+        # pre-partitioning sidecars lack the per-writer roll-ups; they
+        # default to 0 (the Cursor-level totals those sidecars carry
+        # stay authoritative unless recompute_rollups is called)
         return cls(seg=int(d["seg"]), off=int(d["off"]),
-                   max_seq=int(d["max_seq"]), consumed=int(d["consumed"]))
+                   max_seq=int(d["max_seq"]), consumed=int(d["consumed"]),
+                   checksum=int(d.get("checksum", 0)),
+                   dedup=int(d.get("dedup", 0)),
+                   torn=int(d.get("torn", 0)),
+                   dropped=int(d.get("dropped", 0)))
 
 
 class Cursor:
@@ -404,6 +464,38 @@ class Cursor:
 
     def copy(self) -> "Cursor":
         return Cursor.from_dict(self.to_dict())
+
+    def recompute_rollups(self) -> None:
+        """Re-derive every Cursor-level total from the per-writer
+        fields. Partitioned ingest calls this after merging shard
+        positions from the fleet exchange — the union totals then equal
+        the sum over disjoint shard slices, which is exactly the
+        union-balance the replay audit checks. Only valid on cursors
+        whose writers carry the per-writer roll-ups (anything written
+        since they exist); a legacy sidecar restored into replica-global
+        mode never takes this path."""
+        self.consumed_total = sum(p.consumed for p in self.writers.values())
+        self.dedup_hits = sum(p.dedup for p in self.writers.values())
+        self.torn_segments = sum(p.torn for p in self.writers.values())
+        self.dropped_committed = sum(
+            p.dropped for p in self.writers.values())
+        self.checksum = sum(
+            p.checksum for p in self.writers.values()) % (1 << 64)
+
+    def shard_slice(self, shard: int, num_shards: int) -> dict:
+        """The (consumed, checksum, writers) slice this shard owns under
+        `shard_of` — the per-shard cursor the union balance is audited
+        over. Disjoint across shards; the union over all shards is the
+        full cursor."""
+        owned = {w: p for w, p in self.writers.items()
+                 if shard_of(w, num_shards) == int(shard)}
+        return {
+            "shard": int(shard),
+            "writers": sorted(owned),
+            "consumed": sum(p.consumed for p in owned.values()),
+            "checksum": str(sum(p.checksum for p in owned.values())
+                            % (1 << 64)),
+        }
 
 
 class FeedbackReader:
@@ -495,12 +587,65 @@ class FeedbackReader:
         total = 0
         for writer, top in frontier.items():
             cum = self._cum_counts.setdefault(writer, [])
+            if not cum:
+                # segments below a compaction cut have no manifests —
+                # the marker carries their committed total. The filled
+                # prefix is flat (per-segment splits died with the
+                # manifests), but compaction never deletes the newest
+                # committed segment, so every frontier indexes at or
+                # past the cut and only the total is ever read
+                mk = self._compaction_marker(writer)
+                if mk is not None and int(mk["below"]) > 0:
+                    cum.extend([int(mk.get("committed", 0))]
+                               * int(mk["below"]))
             while len(cum) <= top:
                 man = self._manifest(writer, len(cum))
                 n = 0 if man is None else int(man.get("count", 0))
                 cum.append((cum[-1] if cum else 0) + n)
             total += cum[top] if top >= 0 else 0
         return total
+
+    def _compaction_marker(self, writer: str) -> Optional[dict]:
+        """The writer's compaction marker, if retention ever ran. Never
+        cached: markers advance in place (the one mutable object in the
+        stream layout), and this is only read on the rare
+        missing-segment path."""
+        try:
+            return json.loads(self.store.get_bytes(
+                _compact_key(self.stream, writer)))
+        except (KeyError, ValueError, TypeError):
+            return None
+
+    @staticmethod
+    def _fast_forward(cursor: Cursor, pos: _WriterPos, writer: str,
+                      mk: dict, tr) -> None:
+        """Jump a cursor sitting below a compaction cut to the cut,
+        adopting the marker's ledger fields. The marker was computed by
+        replaying the deleted segments with these exact take() rules, and
+        the cursor's consumption below the cut is a deterministic prefix
+        of that replay — so adopting the absolute marker values advances
+        every roll-up by exactly what reading the deleted segments would
+        have: the replay audit still balances, and a reader at (or past)
+        the frontier never observes a gap."""
+        mk_ck = int(mk.get("checksum", 0))
+        cursor.consumed_total += int(mk["consumed"]) - pos.consumed
+        cursor.checksum = (cursor.checksum + mk_ck
+                           - pos.checksum) % (1 << 64)
+        cursor.dedup_hits += int(mk.get("dedup", 0)) - pos.dedup
+        cursor.torn_segments += int(mk.get("torn", 0)) - pos.torn
+        cursor.dropped_committed += int(mk.get("dropped", 0)) - pos.dropped
+        pos.consumed = int(mk["consumed"])
+        pos.checksum = mk_ck
+        pos.dedup = int(mk.get("dedup", 0))
+        pos.torn = int(mk.get("torn", 0))
+        pos.dropped = int(mk.get("dropped", 0))
+        pos.max_seq = max(pos.max_seq, int(mk.get("max_seq", -1)))
+        pos.seg = int(mk["below"])
+        pos.off = 0
+        if tr.enabled:
+            tr.count("online.compaction_jumps")
+            tr.event("online.compaction_jump", writer=writer,
+                     below=int(mk["below"]))
 
     def _manifest(self, writer: str, seg: int) -> Optional[dict]:
         cached = self._manifest_cache.get((writer, seg))
@@ -554,16 +699,27 @@ class FeedbackReader:
                 lines, digest = self._payload(writer, pos.seg)
                 if man is None or lines is None \
                         or digest != man.get("sha256"):
+                    # a missing segment below the frontier is permanent —
+                    # but it is either TORN (crash mid-commit) or
+                    # COMPACTED (retention deleted it behind a marker
+                    # that preserves its ledger contribution). The
+                    # marker disambiguates; only its absence means torn.
+                    mk = self._compaction_marker(writer)
+                    if mk is not None and pos.seg < int(mk["below"]):
+                        self._fast_forward(cursor, pos, writer, mk, tr)
+                        continue
                     # torn (no manifest / no payload) or corrupt (sha
                     # mismatch): permanent below the frontier — walk past
                     dropped = len(lines) - pos.off if lines else 0
                     cursor.torn_segments += 1
+                    pos.torn += 1
                     if man is not None:
                         # committed-but-corrupt: committed_records()
                         # counts this manifest, so the lag ledger must
                         # write these records off or it never drains
-                        cursor.dropped_committed += int(
-                            man.get("count", 0))
+                        n_bad = int(man.get("count", 0))
+                        cursor.dropped_committed += n_bad
+                        pos.dropped += n_bad
                     if tr.enabled:
                         tr.count("online.segments_dropped_torn")
                         if dropped > 0:
@@ -590,15 +746,17 @@ class FeedbackReader:
                     pos.off += 1
                     if seq <= pos.max_seq:
                         cursor.dedup_hits += 1
+                        pos.dedup += 1
                         if tr.enabled:
                             tr.count("online.dedup_hits")
                         continue
                     pos.max_seq = seq
                     pos.consumed += 1
                     cursor.consumed_total += 1
+                    digest64 = record_digest(writer, seq)
                     cursor.checksum = (
-                        cursor.checksum + record_digest(writer, seq)
-                    ) % (1 << 64)
+                        cursor.checksum + digest64) % (1 << 64)
+                    pos.checksum = (pos.checksum + digest64) % (1 << 64)
                     out.append(rec)
                 if pos.off >= len(lines):
                     pos.seg += 1
@@ -617,3 +775,124 @@ class FeedbackReader:
             if pos is None or pos.seg <= top:
                 return False
         return True
+
+
+# ---------------------------------------------------------------------------
+# fault payloads
+# ---------------------------------------------------------------------------
+
+
+def poison_records(n: int) -> List[dict]:
+    """The ``poison_feedback`` fault's payload: ``n`` records cycling the
+    three shapes `online.quality.QualityGate` rejects — schema violation,
+    outlier token ids / non-finite score, and oversize (resource
+    exhaustion). Kept here (not in the injector) so tests can assert the
+    exact burst contents against the gate's verdicts."""
+    out: List[dict] = []
+    for i in range(int(n)):
+        k = i % 3
+        if k == 0:    # schema: prompt not a token list, response missing
+            out.append({"prompt": "<poison:not-tokens>", "response": None,
+                        "feedback": 1})
+        elif k == 1:  # outlier: tokens outside any vocab, non-finite score
+            out.append({"prompt": [10 ** 9 + i, -7],
+                        "response": [2 ** 31 - 1],
+                        "feedback": float("inf")})
+        else:         # oversize: far past any configured ceiling
+            out.append({"prompt": [1] * 4096, "response": [0] * 4096,
+                        "feedback": 1})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# retention / compaction
+# ---------------------------------------------------------------------------
+
+
+def compact_segments(store, stream: str, cursor: Cursor,
+                     *, reader: Optional[FeedbackReader] = None) -> int:
+    """Delete fully-consumed segments below the fleet-min cursor (the
+    retention follow-up named in docs/ONLINE.md). Returns segments
+    removed (counted as ``online.segments_compacted``).
+
+    ``cursor`` must be a position every consumer has reached — in
+    replica-global and partitioned modes alike that is the fleet cursor
+    itself (it is replicated/union on every rank), so the leader calls
+    this with its own cursor.
+
+    Per writer, the cut is ``min(cursor segment, newest committed
+    segment)``: the newest committed segment always survives, so writer
+    discovery, the frontier probe fast path, and the writer's own tail
+    scan keep working on real objects. Before anything is deleted, the
+    doomed range is REPLAYED with the reader's own take() rules into the
+    writer's compaction marker ({below, consumed, checksum, max_seq,
+    dedup, torn, dropped, committed}), and the marker is written FIRST —
+    the manifest-LAST idiom inverted: the marker is the commit, deletion
+    is garbage collection. A crash between marker and deletes leaves
+    surviving sub-cut segments that every reader skips (the marker is
+    authoritative), never double-counts. A reader at the frontier sees
+    no gap; a fresh replay (`FeedbackReader.take` from a zero cursor)
+    fast-forwards through the marker and still balances the ledger —
+    count, checksum, and the torn/dedup evidence of the deleted range
+    included."""
+    rd = reader if reader is not None else FeedbackReader(
+        store, stream=stream)
+    frontier = rd.frontier(full=True)
+    tr = _telemetry.get_tracer()
+    removed = 0
+    for writer, pos in cursor.writers.items():
+        top = frontier.get(writer)
+        if top is None:
+            continue
+        cut = min(pos.seg, top)
+        mk = rd._compaction_marker(writer) or {
+            "below": 0, "consumed": 0, "checksum": "0", "max_seq": -1,
+            "dedup": 0, "torn": 0, "dropped": 0, "committed": 0}
+        below = int(mk["below"])
+        if cut <= below:
+            continue
+        # replay [below, cut) under the reader's own rules: the marker
+        # must advance a future reader's ledger by exactly what reading
+        # these segments would have
+        scratch = Cursor()
+        scratch.writers[writer] = _WriterPos(
+            seg=below, max_seq=int(mk.get("max_seq", -1)))
+        while rd.take(scratch, {writer: cut - 1}, 4096):
+            pass
+        sp = scratch.writers[writer]
+        committed = int(mk.get("committed", 0))
+        for seg in range(below, cut):
+            man = rd._manifest(writer, seg)
+            if man is not None:
+                committed += int(man.get("count", 0))
+        new_mk = json.dumps({
+            "writer": writer,
+            "below": cut,
+            "consumed": int(mk["consumed"]) + sp.consumed,
+            "checksum": str((int(mk.get("checksum", 0)) + sp.checksum)
+                            % (1 << 64)),
+            "max_seq": max(int(mk.get("max_seq", -1)), sp.max_seq),
+            "dedup": int(mk.get("dedup", 0)) + sp.dedup,
+            "torn": int(mk.get("torn", 0)) + sp.torn,
+            "dropped": int(mk.get("dropped", 0)) + sp.dropped,
+            "committed": committed,
+            "ts": time.time(),
+        }).encode()
+        try:
+            # marker FIRST (the commit), then delete — never the reverse
+            store.put_bytes(_compact_key(stream, writer), new_mk)
+            for seg in range(below, cut):
+                store.delete_prefix(
+                    f"{STREAM_PREFIX}/{stream}/{writer}/seg_{seg:08d}.")
+                removed += 1
+        except OSError as exc:
+            logger.warning(
+                "feedback: compaction of %s below seg %d failed (%s); "
+                "retention retries on the next pass", writer, cut, exc)
+            continue
+        logger.info("feedback: compacted %s segments [%d, %d)",
+                    writer, below, cut)
+    if removed and tr.enabled:
+        tr.count("online.segments_compacted", removed)
+        tr.event("online.compaction", segments=removed)
+    return removed

@@ -26,21 +26,63 @@ lags the trainer:
     the restored checkpoint are re-consumed exactly once, records trained
     before it are never replayed. Exactly-once is a checkpoint property,
     not a protocol.
-  - On a replicated trainer fleet the feed/blend decision must be
-    byte-identical on every rank (the desync sentinel compares loss
-    fingerprints). ``consensus_fn`` — typically one
-    `ElasticCluster.exchange` returning the per-writer MIN frontier —
-    pins every rank to the same availability snapshot; manifests at or
-    below an observed frontier are immutable (single-writer streams
-    commit in order), so same frontier ⇒ same records. Without a
-    cluster, the local frontier is the consensus.
-  - ``reshard(index, world, epoch)`` (the guard's membership-transition
-    call) folds the epoch into the base stream but deliberately keeps
-    the ingest **replica-global** (shard 0 of 1): the host-level fleet
-    trains replica-identical batches (the chaos-harness convention), so
-    the cursor is one fleet-wide position every member derives
-    identically. Per-shard feedback partitioning is a named follow-up in
-    docs/ONLINE.md, not silently absent.
+  - An optional `online.quality.QualityGate` sits between the reader and
+    ``batch_fn``: rejected records have already advanced the cursor (they
+    are in the replay ledger like any admitted record), so a poisoned
+    window costs freshness — blend-heavier batches — never correctness.
+    The gate is a pure function, so it composes with either feed mode
+    below without breaking the identical-batches contract.
+
+Two feed modes, selected by construction:
+
+**Replica-global** (``consensus_fn``, the default): every rank reads the
+whole log at one fleet-wide cursor. The feed/blend decision must be
+byte-identical on every rank (the desync sentinel compares loss
+fingerprints), so ``consensus_fn`` — typically one
+`ElasticCluster.exchange` returning the per-writer MIN frontier — pins
+every rank to the same availability snapshot; manifests at or below an
+observed frontier are immutable (single-writer streams commit in order),
+so same frontier ⇒ same records. Ingest I/O is O(writers) *per rank* —
+it cannot scale with world size.
+
+**Partitioned** (``exchange_fn``): the DeAR move applied to the data
+plane — decouple the *read* (scatter) from the *feed* (all-gather).
+Writer ownership is hashed across the data world
+(`online.feedback.shard_of`, seeded by `MembershipView.data_shard` /
+``data_world`` through ``reshard``): each rank reads ONLY its owned
+writers' segments, taking up to its deterministic quota of
+``batch_records`` into a cursor *copy*. One per-step
+``exchange_fn(payload)`` then all-gathers every shard's taken records
+and post-take positions; every rank assembles the identical merged
+batch (concatenation over sorted shard ids) and overlays every shard's
+positions into the identical **union cursor**. Consequences worth
+stating:
+
+  - Batches stay replica-identical, so the desync sentinel, the lockstep
+    exit verdict, and consensus restore carry over *unchanged* from the
+    replica-global mode — partitioning changed who does the I/O, not
+    what anyone trains on.
+  - Because the gather happens inside ``next()`` BEFORE the train step,
+    every rank's checkpoint sidecar holds the exact union cursor at
+    every step. ``reshard`` therefore redistributes ownership with **no
+    state transfer** — the `_repack_comp_state` mass-preservation idiom
+    degenerates to "everyone already holds the whole mass": new owners
+    resume each writer exactly where its old owner left it, no record
+    consumed twice, none skipped.
+  - A failed or skewed exchange (peer timeout mid-transition, documents
+    disagreeing on the world size, a shard missing from the gather) is a
+    **blend step**: the cursor copy is discarded, nothing was consumed,
+    and the fleet retries next step under the new membership. Freshness
+    degrades; the ledger never does.
+  - No per-writer frontier consensus is needed: each writer has exactly
+    one owner per step, and followers adopt the owner's take verbatim.
+
+``reshard(index, world, epoch)`` (the guard's membership-transition
+call) folds the epoch into the base stream; in partitioned mode it also
+re-seats writer ownership from the new ``(shard, world)``. The base
+stream itself stays replica-global (shard 0 of 1) in both modes — the
+host-level fleet trains replica-identical batches (the chaos-harness
+convention).
 
 Telemetry on the step path uses the standard two-lookup disabled gate
 (budgeted by scripts/check_telemetry_overhead.py).
@@ -52,7 +94,8 @@ import logging
 from typing import Callable, Dict, List, Optional
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
-from dear_pytorch_tpu.online.feedback import Cursor, FeedbackReader
+from dear_pytorch_tpu.online.feedback import (Cursor, FeedbackReader,
+                                              _WriterPos, shard_of)
 
 logger = logging.getLogger("dear_pytorch_tpu")
 
@@ -65,21 +108,34 @@ class FeedbackIngest:
 
     ``batch_fn(base_batch, records)`` must be a deterministic pure
     function — same base batch + same records ⇒ same training batch on
-    every rank and on every replay.
+    every rank and on every replay. Pass ``consensus_fn`` for the
+    replica-global mode or ``exchange_fn`` for the partitioned mode (see
+    module docstring); passing both is a configuration error.
     """
 
     def __init__(self, base, reader: FeedbackReader, *,
                  batch_records: int,
                  batch_fn: Callable[[dict, List[dict]], dict],
                  consensus_fn: Optional[
-                     Callable[[Dict[str, int]], Dict[str, int]]] = None):
+                     Callable[[Dict[str, int]], Dict[str, int]]] = None,
+                 exchange_fn: Optional[
+                     Callable[[dict], Optional[List[dict]]]] = None,
+                 quality=None):
+        if consensus_fn is not None and exchange_fn is not None:
+            raise ValueError(
+                "consensus_fn (replica-global) and exchange_fn "
+                "(partitioned) are mutually exclusive feed modes")
         self.base = base
         self.reader = reader
         self.batch_records = int(batch_records)
         self.batch_fn = batch_fn
         self.consensus_fn = consensus_fn
+        self.exchange_fn = exchange_fn
+        self.quality = quality
         self.cursor = Cursor()
         self._epoch = 0
+        self._shard = 0
+        self._world = 1
         self._last_lag = 0
         #: force full-discovery frontiers (instead of the O(writers)
         #: exists-probe fast path, which cannot jump a torn segment's
@@ -95,25 +151,25 @@ class FeedbackIngest:
         self.last_frontier: Dict[str, int] = {}
         self.last_drained = True
         self.last_records = 0
+        # plain-int accounting (works with telemetry disabled)
+        self.blend_steps = 0
 
     # -- the step-path fetch -------------------------------------------------
 
     def next(self, timeout_ms: int = 10_000) -> dict:
         base = self.base.next(timeout_ms)
-        frontier = self.reader.frontier(full=self.full_frontier)
-        if self.consensus_fn is not None:
-            frontier = self.consensus_fn(frontier) or {}
-        self.last_frontier = frontier
-        records = self.reader.take(self.cursor, frontier,
-                                   self.batch_records)
+        if self.exchange_fn is not None:
+            records = self._next_partitioned()
+        else:
+            records = self._next_global()
+        if self.quality is not None and records:
+            # cursor already advanced past every record here: rejection
+            # costs freshness (a blend-heavier batch), never position
+            records = self.quality.admit(records)
         self.last_records = len(records)
-        self.last_drained = self.reader.drained(self.cursor, frontier)
         tr = _telemetry.get_tracer()
         if tr.enabled:
-            lag = max(self.reader.committed_records(frontier)
-                      - self.cursor.consumed_total
-                      - self.cursor.dedup_hits
-                      - self.cursor.dropped_committed, 0)
+            lag = self.lag(self.last_frontier)
             # gauge-style (the cluster.epoch idiom): export the DELTA so
             # the counter's running total is the current lag
             if lag != self._last_lag:
@@ -121,7 +177,87 @@ class FeedbackIngest:
                 self._last_lag = lag
             if not records:
                 tr.count("online.blend_batches")
+        if not records:
+            self.blend_steps += 1
         return self.batch_fn(base, records)
+
+    def _next_global(self) -> List[dict]:
+        frontier = self.reader.frontier(full=self.full_frontier)
+        if self.consensus_fn is not None:
+            frontier = self.consensus_fn(frontier) or {}
+        self.last_frontier = frontier
+        records = self.reader.take(self.cursor, frontier,
+                                   self.batch_records)
+        self.last_drained = self.reader.drained(self.cursor, frontier)
+        return records
+
+    def _next_partitioned(self) -> List[dict]:
+        shard, world = self._shard, self._world
+        frontier = self.reader.frontier(full=self.full_frontier)
+        own = {w: top for w, top in frontier.items()
+               if shard_of(w, world) == shard}
+        # scatter: read only owned writers, into a COPY — consumption
+        # commits only if the gather lands (blend steps consume nothing)
+        work = Cursor.from_dict(self.cursor.to_dict())
+        quota = (self.batch_records // world
+                 + (1 if shard < self.batch_records % world else 0))
+        took = self.reader.take(work, own, quota)
+        payload = {
+            "shard": shard,
+            "world": world,
+            "f": own,
+            "pos": {w: p.to_dict() for w, p in work.writers.items()
+                    if shard_of(w, world) == shard},
+            "took": took,
+            "d": self.reader.drained(work, own) if own else True,
+        }
+        try:
+            docs = self.exchange_fn(payload)
+        except Exception as exc:  # noqa: BLE001 — an availability
+            #               exchange failure (peer timeout mid-election,
+            #               transport hiccup) must cost freshness, not
+            #               training: blend and retry under whatever
+            #               membership the next step brings
+            logger.warning("ingest: partition exchange failed (%s); "
+                           "blending this step", exc)
+            docs = None
+        if docs is None:
+            return self._blend_step("exchange_unavailable")
+        # world-skew guard: mid-transition, ranks can momentarily
+        # disagree on the data world — quotas and ownership would not
+        # tile, so nobody consumes until the views reconverge
+        by_shard: Dict[int, dict] = {}
+        for doc in docs:
+            if int(doc.get("world", -1)) != world:
+                return self._blend_step("world_skew")
+            # member order is deterministic; first claim per shard wins
+            # if two ranks momentarily claim the same shard
+            by_shard.setdefault(int(doc["shard"]), doc)
+        if sorted(by_shard) != list(range(world)):
+            return self._blend_step("shard_gap")
+        # all-gather lands: every rank assembles the identical batch and
+        # the identical union cursor (our own doc included — uniform)
+        records: List[dict] = []
+        merged_frontier: Dict[str, int] = {}
+        drained = True
+        for sid in sorted(by_shard):
+            doc = by_shard[sid]
+            records.extend(doc.get("took") or [])
+            merged_frontier.update(doc.get("f") or {})
+            drained = drained and bool(doc.get("d", True))
+            for w, pd in (doc.get("pos") or {}).items():
+                self.cursor.writers[w] = _WriterPos.from_dict(pd)
+        self.cursor.recompute_rollups()
+        self.last_frontier = merged_frontier
+        self.last_drained = drained
+        return records
+
+    def _blend_step(self, reason: str) -> List[dict]:
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.count(f"online.partition_blend_{reason}")
+        self.last_drained = False
+        return []
 
     def lag(self, frontier: Optional[Dict[str, int]] = None) -> int:
         """Committed-but-unconsumed records behind the cursor (records
@@ -133,13 +269,25 @@ class FeedbackIngest:
                    - self.cursor.consumed_total - self.cursor.dedup_hits
                    - self.cursor.dropped_committed, 0)
 
+    def shard_cursors(self) -> Dict[str, dict]:
+        """The union cursor sliced by current writer ownership — one
+        entry per shard with its writers, consumed count, and partial
+        checksum. The slices tile the union exactly (`shard_of` assigns
+        each writer to exactly one shard), which is what the chaos
+        audit's union-balance assertion checks against the jax-free full
+        replay."""
+        return {str(s): self.cursor.shard_slice(s, self._world)
+                for s in range(self._world)}
+
     # -- the guard contract: sidecar state + elastic reshard ------------------
 
     def state_dict(self) -> dict:
         """Base-pipeline position + the ingest cursor, as one sidecar
         payload: the guard persists it with every checkpoint and restores
         it on every rollback, making cursor and model state move
-        together."""
+        together. In partitioned mode the cursor is the UNION (the
+        gather runs before the train step), so any rank's sidecar
+        restores the whole fleet's data position."""
         return {
             "backend": "feedback-ingest",
             "base": self.base.state_dict(),
@@ -172,15 +320,28 @@ class FeedbackIngest:
                      epoch=self._epoch)
 
     def reshard(self, shard: int, num_shards: int, *, epoch: int = 0) -> None:
-        """Membership transition: fold the epoch into the base stream but
-        keep the feed replica-global — every member of the new world must
-        train identical batches from one fleet-wide cursor (see module
-        docstring). The (shard, world) arguments are accepted for the
-        guard's pipeline contract and deliberately not used to partition
-        the feedback stream."""
-        del shard, num_shards
+        """Membership transition. The base stream stays replica-global
+        (shard 0 of 1) — every member of the new world must train
+        identical batches (see module docstring). In partitioned mode the
+        (shard, world) arguments re-seat writer *ownership*: because the
+        cursor is already the union on every rank, redistribution needs
+        no state transfer — each new owner resumes every writer exactly
+        where the union says it stands (mass preservation for free)."""
+        old = (self._shard, self._world)
+        self._shard = int(shard)
+        self._world = max(int(num_shards), 1)
         self._epoch = int(epoch)
         self.base.reshard(0, 1, epoch=epoch)
+        if self.exchange_fn is not None and old != (self._shard,
+                                                    self._world):
+            logger.info(
+                "ingest: ownership re-seated shard %d/%d -> %d/%d "
+                "(epoch %d); union cursor carries, no state transfer",
+                old[0], old[1], self._shard, self._world, epoch)
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                tr.event("online.ingest_resharded", shard=self._shard,
+                         world=self._world, epoch=epoch)
 
     # -- passthroughs ---------------------------------------------------------
 

@@ -38,6 +38,16 @@ __all__ = ["VersionPublisher", "ONLINE_SIDECAR", "read_online_sidecar"]
 ONLINE_SIDECAR = "ONLINE.json"
 
 
+def _poison_tree(tree):
+    """A NaN-everywhere copy of a nested param tree (the ``bad_version``
+    fault payload). ``leaf * nan`` keeps every leaf's shape, so the
+    published artifact is structurally indistinguishable from a good
+    version — exactly the failure only a canary catches."""
+    if isinstance(tree, dict):
+        return {k: _poison_tree(v) for k, v in tree.items()}
+    return tree * float("nan")
+
+
 def read_online_sidecar(store, version: int) -> Optional[dict]:
     """The cursor-provenance sidecar stamped next to a published version
     (None when missing — e.g. a version published outside the online
@@ -58,16 +68,27 @@ class VersionPublisher:
     Publish failures count and keep the previous version serving — the
     trainer must survive a dead store exactly like the checkpoint
     streamer does.
+
+    ``injector`` wires the ``bad_version`` chaos fault: the Nth publish
+    ships a NaN-poisoned copy of the tree through the REAL publish path
+    (committed manifest, ONLINE sidecar, LATEST bump — byte-valid in
+    every way the store can check). Only the serving-side canary can
+    catch it: the replica's finiteness probe fails its quality gauge and
+    the router's verdict rolls the version back. The trainer's live
+    params are untouched — the fault models a publish-path corruption /
+    bad-training-regression, not a diverged trainer.
     """
 
     def __init__(self, store, *, publish_every: int,
-                 params_fn, cursor_fn=None):
+                 params_fn, cursor_fn=None, injector=None):
         self.store = store
         self.publish_every = max(int(publish_every), 1)
         self.params_fn = params_fn
         self.cursor_fn = cursor_fn
+        self.injector = injector
         self.published: list = []          # versions this process published
         self.publish_failures = 0
+        self._publishes = 0        # injector step clock (bad_version)
         self._last_publish_step: Optional[int] = None
 
     def maybe_publish(self, step: int, *, leader: bool = True,
@@ -86,6 +107,17 @@ class VersionPublisher:
         try:
             version = (W.latest_version(self.store) or 0) + 1
             params = self.params_fn()
+            self._publishes += 1
+            if (self.injector is not None
+                    and self.injector.bad_version_due(self._publishes)):
+                params = _poison_tree(params)
+                logger.warning(
+                    "publish: bad_version fault poisons publish #%d "
+                    "(version %d)", self._publishes, version)
+                if tr.enabled:
+                    tr.count("online.bad_versions_injected")
+                    tr.event("online.bad_version_injected",
+                             version=version)
             W.publish_params(self.store, params, version)
             cursor = self.cursor_fn() if self.cursor_fn is not None else None
             self.store.put_bytes(

@@ -1,0 +1,151 @@
+"""Deterministic data-quality gating ABOVE the feedback reader.
+
+The continual-learning loop's trust boundary: feedback records come from
+the serving fleet's clients, which makes them adversarial input to
+*training*. `QualityGate` sits between `FeedbackReader.take` and the
+trainer's ``batch_fn`` as a cursor-accounted stage:
+
+  - **Rejected records still advance the cursor.** The reader consumed
+    them — they are in the replay ledger (consumed count + checksum) like
+    any other record — the gate only decides whether they reach the
+    batch. A poisoned burst therefore costs *freshness* (those cursor
+    positions trained nothing), never *correctness*: the exactly-once
+    audit balances unchanged, and model parameters never see the poison.
+  - **Deterministic by construction.** ``check`` is a pure function of
+    the record (stdlib arithmetic, no wall clock, no randomness), so two
+    ranks holding the same frontier — hence the same records — derive
+    bitwise-identical post-filter batches. This is the same
+    replicas-must-agree discipline as the frontier consensus; a
+    rank-local heuristic (load-dependent sampling, learned filters with
+    local state) would desynchronize the fleet.
+  - **Counted, per reason.** Rejections count under
+    ``online.records_rejected_<reason>`` (reasons: ``schema``,
+    ``outlier``, ``oversize``) plus plain-int mirrors on the gate, so
+    accounting works with telemetry disabled and a poisoned window is
+    visible as a reject spike while ``online.ingest_lag`` still drains.
+
+What the filters catch (the `poison_feedback` fault injects all three):
+
+  - ``schema``   — prompt/response not lists of ints, non-numeric
+                   feedback score, missing required fields;
+  - ``outlier``  — token ids outside ``[0, vocab_size)``, negative
+                   tokens, non-finite or out-of-range feedback scores;
+  - ``oversize`` — prompt/response longer than the configured ceilings
+                   (resource-exhaustion poisoning).
+
+Numpy-free, jax-free: importable from the jax-free trainer parents and
+from `scripts/check_telemetry_overhead.py`'s standalone harness.
+Telemetry on the admit path uses the standard two-lookup disabled gate
+(budgeted by scripts/check_telemetry_overhead.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from dear_pytorch_tpu.observability import tracer as _telemetry
+
+__all__ = ["QualityGate", "REJECT_REASONS"]
+
+REJECT_REASONS = ("schema", "outlier", "oversize")
+
+
+class QualityGate:
+    """Schema/outlier/size filtering as a deterministic pure function.
+
+    ``vocab_size=None`` disables the vocabulary bound (tokens must still
+    be non-negative ints). ``feedback_range`` bounds the numeric
+    ``feedback`` score when present; non-finite scores are always
+    outliers. ``require_response=False`` admits prompt-only records
+    (pretraining-style streams).
+    """
+
+    def __init__(self, *, vocab_size: Optional[int] = None,
+                 max_prompt_tokens: int = 1024,
+                 max_response_tokens: int = 1024,
+                 feedback_range: Tuple[float, float] = (-1e6, 1e6),
+                 require_response: bool = True):
+        self.vocab_size = None if vocab_size is None else int(vocab_size)
+        self.max_prompt_tokens = int(max_prompt_tokens)
+        self.max_response_tokens = int(max_response_tokens)
+        self.feedback_range = (float(feedback_range[0]),
+                               float(feedback_range[1]))
+        self.require_response = bool(require_response)
+        # plain-int accounting (works with telemetry disabled)
+        self.checked = 0
+        self.admitted = 0
+        self.rejected: Dict[str, int] = {r: 0 for r in REJECT_REASONS}
+
+    # -- the pure predicate --------------------------------------------------
+
+    def _tokens_reason(self, toks, max_len: int) -> Optional[str]:
+        if not isinstance(toks, (list, tuple)):
+            return "schema"
+        if len(toks) > max_len:
+            return "oversize"
+        vocab = self.vocab_size
+        for t in toks:
+            # bool is an int subclass; a True/False "token" is malformed
+            if not isinstance(t, int) or isinstance(t, bool):
+                return "schema"
+            if t < 0 or (vocab is not None and t >= vocab):
+                return "outlier"
+        return None
+
+    def check(self, record: dict) -> Optional[str]:
+        """``None`` when the record is admissible, else the reject
+        reason. Pure: same record ⇒ same verdict on every rank and every
+        replay (the bitwise-identical-batches contract)."""
+        if not isinstance(record, dict):
+            return "schema"
+        reason = self._tokens_reason(record.get("prompt"),
+                                     self.max_prompt_tokens)
+        if reason is not None:
+            return reason
+        resp = record.get("response")
+        if resp is None and not self.require_response:
+            pass
+        else:
+            reason = self._tokens_reason(resp, self.max_response_tokens)
+            if reason is not None:
+                return reason
+        fb = record.get("feedback")
+        if fb is not None:
+            if isinstance(fb, bool) or not isinstance(fb, (int, float)):
+                return "schema"
+            lo, hi = self.feedback_range
+            if not math.isfinite(fb) or fb < lo or fb > hi:
+                return "outlier"
+        return None
+
+    # -- the step-path stage -------------------------------------------------
+
+    def admit(self, records: List[dict]) -> List[dict]:
+        """Filter one take's records, counting rejects per reason. The
+        caller's cursor has already advanced past every record here —
+        admission decides training membership only, never log position."""
+        kept: List[dict] = []
+        hits: Optional[Dict[str, int]] = None
+        for rec in records:
+            self.checked += 1
+            reason = self.check(rec)
+            if reason is None:
+                self.admitted += 1
+                kept.append(rec)
+                continue
+            self.rejected[reason] += 1
+            if hits is None:
+                hits = {}
+            hits[reason] = hits.get(reason, 0) + 1
+        if hits is not None:
+            tr = _telemetry.get_tracer()
+            if tr.enabled:
+                for reason, n in hits.items():
+                    tr.count(f"online.records_rejected_{reason}", n)
+                tr.event("online.quality_rejected", **hits)
+        return kept
+
+    @property
+    def rejected_total(self) -> int:
+        return sum(self.rejected.values())

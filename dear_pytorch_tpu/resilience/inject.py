@@ -95,7 +95,7 @@ FAULT_ENV = "DEAR_FAULTS"
 
 KINDS = ("nan", "exc", "hang", "slow", "ckpt_corrupt", "preempt",
          "corrupt_resp", "torn_seg", "dup_feedback", "dcn_slow",
-         "dcn_drop")
+         "dcn_drop", "poison_feedback", "bad_version")
 
 __all__ = [
     "FAULT_ENV", "KINDS", "Fault", "InjectedFault", "FaultInjector",
@@ -452,6 +452,31 @@ class FaultInjector:
         an at-least-once producer retry the reader's monotonic-seq dedup
         must absorb exactly-once (``online.dedup_hits``)."""
         return bool(self._take(append_no, ("dup_feedback",)))
+
+    def poison_burst(self, append_no: int) -> int:
+        """Burst size (0 = not due) when a ``poison_feedback`` fault
+        fires for this record append (the feedback writer's append
+        counter is the step clock) — the writer then pushes ``arg``
+        (default 8) schema-violating/outlier/oversize records through
+        the REAL append path, so they are stamped, committed, and
+        ledger-accounted like any client feedback. What must survive is
+        the TRAINER: `online.quality.QualityGate` rejects every one
+        (``online.records_rejected_*``) while the cursor still advances
+        past them — poisoning costs freshness, never correctness."""
+        for f in self._take(append_no, ("poison_feedback",)):
+            return max(int(f.arg), 1) if f.arg else 8
+        return 0
+
+    def bad_version_due(self, publish_no: int) -> bool:
+        """True when a due ``bad_version`` fault fires for this weight
+        publication (`online.publish.VersionPublisher`'s publish counter
+        is the step clock) — the publisher then poisons the params to
+        NaN before the store write, publishing a version that fails the
+        serving-side finiteness probe. What must survive is the FLEET:
+        the router's canary verdict fails the version, the rollback
+        marker retires it, and the backfilled replicas converge on the
+        last good version (`serving.router.CanaryController`)."""
+        return bool(self._take(publish_no, ("bad_version",)))
 
     def dcn_slow_s_for(self, exchange_no: int) -> float:
         """Persistent cross-slice latency due at this DCN exchange (the

@@ -50,12 +50,18 @@ class ReplicaServer:
     """Serve loop around a `serving.engine.DecodeEngine`."""
 
     def __init__(self, root: str, rank: int, engine, *, version: int = 0,
-                 injector=None, preemption=None, feedback=None,
-                 poll_s: float = 0.005, heartbeat_s: float = 0.2):
+                 quality: float = 1.0, injector=None, preemption=None,
+                 feedback=None, poll_s: float = 0.005,
+                 heartbeat_s: float = 0.2):
         self.root = os.path.abspath(root)
         self.rank = int(rank)
         self.engine = engine
         self.version = int(version)
+        # the load-time quality probe for THIS version's weights
+        # (`serving.weights.params_finite_fraction`): stamped into every
+        # heartbeat and response so the router's canary verdict can score
+        # version N vs N+1 without any replica-side coordination
+        self.quality = float(quality)
         self.injector = injector
         self.preemption = preemption
         # optional `online.feedback.FeedbackWriter`: every successful
@@ -106,6 +112,7 @@ class ReplicaServer:
             "pid": os.getpid(),
             "incarnation": self.incarnation,
             "version": self.version,
+            "quality": self.quality,
             "draining": self.draining,
             "stopped": stopped,
             "served": self.served,
@@ -203,6 +210,9 @@ class ReplicaServer:
             payload["prefill_s"] = prefill_s
         if decode_s is not None:
             payload["decode_s"] = decode_s
+        # like the phase seconds: outside the signed fields, consumed by
+        # the router's canary controller as the per-version quality gauge
+        payload["quality"] = self.quality
         payload["sha256"] = response_sha256(payload)
         data = json.dumps(payload).encode()
         if self.injector is not None:

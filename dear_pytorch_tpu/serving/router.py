@@ -42,6 +42,24 @@ its request's deadline counts ``serve.deadline_missed`` but is still
 delivered — the deadline's enforcement point is admission
 (`serving.admission` sheds requests whose predicted wait exceeds the
 budget), where rejecting is cheap.
+
+**A/B canary** (`CanaryController`, optional): while the fleet's healthy
+heartbeats span two weight versions — exactly the rolling-swap window —
+the router splits dispatch deterministically (1-in-``share`` requests to
+the newer version) and scores both sides from fields the responses
+already carry: wall service time and the replica's load-time quality
+gauge (`serving.weights.params_finite_fraction`). Once the candidate
+has ``min_requests`` observations, a **deterministic verdict** lands:
+FAIL when its mean quality sits under ``quality_floor`` or its mean
+latency exceeds ``latency_factor``× the baseline version's. A failed
+version is excluded from all future dispatch (with a zero-drop
+fallback: a request is never stranded because only "wrong"-version
+replicas have free slots — serving a stale version beats dropping) and
+the ``on_canary`` callback fires once, where the harness marks the
+store-side rollback (`serving.weights.mark_rolled_back`) and drives the
+PR-11 drain/backfill machinery in reverse. The verdict is pure
+arithmetic over observed responses — no randomness, no wall-clock
+thresholds — so replaying the same response stream reproduces it.
 """
 
 from __future__ import annotations
@@ -58,11 +76,89 @@ from typing import Dict, List, Optional
 from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.serving.admission import AdmissionController
 
-__all__ = ["ReplicaRouter", "response_sha256", "REPLICAS_SUBDIR",
-           "RESPONSES_SUBDIR"]
+__all__ = ["ReplicaRouter", "CanaryController", "response_sha256",
+           "REPLICAS_SUBDIR", "RESPONSES_SUBDIR"]
 
 REPLICAS_SUBDIR = "replicas"
 RESPONSES_SUBDIR = "responses"
+
+
+class CanaryController:
+    """Deterministic A/B scoring between the fleet's live weight
+    versions (see module docstring).
+
+    The candidate is always the NEWEST version among healthy heartbeats
+    when at least two are live; the baseline is the newest older version
+    with enough observations that has not itself failed. Verdicts are
+    memoized per version — a version is judged once per router life, and
+    a FAIL is permanent (the store-side `ROLLBACK.json` marker makes it
+    permanent across router lives too).
+    """
+
+    def __init__(self, *, min_requests: int = 4,
+                 quality_floor: float = 0.9,
+                 latency_factor: float = 3.0, share: int = 4):
+        self.min_requests = max(int(min_requests), 1)
+        self.quality_floor = float(quality_floor)
+        self.latency_factor = float(latency_factor)
+        self.share = max(int(share), 2)
+        # version -> {n, lat (sum s), q (sum of quality gauges)}
+        self.obs: Dict[int, dict] = {}
+        self.decisions: Dict[int, str] = {}
+        self._tick = 0
+
+    def observe(self, version, service_s: float, quality) -> None:
+        if version is None:
+            return
+        o = self.obs.setdefault(int(version),
+                                {"n": 0, "lat": 0.0, "q": 0.0})
+        o["n"] += 1
+        o["lat"] += float(service_s)
+        # pre-canary replicas stamp no gauge: absent means unprobed, and
+        # an unprobed version must not fail on missing evidence
+        o["q"] += 1.0 if quality is None else float(quality)
+
+    def failed(self, version) -> bool:
+        return (version is not None
+                and self.decisions.get(int(version)) == "FAIL")
+
+    def route_candidate(self) -> bool:
+        """The traffic split while a canary is undecided: every
+        ``share``-th dispatch goes to the candidate. Counter-based, so
+        the split is deterministic in dispatch order — no RNG to drift
+        between runs."""
+        self._tick += 1
+        return self._tick % self.share == 0
+
+    def maybe_decide(self, live_versions) -> Optional[tuple]:
+        """Judge the current candidate if its evidence is in. Returns
+        ``(version, "PASS"|"FAIL")`` exactly once per version, else
+        None."""
+        vs = sorted({int(v) for v in live_versions if v is not None})
+        if len(vs) < 2:
+            return None
+        cand = vs[-1]
+        if cand in self.decisions:
+            return None
+        o = self.obs.get(cand)
+        if o is None or o["n"] < self.min_requests:
+            return None
+        verdict = "PASS"
+        if o["q"] / o["n"] < self.quality_floor:
+            verdict = "FAIL"
+        else:
+            base = None
+            for v in reversed(vs[:-1]):
+                b = self.obs.get(v)
+                if (b is not None and b["n"] >= self.min_requests
+                        and not self.failed(v)):
+                    base = b
+                    break
+            if (base is not None and o["lat"] / o["n"]
+                    > self.latency_factor * (base["lat"] / base["n"])):
+                verdict = "FAIL"
+        self.decisions[cand] = verdict
+        return cand, verdict
 
 
 def response_sha256(payload: dict) -> str:
@@ -107,9 +203,15 @@ class ReplicaRouter:
 
     def __init__(self, root: str, *, admission: AdmissionController,
                  slots_per_replica: int = 4, health_timeout_s: float = 6.0,
-                 poll_s: float = 0.02):
+                 poll_s: float = 0.02, canary: Optional[
+                     "CanaryController"] = None, on_canary=None):
         self.root = os.path.abspath(root)
         self.admission = admission
+        self.canary = canary
+        # fires once per verdict, OUTSIDE the router lock (it does store
+        # I/O: mark_rolled_back + capacity-file drains in the harness)
+        self.on_canary = on_canary
+        self.canary_verdicts: List[tuple] = []
         self.slots_per_replica = int(slots_per_replica)
         self.health_timeout_s = float(health_timeout_s)
         self.poll_s = float(poll_s)
@@ -237,6 +339,7 @@ class ReplicaRouter:
             "latency_p99_ms": (None if not lats
                                else round(pct(0.99) * 1e3, 2)),
             "healthy": self.healthy_replicas(),
+            "canary_verdicts": list(self.canary_verdicts),
         }
 
     # -- the routing loop ----------------------------------------------------
@@ -340,6 +443,31 @@ class ReplicaRouter:
                 if r.healthy and not r.draining)
             self.admission.set_capacity(max(live_slots, 1))
 
+    def _canary_filter_locked(self, targets: list) -> list:
+        """Apply canary routing to a non-empty dispatch target list;
+        never returns empty (the zero-drop fallback: when only "wrong"-
+        version replicas have free slots, a stale-version response beats
+        a stranded request). Caller holds the lock."""
+        if self.canary is None:
+            return targets
+        # a version that lost its canary gets no new work — the drain-in-
+        # reverse starts at the dispatch boundary, before the harness
+        # even reacts to the verdict callback
+        live = [r for r in targets if not self.canary.failed(r.version)]
+        if live:
+            targets = live
+        versions = sorted({r.version for r in targets
+                           if r.version is not None})
+        if len(versions) >= 2 \
+                and versions[-1] not in self.canary.decisions:
+            cand_v = versions[-1]
+            want = self.canary.route_candidate()
+            preferred = [r for r in targets
+                         if (r.version == cand_v) == want]
+            if preferred:
+                targets = preferred
+        return targets
+
     def _dispatch(self) -> None:
         # the inbox writes happen OUTSIDE the lock: per-request file I/O
         # under it would block the whole client surface (submit/result/
@@ -351,6 +479,7 @@ class ReplicaRouter:
                            and len(r.inflight) < self.slots_per_replica]
                 if not self._pending or not targets:
                     return
+                targets = self._canary_filter_locked(targets)
                 rep = min(targets, key=lambda r: (len(r.inflight), r.rank))
                 rid = self._pending.popleft()
                 record = self._requests[rid].record
@@ -449,6 +578,35 @@ class ReplicaRouter:
                 tr.count("serve.completed")
                 if missed:
                     tr.count("serve.deadline_missed")
+            if self.canary is not None:
+                self.canary.observe(doc.get("model_version"), service_s,
+                                    doc.get("quality"))
+                with self._lock:
+                    live = [r.version for r in self._replicas.values()
+                            if r.healthy]
+                decision = self.canary.maybe_decide(live)
+                if decision is not None:
+                    version, verdict = decision
+                    self.canary_verdicts.append(decision)
+                    if tr.enabled:
+                        tr.count("online.canary_verdicts")
+                        tr.event("online.canary_verdict",
+                                 version=version, verdict=verdict)
+                        if verdict == "FAIL":
+                            tr.count("online.canary_rollbacks")
+                    if self.on_canary is not None:
+                        try:
+                            self.on_canary(version, verdict)
+                        except Exception:  # noqa: BLE001 — a broken
+                            #               rollback hook must not stop
+                            #               response collection; the
+                            #               dispatch-side exclusion
+                            #               already protects traffic
+                            import logging
+
+                            logging.getLogger(
+                                "dear_pytorch_tpu").exception(
+                                "router: on_canary hook failed")
             pend.response = doc
             pend.event.set()
             try:

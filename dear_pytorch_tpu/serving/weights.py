@@ -22,6 +22,19 @@ a corrupted or torn version (counting ``serve.weight_corrupt_detected``)
 — the same degrade-never-crash posture as
 `utils.checkpoint.restore_from_object_store`.
 
+Canary rollback rides the same layout: ``mark_rolled_back`` drops a
+``ROLLBACK.json`` marker inside the version directory (first writer
+wins — `put_bytes_if_absent` — so a double-verdict records one
+rollback). A rolled-back version stays committed and intact (the
+manifest is never touched — provenance audits still read it), but
+``load_params(store, None)`` and ``latest_live_version`` walk past it,
+so every backfill after a rollback lands on the newest version that has
+*not* lost a canary. ``latest_version`` deliberately stays RAW — it is
+the publisher's numbering authority, and keeping rolled-back numbers in
+it is exactly what guarantees a rolled-back number is never reused.
+Loading a rolled-back version *explicitly* (``version=N``) still works:
+that is the operator-override and post-mortem path.
+
 Numpy + stdlib only (no jax): publishable and loadable from any host-side
 process; flax applies numpy arrays directly.
 """
@@ -31,7 +44,9 @@ from __future__ import annotations
 import hashlib
 import io
 import json
+import logging
 import re
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -39,9 +54,13 @@ import numpy as np
 from dear_pytorch_tpu.observability import tracer as _telemetry
 
 __all__ = ["publish_params", "load_params", "list_versions",
-           "latest_version"]
+           "latest_version", "latest_live_version", "mark_rolled_back",
+           "rolled_back", "params_finite_fraction"]
+
+logger = logging.getLogger("dear_pytorch_tpu")
 
 _PREFIX = "weights"
+ROLLBACK_MARKER = "ROLLBACK.json"
 
 
 def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
@@ -103,11 +122,68 @@ def list_versions(store) -> List[int]:
 
 
 def latest_version(store) -> Optional[int]:
+    """Newest committed version, rolled-back ones INCLUDED — this is the
+    publisher's numbering authority (see module docstring)."""
     try:
         return int(store.get_bytes(f"{_PREFIX}/LATEST").decode().strip())
     except (KeyError, ValueError):
         versions = list_versions(store)
         return versions[0] if versions else None
+
+
+def mark_rolled_back(store, version: int, reason: str = "") -> bool:
+    """Record a canary rollback for ``version``. First writer wins;
+    returns False when the version was already marked."""
+    fresh = store.put_bytes_if_absent(
+        f"{_vdir(version)}/{ROLLBACK_MARKER}", json.dumps({
+            "version": int(version),
+            "reason": str(reason),
+            "ts": time.time(),
+        }).encode())
+    if fresh:
+        logger.warning("weights: version %d rolled back (%s)",
+                       int(version), reason or "unspecified")
+    return bool(fresh)
+
+
+def rolled_back(store, version: int) -> bool:
+    try:
+        store.get_bytes(f"{_vdir(version)}/{ROLLBACK_MARKER}")
+        return True
+    except KeyError:
+        return False
+
+
+def latest_live_version(store) -> Optional[int]:
+    """Newest committed version that has NOT lost a canary — what every
+    post-rollback backfill should load."""
+    for v in list_versions(store):
+        if not rolled_back(store, v):
+            return v
+    return None
+
+
+def params_finite_fraction(params) -> float:
+    """Fraction of parameter scalars that are finite — the replica's
+    load-time quality probe. A healthy version reads 1.0; the
+    ``bad_version`` fault's NaN-poisoned publish reads 0.0. Stamped into
+    heartbeats/responses as the per-version quality gauge the router's
+    canary verdict consumes. Cheap (one vectorized pass at weight load,
+    never on the serve path) and deliberately structural: it needs no
+    eval set, no labels — the same role the checkpoint sha plays for
+    bytes, played for values."""
+    flat = _flatten(params)
+    total = 0
+    finite = 0
+    for arr in flat.values():
+        a = np.asarray(arr)
+        total += a.size
+        if np.issubdtype(a.dtype, np.floating) \
+                or np.issubdtype(a.dtype, np.complexfloating):
+            finite += int(np.isfinite(a).sum())
+        else:
+            finite += a.size
+    return (finite / total) if total else 1.0
 
 
 def load_params(store, version: Optional[int] = None
@@ -126,6 +202,10 @@ def load_params(store, version: Optional[int] = None
             candidates.insert(0, newest)
     tr = _telemetry.get_tracer()
     for v in candidates:
+        if version is None and rolled_back(store, v):
+            # a canary loser: committed and intact, but no default load
+            # may resurrect it — backfills land on the last good version
+            continue
         vdir = _vdir(v)
         try:
             manifest = json.loads(store.get_bytes(f"{vdir}/MANIFEST.json"))

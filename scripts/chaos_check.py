@@ -1553,7 +1553,12 @@ def run_worker_serve_replica(workdir: str) -> dict:
     raw = os.environ.get(INJ.FAULT_ENV, "").strip()
     injector = (INJ.FaultInjector(INJ.parse_faults(raw), own_rank=rank)
                 if raw else None)
+    # default load walks past corrupt AND rolled-back versions: a
+    # backfill after a canary rollback lands on the last good version
     params, version = W.load_params(store)
+    # load-time quality probe: the canary's per-version gauge (a NaN-
+    # poisoned bad_version publish reads 0.0 here and fails the verdict)
+    quality = W.params_finite_fraction(params)
     model, _cfg = _serve_model()
     engine = DecodeEngine(
         model, params,
@@ -1580,8 +1585,8 @@ def run_worker_serve_replica(workdir: str) -> dict:
                 os.environ.get("DEAR_ONLINE_FLUSH_INTERVAL_S", "0.3")),
             injector=injector)
     srv = ReplicaServer(serve_dir, rank, engine, version=version,
-                        injector=injector, preemption=pre,
-                        feedback=feedback)
+                        quality=quality, injector=injector,
+                        preemption=pre, feedback=feedback)
     summary = srv.run(
         deadline_s=float(os.environ.get("DEAR_SERVE_DEADLINE", "600")))
     if feedback is not None:
@@ -1912,16 +1917,22 @@ def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
     autoscale worker (guard + elastic cluster + checkpoint streamer +
     preemption) with the data path swapped for the online loop:
 
-      - the pipeline is a `online.ingest.FeedbackIngest` over the shared
-        object store — every step blends a base synthetic batch with up
-        to one batch-row's worth of feedback records at the checkpointed
-        cursor,
-      - the feed/blend decision and the frontier are fleet-consensus:
-        ONE `ElasticCluster.exchange` per step carries each rank's local
-        frontier, stop-file observation, drained flag, and newest store
-        version; every rank derives the identical MIN/ALL merge, so
-        replicas train byte-identical batches (the desync sentinel
-        watches),
+      - the pipeline is a PARTITIONED `online.ingest.FeedbackIngest`
+        over the shared object store — each rank scatter-reads only its
+        owned writers' segments (ownership hashed over the data world),
+        takes its quota into a cursor copy, and ONE
+        `ElasticCluster.exchange` per step all-gathers every shard's
+        records + positions together with the exit votes (stop-file
+        observation, drained flag, newest store version); every rank
+        assembles the identical merged batch and union cursor, so
+        replicas still train byte-identical batches (the desync
+        sentinel watches) while ingest I/O scales with world size,
+      - a `online.quality.QualityGate` sits above the reader: the
+        scheduled `poison_feedback` burst advances the cursor and the
+        reject counters but never reaches the model,
+      - the leader compacts feedback segments below the cursor of the
+        version two publishes back after each publish (retention riding
+        the publish cadence),
       - the member-0 leader publishes weights through
         `online.publish.VersionPublisher` every N steps with cursor
         provenance,
@@ -1947,11 +1958,17 @@ def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
     import numpy as np
 
     from dear_pytorch_tpu.observability import tracer as T
-    from dear_pytorch_tpu.online.feedback import FeedbackReader
+    from dear_pytorch_tpu.online.feedback import (
+        Cursor, FeedbackReader, compact_segments,
+    )
     from dear_pytorch_tpu.online.ingest import FeedbackIngest
-    from dear_pytorch_tpu.online.publish import VersionPublisher
+    from dear_pytorch_tpu.online.publish import (
+        VersionPublisher, read_online_sidecar,
+    )
+    from dear_pytorch_tpu.online.quality import QualityGate
     from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
     from dear_pytorch_tpu.resilience import PreemptionHandler
+    from dear_pytorch_tpu.resilience import inject as INJ
     from dear_pytorch_tpu.resilience import membership as M
     from dear_pytorch_tpu.resilience.cluster import PeerTimeout
     from dear_pytorch_tpu.runtime import build as RB
@@ -1973,10 +1990,28 @@ def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
     target_versions = int(os.environ.get("DEAR_CHAOS_ONLINE_VERSIONS", "3"))
     target_epoch = int(os.environ.get("DEAR_CHAOS_ONLINE_EPOCHS", "2"))
     stop_file = os.environ["DEAR_CHAOS_ONLINE_STOP"]
+    # deploy freeze: the parent caps the store's version ladder while
+    # the canary judges the newest publish — the production push-freeze
+    # during canary evaluation. The force path (drain) is uncapped.
+    cap_path = os.environ.get("DEAR_CHAOS_ONLINE_PUBLISH_CAP")
+
+    def publish_cap() -> int:
+        if not cap_path:
+            return 1 << 30
+        try:
+            with open(cap_path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return 1 << 30
     remote_root = os.environ["DEAR_CHAOS_REMOTE"]
     store = LocalObjectStore(os.environ["DEAR_CHAOS_ONLINE_STORE"])
     ckpt_dir = os.path.join(workdir, f"trainer_rank{rank}", "ckpts")
     tracer = T.get_tracer()
+    # rank-targeted trainer faults (bad_version): own_rank from the
+    # supervisor contract, same as the serving side
+    raw_faults = os.environ.get(INJ.FAULT_ENV, "").strip()
+    injector = (INJ.FaultInjector(INJ.parse_faults(raw_faults),
+                                  own_rank=rank) if raw_faults else None)
 
     # the trainer trains THE MODEL THE FLEET SERVES — the same tiny
     # causal LM `run_worker_serve_replica` decodes with — so a published
@@ -2025,45 +2060,50 @@ def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
             ids[j, :len(toks)] = np.asarray(toks, np.int32) % 61
         return ids
 
-    # ONE consensus exchange per step: frontier MIN (same availability
-    # snapshot => byte-identical feed/blend on every rank) + the exit
-    # votes. A dead peer costs one short timeout and a blend step; the
-    # guard's own health sync then commits the shrink.
+    # ONE exchange per step, now carrying the PARTITIONED ingest gather
+    # (each rank's owned-writer take + post-take positions — the
+    # scatter-read/all-gather protocol in online/ingest.py) PLUS the
+    # exit votes. A dead peer costs one short timeout and a blend step
+    # (nothing consumed); the guard's own health sync then commits the
+    # shrink.
     shared = {"stop": False, "drained": False, "version": 0}
 
-    def consensus(frontier):
+    def exchange_ingest(payload):
         stop_seen = os.path.exists(stop_file)
         if stop_seen:
             # drain intent: the drained verdict must rest on the
             # DEFINITIVE frontier (the probe fast path cannot jump a
             # torn segment's numbering gap until a discovery listing)
             ing.full_frontier = True
-        payload = json.dumps({
-            "f": frontier,
+        wrapped = json.dumps({
+            "ing": payload,
             "stop": stop_seen,
-            "drained": bool(ing.last_drained),
             "v": int(W.latest_version(store) or 0),
         })
         try:
-            views = cluster.exchange("online.avail", payload, timeout_s=4.0)
+            views = cluster.exchange("online.avail", wrapped,
+                                     timeout_s=4.0)
         except PeerTimeout:
             shared["stop"] = shared["drained"] = False
-            return {}
+            return None  # blend step: the cursor copy is discarded
         docs = [json.loads(v) for v in views]
         shared["stop"] = all(d["stop"] for d in docs)
-        shared["drained"] = all(d["drained"] for d in docs)
+        shared["drained"] = all(d["ing"]["d"] for d in docs)
         shared["version"] = min(d["v"] for d in docs)
-        merged = {}
-        for w in set().union(*(set(d["f"]) for d in docs)):
-            vals = [d["f"].get(w) for d in docs]
-            if any(v is None for v in vals):
-                continue  # a writer one rank has not discovered yet
-            merged[w] = min(vals)
-        return merged
+        return [d["ing"] for d in docs]
 
+    # the quality gate: poison bursts (the `poison_feedback` fault)
+    # advance the cursor and the reject counters, never the model. Pure
+    # => the post-filter batch stays identical across ranks.
+    qgate = QualityGate(max_prompt_tokens=64, max_response_tokens=64)
     ing = FeedbackIngest(
         base, FeedbackReader(store, stream="main"), batch_records=B,
-        batch_fn=batch_fn, consensus_fn=consensus)
+        batch_fn=batch_fn, exchange_fn=exchange_ingest, quality=qgate)
+    if cluster.members and rank in cluster.members:
+        # seat writer ownership for the boot membership; every later
+        # transition re-seats it through the guard's reshard call
+        ing.reshard(list(cluster.members).index(rank),
+                    len(cluster.members), epoch=cluster.epoch)
 
     streamer = ckpt.CheckpointStreamer(
         ckpt_dir, LocalObjectStore(os.path.join(remote_root, f"rank{rank}")),
@@ -2084,7 +2124,7 @@ def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
         store, publish_every=publish_every,
         params_fn=lambda: jax.device_get(
             guard.ts.gather_params(holder["state"])),
-        cursor_fn=lambda: ing.cursor.to_dict())
+        cursor_fn=lambda: ing.cursor.to_dict(), injector=injector)
 
     resumed_at = None
     if rejoining:
@@ -2097,7 +2137,7 @@ def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
         state = tuner.init(params)
     holder["state"] = state
 
-    deadline = time.monotonic() + 380.0
+    deadline = time.monotonic() + 520.0
     kill_at = None
     preempted = False
     last_pub_consumed = [-1]
@@ -2127,12 +2167,29 @@ def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
             break  # parent shutdown: drain cleanly with the grace window
         # publish on the cadence, but (past v1) only versions that
         # actually contain NEW feedback — a version bump should mean new
-        # data reached the fleet, and the freshness audit relies on it
-        if ing.cursor.consumed_total > last_pub_consumed[0] \
-                or not publisher.published:
+        # data reached the fleet, and the freshness audit relies on it —
+        # and never past the parent's deploy-freeze cap
+        if (ing.cursor.consumed_total > last_pub_consumed[0]
+                or not publisher.published) \
+                and int(W.latest_version(store) or 0) < publish_cap():
             v = publisher.maybe_publish(guard.steps_seen, leader=leader())
             if v is not None:
                 last_pub_consumed[0] = ing.cursor.consumed_total
+                # retention rides the publish cadence: the leader
+                # compacts segments below the cursor of the version TWO
+                # publishes back — a floor every restore horizon has
+                # cleared (a guard rollback or a rejoiner's consensus
+                # restore never needs a deleted segment) and that keeps
+                # the previous version's provenance window replayable
+                # for the parent's freshness audit
+                if len(publisher.published) >= 3:
+                    side = read_online_sidecar(
+                        store, publisher.published[-3])
+                    if side and side.get("cursor"):
+                        compact_segments(
+                            store, "main",
+                            Cursor.from_dict(side["cursor"]),
+                            reader=ing.reader)
         if shared["stop"] and shared["drained"] \
                 and cluster.epoch >= target_epoch:
             if shared["version"] >= target_versions:
@@ -2162,6 +2219,9 @@ def run_worker_online_trainer(checkpoint_every: int, workdir: str) -> dict:
         "plan_world": guard.ts.plan.world,
         "plan_epoch": guard.ts.plan.epoch,
         "ingest": ing.cursor.to_dict(),
+        "shard_cursors": ing.shard_cursors(),
+        "quality_rejected": dict(qgate.rejected),
+        "quality_admitted": qgate.admitted,
         "published": publisher.published,
         "publish_failures": publisher.publish_failures,
         "uploaded": sorted(streamer.uploaded),
@@ -2202,17 +2262,29 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
 
     The storm: SIGKILL a serving replica mid-traffic (zero
     accepted-then-lost), SIGKILL a trainer rank mid-step (elastic shrink
-    + rejoin = the forced reshard; cursor restored from the consensus
-    checkpoint), walk a torn feedback segment and absorb a duplicate
-    record, and execute the PR-11 drain+backfill rolling swap every time
-    the trainer's published version bumps — twice. The gate then freezes
-    the log (clients stopped, serving fleet drained), lets the trainer
-    drain the cursor, and asserts the exactly-once ledger: the fleet's
-    final cursor equals a jax-free replay of the whole log (consumed
-    count AND order-independent checksum — no gaps, no dups), with the
-    torn segment walked past and the duplicate deduplicated. Freshness
-    (feedback-commit → first version serving it) and throughput are
-    machine-checked through `bench_gate.py --slo`."""
+    + rejoin = the forced reshard; the PARTITIONED shard cursors
+    redistribute across the world change with the union restored from
+    the consensus checkpoint), walk a torn feedback segment, absorb a
+    duplicate record, swallow a 12-record poisoned feedback burst
+    through the quality gate, and execute the PR-11 drain+backfill
+    rolling swap every time the trainer's published version bumps —
+    twice. Then the DATA-plane and CONTROL-plane faults interact: the
+    trainer's 4th publish is NaN-poisoned (``bad_version``), a canary
+    deployment rolls one replica onto it, the router's A/B verdict fails
+    it on the load-time quality gauge, the rollback marker lands in the
+    store, and the loser's backfill returns the fleet to the last good
+    version — the next publish minting a FRESH number, never reusing
+    the rolled-back one. The gate then freezes the log (clients
+    stopped, serving fleet drained), lets the trainer drain the cursor,
+    and asserts the exactly-once ledger: the fleet's final cursor
+    equals a jax-free replay of the whole log (consumed count AND
+    order-independent checksum — no gaps, no dups; per-shard slices
+    tile the union exactly), with the torn segment walked past, the
+    duplicate deduplicated, the poison rejected-but-accounted, and the
+    compaction markers (retention ran mid-storm) preserving the ledger
+    across deleted segments. Freshness (feedback-commit → first version
+    serving it) and throughput are machine-checked through
+    `bench_gate.py --slo`."""
     import signal
     import tempfile
     import threading
@@ -2227,7 +2299,9 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
     from dear_pytorch_tpu.serving.admission import (
         AdmissionController, SheddingError,
     )
-    from dear_pytorch_tpu.serving.router import ReplicaRouter
+    from dear_pytorch_tpu.serving.router import (
+        CanaryController, ReplicaRouter,
+    )
     from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
 
     workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_online_")
@@ -2245,7 +2319,7 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
     write_capacity({"target_world": 2})
 
     trainer_kill_rank, serve_kill_rank = 1, 1
-    target_versions = 3
+    target_versions = 5
     env = dict(os.environ)
     env.pop("DEAR_NUM_CPU_DEVICES", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -2258,12 +2332,29 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
     env["DEAR_CHAOS_ONLINE_KILL"] = f"{trainer_kill_rank}:8:1"
     env["DEAR_CHAOS_ONLINE_PUBLISH_EVERY"] = "20"
     env["DEAR_CHAOS_ONLINE_VERSIONS"] = str(target_versions)
+
+    # the deploy freeze: phases A-E run to v3; phase G lifts the cap to
+    # v4 (the poisoned canary candidate), judges it, and only then
+    # uncaps — so v5 can never race the canary verdict
+    publish_cap = os.path.join(workdir, "publish_cap.txt")
+
+    def write_publish_cap(n: int) -> None:
+        tmp = publish_cap + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(n))
+        os.replace(tmp, publish_cap)
+
+    write_publish_cap(3)
+    env["DEAR_CHAOS_ONLINE_PUBLISH_CAP"] = publish_cap
     env["DEAR_PREEMPT_GRACE_S"] = "30"
     # a peer's post-transition XLA recompile must not read as a death
     env.setdefault("DEAR_CLUSTER_TIMEOUT_SECS", "30")
 
     sup_mod = CC.load_supervisor()
     trainer_env = dict(env)
+    # the control-plane fault: the leader's 4th publish ships NaN
+    # weights — v4 is the storm's poisoned canary candidate
+    trainer_env["DEAR_FAULTS"] = "bad_version@4:r0"
     sup_t = sup_mod.ElasticSupervisor(
         2,
         [sys.executable, os.path.abspath(__file__), "--worker",
@@ -2276,7 +2367,7 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
     store = LocalObjectStore(store_dir)
     reader = FeedbackReader(store, stream="main")
     t0 = time.monotonic()
-    fleet = CC.FleetPump([sup_t], failures, deadline_s=460.0)
+    fleet = CC.FleetPump([sup_t], failures, deadline_s=560.0)
     pump = fleet.pump
 
     # -- phase A: the trainer publishes v1 before any replica boots -------
@@ -2298,9 +2389,13 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
     # makes replica 1 a straggler from its 4th request on — which is
     # what guarantees the SIGKILL below lands while it HOLDS in-flight
     # work (without it the tiny model answers in milliseconds and the
-    # mid-traffic kill is a coin flip)
+    # mid-traffic kill is a coin flip). poison_feedback injects a
+    # 12-record poisoned burst through writer r0's 10th append — the
+    # trainer-side quality gate must reject every one while the cursor
+    # ledger still accounts for them
     serve_env["DEAR_FAULTS"] = \
-        "torn_seg@2:r0,dup_feedback@6:r1,slow@4:0.1:r1"
+        "torn_seg@2:r0,dup_feedback@6:r1,slow@4:0.1:r1," \
+        "poison_feedback@10:12:r0"
     policy = ScalePolicy(capacity_file=capacity, hysteresis_s=0.5,
                          max_world=3)
     sup_s = sup_mod.ElasticSupervisor(
@@ -2315,9 +2410,27 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
     prev_tracer = T._tracer
     T.set_tracer(T.Tracer([T.MemoryExporter()]))
     admission = AdmissionController(max_depth=8)
-    router = ReplicaRouter(serve_dir, admission=admission,
-                           slots_per_replica=4,
-                           health_timeout_s=5.0).start()
+
+    # the canary's store-side commit: a FAIL verdict drops the
+    # first-writer-wins rollback marker; the parent then drives the
+    # loser's drain+backfill (the PR-11 swap in reverse) below
+    canary_rolled: list[int] = []
+
+    def on_canary(version, verdict):
+        if verdict == "FAIL":
+            W.mark_rolled_back(store, version,
+                               reason="canary quality gauge")
+            canary_rolled.append(int(version))
+
+    # latency_factor is deliberately loose: the slow@ fault makes one
+    # replica a legitimate straggler, so only the quality gauge (NaN
+    # params -> 0.0) may sink a candidate here
+    router = ReplicaRouter(
+        serve_dir, admission=admission, slots_per_replica=4,
+        health_timeout_s=5.0,
+        canary=CanaryController(min_requests=4, quality_floor=0.9,
+                                latency_factor=50.0, share=3),
+        on_canary=on_canary).start()
 
     # continuous observation: first wall-clock time each weight version
     # was seen SERVING (freshness), min healthy during the swaps
@@ -2330,8 +2443,19 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
         for _r, v in versions.items():
             if v is not None:
                 first_served.setdefault(int(v), now)
-        min_healthy[0] = min(min_healthy[0],
-                             len(router.healthy_replicas()))
+        healthy = len(router.healthy_replicas())
+        if healthy == 0 and min_healthy[0] > 0:
+            # first zero-healthy observation: dump per-replica state so
+            # a min-healthy failure is diagnosable from the log
+            with router._lock:
+                states = {r.rank: {"healthy": r.healthy,
+                                   "draining": r.draining,
+                                   "hb_age_s": round(
+                                       now - r.last_wall_ts, 2)}
+                          for r in router._replicas.values()}
+            print(f"chaos_check: healthy=0 observed "
+                  f"(replica states {states})", flush=True)
+        min_healthy[0] = min(min_healthy[0], healthy)
 
     stop_clients = threading.Event()
     client_failures: list[str] = []
@@ -2463,6 +2587,67 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
         _check(min_healthy[0] >= 1,
                "at least one replica stayed healthy through every "
                "rolling swap", failures)
+
+        # -- phase G: poisoned publish -> canary verdict -> rollback ------
+        # lift the deploy freeze one rung: the trainer's next cadenced
+        # publish is v4, and the scheduled bad_version fault NaNs it on
+        # the way out of the leader
+        write_publish_cap(4)
+        _check(pump(lambda: (W.latest_version(store) or 0) >= 4,
+                    "v4 published", 150.0),
+               "the trainer published v4 (the NaN-poisoned canary "
+               "candidate)", failures)
+        # canary deployment: roll ONLY rank 0 forward; rank 1 keeps
+        # serving v3 as the baseline while the router splits traffic
+        before = drains_of(0)
+        write_capacity({"target_world": 2, "drain": [0]})
+        _check(pump(lambda b=before: drains_of(0) > b,
+                    "canary rank drained", 90.0),
+               "the canary rank drained for the v4 rollout", failures)
+        _check(pump(lambda: (router.fleet_versions().get(0) or 0) >= 4,
+                    "canary rank on v4", 120.0),
+               "the canary rank came back serving v4", failures)
+        # clear the drain hint NOW: the policy dedups acted-on drain
+        # victims until the hint stops listing them, and the rollback
+        # below must drain rank 0 a second time — the verdict wait gives
+        # the policy plenty of ticks to observe the cleared hint
+        write_capacity({"target_world": 2})
+        _check(pump(lambda: any(v == 4 and verdict == "FAIL"
+                                for v, verdict in router.canary_verdicts),
+                    "canary verdict on v4", 120.0),
+               "the router's A/B verdict FAILed v4 on the load-time "
+               "quality gauge", failures)
+        _check(pump(lambda: W.rolled_back(store, 4),
+                    "rollback marker", 30.0),
+               "the FAIL verdict committed the first-writer-wins "
+               "ROLLBACK.json marker for v4", failures)
+        # the loser's drain — the PR-11 swap in reverse: the backfill
+        # must land on the newest LIVE version (v3), never the dead v4
+        before = drains_of(0)
+        write_capacity({"target_world": 2, "drain": [0]})
+        _check(pump(lambda b=before: drains_of(0) > b,
+                    "rolled-back rank drained", 90.0),
+               "the failed canary rank drained for the rollback",
+               failures)
+        _check(pump(lambda: router.fleet_versions().get(0) == 3,
+                    "rollback backfill on v3", 120.0),
+               "the rolled-back rank backfilled onto the last good "
+               "version v3 (never the failed v4)", failures)
+        write_capacity({"target_world": 2})  # clear the stale drain hint
+        # lift the freeze exactly one rung: the next publish must mint
+        # v5 — a FRESH number; the store-authoritative ladder never
+        # reuses 4. The cap stays at 5 (not unlimited) so a fast box
+        # can't keep minting versions between here and shutdown —
+        # runaway publishes advance the trainer's compaction cut
+        # (published[-3]) past the served versions' cursor windows and
+        # destroy the freshness measurement below (observed: published
+        # reached v10 and every freshness sample fell below the cut)
+        write_publish_cap(5)
+        _check(pump(lambda: (W.latest_version(store) or 0) >= 5,
+                    "v5 minted past the rollback", 150.0),
+               "the republish after the rollback minted v5 "
+               "(numbering skips the dead version, never reuses it)",
+               failures)
 
         # -- phase F: freeze the log, drain the cursor --------------------
         stop_clients.set()
@@ -2600,12 +2785,58 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
            f"the trainer published >= {target_versions} versions "
            f"({published})", failures)
 
+    # -- the canary/rollback ledger ---------------------------------------
+    _check(canary_rolled == [4] and W.rolled_back(store, 4),
+           f"exactly the poisoned v4 was canary-rolled-back "
+           f"({canary_rolled})", failures)
+    _check(4 in published and 5 in published
+           and not W.rolled_back(store, 5)
+           and W.latest_live_version(store) == max(published),
+           "the post-rollback republish is live and the dead number "
+           f"stays dead (published {published}, live "
+           f"{W.latest_live_version(store)})", failures)
+    prov = []
+    for v in published:
+        side = read_online_sidecar(store, v)
+        prov.append(int((((side or {}).get("cursor")) or {})
+                        .get("consumed_total", 0)))
+    _check(all(a <= b for a, b in zip(prov, prov[1:])),
+           f"sidecar cursor provenance is monotonic across the rollback "
+           f"({dict(zip(published, prov))})", failures)
+
+    # -- the quality-gate + retention ledger -------------------------------
+    rej0 = finals[0].get("quality_rejected") or {}
+    _check(sum(rej0.values()) >= 12,
+           f"the never-restarted rank's quality gate rejected the full "
+           f"12-record poison burst ({rej0})", failures)
+    for kind in ("schema", "outlier", "oversize"):
+        _check(merged.get(f"online.records_rejected_{kind}", 0) >= 1,
+               f"poison shape '{kind}' hit its reject counter", failures)
+    _check(merged.get("online.segments_compacted", 0) >= 1,
+           "feedback retention compacted >= 1 segment mid-storm "
+           f"(online.segments_compacted="
+           f"{merged.get('online.segments_compacted', 0)})", failures)
+
+    # -- the partition ledger: shard slices tile the union -----------------
+    for r, v in sorted(finals.items()):
+        CC.shard_union_balanced(v.get("shard_cursors") or {}, audit,
+                                failures, f"trainer rank {r}")
+
     # -- feedback freshness: commit -> first version serving it -----------
     # for each version the fleet actually served, the oldest NEWLY
     # included record (per the cursor-provenance sidecar) waited
     # first_served - its append ts; the ceiling bounds the worst wait
     freshness = []
     served_versions = sorted(v for v in first_served if v >= 2)
+    # compaction-aware index: the replay only holds records from each
+    # writer's compaction cut up — the marker's consumed count is how
+    # many older records were folded into the ledger, so absolute
+    # per-writer positions shift down by it. A sample whose record fell
+    # below the cut is unmeasurable (freshness lost to retention, by
+    # design); the two-publish compaction lag keeps the NEWEST served
+    # version's window above every cut.
+    mk_off = {w: int((reader._compaction_marker(w) or {})
+                     .get("consumed", 0)) for w in ts_by_writer}
     for v in served_versions:
         side = read_online_sidecar(store, v)
         prev_side = read_online_sidecar(store, v - 1)
@@ -2618,8 +2849,9 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
             if int(pos["consumed"]) <= prev_c:
                 continue  # no new records from this writer in v
             ts_list = ts_by_writer.get(w, [])
-            if prev_c < len(ts_list):
-                freshness.append(first_served[v] - ts_list[prev_c])
+            idx = prev_c - mk_off.get(w, 0)
+            if 0 <= idx < len(ts_list):
+                freshness.append(first_served[v] - ts_list[idx])
     fresh_s = max(freshness) if freshness else None
     _check(fresh_s is not None,
            f"freshness measurable for the served versions "
@@ -2654,6 +2886,8 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
         "torn_segments": audit.torn_segments,
         "published": published,
         "served_versions": served_versions,
+        "canary_verdicts": list(router.canary_verdicts),
+        "rolled_back": canary_rolled,
         "weight_swaps": router.weight_swaps,
         "serve_counters": {k: v for k, v in sorted(counters.items())
                            if k.startswith(("serve.", "online."))},

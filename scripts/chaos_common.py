@@ -16,6 +16,8 @@ device.
   - `FleetPump`        poll-the-supervisor-until-condition with one
                        shared deadline and failure accounting — the
                        heartbeat-poll loop every storm phase runs
+  - `shard_union_balanced`  assert a partitioned-ingest member's
+                       per-shard cursor slices tile the replay audit
   - `slo_gate`         write a contract JSON and machine-check it through
                        `scripts/bench_gate.py --slo`
 """
@@ -181,6 +183,36 @@ class FleetPump:
             time.sleep(self.poll_s)
         self.failures.append(f"timeout waiting for: {what}")
         return False
+
+
+def shard_union_balanced(shard_cursors: dict, audit, failures: List[str],
+                         what: str) -> None:
+    """Assert a fleet member's per-shard cursor slices (the
+    ``Cursor.shard_slice`` dicts a partitioned-ingest verdict reports)
+    tile the full-log replay EXACTLY: writer sets disjoint, their union
+    covering every audited writer, and consumed counts plus
+    order-independent checksums summing to the replay's (mod 2**64).
+    ``audit`` is the replay `online.feedback.Cursor`."""
+    writers: List[str] = []
+    consumed = 0
+    chk = 0
+    for sid in sorted(shard_cursors, key=int):
+        sl = shard_cursors[sid]
+        writers.extend(sl.get("writers") or [])
+        consumed += int(sl.get("consumed", 0))
+        chk = (chk + int(sl.get("checksum", 0))) % (1 << 64)
+    check(len(writers) == len(set(writers)),
+          f"{what}: shard writer sets are disjoint ({sorted(writers)})",
+          failures)
+    check(sorted(writers) == sorted(audit.writers),
+          f"{what}: the shard union covers exactly the audited writers "
+          f"({sorted(writers)} vs {sorted(audit.writers)})", failures)
+    check(consumed == audit.consumed_total,
+          f"{what}: per-shard consumed sums to the replay total "
+          f"({consumed} == {audit.consumed_total})", failures)
+    check(chk == audit.checksum,
+          f"{what}: per-shard checksums sum to the replay checksum "
+          f"(mod 2^64)", failures)
 
 
 def slo_gate(run_json: str, metric: str, value, extra_metrics: List[dict],

@@ -207,6 +207,39 @@ def main(argv=None) -> int:
             tr.count("online.records_trained", 8)
             tr.count("online.ingest_lag", 3)
 
+    # quality-gate admit gate, the way online/quality.py's admit() runs
+    # it per ingest step: the per-record check() is pure arithmetic with
+    # NO telemetry (rejects accumulate in a plain dict), and one batched
+    # count+event block fires per admit call that saw rejects — so the
+    # disabled shape on the step path is the standard two lookups.
+    def online_quality_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("online.records_rejected_schema", 2)
+            tr.event("online.quality_rejected", schema=2)
+
+    def online_quality_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("online.records_rejected_schema", 2)
+            tr.event("online.quality_rejected", schema=2)
+
+    # canary-gauge gate, the way serving/router.py's response-collection
+    # path runs it when a verdict lands (observe() itself is plain dict
+    # arithmetic — no telemetry per observation; the count+event pair
+    # fires once per VERDICT, but its disabled shape must still budget)
+    def canary_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("online.canary_verdicts")
+            tr.event("online.canary_verdict", version=2, verdict="FAIL")
+
+    def canary_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("online.canary_verdicts")
+            tr.event("online.canary_verdict", version=2, verdict="FAIL")
+
     # plan-tuner decision-loop gate, the way tuning/autotune.py's step
     # path runs it once the search has FINISHED (or never started): the
     # per-step cost must be one attribute check + return — the tuner
@@ -245,6 +278,11 @@ def main(argv=None) -> int:
     oc_disabled_ns = _bench(online_cursor_disabled_gate, args.iters)
     oc_enabled_ns = _bench(online_cursor_enabled_site,
                            max(args.iters // 10, 1))
+    oq_disabled_ns = _bench(online_quality_disabled_gate, args.iters)
+    oq_enabled_ns = _bench(online_quality_enabled_site,
+                           max(args.iters // 10, 1))
+    cn_disabled_ns = _bench(canary_disabled_gate, args.iters)
+    cn_enabled_ns = _bench(canary_enabled_site, max(args.iters // 10, 1))
     tuner_finished_ns = _bench(plan_tuner_finished_gate, args.iters)
     overhead_ns = max(disabled_ns - baseline_ns, 0.0)
 
@@ -278,6 +316,10 @@ def main(argv=None) -> int:
         "online_append_enabled_ns_per_call": round(oa_enabled_ns, 1),
         "online_cursor_disabled_ns_per_call": round(oc_disabled_ns, 1),
         "online_cursor_enabled_ns_per_call": round(oc_enabled_ns, 1),
+        "online_quality_disabled_ns_per_call": round(oq_disabled_ns, 1),
+        "online_quality_enabled_ns_per_call": round(oq_enabled_ns, 1),
+        "canary_disabled_ns_per_call": round(cn_disabled_ns, 1),
+        "canary_enabled_ns_per_call": round(cn_enabled_ns, 1),
         "tuner_finished_ns_per_call": round(tuner_finished_ns, 1),
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
@@ -291,6 +333,8 @@ def main(argv=None) -> int:
                and sp_ring_ns <= args.budget_ns
                and oa_disabled_ns <= args.budget_ns
                and oc_disabled_ns <= args.budget_ns
+               and oq_disabled_ns <= args.budget_ns
+               and cn_disabled_ns <= args.budget_ns
                and tuner_finished_ns <= args.budget_ns),
     }
     print(json.dumps(out))

@@ -14,9 +14,11 @@ import numpy as np
 import pytest
 
 from dear_pytorch_tpu.online.feedback import (
-    Cursor, FeedbackReader, FeedbackWriter, record_digest,
+    Cursor, FeedbackReader, FeedbackWriter, compact_segments,
+    poison_records, record_digest, shard_of,
 )
 from dear_pytorch_tpu.online.ingest import FeedbackIngest
+from dear_pytorch_tpu.online.quality import QualityGate
 from dear_pytorch_tpu.resilience.inject import (
     Fault, FaultInjector, parse_faults,
 )
@@ -270,7 +272,8 @@ def test_parse_data_path_faults():
 # ---------------------------------------------------------------------------
 
 
-def _ingest(store, *, batch_records=4, consensus_fn=None, rows=4):
+def _ingest(store, *, batch_records=4, consensus_fn=None, rows=4,
+            exchange_fn=None, quality=None):
     spec = P.SyntheticSpec((
         P.Field("x", (rows, 6), RB.KIND_NORMAL_F32, 0.0, 1.0),
     ))
@@ -286,7 +289,8 @@ def _ingest(store, *, batch_records=4, consensus_fn=None, rows=4):
 
     return FeedbackIngest(base, FeedbackReader(store, stream="s"),
                           batch_records=batch_records, batch_fn=batch_fn,
-                          consensus_fn=consensus_fn)
+                          consensus_fn=consensus_fn,
+                          exchange_fn=exchange_fn, quality=quality)
 
 
 def test_ingest_blends_when_starved_feeds_when_available(tmp_path):
@@ -471,23 +475,338 @@ def test_sole_survivor_guard_stays_coordinated(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# partitioned ingest: scatter-read + all-gather (ISSUE-17 tentpole a)
+# ---------------------------------------------------------------------------
+
+
+def _wids_by_shard(per_shard, world=2):
+    """Writer ids grouped by `shard_of` ownership — picked by probing so
+    the tests never hardcode the hash layout."""
+    out = {s: [] for s in range(world)}
+    i = 0
+    while any(len(v) < per_shard for v in out.values()):
+        wid = f"w{i}"
+        s = shard_of(wid, world)
+        if len(out[s]) < per_shard:
+            out[s].append(wid)
+        i += 1
+    return out
+
+
+def _pair_exchange():
+    """Barrier-coupled exchange_fn factory emulating
+    `ElasticCluster.exchange` for a 2-rank fleet: both ranks deposit
+    their per-step payload, meet at the barrier, and read the
+    member-ordered document list. The second barrier keeps a fast rank
+    from depositing round N+1 before the slow rank read round N."""
+    slots = {}
+    bar = threading.Barrier(2)
+
+    def make(rank):
+        def exchange(payload):
+            slots[rank] = payload
+            bar.wait(timeout=30)
+            docs = [slots[r] for r in sorted(slots)]
+            bar.wait(timeout=30)
+            return docs
+        return exchange
+    return make
+
+
+def _run_lockstep(ingests, steps):
+    """Drive each rank's ingest `steps` times on its own thread (the
+    exchange barrier needs both in flight). Returns batches per rank."""
+    outs = {r: [] for r in range(len(ingests))}
+
+    def run(r, ing):
+        for _ in range(steps):
+            outs[r].append(ing.next())
+
+    threads = [threading.Thread(target=run, args=(r, ing))
+               for r, ing in enumerate(ingests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads)
+    return outs
+
+
+def _full_replay(store):
+    """A jax-free auditor's ledger: fresh reader, full-discovery
+    frontier, everything consumed into one cursor."""
+    audit = Cursor()
+    rd = FeedbackReader(store, stream="s")
+    fr = rd.frontier(full=True)
+    while rd.take(audit, fr, 100):
+        pass
+    return audit
+
+
+def test_partitioned_ingest_lockstep_tiles_the_union(tmp_path):
+    """Two ranks scatter-read disjoint writer shards and all-gather the
+    documents: every rank materialises the IDENTICAL batch (the desync
+    sentinel stays meaningful) and the identical union cursor, while
+    `shard_cursors()` slices tile that union exactly — disjoint writer
+    sets, consumed counts and checksums summing to the whole."""
+    store = LocalObjectStore(str(tmp_path))
+    by_shard = _wids_by_shard(1)
+    wids = by_shard[0] + by_shard[1]
+    for wid in wids:
+        w = _writer(store, wid=wid)
+        for i in range(8):
+            w.append({"i": i, "w": wid})
+            if (i + 1) % 4 == 0:
+                w.flush()
+    make = _pair_exchange()
+    a = _ingest(store, batch_records=4, exchange_fn=make(0))
+    b = _ingest(store, batch_records=4, exchange_fn=make(1))
+    a.reshard(0, 2, epoch=1)
+    b.reshard(1, 2, epoch=1)
+    outs = _run_lockstep([a, b], steps=6)
+    for ba, bb in zip(outs[0], outs[1]):
+        assert np.allclose(ba["x"], bb["x"]) and ba["nrec"] == bb["nrec"]
+    assert a.cursor.consumed_total == b.cursor.consumed_total == 16
+    assert a.cursor.checksum == b.cursor.checksum
+    audit = _full_replay(store)
+    assert audit.consumed_total == 16
+    for ing in (a, b):
+        sc = ing.shard_cursors()
+        assert sorted(sc) == ["0", "1"]
+        assert sorted(sc["0"]["writers"] + sc["1"]["writers"]) \
+            == sorted(wids)
+        assert not set(sc["0"]["writers"]) & set(sc["1"]["writers"])
+        assert sc["0"]["consumed"] + sc["1"]["consumed"] == 16
+        assert (int(sc["0"]["checksum"]) + int(sc["1"]["checksum"])) \
+            % (1 << 64) == audit.checksum
+
+
+def test_partitioned_reshard_mid_ingest_is_exactly_once(tmp_path):
+    """ISSUE-17 acceptance: a world change MID-INGEST redistributes
+    writer ownership with NO state transfer — the cursor is already the
+    union on every rank — and no record is consumed twice or skipped,
+    pinned by the order-independent checksum of a jax-free full replay."""
+    store = LocalObjectStore(str(tmp_path))
+    by_shard = _wids_by_shard(1)
+    wids = by_shard[0] + by_shard[1]
+    for wid in wids:
+        w = _writer(store, wid=wid)
+        for i in range(10):
+            w.append({"i": i, "w": wid})
+            if (i + 1) % 5 == 0:
+                w.flush()
+    make = _pair_exchange()
+    a = _ingest(store, batch_records=4, exchange_fn=make(0))
+    b = _ingest(store, batch_records=4, exchange_fn=make(1))
+    a.reshard(0, 2, epoch=1)
+    b.reshard(1, 2, epoch=1)
+    _run_lockstep([a, b], steps=2)          # 8 of 20 consumed at world 2
+    assert a.cursor.consumed_total == 8
+    assert a.cursor.to_dict() == b.cursor.to_dict()
+    # rank 1 dies; the survivor owns EVERY shard and resumes each writer
+    # exactly where the union says it stands
+    a.exchange_fn = lambda payload: [payload]
+    a.reshard(0, 1, epoch=2)
+    for _ in range(20):
+        a.next()
+        if a.last_drained and a.last_records == 0:
+            break
+    assert a.cursor.consumed_total == 20
+    audit = _full_replay(store)
+    assert audit.consumed_total == 20
+    assert a.cursor.checksum == audit.checksum
+    assert {w: a.cursor.writers[w].consumed for w in wids} \
+        == {w: audit.writers[w].consumed for w in wids}
+
+
+def test_partitioned_blend_on_exchange_unavailable(tmp_path):
+    """A failed gather costs FRESHNESS, never correctness: the step
+    degrades to a pure blend batch (identical to a starved ingest's),
+    the cursor does not move, and the blend is accounted."""
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for i in range(4):
+        w.append({"i": i})
+    w.flush()
+    ing = _ingest(store, exchange_fn=lambda payload: None)
+    ing.reshard(0, 1, epoch=0)
+    starved = _ingest(LocalObjectStore(str(tmp_path / "empty")))
+    b, ref = ing.next(), starved.next()
+    assert b["nrec"] == 0 and ing.cursor.consumed_total == 0
+    assert np.array_equal(b["x"], ref["x"])
+    assert ing.blend_steps == 1
+
+
+# ---------------------------------------------------------------------------
+# data-quality gate: rejection costs freshness, never position
+# ---------------------------------------------------------------------------
+
+
+def test_quality_gate_poisoned_window_costs_freshness_not_position(
+        tmp_path):
+    """A 100%-poisoned window: nothing reaches batch_fn (the batch is
+    bitwise the pure-blend batch — at trainer level, params untouched by
+    feedback), yet the cursor advances past every rejected record and
+    the per-reason ledger accounts each one."""
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for rec in poison_records(6):
+        w.append(rec)
+    w.flush()
+    gate = QualityGate()
+    ing = _ingest(store, batch_records=8, quality=gate)
+    starved = _ingest(LocalObjectStore(str(tmp_path / "empty")))
+    b, ref = ing.next(), starved.next()
+    assert b["nrec"] == 0
+    assert np.array_equal(b["x"], ref["x"])
+    assert ing.cursor.consumed_total == 6          # position advanced
+    assert gate.checked == 6 and gate.admitted == 0
+    assert gate.rejected == {"schema": 2, "outlier": 2, "oversize": 2}
+    assert gate.rejected_total == 6
+
+
+def test_quality_gate_same_frontier_same_batches(tmp_path):
+    """Determinism: the gate is a pure function of the record, so two
+    consumers at the same frontier produce bitwise-identical post-filter
+    batches and identical reject ledgers — replicas can never diverge on
+    what the gate dropped."""
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    recs = poison_records(3)
+    good = [{"prompt": [1, 2], "response": [3], "feedback": 0.5},
+            {"prompt": [4], "response": [5, 6], "feedback": -0.25}]
+    for rec in (good[0], recs[0], good[1], recs[1], recs[2]):
+        w.append(rec)
+    w.flush()
+    g1, g2 = QualityGate(), QualityGate()
+    a = _ingest(store, batch_records=8, quality=g1)
+    b = _ingest(store, batch_records=8, quality=g2)
+    ba, bb = a.next(), b.next()
+    assert ba["nrec"] == bb["nrec"] == 2           # the two good records
+    assert np.array_equal(ba["x"], bb["x"])
+    assert a.cursor.consumed_total == b.cursor.consumed_total == 5
+    assert g1.rejected == g2.rejected and g1.rejected_total == 3
+
+
+def test_poison_feedback_fault_injects_through_append_path(tmp_path):
+    """The `poison_feedback@N:count` fault rides the writer's REAL
+    append path (committed segments, sequenced, checksummed) — and the
+    gate rejects exactly the burst while the real records pass."""
+    inj = FaultInjector(parse_faults("poison_feedback@2:5"), own_rank=0)
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store, injector=inj)
+    for i in range(3):
+        w.append({"prompt": [i + 1], "response": [i + 2], "feedback": 1})
+    w.flush()
+    audit = _full_replay(store)
+    assert audit.consumed_total == 8               # 3 real + 5 poison
+    cur = Cursor()
+    rd = FeedbackReader(store, stream="s")
+    recs = rd.take(cur, rd.frontier(full=True), 100)
+    gate = QualityGate()
+    kept = gate.admit(recs)
+    assert len(kept) == 3 and gate.rejected_total == 5
+    assert all(isinstance(r["prompt"], list) and r["feedback"] == 1
+               for r in kept)
+
+
+def test_parse_online_fault_grammar():
+    faults = parse_faults("poison_feedback@10:12:r0,bad_version@4:r1")
+    assert faults[0].kind == "poison_feedback" and faults[0].step == 10
+    assert faults[0].arg == 12 and faults[0].rank == 0
+    assert faults[1].kind == "bad_version" and faults[1].step == 4
+    assert faults[1].rank == 1
+    # rank-targeted consumption keeps schedules aligned across the fleet
+    inj = FaultInjector([faults[0]], own_rank=1)
+    assert inj.poison_burst(10) == 0
+    assert [f.kind for f in inj.skipped] == ["poison_feedback"]
+
+
+# ---------------------------------------------------------------------------
+# retention: compaction below the fleet-min frontier
+# ---------------------------------------------------------------------------
+
+
+def test_compaction_below_cursor_keeps_ledger_and_frontier(tmp_path):
+    """Compacting below a consumer's cursor removes segments but never
+    accounting: a fresh full replay still balances bit-for-bit against
+    the pre-compaction ledger (the marker replays the doomed range), the
+    newest committed segment survives, an in-flight reader past the cut
+    resumes with no gap, and a cursor BELOW the cut fast-forwards
+    through the marker and still balances."""
+    store = LocalObjectStore(str(tmp_path))
+    w = _writer(store)
+    for i in range(16):
+        w.append({"i": i})
+        if (i + 1) % 4 == 0:
+            w.flush()                       # 4 segments of 4
+    full = _full_replay(store)
+    assert full.consumed_total == 16
+    rd = FeedbackReader(store, stream="s")
+    fr = rd.frontier(full=True)
+    mid = Cursor()                          # partway: inside segment 2
+    rd.take(mid, fr, 10)
+    below = Cursor()                        # below the cut: segment 0
+    rd2 = FeedbackReader(store, stream="s")
+    rd2.take(below, rd2.frontier(full=True), 4)
+    below = Cursor.from_dict(json.loads(json.dumps(below.to_dict())))
+
+    removed = compact_segments(store, "s", mid)
+    assert removed >= 1
+    keys = store.list("feedback/s/r0")
+    assert any(k.endswith("COMPACTED.json") for k in keys)
+    assert any("seg_00000003" in k for k in keys)   # newest survives
+
+    # 1) the full-replay ledger is unchanged by compaction
+    audit = _full_replay(store)
+    assert audit.consumed_total == 16
+    assert audit.checksum == full.checksum
+    # 2) the partway consumer resumes across the cut with no gap
+    rd3 = FeedbackReader(store, stream="s")
+    fr3 = rd3.frontier(full=True)
+    while rd3.take(mid, fr3, 100):
+        pass
+    assert mid.consumed_total == 16 and mid.checksum == full.checksum
+    # 3) a below-the-cut cursor fast-forwards via the marker: ledger
+    # exact (count + checksum), only freshness lost
+    rd4 = FeedbackReader(store, stream="s")
+    fr4 = rd4.frontier(full=True)
+    while rd4.take(below, fr4, 100):
+        pass
+    assert below.consumed_total == 16 and below.checksum == full.checksum
+    # 4) history stays countable and the writer keeps appending
+    assert rd4.committed_records(fr4) == 16
+    w2 = _writer(store)
+    for i in range(16, 20):
+        w2.append({"i": i})
+    w2.flush()
+    audit2 = _full_replay(store)
+    assert audit2.consumed_total == 20
+
+
+# ---------------------------------------------------------------------------
 # the end-to-end gate
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.timeout(560, method="signal")
+@pytest.mark.timeout(680, method="signal")
 def test_chaos_check_online_storm(tmp_path):
     """scripts/chaos_check.py --online: the training↔serving closed-loop
-    gate (ISSUE-12 acceptance). A serving fleet feeds a live 2-rank
+    gate (ISSUE-12 acceptance, grown by ISSUE-17 to production
+    fidelity). A serving fleet feeds a live 2-rank PARTITIONED-ingest
     trainer through the durable feedback log while a serving replica and
-    a trainer rank are SIGKILLed, a torn segment and a duplicate record
-    are injected, and the published version advances through rolling
-    drain+backfill swaps (>= 2 observed serving). Asserts zero
+    a trainer rank are SIGKILLed, a torn segment, a duplicate record and
+    a 12-record poisoned burst are injected, feedback retention compacts
+    segments mid-storm, and the published version advances through
+    rolling drain+backfill swaps (>= 2 observed serving) — then a
+    NaN-poisoned publish rides a canary rollout, the router's A/B
+    verdict fails it, and the fleet rolls back to the last good version
+    before the republish mints a fresh number. Asserts zero
     accepted-then-lost requests, zero training progress lost past the
     newest upload, exactly-once ingest accounting (count AND
-    order-independent checksum vs a jax-free replay of the log), and
-    `bench_gate.py --slo` holding a throughput floor and the
-    feedback-freshness ceiling."""
+    order-independent checksum vs a jax-free replay of the log, with
+    per-shard slices tiling the union), and `bench_gate.py --slo`
+    holding a throughput floor and the feedback-freshness ceiling."""
     import subprocess
     import sys
 
@@ -498,7 +817,7 @@ def test_chaos_check_online_storm(tmp_path):
     proc = subprocess.run(
         [sys.executable, script, "--online", "--workdir", str(tmp_path)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True, timeout=520,
+        text=True, timeout=640,
     )
     assert proc.returncode == 0, proc.stdout[-3000:]
     assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]

@@ -832,6 +832,192 @@ def test_weights_walk_back_counts_and_explicit_version(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# canary/rollback: deterministic A/B verdicts between live versions,
+# store-side rollback markers, fresh-number republish (ISSUE-17)
+# ---------------------------------------------------------------------------
+
+
+def _canary(**kw):
+    from dear_pytorch_tpu.serving.router import CanaryController
+    kw.setdefault("min_requests", 3)
+    kw.setdefault("quality_floor", 0.9)
+    kw.setdefault("latency_factor", 3.0)
+    kw.setdefault("share", 3)
+    return CanaryController(**kw)
+
+
+def test_canary_quality_floor_fails_candidate():
+    c = _canary()
+    for _ in range(3):
+        c.observe(1, 0.1, 1.0)
+        c.observe(2, 0.1, 0.0)    # NaN-poisoned load: gauge 0.0
+    assert c.maybe_decide([1, 2]) == (2, "FAIL")
+    assert c.failed(2) and not c.failed(1)
+    # memoized: judged exactly once per router life
+    assert c.maybe_decide([1, 2]) is None
+    assert c.decisions == {2: "FAIL"}
+
+
+def test_canary_latency_regression_fails_against_baseline():
+    c = _canary(latency_factor=3.0)
+    for _ in range(3):
+        c.observe(1, 0.1, 1.0)    # baseline: 100ms
+        c.observe(2, 0.5, 1.0)    # candidate: 5x the baseline
+    assert c.maybe_decide([1, 2]) == (2, "FAIL")
+
+
+def test_canary_passes_healthy_candidate_and_none_quality():
+    """A pre-canary replica stamps no gauge — absent evidence must not
+    fail a version (None counts as healthy)."""
+    c = _canary()
+    for _ in range(3):
+        c.observe(1, 0.1, None)
+        c.observe(2, 0.12, None)
+    assert c.maybe_decide([1, 2]) == (2, "PASS")
+    assert not c.failed(2)
+
+
+def test_canary_waits_for_two_versions_and_evidence():
+    c = _canary(min_requests=3)
+    c.observe(2, 0.1, 1.0)
+    assert c.maybe_decide([2, 2]) is None       # one distinct version
+    c.observe(2, 0.1, 1.0)
+    assert c.maybe_decide([1, 2]) is None       # n=2 < min_requests
+    c.observe(2, 0.1, 1.0)
+    assert c.maybe_decide([1, 2]) == (2, "PASS")
+
+
+def test_canary_skips_failed_baseline():
+    """The latency baseline is the newest QUALIFIED non-failed older
+    version — a failed predecessor must not judge its successor."""
+    c = _canary(latency_factor=2.0)
+    for _ in range(3):
+        c.observe(1, 0.4, 1.0)    # old, slow, healthy
+        c.observe(2, 0.01, 0.0)   # poisoned (and deceptively fast)
+    assert c.maybe_decide([1, 2]) == (2, "FAIL")
+    for _ in range(3):
+        c.observe(3, 0.1, 1.0)    # candidate: 10x v2 but < 2x v1
+    assert c.maybe_decide([1, 2, 3]) == (3, "PASS")
+
+
+def test_canary_route_split_is_deterministic():
+    c = _canary(share=3)
+    picks = [c.route_candidate() for _ in range(9)]
+    assert picks == [False, False, True] * 3
+
+
+def test_weights_rollback_marker_and_live_walk(tmp_path):
+    store, W = _publish_versions(tmp_path)      # v1..v3
+    assert W.latest_live_version(store) == 3
+    assert W.mark_rolled_back(store, 3, reason="canary") is True
+    # first-writer-wins: the marker commits once, repeats are idempotent
+    assert W.mark_rolled_back(store, 3, reason="again") is False
+    assert W.rolled_back(store, 3) and not W.rolled_back(store, 2)
+    # the default load walks PAST the dead version; numbering authority
+    # still sees it (latest_version is raw — numbers are never reused)
+    assert W.latest_live_version(store) == 2
+    assert W.latest_version(store) == 3
+    params, version = W.load_params(store)
+    assert version == 2 and params["layer"]["kernel"][0, 0] == 2.0
+    # an EXPLICIT version request overrides the marker (forensics)
+    params, version = W.load_params(store, version=3)
+    assert version == 3
+
+
+def test_params_finite_fraction_gauge():
+    from dear_pytorch_tpu.serving import weights as W
+
+    good = {"a": {"w": np.ones((2, 2))}, "b": np.arange(3)}
+    assert W.params_finite_fraction(good) == 1.0
+    bad = {"a": {"w": np.full((2, 2), np.nan)}, "b": np.arange(3)}
+    frac = W.params_finite_fraction(bad)
+    assert 0.0 < frac < 1.0                     # ints count as finite
+    assert W.params_finite_fraction({}) == 1.0
+
+
+def test_publisher_rollback_then_republish_mints_fresh_number(tmp_path):
+    """ISSUE-17 satellite: after a canary rollback the next publish
+    mints a FRESH store-authoritative number — the dead version is
+    skipped, never reused — and the sidecar provenance (consumed_total)
+    stays monotonic across the gap."""
+    from dear_pytorch_tpu.online.publish import (
+        VersionPublisher, read_online_sidecar,
+    )
+    from dear_pytorch_tpu.serving import weights as W
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    store = LocalObjectStore(str(tmp_path))
+    consumed = [0]
+    pub = VersionPublisher(
+        store, publish_every=2,
+        params_fn=lambda: {"w": np.ones((2,)) * (consumed[0] + 1)},
+        cursor_fn=lambda: {"consumed_total": consumed[0]})
+    for step in (0, 2, 4):
+        consumed[0] += 5
+        assert pub.maybe_publish(step) == step // 2 + 1
+    assert pub.published == [1, 2, 3]
+    assert W.mark_rolled_back(store, 3, reason="canary")
+    consumed[0] += 5
+    assert pub.maybe_publish(6) == 4            # fresh number, never 3
+    assert pub.published == [1, 2, 3, 4]
+    _params, version = W.load_params(store)
+    assert version == 4                         # serving walks onto v4
+    prov = [read_online_sidecar(store, v)["cursor"]["consumed_total"]
+            for v in pub.published]
+    assert prov == sorted(prov) == [5, 10, 15, 20]
+    # cadence: a step inside the publish window is a no-op
+    assert pub.maybe_publish(7) is None
+    # non-leaders never publish
+    assert pub.maybe_publish(99, leader=False) is None
+
+
+def test_publisher_bad_version_fault_poisons_the_artifact(tmp_path):
+    """The ``bad_version`` fault NaNs the Nth publish through the REAL
+    publish path: the artifact commits byte-valid, only the serving-side
+    finiteness gauge can tell — exactly what the canary exists for."""
+    from dear_pytorch_tpu.online.publish import VersionPublisher
+    from dear_pytorch_tpu.resilience.inject import (
+        FaultInjector, parse_faults,
+    )
+    from dear_pytorch_tpu.serving import weights as W
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    store = LocalObjectStore(str(tmp_path))
+    inj = FaultInjector(parse_faults("bad_version@2"), own_rank=0)
+    pub = VersionPublisher(store, publish_every=1,
+                           params_fn=lambda: {"w": np.ones((4,))},
+                           injector=inj)
+    assert pub.maybe_publish(1) == 1
+    assert pub.maybe_publish(2) == 2            # poisoned on the way out
+    assert pub.maybe_publish(3) == 3
+    p1, _ = W.load_params(store, version=1)
+    p2, _ = W.load_params(store, version=2)
+    p3, _ = W.load_params(store, version=3)
+    assert W.params_finite_fraction(p1) == 1.0
+    assert W.params_finite_fraction(p2) == 0.0  # every leaf NaN
+    assert W.params_finite_fraction(p3) == 1.0  # trainer state untouched
+
+
+def test_publisher_survives_publish_failure(tmp_path):
+    from dear_pytorch_tpu.online.publish import VersionPublisher
+    from dear_pytorch_tpu.utils.objectstore import LocalObjectStore
+
+    store = LocalObjectStore(str(tmp_path))
+    boom = [True]
+
+    def params_fn():
+        if boom[0]:
+            raise IOError("store down")
+        return {"w": np.zeros((2,))}
+
+    pub = VersionPublisher(store, publish_every=1, params_fn=params_fn)
+    assert pub.maybe_publish(1) is None
+    assert pub.publish_failures == 1 and pub.published == []
+    boom[0] = False
+    assert pub.maybe_publish(2) == 1            # next cadence recovers
+
+
+# ---------------------------------------------------------------------------
 # serving fault grammar (resilience.inject satellites)
 # ---------------------------------------------------------------------------
 

@@ -262,6 +262,59 @@ def test_serving_tp_ring_priced_per_tick():
     assert tp["p99_s"] > base["p99_s"]
 
 
+def test_phase_priced_sim_matches_recorded_serving_episode():
+    """Parity against the RECORDED serving_r08 A/B cells (ISSUE-17
+    satellite): feed the chunk-1 and chunk-4 cells' measured
+    seconds-per-tick into a live `AdmissionController`'s split-phase
+    EWMAs, convert through `phase_ticks_from_admission`, and replay the
+    tuner's exact workload — the phase-priced sim must land within 35%
+    of each recorded wall and price the chunked:token speedup STRICTLY
+    closer to the recorded ratio than the one-blended-tick model does."""
+    import os
+
+    from dear_pytorch_tpu.serving.admission import AdmissionController
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "perf", "serving_r08",
+                           "summary.json")) as f:
+        eps = json.load(f)["episodes"]
+    rec_tok = eps["1/2/bf16/False/False"]
+    rec_chk = eps["4/2/bf16/False/False"]
+    t_tok = rec_tok["wall_s"] / rec_tok["ticks"]   # s per engine tick
+    t_chk = rec_chk["wall_s"] / rec_chk["ticks"]
+    # the serve_tune episode workload: 24 requests, prompts 4..16,
+    # 4 new tokens, all pending at t=0, slots=2 (scripts/serve_tune.py)
+    trace = sim.TrafficTrace(requests=tuple(
+        (0.0, 4 + (i * 5) % 13, 4) for i in range(24)))
+
+    def arm(chunk, tick):
+        adm = AdmissionController(max_depth=64)
+        adm.complete(prefill_tokens=chunk, prefill_s=tick,
+                     decode_tokens=1, decode_s=tick)
+        pt, dt = sim.phase_ticks_from_admission(adm, chunk)
+        assert pt == pytest.approx(tick) and dt == pytest.approx(tick)
+        return sim.simulate_serving(TOPO8, trace, prefill_chunk=chunk,
+                                    slots=2, prefill_tick_s=pt,
+                                    decode_tick_s=dt)
+
+    sim_tok = arm(1, t_tok)
+    sim_chk = arm(4, t_chk)
+    assert sim_tok["ticks"] == 337 and sim_chk["ticks"] == 165
+    assert sim_tok["wall_s"] == pytest.approx(rec_tok["wall_s"], rel=0.35)
+    assert sim_chk["wall_s"] == pytest.approx(rec_chk["wall_s"], rel=0.35)
+    rec_ratio = rec_tok["wall_s"] / rec_chk["wall_s"]
+    sim_ratio = sim_tok["wall_s"] / sim_chk["wall_s"]
+    assert sim_ratio > 1.0                  # chunked wins, as recorded
+    # the blended-tick model prices both phases identically, so its
+    # ratio is fixed at total-ticks/total-ticks regardless of the tick
+    blend_tok = sim.simulate_serving(TOPO8, trace, prefill_chunk=1,
+                                     slots=2)
+    blend_chk = sim.simulate_serving(TOPO8, trace, prefill_chunk=4,
+                                     slots=2)
+    blend_ratio = blend_tok["wall_s"] / blend_chk["wall_s"]
+    assert abs(sim_ratio - rec_ratio) < abs(blend_ratio - rec_ratio)
+
+
 def test_serving_autoscaler_relieves_backlog():
     tr = sim.TrafficTrace.poisson(rps=900.0, duration_s=1.5,
                                   prompt_tokens=16, decode_tokens=4,
