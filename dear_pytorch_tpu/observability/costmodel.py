@@ -38,6 +38,7 @@ from typing import Any, Callable, Optional, Sequence
 __all__ = [
     "CostModel", "ServeCostModel", "LinkFit", "Calibration",
     "load_calibration", "fit_allgather_sweep", "fit_dcn",
+    "price_degraded_round",
     "DTYPE_ITEMSIZE",
 ]
 
@@ -271,6 +272,36 @@ class CostModel:
         if config.remat == "full":
             compute = compute * self.remat_factor
         return max(compute, self._scale * self.comm(config))
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode DCN pricing (comm/dcn.py's escalation ladder)
+# ---------------------------------------------------------------------------
+
+
+def price_degraded_round(fit: LinkFit, wire_bytes: float, *,
+                         timeout_s: float,
+                         partition_mb: Optional[float] = None,
+                         outage: bool = False) -> float:
+    """Seconds the cross-slice leg charges for ONE remote slice in one
+    exchange round under the degraded-mode ladder (`comm/dcn.py`).
+
+    Healthy: the α-β price of the slice's chunked payload — one α per
+    chunk at ``partition_mb`` granularity plus β per wire byte, the
+    same per-message accounting `overlap.predict_leg_times` applies to
+    'dcn' rows. Outage: rung 1 burns the slice's WHOLE retry budget
+    (``DEAR_DCN_TIMEOUT_SECS`` — retries spread inside it) before rung
+    2 skips, so the cost of deciding to skip is exactly ``timeout_s``,
+    bounded by construction. `sim.simulate_degraded_dcn` composes this
+    per-round price into whole skip-vs-stall traces."""
+    if outage:
+        return float(timeout_s)
+    wire = max(float(wire_bytes), 0.0)
+    if partition_mb is not None and partition_mb > 0:
+        chunks = max(int(math.ceil(wire / (partition_mb * 2**20))), 1)
+    else:
+        chunks = 1
+    return chunks * fit.alpha + wire * fit.beta
 
 
 # ---------------------------------------------------------------------------

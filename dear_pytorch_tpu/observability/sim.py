@@ -51,7 +51,9 @@ import os
 import random
 import statistics
 import threading
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (
+    Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple,
+)
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.observability.costmodel import (
@@ -61,6 +63,7 @@ from dear_pytorch_tpu.observability.costmodel import (
 __all__ = [
     "SimTopology", "load_topology", "synthetic_plan",
     "simulate_training", "simulate_serving", "TrafficTrace",
+    "simulate_degraded_dcn", "sweep_staleness_policies",
     "phase_ticks_from_admission",
     "SimTransport", "run_membership_storm",
     "VirtualClock", "tune_plan_sim", "tune_serve_sim", "tune_fleet_sim",
@@ -467,6 +470,156 @@ def _quantiles(samples: Sequence[float]) -> dict:
 
     return {"p50": q(0.50), "p90": q(0.90), "p99": q(0.99),
             "mean": statistics.fmean(xs), "n": len(xs)}
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode DCN: skip-vs-stall under an outage trace
+# ---------------------------------------------------------------------------
+
+
+def simulate_degraded_dcn(
+    topo: SimTopology,
+    *,
+    staleness: int,
+    steps: int = 12,
+    compute_time_s: float = 0.030,
+    wire_bytes_per_round: float = 4 * 2**20,
+    partition_mb: Optional[float] = None,
+    timeout_s: float = 3.0,
+    outages: Mapping[int, Sequence[int]] = (),
+    ckpt_every: int = 4,
+    restore_s: float = 0.5,
+    evict_s: float = 2.0,
+    rejoin_s: float = 2.0,
+) -> dict:
+    """Replay one staleness policy against a cross-slice outage trace:
+    the skip-vs-stall half of `comm/dcn.py`'s escalation ladder, priced
+    per round by `costmodel.price_degraded_round` so the policy is a
+    searchable axis next to ``partition_mb``.
+
+    ``outages`` maps slice id -> exchange-ATTEMPT numbers (0-based)
+    whose publishes are suppressed — attempt-indexed like the live
+    injector's ``dcn_flap``/``dcn_partition`` grammar counts exchange
+    calls, so a strict-mode retry loop advances through the outage
+    instead of replaying it forever.
+
+    Event model per attempt (deterministic — pure function of inputs,
+    no RNG): a healthy remote slice costs its α-β chunk price; an
+    outage slice burns the whole per-slice retry budget (``timeout_s``,
+    rung 1). Under ``staleness == 0`` (strict) any outage FAILS the
+    step: the guard restores the newest checkpoint (``restore_s``) and
+    replays the lost steps at full price. Under ``staleness >= 1`` the
+    round completes over the committed subset (rung 2, one skip per
+    excluded slice per round); a slice past its budget is escalated to
+    membership (rung 3: one ``evict_s`` transition) and stops costing
+    anything until the outage ends, when it rejoins (``rejoin_s``).
+    The DCN leg hides under the step's compute window in BOTH modes —
+    the policies differ only in rollback/skip economics, not in an
+    overlap bonus. Returns ladder counters + ``steps_per_hour``."""
+    from dear_pytorch_tpu.observability import costmodel as CM
+
+    if steps < 1:
+        raise ValueError("steps must be >= 1")
+    if staleness < 0:
+        raise ValueError("staleness must be >= 0")
+    out_by_slice = {int(s): frozenset(int(a) for a in atts)
+                    for s, atts in dict(outages).items()}
+    fits = topo.dcn_fits()
+    remotes = [s for s in range(topo.num_slices) if s != 0]
+
+    def leg_price(s: int, outage: bool) -> float:
+        return CM.price_degraded_round(
+            fits[s], wire_bytes_per_round, timeout_s=timeout_s,
+            partition_mb=partition_mb, outage=outage)
+
+    total_s = 0.0
+    done = 0
+    last_ckpt = 0
+    attempt = 0
+    stale = {s: 0 for s in remotes}
+    evicted: set = set()
+    counters = {"rollbacks": 0, "timeouts": 0, "degraded_rounds": 0,
+                "skips": 0, "escalations": 0, "rejoins": 0}
+    cap = steps * 50 + 100   # strict mode inside a long partition spins
+    while done < steps and attempt < cap:
+        down = [s for s in remotes if s not in evicted
+                and attempt in out_by_slice.get(s, frozenset())]
+        # a previously evicted slice whose outage ended rejoins before
+        # the round runs (slice-gated admission, one membership epoch)
+        for s in sorted(evicted):
+            if attempt not in out_by_slice.get(s, frozenset()):
+                evicted.discard(s)
+                stale[s] = 0
+                counters["rejoins"] += 1
+                total_s += rejoin_s
+        attempt += 1
+        if staleness == 0 and down:
+            # strict: the step fails after burning the fetch budget;
+            # the guard restores and the replay re-pays full steps
+            counters["timeouts"] += len(down)
+            counters["rollbacks"] += 1
+            total_s += compute_time_s + timeout_s + restore_s
+            done = last_ckpt
+            continue
+        leg = 0.0
+        for s in remotes:
+            if s in evicted:
+                continue
+            if s in down:
+                leg = max(leg, leg_price(s, True))
+                counters["skips"] += 1
+                stale[s] += 1
+            else:
+                leg = max(leg, leg_price(s, False))
+                stale[s] = 0
+        if down or evicted:
+            counters["degraded_rounds"] += 1
+        total_s += compute_time_s + max(0.0, leg - compute_time_s)
+        done += 1
+        if done % max(int(ckpt_every), 1) == 0:
+            last_ckpt = done
+        for s in list(stale):
+            if stale[s] > staleness:
+                evicted.add(s)
+                stale[s] = 0
+                counters["escalations"] += 1
+                total_s += evict_s
+    finished = done >= steps
+    result = {
+        "staleness": int(staleness),
+        "steps": done,
+        "finished": finished,
+        "attempts": attempt,
+        "total_s": total_s,
+        "steps_per_hour": (done / total_s * 3600.0) if total_s > 0
+                          else float("inf"),
+        **counters,
+    }
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("sim.degraded_dcn_runs")
+        tr.event("sim.degraded_dcn_run", staleness=int(staleness),
+                 steps=done, rollbacks=counters["rollbacks"],
+                 escalations=counters["escalations"])
+    return result
+
+
+def sweep_staleness_policies(
+    topo: SimTopology,
+    *,
+    policies: Sequence[int] = (0, 1, 2),
+    **kwargs,
+) -> List[dict]:
+    """Rank staleness budgets over one outage trace: one
+    `simulate_degraded_dcn` run per policy, sorted best-first by
+    (finished, steps_per_hour, fewest rollbacks). The offline
+    skip-vs-stall search `scripts/sim_check.py` gates against the
+    recorded flap-storm artifact (perf/dcn_degraded_r18)."""
+    runs = [simulate_degraded_dcn(topo, staleness=p, **kwargs)
+            for p in policies]
+    return sorted(runs, key=lambda r: (-int(r["finished"]),
+                                       -r["steps_per_hour"],
+                                       r["rollbacks"]))
 
 
 # ---------------------------------------------------------------------------

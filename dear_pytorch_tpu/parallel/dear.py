@@ -1197,11 +1197,18 @@ def build_train_step(
 
     def _hier_step(state: DearState, batch):
         padded = [b.padded_size for b in plan.buckets]
+        # step number read from the INPUT state (ready before dispatch):
+        # it keys both the exchange and the cross-iteration prefetch
+        step_no = int(np.asarray(jax.device_get(state.step)))
         grads_g, loss_sl = _hier_grads_jitted(state, batch)(state, batch)
+        # bounded-stale mode only (no-op otherwise): start pulling the
+        # peers' partials for THIS step while our backward is still
+        # running on device — a peer up to one round ahead has already
+        # published, so its wire time hides under the compute
+        dcn.prefetch(step_no)
         # the host leg is the synchronization point of this schedule: the
         # step number keys the exchange and the partials are its payload,
         # so these transfers are the leg itself, not a stray sync
-        step_no = int(np.asarray(jax.device_get(state.step)))
         host = [np.asarray(jax.device_get(g)) for g in grads_g]
         losses = np.asarray(jax.device_get(loss_sl),
                             np.float64).reshape(-1)

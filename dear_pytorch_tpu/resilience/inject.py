@@ -49,10 +49,28 @@ Fault kinds (all fire exactly once per scheduled entry):
                     `dcn_slow_s_for` per exchange)
   ``dcn_drop``      cross-slice transport only: the Nth DCN exchange
                     suppresses its outbound publish once (a transient
-                    partition / lost message) — the peer slices' fetches
-                    time out, the guard rolls every slice back in
-                    lockstep, and the replay re-publishes
+                    partition / lost message); in strict mode the peer
+                    fetches time out and the guard rolls back, in
+                    degraded mode the ladder's skip rung absorbs it
                     (`dcn_drop_due` per exchange)
+  ``dcn_flap``      cross-slice transport only: from the Nth DCN
+                    exchange, ``arg`` (default 1) DROP/RECOVER cycles —
+                    outbound publish suppressed on exchanges N, N+2,
+                    N+4, ... for ``arg`` cycles, delivered in between
+                    (a flapping DCN link). The canonical SUB-budget
+                    transient: with `DEAR_DCN_STALENESS` >= 1 the
+                    degraded exchange must absorb every cycle with
+                    zero guard rollbacks (``dcn_outage_due`` per
+                    exchange)
+  ``dcn_partition`` cross-slice transport only: from the Nth DCN
+                    exchange, outbound publish suppressed for ``arg``
+                    SECONDS of wall time (a sustained partition). Sized
+                    past the staleness budget it must walk the whole
+                    ladder: skip rounds, then slice-granular eviction,
+                    then rejoin. Wall-clock armed (the partitioned
+                    process keeps exchanging at its own pace), so runs
+                    are deterministic in outcome, not in exact round
+                    count (``dcn_outage_due`` per exchange)
 
 Enable from the environment — ``DEAR_FAULTS="nan@6,exc@9,hang@12:0.5,
 ckpt_corrupt@15,preempt@18"`` — or construct a `FaultInjector` in code and
@@ -95,7 +113,8 @@ FAULT_ENV = "DEAR_FAULTS"
 
 KINDS = ("nan", "exc", "hang", "slow", "ckpt_corrupt", "preempt",
          "corrupt_resp", "torn_seg", "dup_feedback", "dcn_slow",
-         "dcn_drop", "poison_feedback", "bad_version")
+         "dcn_drop", "dcn_flap", "dcn_partition", "poison_feedback",
+         "bad_version")
 
 __all__ = [
     "FAULT_ENV", "KINDS", "Fault", "InjectedFault", "FaultInjector",
@@ -290,6 +309,10 @@ class FaultInjector:
         #: persistent per-DCN-exchange latency armed by ``dcn_slow``
         #: faults (the straggler-slice analog of ``slow_s``)
         self.dcn_slow_s: float = 0.0
+        #: armed ``dcn_flap`` cycles: (first exchange, cycle count)
+        self._flaps: List[Tuple[int, int]] = []
+        #: wall-clock deadline of an armed ``dcn_partition`` (monotonic)
+        self._partition_until: float = 0.0
         self._own_rank = own_rank
         self._own_slice = own_slice
         # kill=False turns ``preempt`` into a no-op marker (tests that
@@ -497,6 +520,36 @@ class FaultInjector:
         rolls every slice back in lockstep, and the replayed exchange
         publishes normally (the fault fired exactly once)."""
         return bool(self._take(exchange_no, ("dcn_drop",)))
+
+    def dcn_outage_due(self, exchange_no: int) -> bool:
+        """True while an armed ``dcn_flap`` or ``dcn_partition`` fault
+        suppresses THIS exchange's outbound publish.
+
+        ``dcn_flap@N:K`` arms at exchange ``N`` and suppresses exchanges
+        ``N, N+2, ..., N+2(K-1)`` — K drop/recover cycles, the flapping
+        link whose every cycle the degraded ladder's retry/skip rungs
+        must absorb without a rollback. ``dcn_partition@N:SECS`` arms at
+        exchange ``N`` and suppresses every exchange for the next SECS
+        of wall time — the sustained outage that must walk past the
+        staleness budget into eviction. Wall-clock on purpose: the
+        partitioned slice keeps stepping at its own (skipped) pace, so
+        the outage spans however many rounds that takes — deterministic
+        in outcome, not in round count."""
+        for f in self._take(exchange_no, ("dcn_flap",)):
+            self._flaps.append(
+                (int(exchange_no), max(int(f.arg), 1) if f.arg else 1))
+        for f in self._take(exchange_no, ("dcn_partition",)):
+            self._partition_until = max(
+                self._partition_until,
+                time.monotonic() + max(float(f.arg), 0.0))
+        out = False
+        for n0, k in self._flaps:
+            rel = int(exchange_no) - n0
+            if 0 <= rel < 2 * k and rel % 2 == 0:
+                out = True
+        if time.monotonic() < self._partition_until:
+            out = True
+        return out
 
     def corrupt_payload(self, step: int, data: bytes) -> bytes:
         """Apply a due ``corrupt_resp`` fault to an outbound response

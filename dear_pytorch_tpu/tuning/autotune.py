@@ -495,6 +495,12 @@ class AutoTuner:
         with tr.span("autotune.rebuild", strategy=self.strategy,
                      buckets=new_ts.plan.num_buckets):
             state = repack_state(state, old_ts, new_ts)
+        _dcn = self._build_kwargs.get("dcn")
+        if _dcn is not None and hasattr(_dcn, "repack_residual"):
+            # the degraded-DCN error-feedback residual lives in bucket
+            # rows of the OLD plan: carry it across the re-bucketing with
+            # the same mass-preserving algebra as the compressor state
+            _dcn.repack_residual(old_ts.plan, new_ts.plan)
         self.ts = new_ts
         self.rebuilds += 1
         if tr.enabled:

@@ -266,6 +266,7 @@ def save_checkpoint(
     *, asynchronous: bool = False,
     pipeline_state: Optional[dict] = None,
     mem_epoch: Optional[int] = None,
+    dcn_state: Optional[dict] = None,
 ) -> str:
     """Write a checkpoint for the state's current step; returns its path.
 
@@ -280,6 +281,10 @@ def save_checkpoint(
     restoring the model without restoring the input-pipeline position
     silently replays or skips data, so the guard persists both and
     `read_pipeline_state` / `read_mem_epoch` recover them.
+    ``dcn_state`` (a `comm.dcn.DcnExchanger` ``state_dict()``) rides the
+    same way: the degraded-mode error-feedback residual is deferred
+    gradient mass belonging to THIS model state — restoring one without
+    the other double-counts or drops it (`read_dcn_state` recovers it).
     """
     import orbax.checkpoint as ocp
 
@@ -313,6 +318,8 @@ def save_checkpoint(
             meta["pipeline"] = pipeline_state
         if mem_epoch is not None:
             meta["mem_epoch"] = int(mem_epoch)
+        if dcn_state is not None:
+            meta["dcn"] = dcn_state
         # checksum manifest over the committed files: only the sync paths
         # have them on disk here; async saves backfill via `write_manifest`
         # after `wait_for_checkpoints` (manifest=None verifies vacuously)
@@ -397,6 +404,18 @@ def read_pipeline_state(directory: str, step: int) -> Optional[dict]:
     every restore silently replays or skips data."""
     meta = read_sidecar(directory, step)
     return meta.get("pipeline") if meta else None
+
+
+def read_dcn_state(directory: str, step: int) -> Optional[dict]:
+    """The cross-slice exchanger ``state_dict()`` persisted with a
+    checkpoint (None when the save predates degraded-DCN sidecars or the
+    run had no ladder state). Feed it to
+    `comm.dcn.DcnExchanger.load_state_dict` so a rollback re-seats the
+    error-feedback residual with the parameters it was deferred against —
+    without this a restore silently drops (or, after replay, double
+    counts) the skipped rounds' gradient mass."""
+    meta = read_sidecar(directory, step)
+    return meta.get("dcn") if meta else None
 
 
 def read_mem_epoch(directory: str, step: int) -> Optional[int]:

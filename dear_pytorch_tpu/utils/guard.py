@@ -107,6 +107,15 @@ def _is_dcn_error(exc: BaseException) -> bool:
     return isinstance(exc, DcnError)
 
 
+def _is_self_evict(exc: BaseException) -> bool:
+    """Is this the degraded-DCN ladder's self-eviction verdict? Must NOT
+    be handled as a step error: a rollback cannot fix an outbound
+    partition — the rank exits for relaunch + rejoin instead."""
+    from dear_pytorch_tpu.comm.dcn import DcnSelfEvict
+
+    return isinstance(exc, DcnSelfEvict)
+
+
 class DivergenceError(RuntimeError):
     """Raised when training diverges and no checkpoint exists to restore."""
 
@@ -286,6 +295,39 @@ class GuardedTrainer:
                 "guard: pipeline state restore failed (%s); continuing "
                 "with the live stream position", exc)
 
+    def _dcn_state(self) -> Optional[dict]:
+        """The cross-slice exchanger's ladder state (error-feedback
+        residual + staleness clocks) for the checkpoint sidecar — None
+        on non-hierarchical schedules or when there is nothing carried
+        (keeps legacy sidecars byte-identical)."""
+        dcn = getattr(self.ts, "dcn", None)
+        if dcn is None or not hasattr(dcn, "state_dict"):
+            return None
+        try:
+            state = dcn.state_dict()
+        except Exception as exc:  # a ladder bug must not block the save
+            logger.error("guard: dcn.state_dict() failed: %s", exc)
+            return None
+        if not state.get("residual") and not state.get("staleness"):
+            return None
+        return state
+
+    def _restore_dcn(self, step: int) -> None:
+        """Re-seat the degraded-DCN error-feedback residual persisted
+        with the checkpoint being restored — the deferred gradient mass
+        belongs to THESE parameters; keeping the live residual across a
+        rollback would double-count every skipped round the replay
+        re-earns."""
+        dcn = getattr(self.ts, "dcn", None)
+        if dcn is None or not hasattr(dcn, "load_state_dict"):
+            return
+        try:
+            dcn.load_state_dict(ckpt.read_dcn_state(self.directory, step))
+        except Exception as exc:  # a sidecar bug must not kill recovery
+            logger.error(
+                "guard: dcn ladder state restore failed (%s); continuing "
+                "with fresh (empty) residuals", exc)
+
     def _reshard_pipeline(self) -> None:
         """Reassign this rank's data slice after a committed membership
         transition. The shard slot is the view's ``data_shard`` — the
@@ -366,7 +408,8 @@ class GuardedTrainer:
             ckpt.save_checkpoint(self.directory, state, self.ts.plan,
                                  asynchronous=self.async_checkpoints,
                                  pipeline_state=self._pipeline_state(),
-                                 mem_epoch=self._mem_epoch)
+                                 mem_epoch=self._mem_epoch,
+                                 dcn_state=self._dcn_state())
         except Exception as exc:
             if not self.async_checkpoints:
                 raise
@@ -476,6 +519,7 @@ class GuardedTrainer:
             state = self._restore_step(step)
             self._template = None
             self._restore_pipeline(step)
+            self._restore_dcn(step)
             # the consensus step may be OLDER than this rank's newest
             # (elastic rejoin, a step corrupted elsewhere): anything newer
             # is now an abandoned timeline — replay will re-reach those
@@ -508,6 +552,7 @@ class GuardedTrainer:
             )
             self._template = None
             self._restore_pipeline(step)
+            self._restore_dcn(step)
             logger.warning("guard: rolled back to checkpoint step %d", step)
             return state, step
         # single-host: walk newest -> oldest. Checksum verification skips
@@ -539,6 +584,7 @@ class GuardedTrainer:
             # the restore; caching it would permanently double device memory
             self._template = None
             self._restore_pipeline(step)
+            self._restore_dcn(step)
             # a corrupted/unrestorable newer step just became an abandoned
             # timeline; sweep it so replayed saves don't collide with it
             ckpt.prune_future_steps(self.directory, above=step)
@@ -663,6 +709,22 @@ class GuardedTrainer:
             new_state, metrics, is_ckpt, is_check, healthy = \
                 self._attempt(state, batch, tr)
         except (FloatingPointError, RuntimeError) as exc:
+            if _is_self_evict(exc):
+                # the degraded-DCN ladder's last rung: the fleet's
+                # replica-identical participation view says THIS slice is
+                # unmerged past the staleness budget. A rollback cannot
+                # fix an outbound partition — re-raise so the rank exits
+                # like an `EvictedError` (supervisor relaunch → hydrate →
+                # slice-gated rejoin), while the survivors' membership
+                # sync books the slice loss. Every rank of the slice
+                # reaches the same verdict from the same gathered records.
+                if tr.enabled:
+                    tr.count("guard.step_errors")
+                    tr.event("guard.step_error", error=type(exc).__name__)
+                logger.error(
+                    "guard: DCN ladder escalated to self-eviction: %s — "
+                    "exiting for relaunch + rejoin", exc)
+                raise
             if self._coordinated and dispatched and _is_dcn_error(exc):
                 # hierarchical schedule: the CROSS-SLICE leg failed (dead
                 # slice, DCN partition, dropped publish). Unlike a failure
@@ -1094,7 +1156,8 @@ class GuardedTrainer:
             ckpt.save_checkpoint(self.directory, state, self.ts.plan,
                                  asynchronous=False,
                                  pipeline_state=self._pipeline_state(),
-                                 mem_epoch=self._mem_epoch)
+                                 mem_epoch=self._mem_epoch,
+                                 dcn_state=self._dcn_state())
         except Exception as exc:
             # the grace window must still end in a clean preempted exit:
             # a failed emergency save (disk full, shared-fs error) means

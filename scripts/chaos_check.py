@@ -1139,7 +1139,22 @@ def run_worker_multislice(checkpoint_every: int, workdir: str) -> dict:
     epoch, renormalize the DCN leg, and train degraded; the relaunched
     slice hydrates from the remote tier and readmits as one epoch at
     the barrier. A slice-targeted ``dcn_slow`` fault turns the
-    surviving slice into a straggler the fleet must tolerate."""
+    surviving slice into a straggler the fleet must tolerate.
+
+    ``DEAR_CHAOS_MULTI_MODE`` selects the storm's fault story:
+
+    * ``kill`` (default) — the SIGKILL narrative above;
+    * ``flap`` — NO kill: a fixed-step degraded-mode run
+      (``DEAR_CHAOS_MULTI_STEPS``) under a sub-budget ``dcn_flap``
+      transient, where the ladder's skip-don't-stall rung must absorb
+      every dropped exchange without a single guard rollback;
+    * ``partition`` — NO SIGKILL either: a past-budget
+      ``dcn_partition`` starves the victim slice until its own
+      bounded-staleness clock trips ``DcnSelfEvict`` — the process
+      exits 70, the supervisor relaunches it with the rejoin flag, and
+      the relaunched life STRIPS the one-shot dcn_flap/dcn_partition
+      faults from ``DEAR_FAULTS`` so the armed outage does not re-fire
+      on the rejoined slice."""
     import json
 
     os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
@@ -1151,7 +1166,7 @@ def run_worker_multislice(checkpoint_every: int, workdir: str) -> dict:
     import jax
     import numpy as np
 
-    from dear_pytorch_tpu.comm.dcn import DcnExchanger
+    from dear_pytorch_tpu.comm.dcn import DcnExchanger, DcnSelfEvict
     from dear_pytorch_tpu.observability import tracer as T
     from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
     from dear_pytorch_tpu.resilience import membership as M
@@ -1170,18 +1185,30 @@ def run_worker_multislice(checkpoint_every: int, workdir: str) -> dict:
     cluster = M.ElasticCluster.from_env(max_candidates=256)
     rejoining = M.ElasticCluster.rejoining_by_env()
     rank, my_slice = cluster.rank, cluster.slice_of(cluster.rank)
-    ks, ka = os.environ["DEAR_CHAOS_MULTI_KILL"].split(":")
-    kill_slice, kill_at = int(ks), int(ka)
+    mode = os.environ.get("DEAR_CHAOS_MULTI_MODE", "kill")
+    if mode == "kill":
+        ks, ka = os.environ["DEAR_CHAOS_MULTI_KILL"].split(":")
+        kill_slice, kill_at = int(ks), int(ka)
+    else:
+        kill_slice, kill_at = -1, 1
     target_epoch = int(os.environ.get("DEAR_CHAOS_MULTI_EPOCHS", "2"))
     post = int(os.environ.get("DEAR_CHAOS_MULTI_POST", "3"))
     remote_root = os.environ["DEAR_CHAOS_REMOTE"]
     ckpt_dir = os.path.join(workdir, f"rank{rank}", "ckpts")
     tracer = T.get_tracer()
 
+    faults_spec = os.environ.get("DEAR_FAULTS", "").strip()
+    if rejoining and faults_spec:
+        # a relaunched life must not re-arm the one-shot outage that
+        # evicted it — a fresh injector would fire dcn_flap/dcn_partition
+        # again at ITS exchange N and thrash the rejoined slice forever
+        faults_spec = ",".join(
+            f for f in faults_spec.split(",")
+            if f.split("@", 1)[0] not in ("dcn_flap", "dcn_partition"))
     injector = None
-    if os.environ.get("DEAR_FAULTS", "").strip():
+    if faults_spec:
         injector = FaultInjector(
-            parse_faults(os.environ["DEAR_FAULTS"]),
+            parse_faults(faults_spec),
             own_rank=rank, own_slice=my_slice)
     # a rejoiner's exchanger starts at the INITIAL view; admission hands
     # it the committed slice set through AutoTuner.rescale (reenter)
@@ -1244,12 +1271,41 @@ def run_worker_multislice(checkpoint_every: int, workdir: str) -> dict:
     else:
         state = tuner.init(params)
 
-    kill = ((rank, 0, kill_at - 1) if my_slice == kill_slice
-            else (-1, 0, 0))
-    state, m = EH.run_autoscale_loop(
-        cluster, guard, pipe, state, batch_at,
-        rejoining=rejoining, target_epoch=target_epoch, post=post,
-        kill=kill, deadline_s=420.0)
+    if mode == "flap":
+        # fixed-step degraded-mode run: NO membership churn expected —
+        # the sub-budget transient must be absorbed entirely by the
+        # ladder's skip rung, with zero guard rollbacks
+        steps = int(os.environ.get("DEAR_CHAOS_MULTI_STEPS", "12"))
+        m = {}
+        while guard.steps_seen < steps:
+            i = guard.steps_seen
+            pipe.next()
+            state, m = guard.step(state, batch_at(i))
+    else:
+        kill = ((rank, 0, kill_at - 1) if my_slice == kill_slice
+                else (-1, 0, 0))
+        try:
+            state, m = EH.run_autoscale_loop(
+                cluster, guard, pipe, state, batch_at,
+                rejoining=rejoining, target_epoch=target_epoch, post=post,
+                kill=kill, deadline_s=420.0)
+        except DcnSelfEvict as exc:
+            # rung 3, local side: the bounded-staleness clock says WE are
+            # the partitioned slice. Flush what we have, leave a durable
+            # marker for the parent gate, and exit nonzero so the
+            # supervisor relaunches this rank through slice-gated rejoin.
+            streamer.flush(20.0)
+            streamer.close()
+            doc = {"rank": rank, "slice": my_slice, "pid": os.getpid(),
+                   "steps_seen": guard.steps_seen, "reason": str(exc)}
+            path = os.path.join(
+                workdir, f"selfevict_rank{rank}.{os.getpid()}.json")
+            with open(path + ".tmp", "w") as f:
+                json.dump(doc, f)
+            os.replace(path + ".tmp", path)
+            print(f"CHAOS_MULTI rank={rank} SELF-EVICT "
+                  + json.dumps(doc), flush=True)
+            raise SystemExit(70)
     streamer.flush(20.0)
     streamer.close()
     counters = tracer.counters()
@@ -1478,6 +1534,307 @@ def run_multislice(checkpoint_every: int, workdir: str | None) -> dict:
         "newest_uploaded": newest_uploaded,
         "failures": failures,
     })
+    return summary
+
+
+def run_multislice_flap(checkpoint_every: int, workdir: str | None) -> dict:
+    """Parent of the DCN flap storm — the degraded-mode acceptance gate
+    (ISSUE 18, rung 2 of the ladder). A 2-slice x 2-rank supervised
+    fleet trains the hierarchical schedule in BOUNDED-STALENESS mode
+    (``DEAR_DCN_STALENESS=2``) while a sub-budget ``dcn_flap`` suppresses
+    the victim slice's publishes on alternating exchanges and a
+    ``dcn_slow`` straggler fault drags the other slice; the gate asserts:
+
+      1. ZERO guard rollbacks on EVERY rank — the transient is absorbed
+         entirely by retry + skip-with-error-feedback, never by the
+         recovery machinery (the acceptance bar that separates degraded
+         mode from the strict-mode rollback story);
+      2. zero membership epochs, zero relaunches — nobody was evicted
+         for a transient inside the staleness budget;
+      3. the ladder actually engaged: every rank skipped at least one
+         absent peer (``dcn.skips``), the flapped slice carried its
+         unmerged partial as an error-feedback residual
+         (``dcn.residual_carries``), and nobody escalated;
+      4. the fleet finishes in lockstep at the exact step target, and
+         ``bench_gate --slo`` holds the steps/hour floor — degraded
+         rounds cost bounded retry budget, not stalls.
+    """
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_flap_")
+    elastic_dir = os.path.join(workdir, "elastic")
+    remote_root = os.path.join(workdir, "remote")
+    os.makedirs(remote_root, exist_ok=True)
+    sup_mod = CC.load_supervisor()
+
+    nslices, rps, steps = 2, 2, 12
+    nprocs = nslices * rps
+    flap_slice = 1
+    env = dict(os.environ)
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_CHAOS_MULTI_MODE"] = "flap"
+    env["DEAR_CHAOS_MULTI_STEPS"] = str(steps)
+    env["DEAR_CHAOS_REMOTE"] = remote_root
+    # the canonical sub-budget transient: exchanges 4 and 6 of the
+    # victim slice are suppressed (staleness never exceeds 1 < budget 2),
+    # plus a 30ms straggler on the survivor side from exchange 8
+    env["DEAR_FAULTS"] = (f"dcn_flap@4:2:s{flap_slice},"
+                          f"dcn_slow@8:0.03:s{1 - flap_slice}")
+    env["DEAR_DCN_STALENESS"] = "2"
+    env["DEAR_DCN_RETRIES"] = "1"
+    env["DEAR_DCN_TIMEOUT_SECS"] = "3"
+    env.setdefault("DEAR_CLUSTER_TIMEOUT_SECS", "45")
+    sup = sup_mod.ElasticSupervisor(
+        nprocs,
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--multislice", "--checkpoint-every", str(checkpoint_every),
+         "--workdir", workdir],
+        elastic_dir=elastic_dir, env=env,
+        max_relaunches=0, ranks_per_slice=rps,
+    ).start()
+
+    decided = CC.decided_reader(elastic_dir)
+    rc, elapsed_s = CC.run_fleet(sup, deadline_s=300.0)
+
+    failures: list[str] = []
+    _check(rc == 0, f"supervisor fleet exits clean (got rc={rc})",
+           failures)
+    _check(all(n == 0 for n in sup.relaunches.values()),
+           f"no rank was relaunched under the sub-budget flap "
+           f"({sup.relaunches})", failures)
+    _check(decided(1) is None,
+           f"zero membership epochs: a sub-budget transient never "
+           f"reaches the eviction rung (e1 = {decided(1)})", failures)
+
+    _lives, finals = CC.collect_verdicts(workdir)
+    summary = {"passed": False, "workdir": workdir, "rc": rc,
+               "elapsed_s": round(elapsed_s, 1), "finals": finals,
+               "failures": failures}
+    if sorted(finals) != list(range(nprocs)):
+        failures.append(f"expected final verdicts from ranks 0-"
+                        f"{nprocs - 1}, got {sorted(finals)}")
+        return summary
+
+    for r, v in sorted(finals.items()):
+        c = v["counters"]
+        _check(c.get("guard.rollbacks", 0) == 0
+               and not v["rollback_steps"],
+               f"rank {r}: ZERO guard rollbacks under the sub-budget "
+               f"flap (rollbacks={c.get('guard.rollbacks', 0)}, "
+               f"steps={v['rollback_steps']})", failures)
+        _check(v["steps_seen"] == steps and v["final_step"] == steps,
+               f"rank {r} finished the exact step target "
+               f"({v['steps_seen']}/{steps})", failures)
+        _check(v.get("lockstep"), f"rank {r} finished in lockstep",
+               failures)
+        _check(not v["transitions"],
+               f"rank {r} saw no membership transitions "
+               f"({v['transitions']})", failures)
+        _check(c.get("dcn.degraded_rounds", 0) > 0
+               and c.get("dcn.skips", 0) >= 1,
+               f"rank {r} trained through degraded rounds by SKIPPING "
+               f"the absent slice (degraded_rounds="
+               f"{c.get('dcn.degraded_rounds', 0)}, "
+               f"skips={c.get('dcn.skips', 0)})", failures)
+        _check(c.get("dcn.escalations", 0) == 0
+               and c.get("dcn.self_evicts", 0) == 0,
+               f"rank {r}: the ladder never escalated a SUB-budget "
+               f"transient ({c})", failures)
+    flapped = [v for r, v in finals.items()
+               if v["slice"] == flap_slice]
+    _check(all(v["counters"].get("dcn.residual_carries", 0) >= 1
+               for v in flapped),
+           "the flapped slice carried its unmerged partial as an "
+           "error-feedback residual on every rank", failures)
+    flap_fired = sum(v["counters"].get("faults.injected", 0)
+                     for v in flapped)
+    _check(flap_fired >= rps,
+           f"dcn_flap armed on every flapped-slice rank "
+           f"(faults.injected={flap_fired}, want >= {rps})", failures)
+
+    # the service contract: degraded rounds are priced in bounded retry
+    # budget, so throughput holds an absolute floor even while flapping
+    slo_floor = float(os.environ.get("DEAR_CHAOS_FLAP_SLO", "50"))
+    final_step = finals[0]["final_step"]
+    steps_per_hour = final_step * 3600.0 / max(elapsed_s, 1e-9)
+    CC.slo_gate(
+        os.path.join(workdir, "flap_contract.json"),
+        "steps_per_hour", round(steps_per_hour, 2),
+        [{"metric": "final_step", "value": final_step},
+         {"metric": "dcn_skips",
+          "value": sum(v["counters"].get("dcn.skips", 0)
+                       for v in finals.values())}],
+        [f"steps_per_hour={slo_floor}"], failures,
+        f"bench_gate --slo holds the steps/hour contract while "
+        f"flapping ({steps_per_hour:.0f}/h vs floor {slo_floor:.0f}/h)")
+
+    summary.update({
+        "passed": not failures,
+        "steps_per_hour": round(steps_per_hour, 2),
+        "failures": failures,
+    })
+    return summary
+
+
+def run_multislice_degraded(checkpoint_every: int,
+                            workdir: str | None) -> dict:
+    """Parent of the sustained-partition storm — rung 3 of the ladder
+    (ISSUE 18). A 2-slice x 2-rank fleet trains in bounded-staleness
+    mode while a ``dcn_partition`` sized far PAST the staleness budget
+    starves the victim slice. No SIGKILL anywhere: the victim's own
+    staleness clock must trip ``DcnSelfEvict``, the process exits 70,
+    and the existing slice-granular machinery takes over — survivors
+    escalate the silent peer (``dcn.escalations``), commit the shrink as
+    ONE slice-shaped epoch, and the supervisor's relaunch readmits the
+    slice (its new life strips the armed partition fault) as one epoch.
+    The gate asserts the full ladder walked: skip -> escalate ->
+    self-evict -> evict -> rejoin, with survivor rollbacks ONLY at the
+    two membership transitions."""
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_part_")
+    elastic_dir = os.path.join(workdir, "elastic")
+    remote_root = os.path.join(workdir, "remote")
+    os.makedirs(remote_root, exist_ok=True)
+    sup_mod = CC.load_supervisor()
+
+    nslices, rps = 2, 2
+    nprocs = nslices * rps
+    part_slice, target_epoch, post = 1, 2, 3
+    victims = list(range(part_slice * rps, (part_slice + 1) * rps))
+    env = dict(os.environ)
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_CHAOS_MULTI_MODE"] = "partition"
+    env["DEAR_CHAOS_MULTI_EPOCHS"] = str(target_epoch)
+    env["DEAR_CHAOS_MULTI_POST"] = str(post)
+    env["DEAR_CHAOS_REMOTE"] = remote_root
+    # a partition sized FAR past the staleness budget: outbound-dead
+    # from exchange 3 until the process dies (the relaunched life strips
+    # the fault, so the wall-clock arm never outlives the victim)
+    env["DEAR_FAULTS"] = f"dcn_partition@3:600:s{part_slice}"
+    env["DEAR_DCN_STALENESS"] = "1"
+    env["DEAR_DCN_RETRIES"] = "1"
+    env["DEAR_DCN_TIMEOUT_SECS"] = "2"
+    # dead-member detection is the CLUSTER timeout here (the degraded
+    # step never fails): keep it short so the shrink commits promptly
+    # after the victims exit
+    env["DEAR_CLUSTER_TIMEOUT_SECS"] = "10"
+    sup = sup_mod.ElasticSupervisor(
+        nprocs,
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--multislice", "--checkpoint-every", str(checkpoint_every),
+         "--workdir", workdir],
+        elastic_dir=elastic_dir, env=env,
+        max_relaunches=1, relaunch_window_s=300.0,
+        ranks_per_slice=rps,
+    ).start()
+
+    decided = CC.decided_reader(elastic_dir)
+    rc, elapsed_s = CC.run_fleet(sup, deadline_s=540.0)
+
+    failures: list[str] = []
+    _check(rc == 0, f"supervisor fleet exits clean (got rc={rc})",
+           failures)
+    _check(all(sup.relaunches.get(r, 0) == 1 for r in victims)
+           and all(sup.relaunches.get(r, 0) == 0 for r in range(rps)),
+           f"exactly the partitioned slice's ranks were relaunched "
+           f"({sup.relaunches})", failures)
+
+    # the victim slice must have evicted ITSELF — a durable self-evict
+    # marker per rank, written before the exit-70
+    evicts = []
+    for name in sorted(os.listdir(workdir)):
+        if name.startswith("selfevict_rank") and name.endswith(".json"):
+            with open(os.path.join(workdir, name)) as f:
+                evicts.append(json.load(f))
+    _check(sorted(e["rank"] for e in evicts) == victims
+           and all(e["slice"] == part_slice for e in evicts),
+           f"every rank of the partitioned slice exited through "
+           f"DcnSelfEvict, nobody else did ({evicts})", failures)
+
+    rec1, rec2, rec3 = decided(1), decided(2), decided(3)
+    _check(isinstance(rec1, dict)
+           and rec1.get("delta", {}).get("removed") == victims
+           and rec1.get("delta", {}).get("slices")
+           == {"added": [], "removed": [part_slice]},
+           f"e1 commits the self-evicted slice as one membership event "
+           f"(got {rec1})", failures)
+    _check(isinstance(rec2, dict)
+           and rec2.get("delta", {}).get("added") == victims
+           and rec2.get("delta", {}).get("slices")
+           == {"added": [part_slice], "removed": []}
+           and rec2.get("members") == list(range(nprocs)),
+           f"e2 readmits the relaunched slice as one epoch at full "
+           f"membership (got {rec2})", failures)
+    _check(rec3 is None,
+           f"no spurious membership epochs past e{target_epoch} "
+           f"(e3 = {rec3})", failures)
+
+    _lives, finals = CC.collect_verdicts(workdir)
+    summary = {"passed": False, "workdir": workdir, "rc": rc,
+               "elapsed_s": round(elapsed_s, 1), "finals": finals,
+               "failures": failures}
+    if sorted(finals) != list(range(nprocs)):
+        failures.append(f"expected final verdicts from ranks 0-"
+                        f"{nprocs - 1}, got {sorted(finals)}")
+        return summary
+
+    for r, v in sorted(finals.items()):
+        _check(v["epoch"] == target_epoch
+               and v["members"] == list(range(nprocs))
+               and v["slices"] == [0, 1],
+               f"rank {r} ends at epoch {target_epoch}, both slices "
+               f"live (epoch {v['epoch']}, slices {v['slices']})",
+               failures)
+        _check(v.get("lockstep"), f"rank {r} finished in lockstep",
+               failures)
+        _check(v["dcn_slices"] == [0, 1],
+               f"rank {r}'s DCN leg ends renormalized to both slices "
+               f"({v['dcn_slices']})", failures)
+    survivors = [v for r, v in finals.items() if r not in victims]
+    for v in survivors:
+        c = v["counters"]
+        _check(c.get("dcn.skips", 0) >= 1
+               and c.get("dcn.degraded_rounds", 0) >= 1,
+               f"rank {v['rank']} first SKIPPED the starved slice "
+               f"(skips={c.get('dcn.skips', 0)})", failures)
+        _check(c.get("dcn.escalations", 0) >= 1,
+               f"rank {v['rank']} escalated the past-budget peer "
+               f"(dcn.escalations={c.get('dcn.escalations', 0)})",
+               failures)
+        _check(c.get("cluster.slice_losses", 0) == 1
+               and c.get("cluster.slice_rejoins", 0) == 1,
+               f"rank {v['rank']} saw exactly one slice loss and one "
+               f"slice rejoin ({c})", failures)
+        _check(len(v["rollback_steps"]) <= 2,
+               f"rank {v['rank']}: rollbacks ONLY at the membership "
+               f"transitions, never for the transient itself "
+               f"({v['rollback_steps']})", failures)
+        shrink = [t for t in v["transitions"]
+                  if t["slices"] == [1 - part_slice]]
+        rejoin = [t for t in v["transitions"] if t["slices"] == [0, 1]]
+        _check(bool(shrink) and bool(rejoin)
+               and rejoin[0]["steps_seen"] > shrink[0]["steps_seen"],
+               f"rank {v['rank']} trained DEGRADED between shrink and "
+               f"rejoin ({v['transitions']})", failures)
+    rejoined = [v for r, v in finals.items() if r in victims]
+    _check(all(v["rejoined"] for v in rejoined),
+           "every relaunched rank of the partitioned slice came back "
+           "through rejoin", failures)
+    _check(all(v["counters"].get("faults.injected", 0) == 0
+               for v in rejoined),
+           "the relaunched lives stripped the armed partition fault",
+           failures)
+
+    summary.update({"passed": not failures, "failures": failures})
     return summary
 
 
@@ -2925,6 +3282,20 @@ def main(argv=None) -> int:
                          "under a slice-targeted slow-link fault, and "
                          "the relaunched slice readmits as one epoch — "
                          "zero loss of progress past the newest upload")
+    ap.add_argument("--multislice-flap", action="store_true",
+                    help="degraded-mode DCN flap storm: a 2-slice fleet "
+                         "in bounded-staleness mode absorbs a "
+                         "sub-budget dcn_flap transient plus a dcn_slow "
+                         "straggler with ZERO guard rollbacks, zero "
+                         "membership churn, error-feedback residual "
+                         "carry on the flapped slice, and a steps/hour "
+                         "SLO gate")
+    ap.add_argument("--multislice-degraded", action="store_true",
+                    help="sustained-partition storm: a past-budget "
+                         "dcn_partition walks the full ladder — skip, "
+                         "escalate, DcnSelfEvict (exit 70, no SIGKILL), "
+                         "slice-shaped shrink epoch, supervisor "
+                         "relaunch, slice-gated rejoin")
     ap.add_argument("--serve", action="store_true",
                     help="serving storm: a supervised replica fleet "
                          "absorbs an overload burst (shed+retry), a "
@@ -3001,6 +3372,22 @@ def main(argv=None) -> int:
         print("CHAOS CHECK " + ("PASSED" if summary["passed"]
                                 else "FAILED"))
         return 0 if summary["passed"] else 1
+    if args.multislice_flap:
+        summary = run_multislice_flap(
+            checkpoint_every=args.checkpoint_every, workdir=args.workdir)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "finals"}))
+        print("CHAOS CHECK " + ("PASSED" if summary["passed"]
+                                else "FAILED"))
+        return 0 if summary["passed"] else 1
+    if args.multislice_degraded:
+        summary = run_multislice_degraded(
+            checkpoint_every=args.checkpoint_every, workdir=args.workdir)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "finals"}))
+        print("CHAOS CHECK " + ("PASSED" if summary["passed"]
+                                else "FAILED"))
+        return 0 if summary["passed"] else 1
     if args.worker and args.autoscale:
         # one autoscale rank: the verdict file is the output
         run_worker_autoscale(
@@ -3054,7 +3441,9 @@ if __name__ == "__main__":
         # jax in this process (the workers own the devices)
         sys.exit(main())
     if "--elastic" in sys.argv or "--autoscale" in sys.argv \
-            or "--serve" in sys.argv or "--online" in sys.argv:
+            or "--serve" in sys.argv or "--online" in sys.argv \
+            or "--multislice-flap" in sys.argv \
+            or "--multislice-degraded" in sys.argv:
         # parent of the elastic/autoscale/serving/online storms: likewise
         # jax-free — it drives launch/supervisor.py (+ the ScalePolicy /
         # capacity file, + the serving router) and reads the ranks'

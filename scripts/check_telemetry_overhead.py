@@ -240,6 +240,36 @@ def main(argv=None) -> int:
             tr.count("online.canary_verdicts")
             tr.event("online.canary_verdict", version=2, verdict="FAIL")
 
+    # degraded-DCN ladder gates, the way comm/dcn.py's exchange runs
+    # them on the host leg of every hierarchical step: the per-round
+    # accounting (degraded_rounds + skips) and the per-chunk integrity
+    # reject (count + event) each fire under one enabled check — in
+    # strict healthy rounds neither branch is taken, so the disabled
+    # shape on the step path is the standard two lookups.
+    def dcn_round_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("dcn.degraded_rounds")
+            tr.count("dcn.skips", 1)
+
+    def dcn_round_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("dcn.degraded_rounds")
+            tr.count("dcn.skips", 1)
+
+    def dcn_reject_disabled_gate():
+        tr = T.get_tracer()
+        if tr.enabled:  # pragma: no cover - disabled branch
+            tr.count("dcn.chunk_rejects")
+            tr.event("dcn.chunk_reject", slice=1, bucket=0, chunk=0)
+
+    def dcn_reject_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("dcn.chunk_rejects")
+            tr.event("dcn.chunk_reject", slice=1, bucket=0, chunk=0)
+
     # plan-tuner decision-loop gate, the way tuning/autotune.py's step
     # path runs it once the search has FINISHED (or never started): the
     # per-step cost must be one attribute check + return — the tuner
@@ -283,6 +313,12 @@ def main(argv=None) -> int:
                            max(args.iters // 10, 1))
     cn_disabled_ns = _bench(canary_disabled_gate, args.iters)
     cn_enabled_ns = _bench(canary_enabled_site, max(args.iters // 10, 1))
+    dr_disabled_ns = _bench(dcn_round_disabled_gate, args.iters)
+    dr_enabled_ns = _bench(dcn_round_enabled_site,
+                           max(args.iters // 10, 1))
+    dj_disabled_ns = _bench(dcn_reject_disabled_gate, args.iters)
+    dj_enabled_ns = _bench(dcn_reject_enabled_site,
+                           max(args.iters // 10, 1))
     tuner_finished_ns = _bench(plan_tuner_finished_gate, args.iters)
     overhead_ns = max(disabled_ns - baseline_ns, 0.0)
 
@@ -320,6 +356,10 @@ def main(argv=None) -> int:
         "online_quality_enabled_ns_per_call": round(oq_enabled_ns, 1),
         "canary_disabled_ns_per_call": round(cn_disabled_ns, 1),
         "canary_enabled_ns_per_call": round(cn_enabled_ns, 1),
+        "dcn_round_disabled_ns_per_call": round(dr_disabled_ns, 1),
+        "dcn_round_enabled_ns_per_call": round(dr_enabled_ns, 1),
+        "dcn_reject_disabled_ns_per_call": round(dj_disabled_ns, 1),
+        "dcn_reject_enabled_ns_per_call": round(dj_enabled_ns, 1),
         "tuner_finished_ns_per_call": round(tuner_finished_ns, 1),
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
@@ -335,6 +375,8 @@ def main(argv=None) -> int:
                and oc_disabled_ns <= args.budget_ns
                and oq_disabled_ns <= args.budget_ns
                and cn_disabled_ns <= args.budget_ns
+               and dr_disabled_ns <= args.budget_ns
+               and dj_disabled_ns <= args.budget_ns
                and tuner_finished_ns <= args.budget_ns),
     }
     print(json.dumps(out))

@@ -24,6 +24,14 @@ CI when a simulated delta points the wrong way:
                                 p99 3.28ms vs 5.0ms) -> simulated
                                 chunked prefill must beat token-at-a-
                                 time on BOTH rps and p99.
+  perf/dcn_degraded_r18         degraded-DCN skip-vs-stall: the live
+                                flap storm absorbed a sub-budget flap
+                                with zero rollbacks and the partition
+                                storm escalated to eviction+rejoin ->
+                                the simulated staleness sweep must
+                                rank skip over stall on both traces
+                                with the same ladder shape (rollbacks,
+                                skips, escalations, rejoins).
   (storm)                       a 1000-rank / 8-slice slice-loss storm
                                 must resolve to lockstep with exactly
                                 one shrink epoch + one admission epoch
@@ -225,6 +233,90 @@ def check_serving(sim, checks, skips):
     return None
 
 
+def check_degraded_dcn(sim, checks):
+    """perf/dcn_degraded_r18: the live flap storm absorbed a 2-round
+    sub-budget flap with ZERO rollbacks (skip, rung 2) and the live
+    partition storm escalated a past-budget outage to eviction+rejoin
+    (rung 3) — so the simulator's staleness-policy sweep must rank
+    skip over stall on the same traces, with the same ladder shape."""
+    rec = _load_json(os.path.join(REPO, "perf", "dcn_degraded_r18",
+                                  "summary.json"))
+    if rec is None:
+        return "missing perf/dcn_degraded_r18/summary.json"
+    try:
+        flap, part = rec["flap"], rec["partition"]
+        rec_ok = (flap["per_rank"]["guard_rollbacks"] == 0
+                  and flap["per_rank"]["dcn_skips"] >= 1
+                  and flap["per_rank"]["dcn_escalations"] == 0
+                  and part["survivor_per_rank"]["dcn_escalations"] >= 1
+                  and part["survivor_per_rank"]["cluster_slice_rejoins"]
+                  >= 1
+                  and bool(part["victim"]["rejoined"]))
+        rec_staleness = int(flap["env"]["DEAR_DCN_STALENESS"])
+        rec_timeout = float(flap["env"]["DEAR_DCN_TIMEOUT_SECS"])
+    except (KeyError, TypeError, ValueError):
+        return "perf/dcn_degraded_r18/summary.json malformed"
+
+    topo = sim.SimTopology(
+        num_slices=2, chips_per_slice=2,
+        dcn=sim.LinkFit(alpha=2e-3, beta=1.0 / 2e9, source="default"))
+    # the recorded flap: dcn_flap@4:2:s1 — slice 1 dark for exchange
+    # attempts 4 and 5 of a 12-step run
+    ranked = sim.sweep_staleness_policies(
+        topo, policies=(0, rec_staleness), steps=12,
+        timeout_s=rec_timeout, outages={1: [4, 5]}, ckpt_every=4)
+    skip_run = next(r for r in ranked
+                    if r["staleness"] == rec_staleness)
+    stall_run = next(r for r in ranked if r["staleness"] == 0)
+    flap_ok = (ranked[0]["staleness"] == rec_staleness
+               and skip_run["rollbacks"] == 0
+               and skip_run["skips"] >= 1
+               and skip_run["escalations"] == 0
+               and stall_run["rollbacks"] >= 1
+               and skip_run["steps_per_hour"]
+               > stall_run["steps_per_hour"])
+    # the recorded partition, scaled to sim rounds: a past-budget
+    # outage (6 rounds vs staleness 1) that ends before the run does,
+    # so the evicted slice rejoins — the live storm's evict+rejoin arc
+    part_kw = dict(steps=12, timeout_s=2.0,
+                   outages={1: list(range(3, 9))}, ckpt_every=2)
+    p_skip = sim.simulate_degraded_dcn(topo, staleness=1, **part_kw)
+    p_stall = sim.simulate_degraded_dcn(topo, staleness=0, **part_kw)
+    part_ok = (p_skip["escalations"] >= 1 and p_skip["rejoins"] >= 1
+               and p_skip["rollbacks"] == 0
+               and p_stall["rollbacks"] >= 1
+               and p_skip["steps_per_hour"]
+               > p_stall["steps_per_hour"])
+    checks.append({
+        "name": "degraded_dcn_skip_vs_stall_r18",
+        "recorded": {
+            "flap_rollbacks": flap["per_rank"]["guard_rollbacks"],
+            "flap_skips": flap["per_rank"]["dcn_skips"],
+            "partition_escalations":
+                part["survivor_per_rank"]["dcn_escalations"],
+            "partition_rejoined": part["victim"]["rejoined"],
+        },
+        "simulated": {
+            "flap": {"skip": {"steps_per_hour":
+                              skip_run["steps_per_hour"],
+                              "rollbacks": skip_run["rollbacks"],
+                              "skips": skip_run["skips"]},
+                     "stall": {"steps_per_hour":
+                               stall_run["steps_per_hour"],
+                               "rollbacks": stall_run["rollbacks"]}},
+            "partition": {"skip": {"steps_per_hour":
+                                   p_skip["steps_per_hour"],
+                                   "escalations": p_skip["escalations"],
+                                   "rejoins": p_skip["rejoins"]},
+                          "stall": {"steps_per_hour":
+                                    p_stall["steps_per_hour"],
+                                    "rollbacks": p_stall["rollbacks"]}},
+        },
+        "ok": bool(rec_ok and flap_ok and part_ok),
+    })
+    return None
+
+
 def check_storm(sim, checks, budget_s):
     t0 = time.perf_counter()
     out = sim.run_membership_storm(world=1000, ranks_per_slice=125,
@@ -268,7 +360,8 @@ def main(argv=None) -> int:
     for fn in (lambda: check_mode_ordering(sim, checks, skips),
                lambda: check_overlap_structure(sim, checks),
                lambda: check_gather_dtype(sim, checks),
-               lambda: check_serving(sim, checks, skips)):
+               lambda: check_serving(sim, checks, skips),
+               lambda: check_degraded_dcn(sim, checks)):
         try:
             infra = fn()
         except Exception as exc:  # noqa: BLE001

@@ -23,7 +23,7 @@ import numpy as np
 import pytest
 
 from dear_pytorch_tpu.comm.dcn import (
-    DcnExchanger, DcnPeerTimeout,
+    DcnChunkReject, DcnExchanger, DcnPeerTimeout, DcnSelfEvict, _encode,
 )
 from dear_pytorch_tpu.ops import fusion as F
 from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
@@ -624,3 +624,391 @@ def test_chaos_check_multislice_storm(tmp_path):
     )
     assert proc.returncode == 0, proc.stdout[-3000:]
     assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]
+
+
+@pytest.mark.timeout(360, method="signal")
+def test_chaos_check_multislice_flap_storm(tmp_path):
+    """scripts/chaos_check.py --multislice-flap: the ISSUE-18 acceptance
+    gate. A 2-slice x 2-rank fleet trains in bounded-staleness mode
+    (DEAR_DCN_STALENESS=2) under a sub-budget dcn_flap transient plus a
+    dcn_slow straggler; the gate asserts ZERO guard rollbacks on every
+    rank (the transient is absorbed by retry + skip-with-error-feedback,
+    never by the recovery machinery), zero membership churn, residual
+    carry on the flapped slice, lockstep at the exact step target, and a
+    bench_gate --slo steps/hour floor."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "chaos_check.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, "--multislice-flap",
+         "--checkpoint-every", "4", "--workdir", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=330,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(640, method="signal")
+def test_chaos_check_multislice_degraded_storm(tmp_path):
+    """scripts/chaos_check.py --multislice-degraded: the full ladder —
+    a past-budget dcn_partition starves one slice until its own
+    staleness clock trips DcnSelfEvict (exit 70, no SIGKILL anywhere);
+    survivors escalate, the shrink commits as one slice-shaped epoch,
+    the supervisor relaunch readmits the slice (its new life strips the
+    armed fault), and survivor rollbacks happen ONLY at the membership
+    transitions. Covered in tier-1 at unit granularity by
+    test_dcn_sustained_partition_walks_the_ladder; this end-to-end storm
+    is the slow-tier variant."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "chaos_check.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, script, "--multislice-degraded",
+         "--checkpoint-every", "2", "--workdir", str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "CHAOS CHECK PASSED" in proc.stdout, proc.stdout[-3000:]
+
+
+# -- degraded-mode DCN: wire integrity + the escalation ladder ----------------
+
+
+def _mem_tracer():
+    """Install a fresh counting tracer; returns (tracer, restore_fn)."""
+    from dear_pytorch_tpu.observability import tracer as T
+
+    prev = T._tracer
+    tracer = T.Tracer([T.MemoryExporter()])
+    T.set_tracer(tracer)
+    return tracer, lambda: T.set_tracer(prev)
+
+
+def test_dcn_chunk_integrity_rejects(tmp_path):
+    """Wire integrity (strict mode): a torn KV payload and a replayed
+    stale-step value at a chunk key are REJECTED (counted, never merged);
+    with no clean replacement the fetch fails as DcnChunkReject inside
+    the deadline; once the honest publisher's value lands, the exchange
+    completes with the exact mean."""
+    tracer, restore = _mem_tracer()
+    try:
+        tr = CL.FileTransport(str(tmp_path))
+        ex0 = DcnExchanger(tr, local_slices=(0,), slices=(0, 1),
+                           partition_mb=None, timeout_s=0.4)
+        b0 = [np.arange(6, dtype=np.float32)]
+        b1 = [np.ones(6, np.float32)]
+        # a REPLAYED stale key: a validly framed chunk from step 0
+        # planted at step 3's key (epoch/step header mismatch)
+        tr.set(ex0._key(3, 0, 0, 1), _encode(
+            b1[0], meta={"epoch": 0, "step": 0, "bucket": 0, "chunk": 0,
+                         "seq": 1}))
+        with pytest.raises(DcnChunkReject):
+            ex0.exchange(3, {0: b0})
+        # a TORN write: header promises more bytes than the payload has
+        good = _encode(b1[0], meta={"epoch": 0, "step": 4, "bucket": 0,
+                                    "chunk": 0, "seq": 2})
+        head, _, body = good.partition("\n")
+        tr.set(ex0._key(4, 0, 0, 1), head + "\n" + body[:8])
+        with pytest.raises(DcnChunkReject):
+            ex0.exchange(4, {0: b0})
+        assert tracer.counters()["dcn.chunk_rejects"] >= 2
+        # the honest value supersedes: exact mean, no residue of the bad
+        # bytes (the reject path never accumulates)
+        tr.set(ex0._key(5, 0, 0, 1), _encode(
+            b1[0], meta={"epoch": 0, "step": 5, "bucket": 0, "chunk": 0,
+                         "seq": 3}))
+        means, _ = ex0.exchange(5, {0: b0})
+        np.testing.assert_array_equal(means[0], (b0[0] + b1[0]) / 2.0)
+    finally:
+        restore()
+
+
+def _run_n(fns, join_s=60):
+    out, err = [None] * len(fns), [None] * len(fns)
+
+    def w(i, f):
+        try:
+            out[i] = f()
+        except BaseException as exc:  # noqa: BLE001 - asserted below
+            err[i] = exc
+    ts = [threading.Thread(target=w, args=(i, f))
+          for i, f in enumerate(fns)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join_s)
+    return out, err
+
+
+def test_dcn_degraded_skip_is_replica_identical():
+    """The skip rung: a slice whose publish is dropped is excluded on
+    EVERY exchanger — its own included (the two-phase participation
+    record), all three means bitwise identical per round — and its
+    deferred mass returns through the error-feedback residual on the
+    next round (nothing lost, nothing double-counted)."""
+    tracer, restore = _mem_tracer()
+    try:
+        tr = CL.LocalTransport()
+        inj2 = FaultInjector(parse_faults("dcn_drop@1:s2"),
+                             own_rank=0, own_slice=2)
+        exs = [
+            DcnExchanger(tr, local_slices=(i,), slices=(0, 1, 2),
+                         partition_mb=0.00002, timeout_s=1.5, retries=1,
+                         staleness=2, injector=inj2 if i == 2 else None)
+            for i in range(3)
+        ]
+        rng = np.random.default_rng(7)
+        g1 = [rng.normal(size=9).astype(np.float32) for _ in range(3)]
+        g2 = [rng.normal(size=9).astype(np.float32) for _ in range(3)]
+        out, err = _run_n([
+            (lambda i=i: exs[i].exchange(0, {i: [g1[i]]}))
+            for i in range(3)
+        ])
+        assert not any(err), err
+        # round 1: slice 2's publish dropped -> everyone averages {0,1}
+        for i in (1, 2):
+            np.testing.assert_array_equal(out[0][0][0], out[i][0][0])
+        np.testing.assert_allclose(out[0][0][0], (g1[0] + g1[1]) / 2.0,
+                                   rtol=1e-6)
+        out2, err = _run_n([
+            (lambda i=i: exs[i].exchange(1, {i: [g2[i]]}))
+            for i in range(3)
+        ])
+        assert not any(err), err
+        # round 2: slice 2 republishes grad+residual -> full membership
+        # mean carries the deferred mass exactly (mass preservation:
+        # 2*m1 + 3*m2 == every gradient published across both rounds)
+        for i in (1, 2):
+            np.testing.assert_array_equal(out2[0][0][0], out2[i][0][0])
+        total = 2.0 * out[0][0][0] + 3.0 * out2[0][0][0]
+        np.testing.assert_allclose(
+            total, sum(g1) + sum(g2), rtol=1e-5)
+        c = tracer.counters()
+        assert c["dcn.skips"] >= 3         # slice 2 skipped on 3 views
+        assert c["dcn.degraded_rounds"] >= 3
+        assert c["dcn.residual_carries"] >= 1
+        assert "dcn.escalations" not in c  # sub-budget: no ladder rung 3
+        assert "guard.rollbacks" not in c
+    finally:
+        restore()
+
+
+def test_dcn_residual_state_roundtrip_and_repack():
+    """EF residual durability: state_dict -> JSON -> load_state_dict is
+    bit-exact (the checkpoint sidecar contract), and a fusion-plan
+    change re-packs the carried mass with the sum exactly invariant
+    (the `_repack_comp_state` algebra at DCN level)."""
+    tr = CL.LocalTransport()
+    ex = DcnExchanger(tr, local_slices=(0,), slices=(0, 1), staleness=2)
+    rng = np.random.default_rng(3)
+    params = {"a": rng.normal(size=(5, 4)).astype(np.float32),
+              "b": rng.normal(size=(7,)).astype(np.float32),
+              "c": rng.normal(size=(3, 3)).astype(np.float32)}
+    old_plan = F.plan_by_threshold(params, 1, threshold_mb=1e-4)
+    new_plan = F.plan_by_threshold(params, 1, threshold_mb=1.0)
+    assert old_plan.num_buckets != new_plan.num_buckets
+    leaves = jax.tree_util.tree_leaves(params)
+    ex._residual = {0: [np.asarray(F.pack_bucket(leaves, old_plan, b),
+                                   np.float32)
+                        for b in range(old_plan.num_buckets)]}
+    ex._staleness = {0: 1, 1: 0}
+    # sidecar round-trip through actual JSON text, bit-exact
+    blob = json.loads(json.dumps(ex.state_dict()))
+    ex2 = DcnExchanger(tr, local_slices=(0,), slices=(0, 1), staleness=2)
+    ex2.load_state_dict(blob)
+    for a, b in zip(ex._residual[0], ex2._residual[0]):
+        np.testing.assert_array_equal(a, b)
+    assert ex2._staleness[0] == 1
+    # plan change: unpack-old/pack-new preserves every leaf's mass
+    before = float(sum(np.sum(r, dtype=np.float64)
+                       for r in ex._residual[0]))
+    ex.repack_residual(old_plan, new_plan)
+    assert len(ex._residual[0]) == new_plan.num_buckets
+    after = float(sum(np.sum(r, dtype=np.float64)
+                      for r in ex._residual[0]))
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+    rec = {}
+    for b in range(new_plan.num_buckets):
+        rec.update(F.unpack_bucket(ex._residual[0][b], new_plan, b))
+    for lid, leaf in enumerate(leaves):
+        np.testing.assert_allclose(np.asarray(rec[lid]), leaf, rtol=1e-6)
+    # alien payload resets to fresh zeros instead of guessing
+    ex2.load_state_dict({"residual": {"0": [{"bogus": True}]}})
+    assert ex2._residual == {}
+
+
+def test_dcn_flap_partition_grammar_and_schedule():
+    """dcn_flap@N:K drops exchanges N, N+2, ... for K cycles (recovering
+    in between); dcn_partition@N:SECS suppresses outbound for SECS of
+    wall time; both slice-targetable, both drained as `skipped` off
+    target."""
+    fs = parse_faults("dcn_flap@3:2:s1,dcn_partition@5:0.25:s0")
+    assert fs[0].kind == "dcn_flap" and fs[0].slice_id == 1
+    assert fs[1].kind == "dcn_partition" and fs[1].arg == 0.25
+    inj = FaultInjector([Fault(kind="dcn_flap", step=3, arg=2)],
+                        own_rank=0, own_slice=0)
+    sched = [inj.dcn_outage_due(n) for n in range(1, 9)]
+    assert sched == [False, False, True, False, True, False, False,
+                     False]
+    inj2 = FaultInjector([Fault(kind="dcn_partition", step=2, arg=0.2)],
+                         own_rank=0, own_slice=0)
+    assert not inj2.dcn_outage_due(1)
+    t0 = time.monotonic()
+    assert inj2.dcn_outage_due(2)          # arms the wall-clock window
+    assert inj2.dcn_outage_due(3)          # still inside it
+    time.sleep(max(0.0, 0.25 - (time.monotonic() - t0)))
+    assert not inj2.dcn_outage_due(4)      # window elapsed: recovered
+    # off-target: consumed into skipped, never fired
+    inj3 = FaultInjector(parse_faults("dcn_flap@1:2:s1"),
+                         own_rank=0, own_slice=0)
+    assert not inj3.dcn_outage_due(1)
+    assert inj3.skipped and not inj3.fired
+
+
+def test_dcn_sustained_partition_walks_the_ladder():
+    """Past-budget escalation, both verdicts from the SAME records: the
+    survivor escalates the dark slice (stops waiting for it) while the
+    partitioned slice — which still sees the survivor's records naming
+    a world without it — self-evicts for relaunch + rejoin."""
+    tracer, restore = _mem_tracer()
+    try:
+        tr = CL.LocalTransport()
+        inj1 = FaultInjector(parse_faults("dcn_partition@1:30:s1"),
+                             own_rank=0, own_slice=1)
+        ex0 = DcnExchanger(tr, local_slices=(0,), slices=(0, 1),
+                           partition_mb=None, timeout_s=0.8, retries=1,
+                           staleness=1)
+        ex1 = DcnExchanger(tr, local_slices=(1,), slices=(0, 1),
+                           partition_mb=None, timeout_s=0.8, retries=1,
+                           staleness=1, injector=inj1)
+        b = [np.ones(4, np.float32)]
+        evicted = None
+        for step in range(4):
+            out, err = _run_n([
+                lambda s=step: ex0.exchange(s, {0: b}),
+                lambda s=step: ex1.exchange(s, {1: b}),
+            ])
+            assert err[0] is None, err[0]
+            if err[1] is not None:
+                evicted = err[1]
+                break
+        assert isinstance(evicted, DcnSelfEvict), evicted
+        c = tracer.counters()
+        assert c["dcn.escalations"] >= 1    # survivor stopped waiting
+        assert c["dcn.self_evicts"] >= 1    # victim exited for relaunch
+        assert c["dcn.skips"] >= 2
+        # the survivor keeps exchanging alone without stalling: the
+        # escalated slice costs it nothing further
+        t0 = time.monotonic()
+        means, _ = ex0.exchange(9, {0: b})
+        assert time.monotonic() - t0 < ex0.timeout_s
+        np.testing.assert_array_equal(means[0], b[0])
+    finally:
+        restore()
+
+
+def test_dcn_prefetch_overlaps_next_round():
+    """staleness=1 as the cross-iteration prefetch primitive: chunks a
+    peer already published for THIS step are staged by `prefetch` while
+    'the backward pass runs' and consumed without a second fetch
+    (dcn.prefetch_hits), with the mean exact."""
+    tracer, restore = _mem_tracer()
+    try:
+        tr = CL.LocalTransport()
+        ex0 = DcnExchanger(tr, local_slices=(0,), slices=(0, 1),
+                           partition_mb=0.00002, timeout_s=2.0,
+                           staleness=1)
+        ex1 = DcnExchanger(tr, local_slices=(1,), slices=(0, 1),
+                           partition_mb=0.00002, timeout_s=2.0,
+                           staleness=1)
+        b0 = [np.arange(9, dtype=np.float32)]
+        b1 = [np.ones(9, np.float32) * 2.0]
+        out, err = _run_n([
+            lambda: ex0.exchange(0, {0: b0}),
+            lambda: ex1.exchange(0, {1: b1}),
+        ])
+        assert not any(err), err
+        # ex1 publishes step 1 first (a peer one round ahead) ...
+        t1 = threading.Thread(
+            target=lambda: ex1.exchange(1, {1: b1}))
+        t1.start()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            try:
+                tr.get(ex0._key(1, 0, 0, 1), 0.05)
+                break
+            except CL.PeerTimeout:
+                pass
+        # ... so ex0's prefetch stages them before its own exchange
+        ex0.prefetch(1)
+        ex0._join_prefetch()
+        means, _ = ex0.exchange(1, {0: b0})
+        t1.join(10)
+        np.testing.assert_allclose(means[0], (b0[0] + b1[0]) / 2.0,
+                                   rtol=1e-6)
+        assert tracer.counters().get("dcn.prefetch_hits", 0) >= 1
+    finally:
+        restore()
+
+
+def test_dcn_bounded_stale_loss_parity_band():
+    """Numerics: EF-SGD through the skip rung tracks synchronous SGD.
+    Two slices minimize a shared quadratic; the victim's link flaps
+    (two drop/recover cycles) under staleness=2. Both replicas stay
+    bitwise in lockstep, converge, and land inside a pinned parity band
+    of the fault-free synchronous trajectory."""
+    tr = CL.LocalTransport()
+    inj1 = FaultInjector(parse_faults("dcn_flap@3:2:s1"),
+                         own_rank=0, own_slice=1)
+    ex0 = DcnExchanger(tr, local_slices=(0,), slices=(0, 1),
+                       partition_mb=None, timeout_s=1.0, retries=1,
+                       staleness=2)
+    ex1 = DcnExchanger(tr, local_slices=(1,), slices=(0, 1),
+                       partition_mb=None, timeout_s=1.0, retries=1,
+                       staleness=2, injector=inj1)
+    rng = np.random.default_rng(11)
+    c0 = rng.normal(size=8).astype(np.float32)
+    c1 = -c0 + rng.normal(size=8).astype(np.float32) * 0.3
+    w0 = rng.normal(size=8).astype(np.float32) * 3.0
+    lr, steps = 0.2, 12
+
+    def sync_run():
+        w = w0.copy()
+        for _ in range(steps):
+            w = w - lr * ((w - c0) + (w - c1)) / 2.0
+        return w
+
+    def stale_run():
+        w = [w0.copy(), w0.copy()]
+        for s in range(steps):
+            out, err = _run_n([
+                lambda s=s: ex0.exchange(s, {0: [w[0] - c0]}),
+                lambda s=s: ex1.exchange(s, {1: [w[1] - c1]}),
+            ])
+            assert not any(err), err
+            # replica-identical means -> replica-identical parameters
+            np.testing.assert_array_equal(out[0][0][0], out[1][0][0])
+            w = [wi - lr * out[i][0][0] for i, wi in enumerate(w)]
+            np.testing.assert_array_equal(w[0], w[1])
+        return w[0]
+
+    w_sync, w_stale = sync_run(), stale_run()
+    opt = (c0 + c1) / 2.0
+    d0 = float(np.linalg.norm(w0 - opt))
+    # the parity band: bounded staleness costs a bounded trajectory gap
+    gap = float(np.linalg.norm(w_stale - w_sync))
+    assert gap < 0.25 * d0, (gap, d0)
+    # and it still CONVERGES (the flap cost progress, not correctness)
+    assert float(np.linalg.norm(w_stale - opt)) < 0.35 * d0
+    assert inj1.fired and inj1.fired[0].kind == "dcn_flap"

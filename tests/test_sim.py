@@ -185,6 +185,74 @@ def test_multislice_partition_tradeoff_visible():
 
 
 # ---------------------------------------------------------------------------
+# degraded-mode DCN: skip-vs-stall policy replay
+# ---------------------------------------------------------------------------
+
+DCN_TOPO = sim.SimTopology(num_slices=2, chips_per_slice=2,
+                           dcn=LinkFit(alpha=2e-3, beta=1.0 / 2e9))
+
+
+def test_price_degraded_round_bounds():
+    from dear_pytorch_tpu.observability.costmodel import (
+        price_degraded_round,
+    )
+    fit = LinkFit(alpha=1e-3, beta=1.0 / 1e9)
+    healthy = price_degraded_round(fit, 8 * 2**20, timeout_s=3.0)
+    assert healthy == pytest.approx(1e-3 + 8 * 2**20 / 1e9)
+    # chunking at partition_mb pays one α per chunk
+    chunked = price_degraded_round(fit, 8 * 2**20, timeout_s=3.0,
+                                   partition_mb=1.0)
+    assert chunked == pytest.approx(8e-3 + 8 * 2**20 / 1e9)
+    # an outage charges exactly the retry budget — the bounded cost of
+    # deciding to skip, regardless of payload
+    assert price_degraded_round(fit, 8 * 2**20, timeout_s=3.0,
+                                outage=True) == 3.0
+
+
+def test_degraded_dcn_sim_deterministic():
+    kw = dict(staleness=2, steps=12, timeout_s=3.0, outages={1: [4, 5]})
+    a = sim.simulate_degraded_dcn(DCN_TOPO, **kw)
+    b = sim.simulate_degraded_dcn(DCN_TOPO, **kw)
+    assert a == b
+
+
+def test_degraded_dcn_flap_skip_beats_stall():
+    """The recorded flap-storm fact (perf/dcn_degraded_r18): a
+    sub-budget flap costs zero rollbacks under the ladder, while
+    strict mode pays a rollback per flapped exchange — and the sweep
+    ranks the skip policy first."""
+    kw = dict(steps=12, timeout_s=3.0, outages={1: [4, 5]},
+              ckpt_every=4)
+    ranked = sim.sweep_staleness_policies(DCN_TOPO, policies=(0, 2),
+                                          **kw)
+    skip = next(r for r in ranked if r["staleness"] == 2)
+    stall = next(r for r in ranked if r["staleness"] == 0)
+    assert ranked[0]["staleness"] == 2
+    assert skip["finished"] and stall["finished"]
+    assert skip["rollbacks"] == 0 and skip["skips"] == 2
+    assert skip["escalations"] == 0
+    assert stall["rollbacks"] >= 1
+    assert skip["steps_per_hour"] > stall["steps_per_hour"]
+
+
+def test_degraded_dcn_partition_walks_the_ladder():
+    """A past-budget outage escalates to eviction (rung 3), trains on
+    without the slice, and readmits it when the outage ends — no
+    rollbacks anywhere on the degraded path."""
+    kw = dict(steps=12, timeout_s=2.0, outages={1: list(range(3, 9))},
+              ckpt_every=2)
+    deg = sim.simulate_degraded_dcn(DCN_TOPO, staleness=1, **kw)
+    strict = sim.simulate_degraded_dcn(DCN_TOPO, staleness=0, **kw)
+    assert deg["finished"]
+    assert deg["rollbacks"] == 0
+    assert deg["escalations"] == 1 and deg["rejoins"] == 1
+    # skips stop accruing once the slice is evicted
+    assert deg["skips"] == 2
+    assert strict["rollbacks"] >= 6
+    assert deg["steps_per_hour"] > strict["steps_per_hour"]
+
+
+# ---------------------------------------------------------------------------
 # topology / calibration round-trips
 # ---------------------------------------------------------------------------
 
