@@ -40,8 +40,8 @@ from dear_pytorch_tpu.analysis.rules_registry import (
 )
 from dear_pytorch_tpu.analysis.rules_sim import SimDeterminismRule
 from dear_pytorch_tpu.analysis.rules_trace import (
-    DcnBlockingRule, DonationAliasRule, HotPathSyncRule,
-    UngatedTelemetryRule,
+    DcnBlockingRule, DonationAliasRule, HotPathSyncRule, TraceSchemaRule,
+    UngatedSpanStreamRule, UngatedTelemetryRule,
 )
 
 __all__ = ["ALL_RULES", "make_rules", "main", "changed_files",
@@ -55,7 +55,8 @@ ALL_RULES = (
     LockHeldIORule, AtomicWriteRule, HotPathSyncRule,
     UngatedTelemetryRule, SignalHandlerImportRule, DonationAliasRule,
     EnvRegistryRule, CounterDocsRule, BareExceptHotPathRule,
-    DcnBlockingRule, SimDeterminismRule,
+    DcnBlockingRule, SimDeterminismRule, UngatedSpanStreamRule,
+    TraceSchemaRule,
 )
 
 

@@ -19,7 +19,8 @@ from dear_pytorch_tpu.analysis.core import (
 )
 from dear_pytorch_tpu.analysis.rules_host import _walk_no_nested_functions
 
-__all__ = ["HotPathSyncRule", "UngatedTelemetryRule", "DonationAliasRule",
+__all__ = ["HotPathSyncRule", "UngatedTelemetryRule",
+           "UngatedSpanStreamRule", "TraceSchemaRule", "DonationAliasRule",
            "DcnBlockingRule"]
 
 
@@ -241,6 +242,167 @@ class UngatedTelemetryRule(Rule):
                              "an `.enabled` gate — the disabled-"
                              "telemetry contract is two lookups per "
                              "site; wrap in `if tr.enabled:`"))
+
+
+# -- ungated-trace-stream ----------------------------------------------------
+
+_STREAM_NAMES = {"ds", "stream", "_ds", "_stream"}
+_STREAM_ATTR_TAILS = (".stream", "._stream", "._ds")
+_STREAM_METHODS = ("emit", "clock_sample", "span")
+
+
+class UngatedSpanStreamRule(UngatedTelemetryRule):
+    """`ds.emit`/`ds.clock_sample` call sites outside the enabled gate.
+
+    The fleet-trace span stream (`observability.dtrace`) extends the
+    disabled-telemetry contract to tracing: a trace-instrumented
+    hot-path site (engine tick, DCN round, guard step) must cost one
+    `get_stream()` lookup plus one `.enabled` read when ``DEAR_TRACE``
+    is unset — the same 1 µs budget
+    `scripts/check_telemetry_overhead.py` enforces dynamically for the
+    tracer gate. An ungated ``ds.emit(...)`` still works (NullStream
+    no-ops) but evaluates every span attribute, a trace-context
+    construction and a clock read per step. Gate semantics are shared
+    with `ungated-telemetry`: the call must sit on the positive branch
+    of an ``if ds.enabled:`` or after an early
+    ``if not ds.enabled: return``.
+    """
+
+    name = "ungated-trace-stream"
+    doc = ("span-stream emit/clock_sample call site not under an "
+           "`.enabled` gate")
+
+    @staticmethod
+    def _is_stream_receiver(func: ast.Attribute) -> bool:
+        v = func.value
+        if isinstance(v, ast.Name):
+            return v.id in _STREAM_NAMES
+        chain = attr_chain(v)
+        if chain and chain.endswith(_STREAM_ATTR_TAILS):
+            return True
+        if isinstance(v, ast.Call):
+            leaf = attr_chain(v.func).rsplit(".", 1)[-1]
+            return leaf == "get_stream"
+        return False
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        for mod in scanner.modules:
+            if not _runtime_module(mod):
+                continue
+            if mod.relpath.endswith("observability/dtrace.py"):
+                continue  # the stream's own machinery defines the calls
+            for node in mod.walk():
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _STREAM_METHODS
+                        and self._is_stream_receiver(node.func)):
+                    continue
+                if self._gated(mod, node):
+                    continue
+                key = self._counter_key(node)
+                yield Finding(
+                    rule=self.name, path=mod.relpath, line=node.lineno,
+                    qualname=mod.qualname(node),
+                    key=f"{node.func.attr}:{key}",
+                    message=(f"`{node.func.attr}(\"{key}\")` outside an "
+                             "`.enabled` gate — a disabled trace stream "
+                             "is two lookups per site (the 1 µs "
+                             "contract); wrap in `if ds.enabled:`"))
+
+
+# -- trace-schema ------------------------------------------------------------
+
+
+class TraceSchemaRule(Rule):
+    """Serving wire records that do not carry the request's trace
+    context.
+
+    Originating contract: a request's trace must survive every hop —
+    router dispatch file -> replica inbox -> engine -> signed response
+    -> router — including a redispatch across a replica death. One wire
+    record that drops the ``trace`` field orphans the merged timeline
+    at that hop, and the break only shows up when someone debugs a
+    production tail with `scripts/fleet_trace.py`. The rule covers both
+    directions: request records (``id`` + ``prompt``) and response
+    records (``id`` + ``tokens``).
+
+    Carrying the trace either in the dict literal or via a later
+    ``rec["trace"] = ...`` in the same function satisfies the rule.
+    Projections that re-serialize an existing record key-by-key from
+    one source (the sha256 canonicalization in `response_sha256`) are
+    exempt — the trace deliberately rides OUTSIDE the signed canonical
+    fields so trace-less verifiers keep verifying.
+    """
+
+    name = "trace-schema"
+    doc = "serving wire-record dict without a trace-context field"
+
+    _PAYLOAD_KEYS = {"prompt", "tokens"}
+
+    @staticmethod
+    def _const_keys(d: ast.Dict) -> Set[str]:
+        return {k.value for k in d.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str)}
+
+    @staticmethod
+    def _is_projection(d: ast.Dict) -> bool:
+        # {"id": payload["id"], ...}: every value reads the same source
+        # record — a canonicalization of a record that already carried
+        # (or already failed this rule for) the trace field
+        bases = set()
+        for v in d.values:
+            if not (isinstance(v, ast.Subscript)
+                    and isinstance(v.value, ast.Name)):
+                return False
+            bases.add(v.value.id)
+        return len(bases) == 1
+
+    @staticmethod
+    def _enclosing_function(node):
+        while node is not None:
+            node = getattr(node, "_dearlint_parent", None)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node
+        return None
+
+    @staticmethod
+    def _assigns_trace(fn) -> bool:
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Assign):
+                continue
+            for t in n.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and t.slice.value == "trace"):
+                    return True
+        return False
+
+    def check(self, scanner: Scanner) -> Iterable[Finding]:
+        for mod in scanner.modules:
+            if not mod.relpath.startswith("dear_pytorch_tpu/serving/"):
+                continue
+            for node in mod.walk():
+                if not isinstance(node, ast.Dict):
+                    continue
+                keys = self._const_keys(node)
+                if "id" not in keys or not (keys & self._PAYLOAD_KEYS):
+                    continue
+                if "trace" in keys or self._is_projection(node):
+                    continue
+                fn = self._enclosing_function(node)
+                if fn is not None and self._assigns_trace(fn):
+                    continue
+                direction = "request" if "prompt" in keys else "response"
+                yield Finding(
+                    rule=self.name, path=mod.relpath, line=node.lineno,
+                    qualname=mod.qualname(node),
+                    key=f"{direction}:{','.join(sorted(keys)[:4])}",
+                    message=(f"serving {direction} record has no "
+                             "`\"trace\"` field — the request timeline "
+                             "breaks at this hop; stamp the propagated "
+                             "context (it rides in the unsigned extras, "
+                             "outside the sha256 canonical fields)"))
 
 
 # -- dcn-blocking ------------------------------------------------------------

@@ -26,7 +26,9 @@ The exchange protocol, per training step:
      (`ops.fusion.chunk_bounds` — the per-level bucket partition, so the
      DCN level pipelines at its own message size independent of the ICI
      bucket threshold); every chunk carries an integrity header
-     (epoch, step, bucket, chunk, publish seq, sha256) so a torn KV
+     (epoch, step, bucket, chunk, publish seq, sha256 — plus the
+     fleet step-trace id under ``DEAR_TRACE``, ignored by trace-less
+     decoders) so a torn KV
      write, a duplicated stale value, or a replayed old key is REJECTED
      and counted (``dcn.chunk_rejects``), never silently merged;
   2. it FETCHES the other slices' chunks with a one-ahead prefetch
@@ -114,6 +116,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from dear_pytorch_tpu.observability import dtrace as _dtrace
 from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.ops import fusion as F
 
@@ -524,6 +527,18 @@ class DcnExchanger:
         live_local = [s for s in self.local_slices if s in self.slices]
         remote = [s for s in self.slices if s not in self.local_slices]
         tr = _telemetry.get_tracer()
+        ds = _dtrace.get_stream()
+        trace_ctx = None
+        trace_hdr = None
+        t_round = 0.0
+        if ds.enabled:
+            # one step-trace context per round: stamped into every chunk
+            # header and onto the round's comm span, so the merged fleet
+            # timeline correlates each DCN round (and its ladder
+            # decisions) with the guard step that drove it
+            trace_ctx = _dtrace.step_trace(self.epoch, step)
+            trace_hdr = trace_ctx.to_dict()
+            t_round = time.monotonic()
         self._join_prefetch()
 
         # payloads: float32 wire image of the local partials, with any
@@ -562,11 +577,18 @@ class DcnExchanger:
                     for j, (lo, hi) in enumerate(bounds[g]):
                         self._seq += 1
                         key = self._key(step, g, j, sid)
+                        meta = {"epoch": self.epoch, "step": int(step),
+                                "bucket": g, "chunk": j,
+                                "seq": self._seq}
+                        if trace_hdr is not None:
+                            # chunk-header extension: the step-trace id
+                            # rides next to (epoch, step, bucket, chunk,
+                            # sha256); decoders verify only the keys
+                            # they expect, so trace-less peers still
+                            # accept the chunk
+                            meta["trace"] = trace_hdr
                         self._transport.set(key, _encode(
-                            flat[lo:hi],
-                            meta={"epoch": self.epoch, "step": int(step),
-                                  "bucket": g, "chunk": j,
-                                  "seq": self._seq}))
+                            flat[lo:hi], meta=meta))
                         published.append(key)
                         bytes_out += (hi - lo) * flat.dtype.itemsize
                 if scalars is not None:
@@ -591,7 +613,8 @@ class DcnExchanger:
                 step, live_local, arrived, drop, published, tr)
             self._fill_decided(step, include, nbuf, bounds, contrib,
                                scalar_contrib, scalars is not None, tr)
-            self._apply_ladder(live_local, include, payload, tr)
+            self._apply_ladder(step, live_local, include, payload, tr,
+                               trace_ctx)
         else:
             self._fetch_strict(step, remote, nbuf, bounds, contrib,
                                scalar_contrib, scalars is not None, tr)
@@ -611,6 +634,13 @@ class DcnExchanger:
             tr.count("dcn.bytes",
                      bytes_out + self._bytes_in)
             tr.count("dcn.chunks", sum(len(b) for b in bounds))
+        if ds.enabled:
+            ds.emit("dcn.round", t0=t_round,
+                    dur_s=time.monotonic() - t_round, cat="comm",
+                    trace=trace_ctx, step=int(step),
+                    mem_epoch=self.epoch, degraded=self.degraded,
+                    included=len(include), world=len(self.slices),
+                    bytes=bytes_out + self._bytes_in)
         self._gc(step)
         return means, scalar_mean
 
@@ -989,12 +1019,20 @@ class DcnExchanger:
 
     # -- degraded rung 2/3: staleness clocks, EF residual, escalation -------
 
-    def _apply_ladder(self, live_local, include, payload, tr) -> None:
+    def _apply_ladder(self, step, live_local, include, payload, tr,
+                      trace_ctx=None) -> None:
         excluded = [s for s in self.slices if s not in include
                     and s not in self._escalated]
         if excluded and tr.enabled:
             tr.count("dcn.degraded_rounds")
             tr.count("dcn.skips", len(excluded))
+        ds = _dtrace.get_stream()
+        if ds.enabled and excluded:
+            # ladder decision on the step trace: which slices this round
+            # averaged WITHOUT (bounded-staleness skip, the first rung)
+            ds.emit("dcn.ladder", cat="comm", trace=trace_ctx,
+                    step=int(step), mem_epoch=self.epoch,
+                    decision="skip", slices=sorted(excluded))
         for s in self.slices:
             if s in include:
                 self._staleness[s] = 0
@@ -1022,6 +1060,11 @@ class DcnExchanger:
                     tr.event("dcn.self_evict", slice=sid,
                              stale=self._staleness[sid],
                              epoch=self.epoch)
+                if ds.enabled:
+                    ds.emit("dcn.ladder", cat="comm", trace=trace_ctx,
+                            step=int(step), mem_epoch=self.epoch,
+                            decision="self_evict", slice=sid,
+                            stale=self._staleness[sid])
                 raise DcnSelfEvict(
                     f"local slice {sid} unmerged for "
                     f"{self._staleness[sid]} rounds (budget "
@@ -1038,6 +1081,11 @@ class DcnExchanger:
                     tr.event("dcn.escalate", slice=sid,
                              stale=self._staleness[sid],
                              epoch=self.epoch)
+                if ds.enabled:
+                    ds.emit("dcn.ladder", cat="comm", trace=trace_ctx,
+                            step=int(step), mem_epoch=self.epoch,
+                            decision="escalate", slice=sid,
+                            stale=self._staleness[sid])
 
     # -- cross-iteration prefetch (the staleness>=1 overlap primitive) ------
 

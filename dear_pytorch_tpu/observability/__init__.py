@@ -34,6 +34,18 @@ The continuous run-health layer (docs/OBSERVABILITY.md "Run health"):
   - `redaction` — secret/env redaction every exported env block passes
                   through.
 
+The fleet-trace layer (docs/OBSERVABILITY.md "Fleet tracing"):
+
+  - `dtrace`        — trace-context propagation (request traces across
+                      router/replica hops, the deterministic
+                      ``(mem_epoch, step)`` step trace), the durable
+                      per-rank span stream (``DEAR_TRACE``), and the
+                      jax-free collector that clock-aligns and merges
+                      streams into one Perfetto/chrome timeline.
+  - `critical_path` — per-step exposed-vs-hidden comm, straggler and
+                      longest-chain attribution, per-request hop/queue
+                      breakdowns over the merged timeline.
+
 The hot-path contract: instrumented code asks ``get_tracer()`` (a module
 attribute read) and checks ``.enabled`` before doing anything else, so a
 disabled tracer costs one attribute lookup per step.
@@ -69,10 +81,30 @@ _LAZY = {
     "LinkFit": "costmodel",
     "Calibration": "costmodel",
     "load_calibration": "costmodel",
+    "TraceCalibration": "costmodel",
+    "calibrate_from_traces": "costmodel",
+    "load_trace_calibration": "costmodel",
     # the fleet-scale discrete-event simulator (docs/SIM.md)
     "simulate_training": "sim",
     "simulate_serving": "sim",
     "SimTopology": "sim",
+    # fleet tracing (docs/OBSERVABILITY.md "Fleet tracing"): the per-rank
+    # span stream, the jax-free collector, and critical-path attribution
+    "TraceContext": "dtrace",
+    "SpanStream": "dtrace",
+    "MemoryWriter": "dtrace",
+    "new_trace": "dtrace",
+    "step_trace": "dtrace",
+    "get_stream": "dtrace",
+    "set_stream": "dtrace",
+    "configure_stream": "dtrace",
+    "disable_stream": "dtrace",
+    "read_stream": "dtrace",
+    "merge_streams": "dtrace",
+    "write_chrome_trace": "dtrace",
+    "step_attribution": "critical_path",
+    "request_attribution": "critical_path",
+    "critical_path": "critical_path",
     # run-health layer
     "FlightRecorder": "flight",
     "NullFlightRecorder": "flight",

@@ -13,7 +13,9 @@ backend cannot execute cross-process device collectives.
           selected counter totals, and the flight-ring head (newest step,
           loss, step time). Compact by construction: counters are
           prefix-filtered and capped so the JSON stays inside the
-          allgather transport's fixed per-rank slot.
+          allgather transport's fixed per-rank slot. Also carries the
+          rank's wall-vs-monotonic clock offset (``clk``) — the fleet
+          trace collector's alignment sample (docs/OBSERVABILITY.md).
   merge   (`merge_digests`)  — per-rank table + summed counters + straggler
           detection: the rank whose p50 step time exceeds the fleet median
           by more than ``skew_threshold`` (``DEAR_STRAGGLER_SKEW``). The
@@ -29,6 +31,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Optional, Sequence
 
 __all__ = [
@@ -79,6 +82,12 @@ def local_digest(*, rank: Optional[int] = None, recorder=None,
         if len(ctr) > MAX_DIGEST_COUNTERS:
             ctr = dict(sorted(ctr.items())[:MAX_DIGEST_COUNTERS])
     digest = {"rank": int(rank), "ctr": ctr}
+    # wall-minus-monotonic clock offset, sampled on the SAME lockstep
+    # cadence the exchange rides: the trace collector
+    # (`observability.dtrace.merge_streams`) medians these to clock-align
+    # per-rank span streams into one fleet timeline. ~20 bytes, always
+    # under the slot budget.
+    digest["clk"] = round(time.time() - time.monotonic(), 6)
     stats = recorder.step_time_stats()
     if stats:
         digest["st"] = stats

@@ -39,6 +39,7 @@ __all__ = [
     "CostModel", "ServeCostModel", "LinkFit", "Calibration",
     "load_calibration", "fit_allgather_sweep", "fit_dcn",
     "price_degraded_round",
+    "TraceCalibration", "calibrate_from_traces", "load_trace_calibration",
     "DTYPE_ITEMSIZE",
 ]
 
@@ -129,6 +130,150 @@ def load_calibration(source) -> Calibration:
     if "calibration" in d and isinstance(d["calibration"], dict):
         d = d["calibration"]
     return Calibration.from_dict(d)
+
+
+# ---------------------------------------------------------------------------
+# trace-driven calibration: recorded fleet traces -> dearsim replay inputs
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceCalibration:
+    """What a recorded fleet trace teaches the simulator.
+
+    The α-β `Calibration` prices WIRE; this one prices VARIABILITY and
+    the compute base — the two things docs/SIM.md lists as synthetic
+    inputs. Fields:
+
+    ``step_time_s``     recorded fleet step-time quantiles (max over
+                        ranks per step — lockstep pace), the ground
+                        truth `scripts/sim_check.py`'s parity gate
+                        replays against.
+    ``compute_time_s``  recorded p50 step minus the straggler's median
+                        exposed comm — the compute base to hand
+                        `simulate_training` so the event model re-adds
+                        exposure instead of double-counting it.
+    ``compute_scale``   per-step multiplicative scales (step_i / p50),
+                        median 1 by construction — the EMPIRICAL jitter
+                        distribution the sim samples in place of the
+                        synthetic Gaussian (heavy tails included, which
+                        a sigma cannot carry).
+    ``exposed_comm_s``  median straggler exposed comm (provenance for
+                        ``compute_time_s``; reports print both).
+    ``dcn_round_s``     recorded cross-slice round durations — an
+                        empirical alternative to pricing DCN rounds
+                        from an α-β fit alone.
+    """
+
+    step_time_s: dict
+    compute_time_s: float
+    compute_scale: tuple
+    exposed_comm_s: float = 0.0
+    dcn_round_s: tuple = ()
+    n_steps: int = 0
+    source: str = "trace"
+
+    def to_dict(self) -> dict:
+        return {
+            "step_time_s": dict(self.step_time_s),
+            "compute_time_s": self.compute_time_s,
+            "compute_scale": list(self.compute_scale),
+            "exposed_comm_s": self.exposed_comm_s,
+            "dcn_round_s": list(self.dcn_round_s),
+            "n_steps": self.n_steps,
+            "source": self.source,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceCalibration":
+        return cls(
+            step_time_s=dict(d.get("step_time_s", {})),
+            compute_time_s=float(d["compute_time_s"]),
+            compute_scale=tuple(
+                float(x) for x in d.get("compute_scale", ())),
+            exposed_comm_s=float(d.get("exposed_comm_s", 0.0)),
+            dcn_round_s=tuple(float(x) for x in d.get("dcn_round_s", ())),
+            n_steps=int(d.get("n_steps", 0)),
+            source=str(d.get("source", "trace")),
+        )
+
+    def dump(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+
+def load_trace_calibration(source) -> TraceCalibration:
+    """`TraceCalibration` from a dict, JSON path/string, or an artifact
+    that embeds one under ``"trace_calibration"`` (the
+    ``perf/trace_r19`` shape) — same loader grammar as
+    `load_calibration`."""
+    if isinstance(source, TraceCalibration):
+        return source
+    if isinstance(source, dict):
+        d = source
+    else:
+        text = str(source)
+        if text.lstrip().startswith("{"):
+            d = json.loads(text)
+        else:
+            with open(text, encoding="utf-8") as f:
+                d = json.load(f)
+    if ("trace_calibration" in d
+            and isinstance(d["trace_calibration"], dict)):
+        d = d["trace_calibration"]
+    return TraceCalibration.from_dict(d)
+
+
+def calibrate_from_traces(source, *, min_steps: int = 4,
+                          warmup: int = 0) -> TraceCalibration:
+    """Fit a `TraceCalibration` from a recorded fleet trace.
+
+    ``source`` is anything `critical_path.step_attribution` accepts: a
+    `dtrace.merge_streams` artifact, a bare span-record list, or — via
+    a sequence of paths — per-rank stream files (merged here).
+    ``warmup`` drops the first N recorded steps: the compile step is
+    two orders of magnitude over steady state and would otherwise ride
+    into the jitter distribution as a fake 100x tail. Raises
+    ``ValueError`` below ``min_steps`` recorded steps for the same
+    reason `fit_dcn` does: a two-point quantile hands the parity gate a
+    degenerate band."""
+    from dear_pytorch_tpu.observability import critical_path as CP
+    from dear_pytorch_tpu.observability import dtrace as DT
+
+    if (isinstance(source, (list, tuple)) and source
+            and all(isinstance(p, str) for p in source)):
+        source = DT.merge_streams(source)
+    att = CP.step_attribution(source)
+    steps = [s for s in att["steps"][int(warmup):] if s["step_s"] > 0]
+    if len(steps) < int(min_steps):
+        raise ValueError(
+            f"trace calibration needs >= {min_steps} recorded steps, "
+            f"got {len(steps)} — record a longer run (DEAR_TRACE=...)")
+    times = sorted(s["step_s"] for s in steps)
+    n = len(times)
+
+    def q(p: float) -> float:
+        return times[min(int(p * (n - 1)), n - 1)]
+
+    p50 = q(0.50)
+    exposed = sorted(s["exposed_comm_s"] for s in steps)
+    exposed_p50 = exposed[n // 2]
+    spans = (source.get("spans", []) if isinstance(source, dict)
+             else list(source))
+    dcn_rounds = tuple(
+        round(float(s.get("dur", 0.0)), 7) for s in spans
+        if s.get("name") == "dcn.round" and float(s.get("dur", 0.0)) > 0)
+    return TraceCalibration(
+        step_time_s={"p50": p50, "p90": q(0.90), "p99": q(0.99),
+                     "mean": sum(times) / n, "n": n},
+        compute_time_s=max(p50 - exposed_p50, 1e-6),
+        compute_scale=tuple(round(t / p50, 6) for t in (s["step_s"]
+                                                        for s in steps)),
+        exposed_comm_s=exposed_p50,
+        dcn_round_s=dcn_rounds,
+        n_steps=n,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,287 @@
+"""Critical-path attribution over a merged fleet timeline.
+
+Input is the `dtrace.merge_streams` artifact (or a bare span list from
+one stream): clock-aligned spans carrying ``cat`` (step / compute /
+comm / serve), ``step`` / ``mem_epoch`` correlation keys, and trace
+contexts. Output answers the two questions the per-rank views cannot:
+
+  - `step_attribution` — per ``(mem_epoch, step)``: which rank was the
+    straggler, how much communication was EXPOSED (comm intervals not
+    covered by that rank's compute intervals — interval subtraction,
+    the same definition the overlap auditor uses on XLA cost analysis)
+    versus hidden, and the longest rank/leg chain (the straggler's
+    ordered spans — the step's critical path).
+
+  - `request_attribution` — per request trace: the router -> replica ->
+    engine hop breakdown, redispatch hops and the incarnations crossed
+    (a trace that survived a replica death lists >1), and where the
+    deadline actually went (queue vs prefill vs decode vs router
+    overhead).
+
+Everything here is arithmetic over already-recorded dicts: stdlib-only,
+jax-free, usable on a collector box. `report.render_fleet_trace` and
+``scripts/fleet_trace.py`` render the result; `costmodel.
+calibrate_from_traces` feeds the same per-step samples to dearsim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "STEP_SPAN_NAMES", "step_attribution", "request_attribution",
+    "critical_path",
+]
+
+#: Span names that bound one rank's step, in preference order — the
+#: guard wraps the whole attempt (verdict included); a bare dear step
+#: span is the fallback when no guard is in the loop.
+STEP_SPAN_NAMES = ("guard.step", "dear.step")
+
+_COMM_CATS = {"comm"}
+_COMPUTE_CATS = {"compute"}
+
+
+def _merge_intervals(iv: List[Tuple[float, float]]):
+    """Coalesce overlapping [start, end) intervals."""
+    if not iv:
+        return []
+    iv = sorted(iv)
+    out = [list(iv[0])]
+    for a, b in iv[1:]:
+        if a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return [(a, b) for a, b in out]
+
+
+def _exposed_len(comm: List[Tuple[float, float]],
+                 compute: List[Tuple[float, float]]) -> float:
+    """Total length of ``comm`` not covered by ``compute`` — the
+    interval-subtraction definition of exposed communication."""
+    comm = _merge_intervals(comm)
+    compute = _merge_intervals(compute)
+    exposed = 0.0
+    for a, b in comm:
+        cur = a
+        for ca, cb in compute:
+            if cb <= cur or ca >= b:
+                continue
+            if ca > cur:
+                exposed += ca - cur
+            cur = max(cur, cb)
+            if cur >= b:
+                break
+        if cur < b:
+            exposed += b - cur
+    return exposed
+
+
+def _iv(s: dict) -> Tuple[float, float]:
+    t0 = float(s.get("t_wall", s.get("mono", 0.0)))
+    return (t0, t0 + float(s.get("dur", 0.0)))
+
+
+def _spans_of(merged_or_spans) -> List[dict]:
+    if isinstance(merged_or_spans, dict):
+        return list(merged_or_spans.get("spans", []))
+    return [s for s in merged_or_spans if s.get("kind", "span") == "span"]
+
+
+def _quantile(sorted_vals: List[float], p: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    n = len(sorted_vals)
+    return sorted_vals[min(int(p * (n - 1)), n - 1)]
+
+
+def step_attribution(merged_or_spans) -> dict:
+    """Per-step fleet attribution. Returns::
+
+        {"steps": [{"mem_epoch", "step", "step_s", "straggler",
+                    "exposed_comm_s", "hidden_comm_s", "ranks": {...},
+                    "critical_chain": [...]}, ...],
+         "summary": {"n_steps", "step_p50_s", "step_p99_s",
+                     "exposed_frac", "stragglers": {rank: count},
+                     "rollbacks"}}
+
+    ``step_s`` is the fleet step time (max over ranks — lockstep pace);
+    exposed/hidden are the straggler rank's split (its exposure IS the
+    step's exposure); ``critical_chain`` is the straggler's ordered
+    span chain."""
+    spans = _spans_of(merged_or_spans)
+    by_step: Dict[Tuple[int, int], List[dict]] = {}
+    rollbacks = 0
+    for s in spans:
+        if s.get("name") == "guard.rollback":
+            rollbacks += 1
+        st = s.get("step")
+        if st is None:
+            continue
+        key = (int(s.get("mem_epoch") or 0), int(st))
+        by_step.setdefault(key, []).append(s)
+
+    steps_out: List[dict] = []
+    straggler_hist: Dict[str, int] = {}
+    fleet_steps: List[float] = []
+    exposed_fracs: List[float] = []
+    for (epoch, st), ss in sorted(by_step.items()):
+        per_rank: Dict[Any, List[dict]] = {}
+        for s in ss:
+            per_rank.setdefault(s.get("rank", "?"), []).append(s)
+        rank_rows: Dict[str, dict] = {}
+        straggler, straggler_dur = None, -1.0
+        for rank, rs in per_rank.items():
+            step_dur = 0.0
+            for name in STEP_SPAN_NAMES:
+                named = [float(s.get("dur", 0.0))
+                         for s in rs if s.get("name") == name]
+                if named:
+                    step_dur = max(named)
+                    break
+            if step_dur <= 0.0 and rs:
+                lo = min(_iv(s)[0] for s in rs)
+                hi = max(_iv(s)[1] for s in rs)
+                step_dur = hi - lo
+            comm = [s for s in rs if s.get("cat") in _COMM_CATS]
+            compute = [s for s in rs if s.get("cat") in _COMPUTE_CATS]
+            comm_total = sum(float(s.get("dur", 0.0)) for s in comm)
+            exposed = _exposed_len([_iv(s) for s in comm],
+                                   [_iv(s) for s in compute])
+            longest = max(comm, key=lambda s: float(s.get("dur", 0.0)),
+                          default=None)
+            rank_rows[str(rank)] = {
+                "step_s": round(step_dur, 6),
+                "comm_s": round(comm_total, 6),
+                "exposed_comm_s": round(exposed, 6),
+                "hidden_comm_s": round(max(comm_total - exposed, 0.0), 6),
+                "longest_leg": (
+                    {"name": longest.get("name"),
+                     "dur_s": round(float(longest.get("dur", 0.0)), 6)}
+                    if longest is not None else None),
+                "spans": len(rs),
+            }
+            if step_dur > straggler_dur:
+                straggler, straggler_dur = str(rank), step_dur
+        chain = []
+        if straggler is not None:
+            chain = sorted(
+                (s for s in ss if str(s.get("rank", "?")) == straggler),
+                key=lambda s: _iv(s)[0])
+            chain = [{"name": s.get("name"), "cat": s.get("cat"),
+                      "dur_s": round(float(s.get("dur", 0.0)), 6)}
+                     for s in chain]
+        srow = rank_rows.get(straggler, {}) if straggler else {}
+        steps_out.append({
+            "mem_epoch": epoch, "step": st,
+            "step_s": round(max(straggler_dur, 0.0), 6),
+            "straggler": straggler,
+            "exposed_comm_s": srow.get("exposed_comm_s", 0.0),
+            "hidden_comm_s": srow.get("hidden_comm_s", 0.0),
+            "ranks": rank_rows,
+            "critical_chain": chain,
+        })
+        if straggler is not None:
+            straggler_hist[straggler] = straggler_hist.get(straggler, 0) + 1
+            fleet_steps.append(straggler_dur)
+            if straggler_dur > 0:
+                exposed_fracs.append(
+                    srow.get("exposed_comm_s", 0.0) / straggler_dur)
+    fleet_sorted = sorted(fleet_steps)
+    summary = {
+        "n_steps": len(steps_out),
+        "step_p50_s": _quantile(fleet_sorted, 0.50),
+        "step_p99_s": _quantile(fleet_sorted, 0.99),
+        "step_mean_s": (round(sum(fleet_sorted) / len(fleet_sorted), 6)
+                        if fleet_sorted else None),
+        "exposed_frac": (round(sum(exposed_fracs) / len(exposed_fracs), 4)
+                         if exposed_fracs else None),
+        "stragglers": straggler_hist,
+        "rollbacks": rollbacks,
+    }
+    return {"steps": steps_out, "summary": summary}
+
+
+def request_attribution(merged_or_spans) -> dict:
+    """Per-request hop breakdown, grouped by trace_id (step traces —
+    ``step-*`` ids — are excluded; they belong to `step_attribution`).
+    A request that survived a replica death shows ``redispatches >= 1``
+    and more than one incarnation."""
+    spans = _spans_of(merged_or_spans)
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        tr = s.get("trace")
+        if not isinstance(tr, dict):
+            continue
+        tid = tr.get("trace_id")
+        if not tid or tid.startswith("step-"):
+            continue
+        by_trace.setdefault(tid, []).append(s)
+
+    reqs: List[dict] = []
+    service: List[float] = []
+    for tid, ss in sorted(by_trace.items()):
+        ss = sorted(ss, key=lambda s: _iv(s)[0])
+        total = 0.0
+        redispatches = 0
+        incarnations: List[str] = []
+        replicas: List[str] = []
+        phases: Dict[str, float] = {}
+        request_id = None
+        for s in ss:
+            attrs = s.get("attrs") or {}
+            name = s.get("name", "")
+            if request_id is None and attrs.get("request_id"):
+                request_id = attrs["request_id"]
+            if name == "serve.request":
+                total = max(total, float(s.get("dur", 0.0)))
+            elif name == "serve.redispatch_hop":
+                redispatches += 1
+            inc = attrs.get("incarnation")
+            if inc and inc not in incarnations:
+                incarnations.append(inc)
+            rep = attrs.get("replica")
+            if rep is not None and rep not in replicas:
+                replicas.append(rep)
+            for ph in ("prefill_s", "decode_s"):
+                if attrs.get(ph) is not None:
+                    phases[ph] = phases.get(ph, 0.0) + float(attrs[ph])
+        served = sum(phases.values())
+        hops = [{"name": s.get("name"), "rank": s.get("rank"),
+                 "dur_s": round(float(s.get("dur", 0.0)), 6),
+                 "span_id": (s.get("trace") or {}).get("span_id")}
+                for s in ss]
+        reqs.append({
+            "trace_id": tid,
+            "request_id": request_id,
+            "service_s": round(total, 6),
+            "queue_s": round(max(total - served, 0.0), 6) if total else None,
+            "prefill_s": round(phases.get("prefill_s", 0.0), 6),
+            "decode_s": round(phases.get("decode_s", 0.0), 6),
+            "redispatches": redispatches,
+            "incarnations": incarnations,
+            "replicas": replicas,
+            "hops": hops,
+        })
+        if total:
+            service.append(total)
+    service.sort()
+    summary = {
+        "n_requests": len(reqs),
+        "service_p50_s": _quantile(service, 0.50),
+        "service_p99_s": _quantile(service, 0.99),
+        "redispatched": sum(1 for r in reqs if r["redispatches"]),
+        "multi_incarnation": sum(
+            1 for r in reqs if len(r["incarnations"]) > 1),
+    }
+    return {"requests": reqs, "summary": summary}
+
+
+def critical_path(merged_or_spans) -> dict:
+    """Both attributions over one timeline — the `fleet_trace` CLI /
+    `report` artifact shape."""
+    return {
+        "steps": step_attribution(merged_or_spans),
+        "requests": request_attribution(merged_or_spans),
+    }

@@ -1,0 +1,676 @@
+"""Fleet tracing: trace contexts, per-rank span streams, and the collector.
+
+The tracer (`observability.tracer`) answers "what did THIS process do";
+the flight recorder keeps the last N steps of local context. Neither can
+answer fleet-level questions — which rank/leg was on the step's critical
+path, where a served request spent its deadline across router -> replica
+-> engine hops, why a DCN round went degraded. This module adds the three
+missing pieces:
+
+  - **Trace contexts** — a ``(trace_id, span_id, parent)`` triple.
+    `new_trace()` mints a request trace the router stamps on every
+    dispatch record; the context rides the inbox file, the engine slot,
+    and the (unsigned extras of the) signed response, so redispatch after
+    a replica death keeps the SAME trace_id with the incarnation hop
+    recorded as a span. `step_trace(mem_epoch, step)` is deterministic
+    fleet-wide — every rank derives the same trace_id for the same
+    ``(membership epoch, step)`` without any coordination, which is what
+    lets guard verdicts, per-bucket RS/AG legs, DCN rounds and rollbacks
+    from different processes land on one timeline row. The membership
+    epoch is part of the id so an elastic shrink -> rejoin can never
+    collide step 7 of epoch 1 with step 7 of epoch 2.
+
+  - **`SpanStream`** — a durable per-rank JSONL span stream over the
+    shared `JsonlWriter` (same json-safety + rotation rules as every
+    other ``.jsonl`` the framework emits). Each stream opens with a
+    ``meta`` record carrying the rank, pid and the **wall-minus-monotonic
+    clock offset**, refreshed by `clock_sample()` on the lockstep health
+    cadence — the collector aligns per-rank monotonic timestamps onto one
+    wall clock with these offsets. Span attributes and the env block pass
+    through `redaction` before they leave the process. Gated exactly like
+    the tracer/flight recorder: hot paths ask `get_stream()` (one module
+    attribute read) and check ``.enabled`` before building any record, so
+    a disabled stream costs one attribute lookup (the contract
+    ``scripts/check_telemetry_overhead.py`` measures and the
+    ``ungated-trace-stream`` dearlint rule enforces statically).
+
+  - **The collector** — `read_stream` / `merge_streams` /
+    `write_chrome_trace`: merges per-rank streams into one clock-aligned
+    fleet timeline and exports a single Perfetto-loadable chrome trace.
+    Deliberately independent of `utils.chrome_trace` (which imports jax):
+    the collector must run on a machine that has only the ``.jsonl``
+    files.
+
+Stdlib-only at module level (no jax): loadable standalone by the
+overhead probe and by an off-host collector box. ``DEAR_TRACE`` grammar:
+
+  DEAR_TRACE=/tmp/run/trace.{rank}.jsonl    per-rank durable stream
+  DEAR_TRACE=1                              in-memory stream (tests)
+  DEAR_TRACE=0 / unset                      disabled (NullStream)
+
+`critical_path` (exposed-vs-hidden comm, straggler, longest chain) and
+`costmodel.calibrate_from_traces` (trace -> dearsim replay calibration)
+consume the merged timeline; ``scripts/fleet_trace.py`` is the one-shot
+CLI over all of it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Union
+
+__all__ = [
+    "TRACE_ENV", "TRACE_RANK_ENV", "TRACE_MAX_MB_ENV",
+    "TraceContext", "new_trace", "step_trace",
+    "SpanStream", "NullStream", "MemoryWriter",
+    "get_stream", "set_stream", "configure_stream", "disable_stream",
+    "read_stream", "merge_streams", "write_chrome_trace",
+]
+
+#: ``DEAR_TRACE`` — off / ``1`` (in-memory) / a JSONL path (may carry a
+#: literal ``{rank}`` placeholder, resolved per process like the
+#: telemetry sinks).
+TRACE_ENV = "DEAR_TRACE"
+#: ``DEAR_TRACE_RANK`` — explicit rank label for this process's stream
+#: (router/replica processes have no jax process index; storms export
+#: their worker index here).
+TRACE_RANK_ENV = "DEAR_TRACE_RANK"
+#: ``DEAR_TRACE_MAX_MB`` — rotation budget per stream file.
+TRACE_MAX_MB_ENV = "DEAR_TRACE_MAX_MB"
+
+_DEFAULT_MAX_MB = 256.0
+
+
+def _new_id(n: int = 8) -> str:
+    return uuid.uuid4().hex[:2 * n]
+
+
+class TraceContext(NamedTuple):
+    """``(trace_id, span_id, parent)`` — the propagated trace identity.
+
+    ``trace_id`` names the end-to-end story (one served request, one
+    fleet step); ``span_id`` names this hop; ``parent`` is the hop we
+    came from. Serialized as a small dict so it can ride any JSON
+    message schema (router dispatch files, DCN chunk headers, response
+    extras) without coupling those schemas to this module."""
+
+    trace_id: str
+    span_id: str
+    parent: Optional[str] = None
+
+    def child(self) -> "TraceContext":
+        """A new hop under this one (redispatch, replica consume,
+        engine tick): same trace, fresh span id, parent = us."""
+        return TraceContext(self.trace_id, _new_id(4), self.span_id)
+
+    def to_dict(self) -> dict:
+        d = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent:
+            d["parent"] = self.parent
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Any) -> Optional["TraceContext"]:
+        """Tolerant inverse of `to_dict` — a message from an older (or
+        foreign) writer without trace fields yields None, never a
+        throw."""
+        if not isinstance(d, dict):
+            return None
+        tid = d.get("trace_id")
+        if not isinstance(tid, str) or not tid:
+            return None
+        sid = d.get("span_id")
+        par = d.get("parent")
+        return cls(tid, sid if isinstance(sid, str) and sid else _new_id(4),
+                   par if isinstance(par, str) and par else None)
+
+
+def new_trace() -> TraceContext:
+    """Mint a request trace (random ids; the router calls this once per
+    submitted request)."""
+    return TraceContext(_new_id(8), _new_id(4), None)
+
+
+def step_trace(mem_epoch: Optional[int], step: int) -> TraceContext:
+    """The fleet-wide step trace: every rank derives the SAME trace_id
+    for the same ``(membership epoch, step)`` with no coordination. The
+    epoch is baked into the id so elastic shrink/rejoin epochs can never
+    collide their step counters; the span_id stays random per emission
+    (each rank's contribution is its own hop)."""
+    return TraceContext(
+        f"step-{int(mem_epoch or 0)}-{int(step)}", _new_id(4), None)
+
+
+# ---------------------------------------------------------------------------
+# lazy, import-light access to siblings (redaction, the tracer)
+# ---------------------------------------------------------------------------
+
+_RED = None
+
+
+def _redaction():
+    """`redaction` without forcing the package import: prefer the
+    already-imported canonical module; fall back to executing the
+    adjacent stdlib-only file (standalone/off-host loads)."""
+    global _RED
+    if _RED is None:
+        mod = sys.modules.get("dear_pytorch_tpu.observability.redaction")
+        if mod is None:
+            import importlib.util
+
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "redaction.py")
+            spec = importlib.util.spec_from_file_location(
+                "_dtrace_redaction", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        _RED = mod
+    return _RED
+
+
+def _live_tracer():
+    """The global tracer IF the telemetry module is already loaded;
+    None otherwise. Never imports: when nothing else pulled the tracer
+    in, telemetry cannot have been configured, so there is nothing to
+    count into."""
+    mod = sys.modules.get("dear_pytorch_tpu.observability.tracer")
+    return mod.get_tracer() if mod is not None else None
+
+
+def _redact_attrs(attrs: dict) -> dict:
+    """Span attributes leave the process — mask secret-bearing keys with
+    the same key-driven rule every exported env block uses."""
+    red = _redaction()
+    return {
+        k: (red.REDACTED if red.is_sensitive_key(str(k)) else v)
+        for k, v in attrs.items()
+    }
+
+
+def _resolve_rank() -> Optional[Union[int, str]]:
+    v = os.environ.get(TRACE_RANK_ENV)
+    if v:
+        v = v.strip()
+        return int(v) if v.lstrip("-").isdigit() else v
+    # the fleet substrate's stable rank id (launch/supervisor env
+    # contract) — the right identity on elastic/serving fleets, where
+    # every process is jax-single-process and process_index() is 0
+    v = os.environ.get("DEAR_ELASTIC_RANK", "").strip()
+    if v.lstrip("-").isdigit():
+        return int(v)
+    mod = sys.modules.get("dear_pytorch_tpu.observability.tracer")
+    if mod is not None:
+        try:
+            return int(mod.process_index())
+        except Exception:
+            return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the per-rank stream
+# ---------------------------------------------------------------------------
+
+
+class MemoryWriter:
+    """In-process sink (``DEAR_TRACE=1``): records accumulate on a list.
+    Duck-types `JsonlWriter` for everything the stream needs."""
+
+    def __init__(self) -> None:
+        self.records: List[dict] = []
+        self.path = None
+
+    def write(self, rec: dict) -> None:
+        self.records.append(rec)
+
+    def close(self) -> None:
+        pass
+
+
+class _StreamSpan:
+    """``with ds.span("dcn.round", cat="comm"):`` — times the block and
+    emits one span record on exit (exceptions included: the record is
+    the evidence of where the time went)."""
+
+    __slots__ = ("_ds", "_name", "_kw", "_t0")
+
+    def __init__(self, ds: "SpanStream", name: str, kw: dict):
+        self._ds = ds
+        self._name = name
+        self._kw = kw
+
+    def __enter__(self) -> "_StreamSpan":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._ds.emit(self._name, t0=self._t0,
+                      dur_s=time.monotonic() - self._t0, **self._kw)
+
+
+class SpanStream:
+    """Durable per-rank span stream (JSONL over `JsonlWriter`).
+
+    Record kinds:
+
+      ``meta``  — rank, pid, wall time, monotonic time, ``off`` (wall
+                  minus monotonic — the collector's clock-alignment
+                  sample) and the redacted ``DEAR_*`` env.
+      ``span``  — name, rank, monotonic start, duration, optional
+                  category / trace context / step / mem_epoch /
+                  redacted attrs.
+      ``clock`` — a fresh offset sample (emitted on the lockstep health
+                  cadence so drift between wall and monotonic clocks is
+                  bounded by the cadence, not the run length).
+
+    ``sink`` is a path (``{rank}`` placeholder substituted) or any
+    object with ``write(dict)`` — the same duck-writer contract the
+    tracer's `JsonlExporter` honours, which is what lets the overhead
+    probe bench a live stream against a list shim without touching
+    disk."""
+
+    enabled = True
+
+    def __init__(self, sink, *, rank: Optional[Union[int, str]] = None,
+                 env: bool = True, max_bytes: Optional[int] = None,
+                 backups: int = 2):
+        if rank is None:
+            rank = _resolve_rank()
+        self.rank = rank if rank is not None else os.getpid()
+        if isinstance(sink, str):
+            from dear_pytorch_tpu.observability.export import JsonlWriter
+
+            path = sink.replace("{rank}", str(self.rank))
+            self._writer = JsonlWriter(
+                path, append=True,
+                max_bytes=(max_bytes
+                           or int(_DEFAULT_MAX_MB * 2 ** 20)),
+                backups=backups)
+            self.path = path
+        elif hasattr(sink, "write"):
+            self._writer = sink
+            self.path = getattr(sink, "path", None)
+        else:
+            raise TypeError(
+                f"SpanStream sink must be a path or a writer, got "
+                f"{type(sink).__name__}")
+        self.records = 0
+        self.errors = 0
+        self._emit_meta(env=env)
+
+    # -- emission -----------------------------------------------------------
+
+    def _write(self, rec: dict) -> None:
+        # A tracing sink failing (disk full, NFS hiccup) must never take
+        # down the run being traced; errors are counted, not raised.
+        try:
+            self._writer.write(rec)
+            self.records += 1
+        except (OSError, ValueError, TypeError):
+            self.errors += 1
+
+    def _emit_meta(self, *, env: bool = True) -> None:
+        wall, mono = time.time(), time.monotonic()
+        rec = {
+            "kind": "meta", "rank": self.rank, "pid": os.getpid(),
+            "t": round(wall, 6), "mono": round(mono, 7),
+            "off": round(wall - mono, 6),
+        }
+        if env:
+            rec["env"] = _redaction().redact_env()
+        self._write(rec)
+
+    def emit(self, name: str, *, t0: Optional[float] = None,
+             dur_s: float = 0.0, cat: Optional[str] = None,
+             trace: Optional[Union[TraceContext, dict]] = None,
+             step: Optional[int] = None, mem_epoch: Optional[int] = None,
+             **attrs) -> None:
+        """One span record. ``t0`` is monotonic (defaults to now minus
+        ``dur_s``); zero-duration spans render as instants."""
+        if t0 is None:
+            t0 = time.monotonic() - dur_s
+        rec: Dict[str, Any] = {
+            "kind": "span", "name": name, "rank": self.rank,
+            "mono": round(float(t0), 7), "dur": round(float(dur_s), 7),
+        }
+        if cat:
+            rec["cat"] = cat
+        if trace is not None:
+            rec["trace"] = (trace.to_dict()
+                            if isinstance(trace, TraceContext)
+                            else dict(trace))
+        if step is not None:
+            rec["step"] = int(step)
+        if mem_epoch is not None:
+            rec["mem_epoch"] = int(mem_epoch)
+        if attrs:
+            rec["attrs"] = _redact_attrs(attrs)
+        self._write(rec)
+        tr = _live_tracer()
+        if tr is not None:
+            if tr.enabled:
+                tr.count("trace.spans")
+
+    def span(self, name: str, **kw) -> _StreamSpan:
+        return _StreamSpan(self, name, kw)
+
+    def clock_sample(self) -> None:
+        """Refresh the wall-minus-monotonic offset (called on the
+        lockstep health cadence; the collector medians all samples)."""
+        wall, mono = time.time(), time.monotonic()
+        self._write({"kind": "clock", "rank": self.rank,
+                     "t": round(wall, 6), "mono": round(mono, 7),
+                     "off": round(wall - mono, 6)})
+        tr = _live_tracer()
+        if tr is not None:
+            if tr.enabled:
+                tr.count("trace.clock_samples")
+
+    def buffered(self) -> List[dict]:
+        """The in-memory record list (MemoryWriter sinks); [] for file
+        sinks — tests use this, the collector uses the files."""
+        return list(getattr(self._writer, "records", ()) or ())
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except (OSError, ValueError):
+            pass
+
+
+class NullStream:
+    """Disabled stream: ``enabled`` is False and every method is a
+    no-op. Hot paths check ``.enabled`` and never reach the methods —
+    the methods exist so cold paths (tests, shutdown hooks) need no
+    guards."""
+
+    enabled = False
+    rank = -1
+    records = 0
+    errors = 0
+    path = None
+
+    def emit(self, name: str, **kw) -> None:  # noqa: ARG002
+        pass
+
+    def span(self, name: str, **kw) -> "NullStream":  # noqa: ARG002
+        return self
+
+    def __enter__(self) -> "NullStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def clock_sample(self) -> None:
+        pass
+
+    def buffered(self) -> List[dict]:
+        return []
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the process-global stream (same gate machinery as the flight recorder)
+# ---------------------------------------------------------------------------
+
+_NULL_STREAM = NullStream()
+_stream: Union[SpanStream, NullStream] = _NULL_STREAM
+#: True until someone calls set_stream/configure_stream/disable_stream
+#: explicitly — while auto-following, `_configure_from_env(refresh=True)`
+#: (tests, respawned workers) re-reads ``DEAR_TRACE``.
+_auto_follow = True
+_config_lock = threading.Lock()
+
+
+def get_stream() -> Union[SpanStream, NullStream]:
+    """The process-global span stream. Hot-path contract: one module
+    attribute read, then ``.enabled``."""
+    return _stream
+
+
+def set_stream(ds: Optional[Union[SpanStream, NullStream]]):
+    global _stream, _auto_follow
+    with _config_lock:
+        _stream = ds if ds is not None else _NULL_STREAM
+        _auto_follow = False
+    return _stream
+
+
+def configure_stream(sink, **kw) -> SpanStream:
+    """Install a live stream on ``sink`` (path or writer) as the
+    process-global stream."""
+    ds = SpanStream(sink, **kw)
+    set_stream(ds)
+    return ds
+
+
+def disable_stream() -> None:
+    global _stream, _auto_follow
+    with _config_lock:
+        old = _stream
+        _stream = _NULL_STREAM
+        _auto_follow = False
+    if old is not _NULL_STREAM:
+        old.close()
+
+
+_OFF_VALUES = {"", "0", "false", "no", "off"}
+_ON_VALUES = {"1", "true", "yes", "on"}
+
+
+def _max_bytes_from_env() -> Optional[int]:
+    raw = os.environ.get(TRACE_MAX_MB_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{TRACE_MAX_MB_ENV}={raw!r} is not a number (MB)")
+    if mb <= 0:
+        raise ValueError(f"{TRACE_MAX_MB_ENV}={raw!r} must be > 0")
+    return int(mb * 2 ** 20)
+
+
+def _configure_from_env(refresh: bool = False):
+    """Install the stream ``DEAR_TRACE`` asks for. Values are parsed
+    strictly — a value that is neither a boolean word nor path-shaped
+    raises (a typo'd knob silently tracing nothing is the failure mode
+    this refuses to have)."""
+    global _stream, _auto_follow
+    with _config_lock:
+        if not _auto_follow and not refresh:
+            return _stream
+        raw = os.environ.get(TRACE_ENV, "").strip()
+        low = raw.lower()
+        old = _stream
+        if low in _OFF_VALUES:
+            _stream = _NULL_STREAM
+        elif low in _ON_VALUES:
+            _stream = SpanStream(MemoryWriter())
+        elif "/" in raw or os.sep in raw or raw.endswith(".jsonl"):
+            _stream = SpanStream(raw, max_bytes=_max_bytes_from_env())
+        else:
+            raise ValueError(
+                f"{TRACE_ENV}={raw!r}: expected 0/1/true/false or a "
+                f".jsonl path (use '{{rank}}' for per-rank files)")
+        _auto_follow = True
+    if old is not _NULL_STREAM and old is not _stream:
+        old.close()
+    return _stream
+
+
+# ---------------------------------------------------------------------------
+# the collector (jax-free; runs wherever the .jsonl files are)
+# ---------------------------------------------------------------------------
+
+
+def read_stream(path: str) -> List[dict]:
+    """Parse one stream file tolerantly: blank/torn lines (a crashed
+    writer's last line) are skipped, not fatal — a fleet trace must
+    survive exactly the failures it exists to explain."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def merge_streams(sources: Iterable[Union[str, List[dict]]], *,
+                  clock_offsets: Optional[dict] = None) -> dict:
+    """Merge per-rank streams into one clock-aligned fleet timeline.
+
+    Each source is a stream path or an already-parsed record list. Per
+    rank, the wall-minus-monotonic offset is the median of that rank's
+    ``meta``/``clock`` samples (override per rank via ``clock_offsets``
+    — e.g. offsets carried on merged health digests); every span's
+    monotonic start then maps onto the shared wall clock. Returns
+    ``{"spans", "meta", "ranks", "t0", "clock_offsets"}`` with spans
+    sorted by aligned start and stamped with microsecond ``ts_us`` /
+    ``dur_us`` relative to the earliest span."""
+    streams = []
+    for src in sources:
+        recs = src if isinstance(src, list) else read_stream(src)
+        rank = None
+        offs: List[float] = []
+        for r in recs:
+            if rank is None and r.get("rank") is not None:
+                rank = r["rank"]
+            if r.get("kind") in ("meta", "clock") and "off" in r:
+                try:
+                    offs.append(float(r["off"]))
+                except (TypeError, ValueError):
+                    pass
+        if rank is None:
+            rank = f"stream-{len(streams)}"
+        streams.append((rank, recs, offs))
+
+    spans: List[dict] = []
+    metas: Dict[Any, dict] = {}
+    used_offsets: Dict[Any, float] = {}
+    for rank, recs, offs in streams:
+        if clock_offsets is not None and rank in clock_offsets:
+            off = float(clock_offsets[rank])
+        elif offs:
+            off = _median(offs)
+        else:
+            off = 0.0
+        used_offsets[rank] = off
+        for r in recs:
+            kind = r.get("kind")
+            if kind == "span":
+                s = dict(r)
+                s["rank"] = rank
+                s["t_wall"] = float(r.get("mono", 0.0)) + off
+                spans.append(s)
+            elif kind == "meta" and rank not in metas:
+                metas[rank] = r
+    spans.sort(key=lambda s: s["t_wall"])
+    t0 = spans[0]["t_wall"] if spans else 0.0
+    for s in spans:
+        s["ts_us"] = round((s["t_wall"] - t0) * 1e6, 3)
+        s["dur_us"] = round(float(s.get("dur", 0.0)) * 1e6, 3)
+    return {
+        "spans": spans,
+        "meta": metas,
+        "ranks": sorted(used_offsets, key=str),
+        "t0": t0,
+        "clock_offsets": used_offsets,
+    }
+
+
+#: Stable thread lanes per span category — every rank renders its step,
+#: compute, comm, serve and guard activity on the same tids, so eyeballs
+#: trained on one rank's row read every rank's row.
+_CAT_TID = {"step": 0, "compute": 1, "comm": 2, "serve": 3,
+            "guard": 4, "sched": 5}
+_OTHER_TID = 7
+
+
+def write_chrome_trace(merged: dict, path: str) -> int:
+    """Export a merged timeline as ONE Perfetto/chrome trace (stdlib
+    json only — `utils.chrome_trace` imports jax and is therefore
+    unusable on a collector box). Ranks become processes; categories
+    become stable thread lanes; env blocks are re-redacted at the exit
+    boundary. Returns the number of trace events written."""
+    red = _redaction()
+    pids: Dict[Any, int] = {}
+    for rank in merged.get("ranks", []):
+        pids[rank] = rank if isinstance(rank, int) else 10000 + len(pids)
+    events: List[dict] = []
+    for rank in merged.get("ranks", []):
+        events.append({"name": "process_name", "ph": "M", "pid": pids[rank],
+                       "tid": 0, "args": {"name": f"rank {rank}"}})
+    lanes = sorted(_CAT_TID.items(), key=lambda kv: kv[1])
+    for rank in merged.get("ranks", []):
+        for cat, tid in lanes:
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pids[rank], "tid": tid,
+                           "args": {"name": cat}})
+    for s in merged.get("spans", []):
+        cat = s.get("cat") or "span"
+        ev: Dict[str, Any] = {
+            "name": s.get("name", "span"), "cat": cat,
+            "pid": pids.get(s["rank"], _OTHER_TID),
+            "tid": _CAT_TID.get(cat, _OTHER_TID),
+            "ts": s["ts_us"],
+        }
+        if s.get("dur_us", 0) > 0:
+            ev["ph"] = "X"
+            ev["dur"] = s["dur_us"]
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        args: Dict[str, Any] = {}
+        if isinstance(s.get("trace"), dict):
+            args.update(s["trace"])
+        for k in ("step", "mem_epoch"):
+            if k in s:
+                args[k] = s[k]
+        if isinstance(s.get("attrs"), dict):
+            args.update(s["attrs"])
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    other: Dict[str, Any] = {"ranks": [str(r) for r in merged.get(
+        "ranks", [])]}
+    for rank, meta in sorted(merged.get("meta", {}).items(), key=str):
+        env = meta.get("env")
+        if isinstance(env, dict):
+            other[f"env_rank_{rank}"] = {
+                k: (red.REDACTED if red.is_sensitive_key(k) else v)
+                for k, v in env.items()}
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": other}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return len(events)
+
+
+_configure_from_env()

@@ -112,6 +112,80 @@ def render_telemetry(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def _opt_s(v: Optional[float]) -> str:
+    return "n/a" if v is None else f"{v * _MS:.2f} ms"
+
+
+def render_fleet_trace(attr: dict, *, max_steps: int = 8,
+                       max_requests: int = 8) -> str:
+    """Human-readable critical-path attribution over a merged fleet
+    timeline (`critical_path.critical_path` output): the fleet step-time
+    quantiles and exposed-comm fraction, the per-step straggler table,
+    and the per-request hop breakdown."""
+    steps = attr.get("steps") or {}
+    reqs = attr.get("requests") or {}
+    ssum = steps.get("summary") or {}
+    rsum = reqs.get("summary") or {}
+    lines = ["== fleet trace: critical path =="]
+    if ssum.get("n_steps"):
+        lines.append(
+            f"  steps: {ssum['n_steps']}   "
+            f"p50 {_opt_s(ssum.get('step_p50_s'))}   "
+            f"p99 {_opt_s(ssum.get('step_p99_s'))}   "
+            f"exposed-comm frac "
+            + ("n/a" if ssum.get("exposed_frac") is None
+               else f"{ssum['exposed_frac'] * 100:.1f}%")
+            + f"   rollbacks {ssum.get('rollbacks', 0)}")
+        hist = ssum.get("stragglers") or {}
+        if hist:
+            top = sorted(hist.items(), key=lambda kv: -kv[1])
+            lines.append("  stragglers: " + ", ".join(
+                f"rank {r} x{n}" for r, n in top[:6]))
+        lines.append(
+            "  epoch  step    step_s   straggler   exposed    hidden"
+            "   longest leg")
+        rows = steps.get("steps") or []
+        for row in rows[:max_steps]:
+            srank = row.get("straggler")
+            leg = (row.get("ranks") or {}).get(str(srank), {}) \
+                .get("longest_leg") or {}
+            lines.append(
+                f"  {row['mem_epoch']:>5}  {row['step']:>4}  "
+                f"{_opt_s(row.get('step_s')):>8}  {str(srank):>9}  "
+                f"{_opt_s(row.get('exposed_comm_s')):>8}  "
+                f"{_opt_s(row.get('hidden_comm_s')):>8}   "
+                + (f"{leg.get('name')} {_opt_s(leg.get('dur_s'))}"
+                   if leg else "n/a"))
+        if len(rows) > max_steps:
+            lines.append(f"  ... {len(rows) - max_steps} more steps")
+    if rsum.get("n_requests"):
+        lines.append(
+            f"  requests: {rsum['n_requests']}   "
+            f"service p50 {_opt_s(rsum.get('service_p50_s'))}   "
+            f"p99 {_opt_s(rsum.get('service_p99_s'))}   "
+            f"redispatched {rsum.get('redispatched', 0)}   "
+            f"multi-incarnation {rsum.get('multi_incarnation', 0)}")
+        lines.append(
+            "  request            service     queue   prefill    decode"
+            "  hops  incarnations")
+        rows = reqs.get("requests") or []
+        for r in rows[:max_requests]:
+            rid = str(r.get("request_id") or r.get("trace_id"))[:16]
+            lines.append(
+                f"  {rid:<16} {_opt_s(r.get('service_s')):>9} "
+                f"{_opt_s(r.get('queue_s')):>9} "
+                f"{_opt_s(r.get('prefill_s')):>9} "
+                f"{_opt_s(r.get('decode_s')):>9}  "
+                f"{len(r.get('hops') or []):>4}  "
+                f"{len(r.get('incarnations') or [])}"
+                + ("  (redispatched)" if r.get("redispatches") else ""))
+        if len(rows) > max_requests:
+            lines.append(f"  ... {len(rows) - max_requests} more requests")
+    if len(lines) == 1:
+        lines.append("  (no attributable spans in the timeline)")
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
 # entry point: world=N CPU-emulated audit of the schedule modes
 # ---------------------------------------------------------------------------

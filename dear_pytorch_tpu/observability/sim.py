@@ -57,7 +57,7 @@ from typing import (
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.observability.costmodel import (
-    Calibration, LinkFit, load_calibration,
+    Calibration, LinkFit, load_calibration, load_trace_calibration,
 )
 
 __all__ = [
@@ -292,7 +292,7 @@ def simulate_training(
     topo: SimTopology,
     *,
     mode: str = "dear",
-    compute_time_s: float = 0.030,
+    compute_time_s: Optional[float] = None,
     fwd_frac: float = 1.0 / 3.0,
     comm_itemsize: int = 4,
     gather_itemsize: Optional[int] = None,
@@ -302,11 +302,23 @@ def simulate_training(
     steps: Optional[int] = None,
     jitter: Optional[float] = None,
     seed: Optional[int] = None,
+    trace_calibration=None,
 ) -> dict:
     """Replay one (plan, mode, topology) combination: a discrete-event
     schedule of per-bucket collective legs against the backward/forward
     compute windows, repeated ``steps`` times with seeded multiplicative
     jitter for quantiles.
+
+    ``trace_calibration`` (a `costmodel.TraceCalibration`, dict, or
+    path) switches the per-step variability from the synthetic Gaussian
+    to a REPLAY of the recorded fleet's empirical scale distribution
+    (sampled with the seeded rng — determinism contract intact), and —
+    unless the caller pins ``compute_time_s`` explicitly — rebases the
+    compute window on the recorded p50 minus recorded exposed comm, so
+    the event model re-adds exposure instead of double-counting it.
+    `scripts/sim_check.py` gates that this replay reproduces the
+    recorded step-time p50/p99 while preserving the recorded A/B
+    rankings.
 
     Event model (docs/SIM.md states the caveats): backward emits bucket
     gradients in reverse bucket order at size-weighted offsets through
@@ -329,6 +341,19 @@ def simulate_training(
     jitter = default_jitter() if jitter is None else float(jitter)
     seed = default_seed() if seed is None else int(seed)
     rng = random.Random(seed)
+
+    compute_pinned = compute_time_s is not None
+    trace_scales: Optional[List[float]] = None
+    rebase_target: Optional[float] = None
+    if trace_calibration is not None:
+        cal = load_trace_calibration(trace_calibration)
+        trace_scales = [max(float(s), 0.05)
+                        for s in cal.compute_scale] or None
+        if not compute_pinned:
+            compute_time_s = cal.compute_time_s
+            rebase_target = float(cal.step_time_s.get("p50") or 0.0)
+    if compute_time_s is None:
+        compute_time_s = 0.030
 
     acct = CTR.plan_comm_accounting(
         plan, mode=mode, comm_itemsize=comm_itemsize,
@@ -403,16 +428,46 @@ def simulate_training(
         exposed = sum(e for _, e in rows_t.values())
         return (b + f + exposed, rows_t)
 
+    if rebase_target:
+        # Fixed point: the trace-derived compute base (recorded p50
+        # minus RECORDED exposure) meets an event model whose exposure
+        # for this (plan, topology) differs from the recorded run's —
+        # so adjust the base until the UNJITTERED simulated step lands
+        # on the recorded p50. The tail (p99) is then not fit at all:
+        # it must emerge from the replayed scale distribution, which is
+        # exactly what the sim_check parity gate verifies. step(base)
+        # is increasing in base, so the additive update converges.
+        for _ in range(8):
+            s1, _ = one_step(1.0)
+            err = rebase_target - s1
+            if abs(err) <= 1e-9:
+                break
+            compute_time_s = max(float(compute_time_s) + err, 1e-6)
+            bwd = float(compute_time_s) * (1.0 - float(fwd_frac))
+            fwd = float(compute_time_s) * float(fwd_frac)
+            acc = 0
+            for bi in order:
+                acc += sizes[bi]
+                ready[bi] = bwd * acc / total
+
     samples = []
     base_rows = None
+    jittered = bool(trace_scales) or jitter != 0.0
     for k in range(max(steps, 1)):
-        scale = max(1.0 + rng.gauss(0.0, jitter), 0.05) if jitter else 1.0
+        if trace_scales:
+            # trace replay: sample the recorded empirical distribution
+            # (seeded rng instance — the determinism rule allows it)
+            scale = trace_scales[rng.randrange(len(trace_scales))]
+        elif jitter:
+            scale = max(1.0 + rng.gauss(0.0, jitter), 0.05)
+        else:
+            scale = 1.0
         t, rows_t = one_step(scale)
         samples.append(t)
-        if k == 0 or (jitter == 0.0):
+        if k == 0 or not jittered:
             base_rows = rows_t
     # the reported per-leg split comes from the UNJITTERED schedule
-    if jitter:
+    if jittered:
         _, base_rows = one_step(1.0)
 
     comm = sum(_price_row_topo(r, topo, acct.world) for r in acct.rows)
@@ -452,6 +507,7 @@ def simulate_training(
         "step_time_s": measured,
         "steps": steps,
         "seed": seed,
+        "jitter_model": "trace-replay" if trace_scales else "gaussian",
         "wire_bytes_per_step": acct.wire_bytes_per_step,
         "payload_bytes_per_step": acct.payload_bytes_per_step,
         "topology": topo.to_dict(),
@@ -1435,8 +1491,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="comma-separated layer element counts")
     t.add_argument("--threshold-mb", type=float, default=4.0)
     t.add_argument("--partition-mb", type=float, default=None)
-    t.add_argument("--compute-ms", type=float, default=30.0)
+    t.add_argument("--compute-ms", type=float, default=None,
+                   help="compute window in ms (default 30, or the "
+                        "recorded base under --trace-calibration)")
     t.add_argument("--steps", type=int, default=None)
+    t.add_argument("--trace-calibration", default=None,
+                   help="recorded TraceCalibration JSON (file path or "
+                        "inline; e.g. perf/trace_r19/calibration.json) "
+                        "— replay empirical jitter instead of Gaussian")
 
     s = sub.add_parser("serve", help="replay a serving fleet")
     s.add_argument("--rps", type=float, default=500.0)
@@ -1477,8 +1539,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                               threshold_mb=args.threshold_mb)
         out = simulate_training(
             plan, topo, mode=args.mode,
-            compute_time_s=args.compute_ms * 1e-3,
-            partition_mb=args.partition_mb, steps=args.steps, seed=seed)
+            compute_time_s=(None if args.compute_ms is None
+                            else args.compute_ms * 1e-3),
+            partition_mb=args.partition_mb, steps=args.steps, seed=seed,
+            trace_calibration=args.trace_calibration)
     elif args.cmd == "serve":
         trace = TrafficTrace.poisson(
             rps=args.rps, duration_s=args.duration_s,

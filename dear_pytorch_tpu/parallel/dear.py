@@ -92,6 +92,7 @@ Baseline schedules (same builder, ``mode=``):
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, NamedTuple, Optional, Sequence
 
 import jax
@@ -103,6 +104,7 @@ from dear_pytorch_tpu.comm import backend
 from dear_pytorch_tpu.comm import collectives as C
 from dear_pytorch_tpu.comm.backend import DP_AXIS
 from dear_pytorch_tpu.observability import counters as _tel_counters
+from dear_pytorch_tpu.observability import dtrace as _dtrace
 from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.ops import collective_matmul as CM
 from dear_pytorch_tpu.ops import compression as Z
@@ -1200,6 +1202,8 @@ def build_train_step(
         # step number read from the INPUT state (ready before dispatch):
         # it keys both the exchange and the cross-iteration prefetch
         step_no = int(np.asarray(jax.device_get(state.step)))
+        ds = _dtrace.get_stream()
+        t_bwd = time.monotonic() if ds.enabled else 0.0
         grads_g, loss_sl = _hier_grads_jitted(state, batch)(state, batch)
         # bounded-stale mode only (no-op otherwise): start pulling the
         # peers' partials for THIS step while our backward is still
@@ -1212,6 +1216,15 @@ def build_train_step(
         host = [np.asarray(jax.device_get(g)) for g in grads_g]
         losses = np.asarray(jax.device_get(loss_sl),
                             np.float64).reshape(-1)
+        if ds.enabled:
+            # the device_get above IS the backward program's wall time
+            # (the host leg synchronizes on it) — a compute span on the
+            # step trace, so the critical-path analysis attributes the
+            # DCN round's exposure against real backward overlap
+            ds.emit("dear.backward", t0=t_bwd,
+                    dur_s=time.monotonic() - t_bwd, cat="compute",
+                    trace=_dtrace.step_trace(dcn.epoch, step_no),
+                    step=step_no, mem_epoch=dcn.epoch)
         per_slice = {
             sid: [host[g][k * padded[g]:(k + 1) * padded[g]]
                   for g in range(len(padded))]
@@ -1224,28 +1237,53 @@ def build_train_step(
         sh = jax.sharding.NamedSharding(mesh, jax.P(axis_name))
         reduced = tuple(jax.device_put(m, sh) for m in means)
         loss_dev = jnp.float32(loss_mean)
-        return _hier_apply_jitted(state, reduced, loss_dev)(
+        t_apply = time.monotonic() if ds.enabled else 0.0
+        out = _hier_apply_jitted(state, reduced, loss_dev)(
             state, reduced, loss_dev)
+        if ds.enabled:
+            # update-program dispatch (async: the device work may drain
+            # into the NEXT step's backward; the span records the host
+            # cost, which is what this schedule's critical path sees)
+            ds.emit("dear.apply", t0=t_apply,
+                    dur_s=time.monotonic() - t_apply, cat="compute",
+                    trace=_dtrace.step_trace(dcn.epoch, step_no),
+                    step=step_no, mem_epoch=dcn.epoch)
+        return out
 
     def step(state: DearState, batch):
         tr = _telemetry.get_tracer()
-        if not tr.enabled:
+        ds = _dtrace.get_stream()
+        if not tr.enabled and not ds.enabled:
             if dcn is not None:
                 return _hier_step(state, batch)
             return _jitted(state, batch)(state, batch)
-        tr.count("dear.steps")
-        for leg, nbytes in _leg_bytes.items():
-            tr.count(f"dear.{leg}_bytes", nbytes)
-        if fused:
-            # per-step Pallas ring-kernel launch accounting (one fused
-            # RS+update and one ring all-gather per bucket per step) — the
-            # overlap auditor joins these with the static leg bytes above
-            tr.count("kernel.fused_rs_launches", plan.num_buckets)
-            tr.count("kernel.ring_ag_launches", plan.num_buckets)
+        if tr.enabled:
+            tr.count("dear.steps")
+            for leg, nbytes in _leg_bytes.items():
+                tr.count(f"dear.{leg}_bytes", nbytes)
+            if fused:
+                # per-step Pallas ring-kernel launch accounting (one fused
+                # RS+update and one ring all-gather per bucket per step) —
+                # the overlap auditor joins these with the static leg
+                # bytes above
+                tr.count("kernel.fused_rs_launches", plan.num_buckets)
+                tr.count("kernel.ring_ag_launches", plan.num_buckets)
         with tr.span("dear.step", mode=mode):
             if dcn is not None:
+                # no covering stream span here: the hierarchical step's
+                # DCN leg is genuinely exposed comm, and a wrapping
+                # compute span would mark it hidden in the critical-path
+                # analysis (_hier_step emits backward/apply itself)
                 return _hier_step(state, batch)
-            return _jitted(state, batch)(state, batch)
+            if not ds.enabled:
+                return _jitted(state, batch)(state, batch)
+            t0 = time.monotonic()
+            out = _jitted(state, batch)(state, batch)
+            # single-program schedule: in-graph RS/AG overlaps inside
+            # this one dispatch, so the whole step is the compute row
+            ds.emit("dear.step", t0=t0, dur_s=time.monotonic() - t0,
+                    cat="compute", mode=mode)
+            return out
 
     def lower(state: DearState, batch):
         if dcn is not None:

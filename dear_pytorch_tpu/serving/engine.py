@@ -56,7 +56,11 @@ scripts/check_telemetry_overhead.py). Per-phase wall latencies are
 always accounted (plain floats — they feed the admission controller's
 split prefill/decode estimates) and exported as quantile gauges through
 `phase_gauges` (``serve.prefill_ms_*`` / ``serve.decode_tick_ms_*``,
-docs/OBSERVABILITY.md).
+docs/OBSERVABILITY.md). Under ``DEAR_TRACE`` each tick additionally
+lands one span on the fleet trace stream (same disabled-gate budget),
+and the request's propagated trace context (`observability.dtrace`)
+rides the slot from `submit` to `FinishedRequest` untouched — the
+engine is one hop in the router -> replica -> engine trace.
 """
 
 from __future__ import annotations
@@ -69,6 +73,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.observability import dtrace as _dtrace
 
 __all__ = ["DecodeEngine", "FinishedRequest"]
 
@@ -86,13 +91,14 @@ class FinishedRequest:
     steps: int                 # engine ticks this request was live for
     prefill_s: float = 0.0     # wall seconds attributed to prefill ticks
     decode_s: float = 0.0      # wall seconds attributed to decode ticks
+    trace: Optional[dict] = None  # propagated trace context, verbatim
 
 
 class _Slot:
     __slots__ = ("req_id", "prompt", "max_new", "eos_id", "fed",
-                 "generated", "ticks", "prefill_s", "decode_s")
+                 "generated", "ticks", "prefill_s", "decode_s", "trace")
 
-    def __init__(self, req_id, prompt, max_new, eos_id):
+    def __init__(self, req_id, prompt, max_new, eos_id, trace=None):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new = int(max_new)
@@ -102,6 +108,7 @@ class _Slot:
         self.ticks = 0
         self.prefill_s = 0.0
         self.decode_s = 0.0
+        self.trace = trace
 
     def next_token(self) -> int:
         if self.fed < len(self.prompt):
@@ -262,10 +269,11 @@ class DecodeEngine:
         return self.slots - self.active
 
     def submit(self, prompt, max_new_tokens: int,
-               request_id=None) -> Optional[int]:
+               request_id=None, trace=None) -> Optional[int]:
         """Assign a request to a free slot (None when the batch is full —
         admission control lives ABOVE the engine, `serving.admission`).
-        Returns the slot index."""
+        ``trace`` is the request's propagated trace-context dict, carried
+        to the `FinishedRequest` untouched. Returns the slot index."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -281,7 +289,7 @@ class DecodeEngine:
                 # from the position, so the previous occupant's entries
                 # are invalid without any reset pass
                 self._slots[b] = _Slot(request_id, prompt, max_new_tokens,
-                                       self.eos_id)
+                                       self.eos_id, trace=trace)
                 return b
         return None
 
@@ -358,6 +366,10 @@ class DecodeEngine:
         tr = _telemetry.get_tracer()
         if tr.enabled:
             tr.count("serve.prefill_steps")
+        ds = _dtrace.get_stream()
+        if ds.enabled:
+            ds.emit("serve.prefill_tick", t0=t0, dur_s=dt, cat="serve",
+                    active=self.active)
         finished: List[FinishedRequest] = []
         for b, s in enumerate(self._slots):
             if s is None:
@@ -404,6 +416,10 @@ class DecodeEngine:
         tr = _telemetry.get_tracer()
         if tr.enabled:
             tr.count("serve.decode_steps")
+        ds = _dtrace.get_stream()
+        if ds.enabled:
+            ds.emit("serve.decode_tick", t0=t0, dur_s=dt, cat="serve",
+                    active=self.active)
         finished: List[FinishedRequest] = []
         for b, s in enumerate(self._slots):
             if s is None:
@@ -431,4 +447,5 @@ class DecodeEngine:
         self._slots[b] = None
         return FinishedRequest(s.req_id, s.prompt, s.generated, s.ticks,
                                prefill_s=round(s.prefill_s, 6),
-                               decode_s=round(s.decode_s, 6))
+                               decode_s=round(s.decode_s, 6),
+                               trace=s.trace)

@@ -28,7 +28,14 @@ Lifecycle under the fleet substrate (`launch/supervisor.py`):
     the router's checksum catches it).
 
 Telemetry: ``serve.replica_served`` per response written (two-lookup
-disabled gate, scripts/check_telemetry_overhead.py).
+disabled gate, scripts/check_telemetry_overhead.py). Under
+``DEAR_TRACE`` the replica is one hop of the request trace
+(`observability.dtrace`): consuming an inbox record opens a child
+context of the router's stamped trace (the incarnation is a span
+attribute — a redispatched request's timeline shows exactly which life
+served it), the context rides the engine slot, and the response carries
+it back in the unsigned extras; the heartbeat doubles as the stream's
+clock-offset sampling cadence.
 """
 
 from __future__ import annotations
@@ -39,6 +46,7 @@ import time
 from typing import Optional
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.observability import dtrace as _dtrace
 from dear_pytorch_tpu.serving.router import (
     REPLICAS_SUBDIR, RESPONSES_SUBDIR, response_sha256,
 )
@@ -123,6 +131,11 @@ class ReplicaServer:
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, path)
+        ds = _dtrace.get_stream()
+        if ds.enabled:
+            # the heartbeat is the serving side's health cadence — the
+            # collector clock-aligns this replica's stream off these
+            ds.clock_sample()
 
     # -- request plumbing ----------------------------------------------------
 
@@ -155,13 +168,23 @@ class ReplicaServer:
             if not isinstance(rec, dict) or rec.get("id") is None:
                 continue  # not a router record; nothing to answer
             self.consumed += 1
+            # the router's stamped trace context: this consume is a new
+            # hop (child span), so a redispatched request's timeline
+            # shows every incarnation that touched it
+            ctx = _dtrace.TraceContext.from_dict(rec.get("trace"))
+            hop = ctx.child().to_dict() if ctx is not None else None
+            ds = _dtrace.get_stream()
+            if ds.enabled and hop is not None:
+                ds.emit("serve.replica_consume", cat="serve", trace=hop,
+                        request_id=rec["id"], replica=self.rank,
+                        incarnation=self.incarnation)
             if self.injector is not None:
                 # slow/hang/exc/preempt land here, once per request
                 self.injector.before_step(self.consumed)
             try:
                 self.engine.submit(rec.get("prompt") or [],
                                    rec.get("max_new_tokens", 0),
-                                   request_id=rec["id"])
+                                   request_id=rec["id"], trace=hop)
             except Exception as exc:  # noqa: BLE001 — a poison request
                 # (empty prompt, position-budget violation, malformed
                 # record) must NOT crash the replica: the router would
@@ -171,7 +194,8 @@ class ReplicaServer:
                 # verified response" — a signed error response IS that
                 # response.
                 self._write_payload(rec["id"], [],
-                                    error=f"{type(exc).__name__}: {exc}")
+                                    error=f"{type(exc).__name__}: {exc}",
+                                    trace=hop)
                 continue
             taken += 1
         return taken
@@ -180,7 +204,8 @@ class ReplicaServer:
         self._write_payload(fin.request_id,
                             [int(t) for t in fin.tokens],
                             prefill_s=getattr(fin, "prefill_s", None),
-                            decode_s=getattr(fin, "decode_s", None))
+                            decode_s=getattr(fin, "decode_s", None),
+                            trace=getattr(fin, "trace", None))
         if self.feedback is not None:
             # implicit-accept feedback signal: a production surface would
             # carry real user labels; the loop's plumbing is identical
@@ -194,7 +219,8 @@ class ReplicaServer:
     def _write_payload(self, request_id, tokens, *,
                        error: Optional[str] = None,
                        prefill_s: Optional[float] = None,
-                       decode_s: Optional[float] = None) -> None:
+                       decode_s: Optional[float] = None,
+                       trace: Optional[dict] = None) -> None:
         payload = {
             "id": request_id,
             "tokens": tokens,
@@ -213,6 +239,12 @@ class ReplicaServer:
         # like the phase seconds: outside the signed fields, consumed by
         # the router's canary controller as the per-version quality gauge
         payload["quality"] = self.quality
+        # the propagated trace context rides back in the unsigned extras
+        # (the signature predates tracing; a trace-less verifier still
+        # verifies) so the router can close the request span on the SAME
+        # trace even across a redispatch
+        if trace is not None:
+            payload["trace"] = trace
         payload["sha256"] = response_sha256(payload)
         data = json.dumps(payload).encode()
         if self.injector is not None:
@@ -227,6 +259,14 @@ class ReplicaServer:
         tr = _telemetry.get_tracer()
         if tr.enabled:
             tr.count("serve.replica_served")
+        ds = _dtrace.get_stream()
+        if ds.enabled:
+            ds.emit("serve.replica_serve", cat="serve",
+                    dur_s=float((prefill_s or 0.0) + (decode_s or 0.0)),
+                    trace=trace, request_id=request_id,
+                    replica=self.rank, incarnation=self.incarnation,
+                    prefill_s=prefill_s, decode_s=decode_s,
+                    error=bool(error))
 
     def _inbox_empty(self) -> bool:
         try:

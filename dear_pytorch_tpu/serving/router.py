@@ -74,6 +74,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from dear_pytorch_tpu.observability import tracer as _telemetry
+from dear_pytorch_tpu.observability import dtrace as _dtrace
 from dear_pytorch_tpu.serving.admission import AdmissionController
 
 __all__ = ["ReplicaRouter", "CanaryController", "response_sha256",
@@ -232,6 +233,9 @@ class ReplicaRouter:
         self.corrupt_responses = 0
         self.weight_swaps = 0
         self.latencies_s: List[float] = []
+        # redispatch hops recorded under the lock, emitted to the trace
+        # stream after it is released (_reclaim_locked may not do I/O)
+        self._trace_hops: List[dict] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -275,6 +279,13 @@ class ReplicaRouter:
             "max_new_tokens": int(max_new_tokens),
             "deadline_ts": (None if deadline_s is None
                             else now_wall + float(deadline_s)),
+            # the request's trace identity, stamped at birth: every
+            # dispatch file carries it, every hop (replica consume,
+            # redispatch after a death) is a child span of it, and the
+            # response hands it back — one timeline per request
+            # (observability/dtrace.py), regardless of how many
+            # incarnations it crossed
+            "trace": _dtrace.new_trace().to_dict(),
         }
         pend = _Pending(record, time.monotonic(), record["deadline_ts"])
         with self._lock:
@@ -374,6 +385,18 @@ class ReplicaRouter:
                 tr.count("serve.redispatched", len(stale))
                 tr.event("serve.redispatch", replica=rep.rank,
                          requests=len(stale), why=why)
+            ds = _dtrace.get_stream()
+            if ds.enabled:
+                # the lock is held: record each request's incarnation
+                # hop now, emit the spans once the caller releases it
+                for rid in stale:
+                    ctx = _dtrace.TraceContext.from_dict(
+                        self._requests[rid].record.get("trace"))
+                    if ctx is not None:
+                        self._trace_hops.append({
+                            "trace": ctx.child().to_dict(),
+                            "request_id": rid, "replica": rep.rank,
+                            "why": why, "incarnation": rep.incarnation})
 
     def _scan_health(self) -> None:
         try:
@@ -442,6 +465,16 @@ class ReplicaRouter:
                 self.slots_per_replica for r in self._replicas.values()
                 if r.healthy and not r.draining)
             self.admission.set_capacity(max(live_slots, 1))
+        ds = _dtrace.get_stream()
+        if ds.enabled:
+            with self._lock:
+                hops, self._trace_hops = self._trace_hops, []
+            for hop in hops:
+                # the redispatch hop as a span: the request's trace now
+                # shows the incarnation boundary it survived
+                ds.emit("serve.redispatch_hop", cat="serve", **hop)
+            if hops and tr.enabled:
+                tr.count("trace.request_hops", len(hops))
 
     def _canary_filter_locked(self, targets: list) -> list:
         """Apply canary routing to a non-empty dispatch target list;
@@ -494,6 +527,13 @@ class ReplicaRouter:
                 with open(tmp, "w") as f:
                     json.dump(record, f)
                 os.replace(tmp, path)
+                ds = _dtrace.get_stream()
+                if ds.enabled:
+                    ctx = _dtrace.TraceContext.from_dict(
+                        record.get("trace"))
+                    ds.emit("serve.dispatch", cat="serve",
+                            trace=ctx.child() if ctx is not None else None,
+                            request_id=rid, replica=rep.rank)
             except OSError:
                 # undo the assignment so the request is not stranded
                 # in-flight with no inbox file behind it
@@ -607,6 +647,20 @@ class ReplicaRouter:
                             logging.getLogger(
                                 "dear_pytorch_tpu").exception(
                                 "router: on_canary hook failed")
+            ds = _dtrace.get_stream()
+            if ds.enabled:
+                # close the request's end-to-end span on the ROOT
+                # context (hops — dispatch, consume, redispatch, serve —
+                # are its children); dur is router-observed service time
+                ctx = _dtrace.TraceContext.from_dict(
+                    pend.record.get("trace"))
+                if ctx is not None:
+                    ds.emit("serve.request", cat="serve",
+                            t0=pend.submitted_t, dur_s=service_s,
+                            trace=ctx, request_id=rid,
+                            replica=doc.get("replica"),
+                            missed_deadline=bool(missed),
+                            error=("error" in doc))
             pend.response = doc
             pend.event.set()
             try:

@@ -88,6 +88,7 @@ import jax
 
 from dear_pytorch_tpu.observability import aggregate as _aggregate
 from dear_pytorch_tpu.observability import anomaly as _anomaly
+from dear_pytorch_tpu.observability import dtrace as _dtrace
 from dear_pytorch_tpu.observability import export as _export
 from dear_pytorch_tpu.observability import flight as _flight
 from dear_pytorch_tpu.observability import tracer as _telemetry
@@ -632,6 +633,12 @@ class GuardedTrainer:
         """Per-check-interval run-health work: feed the anomaly detectors
         and push the current snapshot to any streaming exporters. Host-
         side only and O(#counters) — stays off the dispatch path."""
+        ds = _dtrace.get_stream()
+        if ds.enabled:
+            # the lockstep health cadence doubles as the span stream's
+            # wall-vs-monotonic sampling point: the collector medians
+            # these per rank to clock-align the merged fleet timeline
+            ds.clock_sample()
         if self._anomaly is not None:
             self._anomaly.observe(
                 step=self.steps_seen, step_time_s=per_step_s,
@@ -665,6 +672,8 @@ class GuardedTrainer:
         every rank has to reach the consensus sync at the same attempt
         number, so the steps_seen/is_check arithmetic cannot be allowed
         to diverge between the two call sites."""
+        ds = _dtrace.get_stream()
+        t0 = time.monotonic() if ds.enabled else 0.0
         new_state, metrics = self.ts.step(state, batch)
         self.steps_seen += 1
         is_ckpt = self.steps_seen % self.checkpoint_every == 0
@@ -675,6 +684,19 @@ class GuardedTrainer:
         healthy = not is_check or self._check(metrics)
         if is_check and not healthy and tr.enabled:
             tr.count("guard.nan_detected")
+        if ds.enabled:
+            # one "guard.step" span per attempt, on the deterministic
+            # (mem_epoch, step) fleet step trace — the same id every
+            # rank computes without coordination, so the collector can
+            # line the attempt up with its DCN round and ICI legs
+            ds.emit("guard.step", t0=t0,
+                    dur_s=time.monotonic() - t0, cat="step",
+                    trace=_dtrace.step_trace(self._mem_epoch,
+                                             self.steps_seen),
+                    step=self.steps_seen, mem_epoch=self._mem_epoch,
+                    checked=is_check, healthy=healthy)
+            if tr.enabled:
+                tr.count("trace.step_spans")
         return new_state, metrics, is_ckpt, is_check, healthy
 
     # -- public --------------------------------------------------------------
@@ -982,6 +1004,16 @@ class GuardedTrainer:
                 tr.count("guard.steps_skipped")  # the bad batch is skipped
                 tr.event("guard.rollback", recoveries=self.recoveries,
                          restored_step=at_step)
+            ds = _dtrace.get_stream()
+            if ds.enabled:
+                # the rollback rides the failed attempt's step trace so
+                # the fleet timeline shows verdict -> restore in one chain
+                ds.emit("guard.rollback", cat="step",
+                        trace=_dtrace.step_trace(self._mem_epoch,
+                                                 self.steps_seen),
+                        step=self.steps_seen, mem_epoch=self._mem_epoch,
+                        restored_step=at_step,
+                        recoveries=self.recoveries)
             if self.on_rollback is not None:
                 self.on_rollback(self.recoveries, at_step)
             if self._watchdog is not None:

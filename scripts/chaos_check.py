@@ -372,6 +372,7 @@ def run_procs(nprocs: int, steps: int, checkpoint_every: int,
     for pid in range(nprocs):
         env = dict(os.environ)
         env.pop("DEAR_DISABLE_DISTRIBUTED", None)
+        env.pop("DEAR_TRACE_RANK", None)
         env.pop("DEAR_NUM_CPU_DEVICES", None)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env["JAX_PLATFORMS"] = "cpu"
@@ -568,6 +569,9 @@ def run_elastic(nprocs: int, checkpoint_every: int,
 
     env = dict(os.environ)
     env.pop("DEAR_NUM_CPU_DEVICES", None)
+    # the parent's trace identity must not leak into the fleet: each
+    # worker's span stream keys off its own DEAR_ELASTIC_RANK
+    env.pop("DEAR_TRACE_RANK", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["DEAR_DISABLE_DISTRIBUTED"] = "1"
@@ -941,6 +945,9 @@ def run_autoscale(checkpoint_every: int, workdir: str | None) -> dict:
     kill_rank, drain_rank, target_epoch, post = 1, 0, 5, 3
     env = dict(os.environ)
     env.pop("DEAR_NUM_CPU_DEVICES", None)
+    # the parent's trace identity must not leak into the fleet: each
+    # worker's span stream keys off its own DEAR_ELASTIC_RANK
+    env.pop("DEAR_TRACE_RANK", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["DEAR_DISABLE_DISTRIBUTED"] = "1"
@@ -1388,6 +1395,9 @@ def run_multislice(checkpoint_every: int, workdir: str | None) -> dict:
     victims = list(range(kill_slice * rps, (kill_slice + 1) * rps))
     env = dict(os.environ)
     env.pop("DEAR_NUM_CPU_DEVICES", None)
+    # the parent's trace identity must not leak into the fleet: each
+    # worker's span stream keys off its own DEAR_ELASTIC_RANK
+    env.pop("DEAR_TRACE_RANK", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["DEAR_DISABLE_DISTRIBUTED"] = "1"
@@ -1572,6 +1582,9 @@ def run_multislice_flap(checkpoint_every: int, workdir: str | None) -> dict:
     flap_slice = 1
     env = dict(os.environ)
     env.pop("DEAR_NUM_CPU_DEVICES", None)
+    # the parent's trace identity must not leak into the fleet: each
+    # worker's span stream keys off its own DEAR_ELASTIC_RANK
+    env.pop("DEAR_TRACE_RANK", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["DEAR_DISABLE_DISTRIBUTED"] = "1"
@@ -1708,6 +1721,9 @@ def run_multislice_degraded(checkpoint_every: int,
     victims = list(range(part_slice * rps, (part_slice + 1) * rps))
     env = dict(os.environ)
     env.pop("DEAR_NUM_CPU_DEVICES", None)
+    # the parent's trace identity must not leak into the fleet: each
+    # worker's span stream keys off its own DEAR_ELASTIC_RANK
+    env.pop("DEAR_TRACE_RANK", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["DEAR_DISABLE_DISTRIBUTED"] = "1"
@@ -2011,6 +2027,9 @@ def run_serve(workdir: str | None) -> dict:  # noqa: C901 — one storm, on
     kill_rank = 1
     env = dict(os.environ)
     env.pop("DEAR_NUM_CPU_DEVICES", None)
+    # the parent's trace identity must not leak into the fleet: each
+    # worker's span stream keys off its own DEAR_ELASTIC_RANK
+    env.pop("DEAR_TRACE_RANK", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["DEAR_DISABLE_DISTRIBUTED"] = "1"
@@ -2679,6 +2698,9 @@ def run_online(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C90
     target_versions = 5
     env = dict(os.environ)
     env.pop("DEAR_NUM_CPU_DEVICES", None)
+    # the parent's trace identity must not leak into the fleet: each
+    # worker's span stream keys off its own DEAR_ELASTIC_RANK
+    env.pop("DEAR_TRACE_RANK", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["DEAR_DISABLE_DISTRIBUTED"] = "1"

@@ -270,6 +270,46 @@ def main(argv=None) -> int:
             tr.count("dcn.chunk_rejects")
             tr.event("dcn.chunk_reject", slice=1, bucket=0, chunk=0)
 
+    # fleet-trace span-stream gates (observability/dtrace.py), the two
+    # hot-path call-site shapes: the engine-tick emission the way
+    # serving/engine.py's prefill/decode ticks run it, and the DCN-round
+    # shape the way comm/dcn.py's exchange runs it — the latter also
+    # builds the deterministic step-trace context + wire header inside
+    # the gate, so the DISABLED shape must still be the standard two
+    # lookups (no context construction, no clock read). dtrace.py is
+    # stdlib-only at module level, same standalone-load contract as the
+    # tracer and flight recorder.
+    DT = load_standalone("_telemetry_dtrace", "dtrace.py")
+    DT.set_stream(DT.NullStream())
+
+    def trace_tick_disabled_gate():
+        ds = DT.get_stream()
+        if ds.enabled:  # pragma: no cover - disabled branch
+            ds.emit("serve.decode_tick", dur_s=1e-3, cat="serve",
+                    batch=4)
+
+    def trace_dcn_disabled_gate():
+        ds = DT.get_stream()
+        if ds.enabled:  # pragma: no cover - disabled branch
+            ctx = DT.step_trace(0, 1)
+            ds.emit("dcn.round", dur_s=1e-3, cat="comm", trace=ctx,
+                    step=1, mem_epoch=0, included=2, world=2)
+
+    live_ds = DT.SpanStream(DT.MemoryWriter(), rank=0)
+
+    def trace_tick_enabled_site():
+        ds = live_ds
+        if ds.enabled:
+            ds.emit("serve.decode_tick", dur_s=1e-3, cat="serve",
+                    batch=4)
+
+    def trace_dcn_enabled_site():
+        ds = live_ds
+        if ds.enabled:
+            ctx = DT.step_trace(0, 1)
+            ds.emit("dcn.round", dur_s=1e-3, cat="comm", trace=ctx,
+                    step=1, mem_epoch=0, included=2, world=2)
+
     # plan-tuner decision-loop gate, the way tuning/autotune.py's step
     # path runs it once the search has FINISHED (or never started): the
     # per-step cost must be one attribute check + return — the tuner
@@ -319,6 +359,12 @@ def main(argv=None) -> int:
     dj_disabled_ns = _bench(dcn_reject_disabled_gate, args.iters)
     dj_enabled_ns = _bench(dcn_reject_enabled_site,
                            max(args.iters // 10, 1))
+    tt_disabled_ns = _bench(trace_tick_disabled_gate, args.iters)
+    tt_enabled_ns = _bench(trace_tick_enabled_site,
+                           max(args.iters // 10, 1))
+    td_disabled_ns = _bench(trace_dcn_disabled_gate, args.iters)
+    td_enabled_ns = _bench(trace_dcn_enabled_site,
+                           max(args.iters // 10, 1))
     tuner_finished_ns = _bench(plan_tuner_finished_gate, args.iters)
     overhead_ns = max(disabled_ns - baseline_ns, 0.0)
 
@@ -360,6 +406,10 @@ def main(argv=None) -> int:
         "dcn_round_enabled_ns_per_call": round(dr_enabled_ns, 1),
         "dcn_reject_disabled_ns_per_call": round(dj_disabled_ns, 1),
         "dcn_reject_enabled_ns_per_call": round(dj_enabled_ns, 1),
+        "trace_tick_disabled_ns_per_call": round(tt_disabled_ns, 1),
+        "trace_tick_enabled_ns_per_call": round(tt_enabled_ns, 1),
+        "trace_dcn_disabled_ns_per_call": round(td_disabled_ns, 1),
+        "trace_dcn_enabled_ns_per_call": round(td_enabled_ns, 1),
         "tuner_finished_ns_per_call": round(tuner_finished_ns, 1),
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
@@ -377,6 +427,8 @@ def main(argv=None) -> int:
                and cn_disabled_ns <= args.budget_ns
                and dr_disabled_ns <= args.budget_ns
                and dj_disabled_ns <= args.budget_ns
+               and tt_disabled_ns <= args.budget_ns
+               and td_disabled_ns <= args.budget_ns
                and tuner_finished_ns <= args.budget_ns),
     }
     print(json.dumps(out))

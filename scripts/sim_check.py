@@ -317,6 +317,65 @@ def check_degraded_dcn(sim, checks):
     return None
 
 
+def check_trace_calibration(sim, checks, skips):
+    """perf/trace_r19: the fleet-trace calibration harvested from the
+    recorded --multislice chaos storm (scripts/fleet_trace.py
+    --calibration).  Two obligations, one per half of the replay
+    contract:
+
+    - parity: replaying the recorded compute-scale distribution
+      (`trace_calibration=` with compute unpinned, so the fixed-point
+      rebase runs) must land the simulated step p50 within 10% of the
+      recorded p50 and the p99 within [0.5x, 1.5x] of the recorded p99.
+      The recorded tail includes the storm's kill/stall steps — wide on
+      purpose; the p50 band is the tight one.
+    - ranking: the same replay with compute PINNED (no rebase — rebase
+      deliberately forces every mode onto the recorded p50, which
+      erases A/B structure) must preserve dear < allreduce on the mean.
+
+    Seed is pinned: the scale distribution is 10 samples with a heavy
+    rollback mass, so an unlucky resample can move the sim median into
+    the tail (seen at seed 4) — the gate verifies the replay mechanism,
+    not resampling luck."""
+    cal_path = os.path.join(REPO, "perf", "trace_r19", "calibration.json")
+    rec = _load_json(cal_path)
+    if rec is None:
+        skips.append({"name": "trace_calibration_r19",
+                      "reason": "missing perf/trace_r19/calibration.json"})
+        return None
+    try:
+        rec_p50 = float(rec["step_time_s"]["p50"])
+        rec_p99 = float(rec["step_time_s"]["p99"])
+        rec_n = int(rec["n_steps"])
+    except (KeyError, TypeError, ValueError):
+        return "perf/trace_r19/calibration.json malformed"
+    rec_ok = rec_n >= 4 and 0.0 < rec_p50 <= rec_p99
+
+    plan = sim.synthetic_plan(BERT_LAYERS, WORLD)
+    topo = sim.SimTopology(num_slices=1, chips_per_slice=WORLD)
+    rep = sim.simulate_training(plan, topo, mode="dear", steps=400,
+                                seed=0, trace_calibration=cal_path)
+    q = rep["quantiles"]
+    parity_ok = (rep["jitter_model"] == "trace-replay"
+                 and abs(q["p50"] - rec_p50) <= 0.10 * rec_p50
+                 and 0.5 * rec_p99 <= q["p99"] <= 1.5 * rec_p99)
+    t = {m: sim.simulate_training(plan, topo, mode=m, steps=400, seed=0,
+                                  compute_time_s=COMPUTE_S,
+                                  trace_calibration=cal_path)
+         ["step_time_s"]
+         for m in ("dear", "allreduce")}
+    rank_ok = t["dear"] < t["allreduce"]
+    checks.append({
+        "name": "trace_calibration_r19",
+        "recorded_step_s": {"p50": rec_p50, "p99": rec_p99, "n": rec_n},
+        "simulated_step_s": {"p50": q["p50"], "p99": q["p99"],
+                             "n": q["n"]},
+        "pinned_mean_s": t,
+        "ok": bool(rec_ok and parity_ok and rank_ok),
+    })
+    return None
+
+
 def check_storm(sim, checks, budget_s):
     t0 = time.perf_counter()
     out = sim.run_membership_storm(world=1000, ranks_per_slice=125,
@@ -361,7 +420,8 @@ def main(argv=None) -> int:
                lambda: check_overlap_structure(sim, checks),
                lambda: check_gather_dtype(sim, checks),
                lambda: check_serving(sim, checks, skips),
-               lambda: check_degraded_dcn(sim, checks)):
+               lambda: check_degraded_dcn(sim, checks),
+               lambda: check_trace_calibration(sim, checks, skips)):
         try:
             infra = fn()
         except Exception as exc:  # noqa: BLE001

@@ -28,8 +28,8 @@ from dear_pytorch_tpu.analysis.rules_registry import (
 )
 from dear_pytorch_tpu.analysis.rules_sim import SimDeterminismRule
 from dear_pytorch_tpu.analysis.rules_trace import (
-    DcnBlockingRule, DonationAliasRule, HotPathSyncRule,
-    UngatedTelemetryRule,
+    DcnBlockingRule, DonationAliasRule, HotPathSyncRule, TraceSchemaRule,
+    UngatedSpanStreamRule, UngatedTelemetryRule,
 )
 
 REPO = repo_root()
@@ -195,6 +195,76 @@ def test_ungated_telemetry_red_and_green(tmp_path):
         ("dear_pytorch_tpu/x/red.py", "event:x.rebuilt"),
         ("dear_pytorch_tpu/x/red.py", "count:x.disabled_path"),
         ("dear_pytorch_tpu/x/red.py", "count:x.negated_body"),
+    }
+
+
+def test_ungated_span_stream_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/x/red.py", """
+        def hot():
+            ds = get_stream()
+            ds.emit("x.span", dur_s=0.1)        # RED
+            get_stream().clock_sample()          # RED: chained
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/green.py", """
+        def gated():
+            ds = get_stream()
+            if ds.enabled:
+                ds.emit("x.span", dur_s=0.1)
+                ds.clock_sample()
+
+        def early_return():
+            ds = get_stream()
+            if not ds.enabled:
+                return run()
+            ds.emit("x.span")
+            return run()
+
+        def other_receiver():
+            db.emit("not.a.stream")   # green: not a stream receiver
+    """)
+    found = _findings(tmp_path, UngatedSpanStreamRule())
+    assert {(f.path, f.key) for f in found} == {
+        ("dear_pytorch_tpu/x/red.py", "emit:x.span"),
+        ("dear_pytorch_tpu/x/red.py", "clock_sample:<dynamic>"),
+    }
+
+
+def test_trace_schema_red_and_green(tmp_path):
+    _plant(tmp_path, "dear_pytorch_tpu/serving/red.py", """
+        def dispatch(rid, prompt):
+            return {"id": rid, "prompt": prompt,      # RED: request
+                    "max_new_tokens": 8}
+
+        def respond(rid, tokens):
+            payload = {"id": rid, "tokens": tokens,   # RED: response
+                       "model_version": "v1"}
+            return payload
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/serving/green.py", """
+        def dispatch(rid, prompt, ctx):
+            return {"id": rid, "prompt": prompt,
+                    "trace": ctx.to_dict()}           # green: in literal
+
+        def respond(rid, tokens, trace):
+            payload = {"id": rid, "tokens": tokens}
+            if trace is not None:
+                payload["trace"] = trace              # green: stamped later
+            return payload
+
+        def canonical(payload):
+            # green: key-by-key projection of one source record (the
+            # sha256 canonicalization) — deliberately trace-free
+            return {"id": payload["id"], "tokens": payload["tokens"],
+                    "model_version": payload["model_version"]}
+    """)
+    _plant(tmp_path, "dear_pytorch_tpu/x/elsewhere.py", """
+        def not_serving(rid, tokens):
+            return {"id": rid, "tokens": tokens}      # green: not serving/
+    """)
+    found = _findings(tmp_path, TraceSchemaRule())
+    assert {(f.path, f.qualname) for f in found} == {
+        ("dear_pytorch_tpu/serving/red.py", "dispatch"),
+        ("dear_pytorch_tpu/serving/red.py", "respond"),
     }
 
 

@@ -1,0 +1,313 @@
+"""Fleet tracing (`observability.dtrace` + `observability.critical_path`
++ `costmodel.calibrate_from_traces` + `scripts/fleet_trace.py`): trace
+contexts, the per-rank span stream and its env gate, clock-aligned
+merging, the Perfetto export, critical-path attribution arithmetic,
+trace-driven sim calibration, and the one-command collector CLI.
+
+Everything here is jax-free by design (the collector contract) — these
+tests import the modules directly, never through the package heavyweights.
+"""
+
+import json
+import os
+
+import pytest
+
+from dear_pytorch_tpu.observability import costmodel
+from dear_pytorch_tpu.observability import critical_path as CP
+from dear_pytorch_tpu.observability import dtrace
+from dear_pytorch_tpu.observability.redaction import REDACTED
+
+
+@pytest.fixture(autouse=True)
+def _restore_stream():
+    yield
+    dtrace.disable_stream()
+
+
+# -- trace contexts ----------------------------------------------------------
+
+
+def test_trace_context_roundtrip_and_child():
+    ctx = dtrace.new_trace()
+    assert ctx.parent is None
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.parent == ctx.span_id
+    assert child.span_id != ctx.span_id
+    back = dtrace.TraceContext.from_dict(child.to_dict())
+    assert back == child
+    assert dtrace.TraceContext.from_dict(None) is None
+    assert dtrace.TraceContext.from_dict({"span_id": "x"}) is None
+
+
+def test_step_trace_is_deterministic_and_epoch_scoped():
+    assert dtrace.step_trace(0, 7).trace_id == "step-0-7"
+    # the trace id is the coordination-free join key; each call's
+    # span_id is fresh (every emission is its own span on that trace)
+    assert (dtrace.step_trace(0, 7).trace_id
+            == dtrace.step_trace(0, 7).trace_id)
+    assert (dtrace.step_trace(1, 7).trace_id
+            != dtrace.step_trace(2, 7).trace_id)
+
+
+# -- the stream and its gate -------------------------------------------------
+
+
+def test_env_gate_file_sink_rank_substitution(tmp_path, monkeypatch):
+    monkeypatch.setenv(dtrace.TRACE_ENV,
+                       str(tmp_path / "trace-{rank}.jsonl"))
+    monkeypatch.setenv(dtrace.TRACE_RANK_ENV, "3")
+    ds = dtrace._configure_from_env(refresh=True)
+    assert ds.enabled and ds.rank == 3
+    ds.emit("x.span", dur_s=0.001, cat="step")
+    dtrace.disable_stream()          # flush + close
+    recs = dtrace.read_stream(str(tmp_path / "trace-3.jsonl"))
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "meta" and "span" in kinds
+    meta = recs[0]
+    assert meta["rank"] == 3 and "off" in meta
+
+
+def test_env_gate_off_and_strict_typo(monkeypatch):
+    monkeypatch.setenv(dtrace.TRACE_ENV, "0")
+    assert not dtrace._configure_from_env(refresh=True).enabled
+    monkeypatch.setenv(dtrace.TRACE_ENV, "definitely-not-a-path")
+    with pytest.raises(ValueError):
+        dtrace._configure_from_env(refresh=True)
+
+
+def test_non_numeric_rank_label(monkeypatch):
+    monkeypatch.setenv(dtrace.TRACE_RANK_ENV, "router")
+    ds = dtrace.SpanStream(dtrace.MemoryWriter())
+    assert ds.rank == "router"
+
+
+def test_elastic_rank_fallback(monkeypatch):
+    monkeypatch.delenv(dtrace.TRACE_RANK_ENV, raising=False)
+    monkeypatch.setenv("DEAR_ELASTIC_RANK", "5")
+    ds = dtrace.SpanStream(dtrace.MemoryWriter())
+    assert ds.rank == 5
+
+
+def test_span_attrs_are_redacted_on_emit():
+    mw = dtrace.MemoryWriter()
+    ds = dtrace.SpanStream(mw, rank=0)
+    ds.emit("x.span", dur_s=0.001, api_token="hunter2", batch=4)
+    span = next(r for r in mw.records if r["kind"] == "span")
+    assert span["attrs"]["api_token"] == REDACTED
+    assert span["attrs"]["batch"] == 4
+
+
+def test_null_stream_is_disabled_and_inert():
+    ds = dtrace.get_stream() if not dtrace.get_stream().enabled \
+        else dtrace.NullStream()
+    assert not ds.enabled
+    ds.emit("never")                 # no-ops, no guard needed cold
+    ds.clock_sample()
+    with ds.span("never"):
+        pass
+    assert ds.buffered() == []
+
+
+# -- merge + export ----------------------------------------------------------
+
+
+def _stream_records(rank, off, spans):
+    """Hand-built stream: meta with a clock offset + span records."""
+    recs = [{"kind": "meta", "rank": rank, "t": 1000.0 + off,
+             "mono": 1000.0, "off": off,
+             "env": {"DEAR_TRACE": "1", "DEAR_API_TOKEN": "s3cret"}}]
+    for name, mono, dur, extra in spans:
+        recs.append({"kind": "span", "name": name, "rank": rank,
+                     "mono": mono, "dur": dur, **extra})
+    return recs
+
+
+def test_merge_aligns_clocks_across_ranks(tmp_path):
+    # rank 0 booted 100s of monotonic time before rank 1; both spans
+    # happened at the same WALL moment
+    a = _stream_records(0, 500.0, [("s", 100.0, 0.01, {"cat": "step"})])
+    b = _stream_records(1, 400.0, [("s", 200.0, 0.01, {"cat": "step"})])
+    merged = dtrace.merge_streams([a, b])
+    assert merged["ranks"] == [0, 1]
+    walls = {s["rank"]: s["t_wall"] for s in merged["spans"]}
+    assert walls[0] == pytest.approx(walls[1])
+    # file round-trip path too
+    p = tmp_path / "t0.jsonl"
+    with open(p, "w") as f:
+        for r in a:
+            f.write(json.dumps(r) + "\n")
+        f.write("{torn")              # crashed writer's last line
+    assert len(dtrace.read_stream(str(p))) == len(a)
+
+
+def test_chrome_trace_export_lanes_and_env_redaction(tmp_path):
+    a = _stream_records(0, 0.0, [
+        ("guard.step", 10.0, 0.02, {"cat": "step", "step": 1}),
+        ("dcn.round", 10.01, 0.005,
+         {"cat": "comm", "trace": {"trace_id": "step-0-1",
+                                   "span_id": "ab"}}),
+        ("mark", 10.02, 0.0, {"cat": "guard"}),
+    ])
+    merged = dtrace.merge_streams([a])
+    out = tmp_path / "fleet.trace.json"
+    n = dtrace.write_chrome_trace(merged, str(out))
+    doc = json.loads(out.read_text())
+    assert n == len(doc["traceEvents"])
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"process_name", "guard.step", "dcn.round", "mark"} <= names
+    round_ev = next(e for e in evs if e["name"] == "dcn.round")
+    assert round_ev["tid"] == 2          # the comm lane
+    assert round_ev["args"]["trace_id"] == "step-0-1"
+    mark_ev = next(e for e in evs if e["name"] == "mark")
+    assert mark_ev["ph"] == "i"          # zero-duration -> instant
+    env = doc["otherData"]["env_rank_0"]
+    assert env["DEAR_API_TOKEN"] == REDACTED
+    assert env["DEAR_TRACE"] == "1"
+
+
+# -- critical-path attribution -----------------------------------------------
+
+
+def _fleet(step_s_by_rank, comm_by_rank, compute_by_rank):
+    """One step's spans across ranks: one stream per rank."""
+    streams = []
+    for rank, step_s in step_s_by_rank.items():
+        recs = [{"kind": "span", "name": "guard.step", "rank": rank,
+                 "mono": 0.0, "dur": step_s, "cat": "step",
+                 "step": 1, "mem_epoch": 0}]
+        for (t0, dur) in comm_by_rank.get(rank, ()):
+            recs.append({"kind": "span", "name": "dcn.round",
+                         "rank": rank, "mono": t0, "dur": dur,
+                         "cat": "comm", "step": 1, "mem_epoch": 0})
+        for (t0, dur) in compute_by_rank.get(rank, ()):
+            recs.append({"kind": "span", "name": "dear.backward",
+                         "rank": rank, "mono": t0, "dur": dur,
+                         "cat": "compute", "step": 1, "mem_epoch": 0})
+        streams.append(recs)
+    return dtrace.merge_streams(streams)
+
+
+def test_step_attribution_exposed_vs_hidden_and_straggler():
+    # rank 1 is the straggler: 2.0s step; its comm [0,2) is half covered
+    # by compute [1,3) -> exposed 1.0, hidden 1.0
+    merged = _fleet({0: 1.0, 1: 2.0},
+                    comm_by_rank={1: [(0.0, 2.0)]},
+                    compute_by_rank={1: [(1.0, 2.0)]})
+    att = CP.step_attribution(merged)
+    row = att["steps"][0]
+    assert row["straggler"] == "1"
+    assert row["step_s"] == pytest.approx(2.0)
+    assert row["exposed_comm_s"] == pytest.approx(1.0)
+    assert row["hidden_comm_s"] == pytest.approx(1.0)
+    assert row["ranks"]["1"]["longest_leg"]["name"] == "dcn.round"
+    chain = [c["name"] for c in row["critical_chain"]]
+    assert chain[0] in ("guard.step", "dcn.round")
+    assert att["summary"]["stragglers"] == {"1": 1}
+    assert att["summary"]["exposed_frac"] == pytest.approx(0.5)
+
+
+def test_fully_hidden_comm_is_not_exposed():
+    merged = _fleet({0: 1.0},
+                    comm_by_rank={0: [(0.2, 0.4)]},
+                    compute_by_rank={0: [(0.0, 1.0)]})
+    att = CP.step_attribution(merged)
+    assert att["steps"][0]["exposed_comm_s"] == pytest.approx(0.0)
+    assert att["steps"][0]["hidden_comm_s"] == pytest.approx(0.4)
+
+
+# -- trace-driven calibration ------------------------------------------------
+
+
+def _training_spans(step_times, dcn_times=()):
+    recs = []
+    t = 0.0
+    for i, st in enumerate(step_times):
+        recs.append({"kind": "span", "name": "guard.step", "rank": 0,
+                     "mono": t, "dur": st, "cat": "step",
+                     "step": i, "mem_epoch": 0})
+        if i < len(dcn_times):
+            recs.append({"kind": "span", "name": "dcn.round", "rank": 0,
+                         "mono": t, "dur": dcn_times[i], "cat": "comm",
+                         "step": i, "mem_epoch": 0})
+        t += st
+    return dtrace.merge_streams([recs])
+
+
+def test_calibrate_from_traces_fits_and_warmup_drops_compile():
+    # step 0 is a 50x compile step; warmup=1 must drop it from the fit
+    merged = _training_spans([0.5] + [0.01] * 9, dcn_times=[0.002] * 10)
+    cal = costmodel.calibrate_from_traces(merged, min_steps=4, warmup=1)
+    assert cal.n_steps == 9
+    assert cal.step_time_s["p50"] == pytest.approx(0.01)
+    assert cal.compute_time_s > 0
+    assert cal.dcn_round_s
+    assert all(d == pytest.approx(0.002) for d in cal.dcn_round_s)
+    uncal = costmodel.calibrate_from_traces(merged, min_steps=4)
+    # without warmup the compile step poisons the distribution
+    assert uncal.step_time_s["mean"] > 5 * cal.step_time_s["mean"]
+
+    with pytest.raises(ValueError):
+        costmodel.calibrate_from_traces(
+            _training_spans([0.01] * 3), min_steps=4)
+
+
+def test_trace_calibration_dump_load_roundtrip(tmp_path):
+    merged = _training_spans([0.01] * 8)
+    cal = costmodel.calibrate_from_traces(merged, min_steps=4)
+    p = str(tmp_path / "cal.json")
+    cal.dump(p)
+    back = costmodel.load_trace_calibration(p)
+    assert back.step_time_s["p50"] == cal.step_time_s["p50"]
+    assert back.n_steps == cal.n_steps
+    # embedded form (the perf-artifact shape)
+    wrapped = str(tmp_path / "art.json")
+    with open(wrapped, "w") as f:
+        json.dump({"round": 19,
+                   "trace_calibration": json.load(open(p))}, f)
+    assert costmodel.load_trace_calibration(
+        wrapped).n_steps == cal.n_steps
+
+
+# -- the collector CLI -------------------------------------------------------
+
+
+def test_fleet_trace_cli_end_to_end(tmp_path, capsys):
+    import scripts.fleet_trace as FT
+
+    for rank in (0, 1):
+        recs = _stream_records(rank, 0.0, [
+            ("guard.step", float(i), 0.01,
+             {"cat": "step", "step": i, "mem_epoch": 0})
+            for i in range(6)
+        ])
+        with open(tmp_path / f"trace-{rank}.jsonl", "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+    out = tmp_path / "fleet.trace.json"
+    rep = tmp_path / "attr.json"
+    cal = tmp_path / "cal.json"
+    rc = FT.main([str(tmp_path), "--out", str(out), "--report",
+                  str(rep), "--calibration", str(cal),
+                  "--min-steps", "4", "--warmup", "1", "--quiet"])
+    assert rc == 0
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["ok"] and verdict["ranks"] == [0, 1]
+    assert verdict["steps"]["n_steps"] == 6
+    assert json.loads(out.read_text())["traceEvents"]
+    assert json.loads(rep.read_text())["steps"]["summary"]["n_steps"] == 6
+    assert costmodel.load_trace_calibration(
+        str(cal)).step_time_s["p50"] == pytest.approx(0.01)
+
+
+def test_fleet_trace_cli_empty_inputs(tmp_path, capsys):
+    import scripts.fleet_trace as FT
+
+    assert FT.main([str(tmp_path / "nope-*.jsonl")]) == 3
+    empty = tmp_path / "trace-0.jsonl"
+    empty.write_text(json.dumps(
+        {"kind": "meta", "rank": 0, "off": 0.0}) + "\n")
+    assert FT.main([str(empty)]) == 2
+    capsys.readouterr()

@@ -593,6 +593,52 @@ def test_supervisor_slice_aligned_scale_up(tmp_path, monkeypatch):
     assert sup.scale_up(1) == [10]
 
 
+def test_step_trace_spans_shrink_rejoin_without_id_collisions():
+    """Fleet step-trace identity across an elastic shrink -> rejoin:
+    the trace id is DERIVED (`step-<mem_epoch>-<step>`), so every rank
+    mints the same id for the same (epoch, step) with zero
+    coordination, and the epoch component keeps step 5 of the shrunk
+    world distinct from step 5 before the loss and step 5 after the
+    rejoin. The merged attribution must keep the three lives of step 5
+    as three rows instead of folding them together."""
+    from dear_pytorch_tpu.observability import critical_path as CP
+    from dear_pytorch_tpu.observability import dtrace
+
+    # same derivation on every rank, no coordination
+    assert (dtrace.step_trace(1, 5).trace_id
+            == dtrace.step_trace(1, 5).trace_id == "step-1-5")
+    # ...and no collisions across the elastic transition
+    assert len({dtrace.step_trace(e, 5).trace_id
+                for e in (0, 1, 2)}) == 3
+
+    # two rank streams through the real SpanStream, emitting the
+    # guard's span shape: epoch 0 both ranks -> shrink (epoch 1, rank 0
+    # alone) -> rejoin (epoch 2, both ranks), step counter re-walking 5
+    writers = {r: dtrace.MemoryWriter() for r in (0, 1)}
+    streams = {r: dtrace.SpanStream(w, rank=r)
+               for r, w in writers.items()}
+    lives = [(0, 5, (0, 1)), (0, 6, (0, 1)),
+             (1, 5, (0,)),                       # shrunk world
+             (2, 5, (0, 1)), (2, 6, (0, 1))]    # rejoined
+    for epoch, step, ranks in lives:
+        for r in ranks:
+            streams[r].emit(
+                "guard.step", dur_s=0.01, cat="step",
+                trace=dtrace.step_trace(epoch, step),
+                step=step, mem_epoch=epoch, checked=False, healthy=True)
+    merged = dtrace.merge_streams(
+        [w.records for w in writers.values()])
+    att = CP.step_attribution(merged)
+    rows = {(s["mem_epoch"], s["step"]): s for s in att["steps"]}
+    assert set(rows) == {(0, 5), (0, 6), (1, 5), (2, 5), (2, 6)}
+    assert set(rows[(1, 5)]["ranks"]) == {"0"}
+    assert set(rows[(2, 5)]["ranks"]) == {"0", "1"}
+    tids = {(s.get("mem_epoch"), s.get("step")):
+            (s.get("trace") or {}).get("trace_id")
+            for s in merged["spans"] if s.get("name") == "guard.step"}
+    assert tids[(0, 5)] != tids[(1, 5)] != tids[(2, 5)]
+
+
 # -- the acceptance storm -----------------------------------------------------
 
 

@@ -82,9 +82,11 @@ def test_rank_targeted_fault_grammar():
     assert parse_faults("hang@3:r1:0.25")[0] == Fault(
         kind="hang", step=3, arg=0.25, rank=1)
     # malformed rank specs raise WITH the valid format in the message
-    with pytest.raises(ValueError, match=r"kind@step\[:arg\]\[:rRANK\]"):
+    with pytest.raises(ValueError,
+                       match=r"kind@step\[:arg\]\[:rRANK\|:sSLICE\]"):
         parse_faults("nan@6:rX")
-    with pytest.raises(ValueError, match="neither a float arg nor an rRANK"):
+    with pytest.raises(ValueError,
+                       match="neither a float arg, an rRANK, nor an sSLICE"):
         parse_faults("nan@6:banana")
     with pytest.raises(ValueError, match="duplicate rank"):
         parse_faults("nan@6:r0:r1")

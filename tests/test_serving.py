@@ -729,6 +729,56 @@ def test_corrupt_response_after_reclaim_not_requeued_twice(tmp_path):
         live.stop()
 
 
+def test_request_trace_survives_replica_death_with_hop_span(tmp_path):
+    """Trace continuity across the zero-drop path: the request keeps ONE
+    trace_id from submit through a replica death and front-of-queue
+    redispatch; the incarnation boundary it crossed is recorded as a
+    `serve.redispatch_hop` span (child of the request trace, carrying
+    the dead life's incarnation), and the survivor's completion closes
+    the root `serve.request` span on the same trace."""
+    from dear_pytorch_tpu.observability import critical_path as CP
+    from dear_pytorch_tpu.observability import dtrace
+
+    root = str(tmp_path)
+    mw = dtrace.MemoryWriter()
+    dtrace.set_stream(dtrace.SpanStream(mw, rank="router"))
+    try:
+        dead = _FakeReplica(root, 0, serve=False, incarnation="a").start()
+        with _router(root) as router:
+            assert _wait(lambda: router.healthy_replicas() == [0])
+            rid = router.submit([7, 8, 9], max_new_tokens=2,
+                                deadline_s=None)
+            tid = router._requests[rid].record["trace"]["trace_id"]
+            assert tid and not tid.startswith("step-")
+            assert _wait(lambda: router.inflight_on(0) == 1)
+            dead.stop()                  # heartbeats cease: replica dies
+            live = _FakeReplica(root, 1, incarnation="b").start()
+            resp = router.result(rid, timeout=15)
+            assert resp["tokens"] == [9, 8, 7]
+            assert router.redispatched >= 1
+            live.stop()
+    finally:
+        dtrace.disable_stream()
+
+    spans = [r for r in mw.records if r.get("kind") == "span"]
+    of_trace = [s for s in spans
+                if (s.get("trace") or {}).get("trace_id") == tid]
+    names = [s["name"] for s in of_trace]
+    # dispatch to the dead life AND to the survivor — same trace id
+    assert names.count("serve.dispatch") >= 2
+    hop = next(s for s in of_trace
+               if s["name"] == "serve.redispatch_hop")
+    assert hop["attrs"]["incarnation"] == "a"
+    assert hop["attrs"]["request_id"] == rid
+    closes = [s for s in of_trace if s["name"] == "serve.request"]
+    assert len(closes) == 1 and closes[0]["attrs"]["replica"] == 1
+
+    att = CP.request_attribution(spans)
+    req = next(r for r in att["requests"] if r["trace_id"] == tid)
+    assert req["redispatches"] >= 1
+    assert req["request_id"] == rid
+
+
 def test_replica_answers_poison_request_with_signed_error(tmp_path):
     """An admitted request that violates the engine's position budget
     must NOT crash the replica — the router would re-dispatch the poison

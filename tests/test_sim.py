@@ -171,6 +171,39 @@ def test_gather_dtype_speedup_reproduced():
     assert bf16["step_time_s"] < f32["step_time_s"]
 
 
+def test_trace_calibration_replay_reproduces_recorded_quantiles():
+    """perf/trace_r19's fleet-trace calibration (harvested by
+    scripts/fleet_trace.py from the recorded --multislice chaos storm)
+    replayed through the sim: with compute unpinned the fixed-point
+    rebase must land the simulated p50 within 10% of the recorded p50
+    and the p99 within [0.5x, 1.5x] of the recorded p99 (the tail is
+    the storm's kill/stall mass — it must EMERGE from the replayed
+    scale distribution, it is never fit); with compute pinned the same
+    replay must preserve dear < allreduce.  Mirrors
+    scripts/sim_check.py check_trace_calibration."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cal_path = os.path.join(repo, "perf", "trace_r19", "calibration.json")
+    with open(cal_path) as f:
+        rec = json.load(f)["step_time_s"]
+    plan = plan8()
+    rep = sim.simulate_training(plan, TOPO8, mode="dear", steps=400,
+                                seed=0, trace_calibration=cal_path)
+    assert rep["jitter_model"] == "trace-replay"
+    q = rep["quantiles"]
+    assert abs(q["p50"] - rec["p50"]) <= 0.10 * rec["p50"]
+    assert 0.5 * rec["p99"] <= q["p99"] <= 1.5 * rec["p99"]
+    # pinned compute skips the rebase (which would force both modes
+    # onto the recorded p50) — the replay must keep the recorded A/B
+    t = {m: sim.simulate_training(plan, TOPO8, mode=m, steps=400,
+                                  seed=0, compute_time_s=0.012,
+                                  trace_calibration=cal_path)
+         ["step_time_s"]
+         for m in ("dear", "allreduce")}
+    assert t["dear"] < t["allreduce"]
+
+
 def test_multislice_partition_tradeoff_visible():
     """Bigger DCN partitions -> fewer messages -> less α cost: the axis
     `PlanTuner(sim)` searches must actually move the objective."""
