@@ -307,6 +307,14 @@ class DcnExchanger:
         self.exchanges = 0           # the fault clock (1-based per call)
         self._seq = 0                # monotone publish sequence (forensics)
         self._published: List[Tuple[int, List[str]]] = []  # (step, keys)
+        # SDC sentinel leg (resilience.sdc): when armed, each exchange
+        # records the dotted-hex checksum of its committed include-set
+        # mean for the guard's fingerprint vote; resolved once here so
+        # the disabled path costs one attribute read per exchange
+        from dear_pytorch_tpu.resilience import sdc as _sdc_mod
+
+        self._sdc_fp = _sdc_mod.sdc_enabled()
+        self.last_mean_fp = ""
         self._stale_epochs: List[int] = []
         self._samples: List[Tuple[float, float]] = []
         self._sample_cap = int(sample_cap)
@@ -629,6 +637,18 @@ class DcnExchanger:
         scalar_mean = (
             sum(scalar_contrib[sid] for sid in order) / world
             if scalars is not None else None)
+        if self._sdc_fp:
+            # SDC sentinel leg: checksum the COMMITTED include-set mean
+            # (host buffers already in hand — no extra transfer) so the
+            # cross-slice exchange is voted on exactly like the device
+            # buckets; the guard appends this to its health-sync
+            # fingerprint (`resilience.sdc.SdcSentinel.local_fingerprint`)
+            from dear_pytorch_tpu.resilience import sdc as _sdc
+
+            self.last_mean_fp = ".".join(
+                f"{_sdc.fingerprint_array(m):08x}" for m in means)
+            if tr.enabled:
+                tr.count("dcn.mean_fingerprints")
         if tr.enabled:
             tr.count("dcn.exchanges")
             tr.count("dcn.bytes",

@@ -64,6 +64,7 @@ __all__ = [
     "SimTopology", "load_topology", "synthetic_plan",
     "simulate_training", "simulate_serving", "TrafficTrace",
     "simulate_degraded_dcn", "sweep_staleness_policies",
+    "simulate_sdc", "sweep_sdc_policies",
     "phase_ticks_from_admission",
     "SimTransport", "run_membership_storm",
     "VirtualClock", "tune_plan_sim", "tune_serve_sim", "tune_fleet_sim",
@@ -859,6 +860,185 @@ def simulate_serving(
         "ab_cell": [round((len(latencies) / wall) if wall else 0.0, 3),
                     0.0],
     }
+
+
+# ---------------------------------------------------------------------------
+# SDC DES: a silently-corrupting replica under the shadow-replay policy
+# ---------------------------------------------------------------------------
+
+
+def simulate_sdc(
+    topo: SimTopology,
+    trace: TrafficTrace,
+    *,
+    replicas: Optional[int] = None,
+    slots: int = 4,
+    prefill_chunk: int = 4,
+    tick_base_s: float = 1e-3,
+    shadow_every: int = 4,
+    strike_threshold: int = 1,
+    corrupt_replica: int = 1,
+    corrupt_at_s: float = 0.0,
+    probation_s: float = 30.0,
+) -> dict:
+    """Replay ``trace`` against a replica fleet where ``corrupt_replica``
+    starts silently corrupting its responses at ``corrupt_at_s`` —
+    checksums verify clean, so only the router's shadow-replay policy
+    (`serving.router`, `resilience.sdc`) can catch it. Models the full
+    detection arc: every ``shadow_every``-th delivered response is
+    re-decoded on a second replica (same request cost, so the policy's
+    overhead is priced, not assumed), a mismatch buys a third-replica
+    arbiter tick, and ``strike_threshold`` confirmed convictions
+    quarantine the culprit (its queue re-dispatches, zero-drop); the
+    probation self-test readmits it ``probation_s`` later, serving clean.
+
+    Deterministic (no RNG beyond the trace). Key outputs: ``exposed``
+    (corrupted responses a client actually received — the quantity the
+    quarantine policy exists to bound), ``detect_s`` (corruption start to
+    first confirmed conviction), ``quarantined_at_s`` / ``readmit_at_s``,
+    ``shadows`` / ``arbiters`` (the policy's overhead), ``requests``.
+    `sweep_sdc_policies` searches the (shadow cadence x strike budget)
+    grid offline; scripts/sim_check.py pins the orderings."""
+    replicas = topo.replicas if replicas is None else int(replicas)
+    replicas = max(replicas, 2)
+    chunk = max(int(prefill_chunk), 1)
+    shadow_every = max(int(shadow_every), 0)
+    strike_threshold = max(int(strike_threshold), 1)
+    tick = _tick_time_s(topo, tick_base_s=float(tick_base_s),
+                        tp_decode=False, weight_bytes=0.0, n_projections=0)
+
+    active = [0] * replicas
+    backlog: List[List[tuple]] = [[] for _ in range(replicas)]
+    fenced = [False] * replicas
+    events: List[tuple] = []   # (t, seq, kind, rep, job)
+    seq = 0
+    _ARRIVE, _DONE, _READMIT = 0, 1, 2
+
+    def push(t, kind, rep, job):
+        nonlocal seq
+        seq += 1
+        heapq.heappush(events, (t, seq, kind, rep, job))
+
+    for (t, p, d) in trace.requests:
+        svc = (math.ceil(p / chunk) + d) * tick
+        push(t, _ARRIVE, -1, {"kind": "real", "svc": svc, "t0": t})
+
+    def assign(job, now, avoid=()):
+        cand = [i for i in range(replicas)
+                if not fenced[i] and i not in avoid]
+        if not cand:
+            return False
+        rep = min(cand, key=lambda i: (active[i] + len(backlog[i]), i))
+        if active[rep] < slots:
+            active[rep] += 1
+            push(now + job["svc"], _DONE, rep, job)
+        else:
+            backlog[rep].append(job)
+        return True
+
+    delivered = exposed = shadows = arbiters = strikes = 0
+    mismatches = 0
+    detect_s: Optional[float] = None
+    quarantined_at: Optional[float] = None
+    readmit_at: Optional[float] = None
+    now = 0.0
+    while events:
+        now, _, kind, rep, job = heapq.heappop(events)
+        if kind == _ARRIVE:
+            assign(job, now)
+            continue
+        if kind == _READMIT:
+            fenced[rep] = False
+            readmit_at = now
+            continue
+        # _DONE
+        active[rep] -= 1
+        if backlog[rep]:
+            assign(backlog[rep].pop(0), now)
+        if fenced[rep]:
+            # fenced mid-service: the zero-drop re-dispatch — the
+            # response is discarded and the request re-runs elsewhere
+            assign(job, now, avoid=(rep,))
+            continue
+        corrupt = (rep == corrupt_replica and now >= corrupt_at_s
+                   and quarantined_at is None)
+        if job["kind"] == "real":
+            delivered += 1
+            if corrupt:
+                exposed += 1
+            if shadow_every and delivered % shadow_every == 0:
+                if assign({"kind": "shadow", "svc": job["svc"],
+                           "t0": now, "primary_corrupt": corrupt,
+                           "primary_rep": rep}, now, avoid=(rep,)):
+                    shadows += 1
+        elif job["kind"] == "shadow":
+            # this replica served the shadow clean (a second corruptor
+            # is out of the model); mismatch iff the primary corrupted
+            if job["primary_corrupt"] or corrupt:
+                mismatches += 1
+                bad = job["primary_rep"] if job["primary_corrupt"] else rep
+                other = rep if job["primary_corrupt"] else job[
+                    "primary_rep"]
+                if assign({"kind": "arbiter", "svc": job["svc"],
+                           "t0": now, "culprit": bad},
+                          now, avoid=(bad, other)):
+                    arbiters += 1
+        else:  # arbiter: the 3-way majority confirms the culprit
+            strikes += 1
+            if detect_s is None:
+                detect_s = now - float(corrupt_at_s)
+            if strikes >= strike_threshold and quarantined_at is None:
+                bad = job["culprit"]
+                fenced[bad] = True
+                quarantined_at = now
+                # zero-drop: the culprit's queue re-dispatches now
+                requeue, backlog[bad] = backlog[bad], []
+                for j in requeue:
+                    assign(j, now, avoid=(bad,))
+                push(now + float(probation_s), _READMIT, bad, None)
+    result = {
+        "shadow_every": shadow_every,
+        "strike_threshold": strike_threshold,
+        "requests": delivered,
+        "exposed": exposed,
+        "mismatches": mismatches,
+        "strikes": strikes,
+        "shadows": shadows,
+        "arbiters": arbiters,
+        "detect_s": detect_s,
+        "quarantined_at_s": quarantined_at,
+        "readmit_at_s": readmit_at,
+        "wall_s": now,
+    }
+    tr = _telemetry.get_tracer()
+    if tr.enabled:
+        tr.count("sim.sdc_runs")
+        tr.event("sim.sdc_run", shadow_every=shadow_every,
+                 strikes=strikes, exposed=exposed,
+                 detect_ms=-1 if detect_s is None else int(detect_s * 1e3))
+    return result
+
+
+def sweep_sdc_policies(
+    topo: SimTopology,
+    trace: TrafficTrace,
+    *,
+    shadow_everys: Sequence[int] = (1, 2, 4, 8),
+    strike_thresholds: Sequence[int] = (1, 2, 3),
+    **kwargs,
+) -> List[dict]:
+    """Search the shadow-cadence x strike-budget grid over one corrupt-
+    replica trace: one `simulate_sdc` run per cell, ranked best-first by
+    (fewest corrupted responses exposed, cheapest shadow overhead,
+    fastest detection) — the offline answer to 'how often must we
+    shadow, and how many confirmations before we pull a host'."""
+    runs = [simulate_sdc(topo, trace, shadow_every=se,
+                         strike_threshold=st, **kwargs)
+            for se in shadow_everys for st in strike_thresholds]
+    big = float("inf")
+    return sorted(runs, key=lambda r: (
+        r["exposed"], r["shadows"] + r["arbiters"],
+        big if r["detect_s"] is None else r["detect_s"]))
 
 
 # ---------------------------------------------------------------------------

@@ -393,6 +393,11 @@ def build_train_step(
     sharded = mode in ("dear", "dear-fused", "fsdp")
     fused = mode == "dear-fused"
     excl = frozenset(exclude_parts)
+    # SDC sentinel: the per-bucket fingerprint is baked into the program
+    # only when armed — resolved once here at build time, so the disabled
+    # path carries zero extra ops and no per-step branch
+    from dear_pytorch_tpu.resilience import sdc as _sdc
+    sdc_fp = _sdc.sdc_enabled()
     if dcn is not None and fused:
         # checked BEFORE the generic dear-fused mesh guards: the caller
         # asked for a ring spanning the DCN boundary, and that — not the
@@ -956,6 +961,23 @@ def build_train_step(
                 )
             new_buffers.append(new_p)
             new_opt.append(new_o)
+        if sdc_fp:
+            # uint32 wraparound checksum per bucket over the post-update
+            # bucket bytes: bitcast + integer sum is exact and
+            # order-independent, so replica-identical state implies
+            # identical fingerprints and any divergence is a silent
+            # corruption. psum completes the checksum across shards
+            # without leaving the program; the guard fetches the value
+            # only at check cadence.
+            fps = []
+            for buf in new_buffers:
+                words = lax.bitcast_convert_type(
+                    buf.astype(jnp.float32), jnp.uint32)
+                s = jnp.sum(words, dtype=jnp.uint32)
+                if sharded:
+                    s = lax.psum(s, axis_name)
+                fps.append(s)
+            metrics["sdc_fp"] = jnp.stack(fps)
         next_state = DearState(
             tuple(new_buffers), tuple(new_opt), state.step + 1,
             new_model_state, new_comp,

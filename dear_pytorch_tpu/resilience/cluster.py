@@ -102,12 +102,34 @@ def evaluate_health_views(ranks, views, *, step, scope="cluster"):
     logging. `ClusterCoordinator` (fixed world) and
     `resilience.membership.ElasticCluster` (member-scoped) must never
     drift on this decision rule, so both call here. Returns
-    ``(unhealthy_ranks, fingerprints, desync, any_preempted)``."""
+    ``(unhealthy_ranks, fingerprints, desync, any_preempted,
+    sdc_suspects, hosts, sdc_voted)`` — ``hosts`` as (rank, host) pairs,
+    ``sdc_voted`` True when enough fingerprint-bearing voters reached
+    this sync for blame to be decidable.
+
+    When views carry per-bucket SDC fingerprints (``sfp``, emitted by
+    the compiled step under `DEAR_SDC`), a strict per-bucket majority
+    vote localizes silent divergence to ``(rank, bucket)`` suspects —
+    the minority is the corrupt party because post-reduce bucket state
+    is replica-identical by construction. With too few voters to blame
+    anyone (< 3), a fingerprint disagreement still trips the plain
+    desync sentinel: caught, just not localized."""
     unhealthy = tuple(r for r, v in zip(ranks, views) if not v["ok"])
     fps = tuple(v["fp"] for v in views)
     healthy_fps = {v["fp"] for v in views if v["ok"] and v["fp"]}
     desync = len(healthy_fps) > 1
     any_pre = any(v["pre"] for v in views)
+    hosts = tuple((r, v.get("host", "")) for r, v in zip(ranks, views))
+    sfps = {r: v.get("sfp", "")
+            for r, v in zip(ranks, views) if v["ok"]}
+    sdc_suspects = ()
+    sdc_voted = sum(1 for s in sfps.values() if s) >= 3
+    if any(sfps.values()):
+        from dear_pytorch_tpu.resilience import sdc as _sdc
+
+        sdc_suspects = tuple(_sdc.vote(sfps))
+        if not sdc_suspects and len({s for s in sfps.values() if s}) > 1:
+            desync = True
     tr = _telemetry.get_tracer()
     if tr.enabled:
         tr.count("cluster.health_checks")
@@ -121,6 +143,15 @@ def evaluate_health_views(ranks, views, *, step, scope="cluster"):
                      fingerprints=";".join(fps)[:200])
         if any_pre:
             tr.count("cluster.preempt_propagated")
+        if sdc_suspects:
+            tr.count("cluster.sdc_suspects_detected")
+            tr.event("cluster.sdc_suspects", step=step or -1,
+                     suspects=";".join(
+                         f"{r}:{b}" for r, b in sdc_suspects))
+    if sdc_suspects:
+        logger.critical(
+            "%s: SDC at step %s — fingerprint minority vote blames "
+            "(rank, bucket) %s", scope, step, list(sdc_suspects))
     if desync:
         logger.critical(
             "%s: DESYNC at step %s — replica fingerprints disagree: %s",
@@ -129,7 +160,7 @@ def evaluate_health_views(ranks, views, *, step, scope="cluster"):
         logger.warning(
             "%s: rank(s) %s unhealthy at step %s — coordinated rollback",
             scope, list(unhealthy), step)
-    return unhealthy, fps, desync, any_pre
+    return unhealthy, fps, desync, any_pre, sdc_suspects, hosts, sdc_voted
 
 
 def newest_common_step(views, *, scope="cluster", epoch=None):
@@ -177,6 +208,9 @@ class HealthVerdict(NamedTuple):
     desync: bool                   # healthy ranks' fingerprints disagree
     any_preempted: bool            # some rank saw a preemption signal
     fingerprints: tuple            # per-rank fingerprint strings
+    sdc_suspects: tuple = ()       # (rank, bucket) fingerprint-vote losers
+    hosts: tuple = ()              # (rank, host-identity) ledger-key pairs
+    sdc_voted: bool = False        # enough voters reached this sync to blame
 
 
 # ---------------------------------------------------------------------------
@@ -650,6 +684,8 @@ class ClusterCoordinator:
         fingerprint: str = "",
         step: Optional[int] = None,
         preempted: bool = False,
+        sdc_fingerprint: str = "",
+        host: str = "",
     ) -> HealthVerdict:
         """The per-check-interval any-rank-unhealthy exchange.
 
@@ -659,18 +695,23 @@ class ClusterCoordinator:
         ``desync=True`` — silent replica divergence, caught instead of
         trained through. ``preempted`` propagates a preemption signal seen
         by any rank to every rank, so emergency saves stay cooperative.
+        ``sdc_fingerprint`` is the per-bucket SDC sentinel (dotted-hex
+        uint32 checksums from the compiled step) and ``host`` the ledger
+        identity blame should stick to — see `resilience.sdc`.
         """
         payload = json.dumps({
             "ok": bool(ok), "fp": fingerprint, "pre": bool(preempted),
+            "sfp": sdc_fingerprint, "host": host,
         })
         views = [json.loads(v)
                  for v in self.exchange("health", payload)]
-        unhealthy, fps, desync, any_pre = evaluate_health_views(
-            range(len(views)), views, step=step)
+        unhealthy, fps, desync, any_pre, suspects, hosts, voted = (
+            evaluate_health_views(range(len(views)), views, step=step))
         return HealthVerdict(
-            ok=not unhealthy and not desync,
+            ok=not unhealthy and not desync and not suspects,
             unhealthy_ranks=unhealthy, desync=desync,
             any_preempted=any_pre, fingerprints=fps,
+            sdc_suspects=suspects, hosts=hosts, sdc_voted=voted,
         )
 
     def consensus_restore_step(

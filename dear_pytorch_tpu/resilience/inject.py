@@ -30,6 +30,25 @@ Fault kinds (all fire exactly once per scheduled entry):
                     `corrupt_payload` per response), so the router's
                     sha256 verification must catch and re-dispatch it;
                     a training run never consumes this kind
+  ``flip``          silent data corruption: from step ``N`` ON, set the
+                    low bit of one element of gradient-bucket ``arg``'s
+                    padded tail in the state entering every step (fires
+                    once; the corruption persists — a stuck ALU lane).
+                    The value is validly checksummed everywhere
+                    downstream and the padding never feeds the loss, so
+                    wire integrity AND the loss-bits desync sentinel are
+                    both blind to it; only the cross-rank per-bucket
+                    fingerprint vote (`resilience.sdc`) can catch it
+                    (`GuardedTrainer._attempt` drives `flip_bucket_for`
+                    per attempt)
+  ``flip_logits``   serving-path silent corruption: from request ``N``
+                    ON, XOR the low bit of the first generated token of
+                    every response BEFORE checksum-signing (fires once;
+                    persists — the serving twin of ``flip``). The
+                    payload verifies clean at the router; only the
+                    1-in-N shadow-replay vote on a second replica can
+                    catch it (`serving.replica` drives `corrupt_tokens`
+                    per response)
   ``torn_seg``      feedback-log only: the Nth segment FLUSH publishes
                     its payload but never its manifest (a crash between
                     the two writes of the manifest-LAST commit), and the
@@ -114,11 +133,12 @@ FAULT_ENV = "DEAR_FAULTS"
 KINDS = ("nan", "exc", "hang", "slow", "ckpt_corrupt", "preempt",
          "corrupt_resp", "torn_seg", "dup_feedback", "dcn_slow",
          "dcn_drop", "dcn_flap", "dcn_partition", "poison_feedback",
-         "bad_version")
+         "bad_version", "flip", "flip_logits")
 
 __all__ = [
     "FAULT_ENV", "KINDS", "Fault", "InjectedFault", "FaultInjector",
     "parse_faults", "poison_pytree", "corrupt_latest_checkpoint",
+    "flip_state_bucket",
 ]
 
 
@@ -313,6 +333,10 @@ class FaultInjector:
         self._flaps: List[Tuple[int, int]] = []
         #: wall-clock deadline of an armed ``dcn_partition`` (monotonic)
         self._partition_until: float = 0.0
+        #: persistent SDC armed by ``flip`` (bucket index) and
+        #: ``flip_logits`` (bool) — a stuck lane, not a hiccup
+        self._flip_bucket: Optional[int] = None
+        self._flip_logits = False
         self._own_rank = own_rank
         self._own_slice = own_slice
         # kill=False turns ``preempt`` into a no-op marker (tests that
@@ -560,3 +584,77 @@ class FaultInjector:
             head = bytes(b ^ 0xFF for b in data[:16])
             return head + data[16:]
         return data
+
+    def flip_bucket_for(self, step: int) -> Optional[int]:
+        """Bucket index to silently corrupt at this step (None = no SDC
+        armed). A due ``flip`` fault ARMS the corruption once — a stuck
+        compute lane is a condition, not a hiccup — and every later
+        attempt on this process re-applies the same bit-flip, so the
+        fault REPRODUCES on the post-rollback replay and the SDC arbiter
+        convicts it as deterministic (`resilience.sdc`). ``arg`` selects
+        the bucket (`flip_state_bucket` clamps it to the plan's range
+        and flips the bucket's last real element)."""
+        for f in self._take(step, ("flip",)):
+            self._flip_bucket = max(int(f.arg), 0)
+        return self._flip_bucket
+
+    def corrupt_tokens(self, step: int, tokens):
+        """Apply an armed ``flip_logits`` fault to a response's token
+        list (returned unchanged otherwise) — the serving replica calls
+        this BEFORE checksum-signing, so the payload verifies clean at
+        the router and only the shadow-replay vote can catch the damage
+        (the serving twin of ``flip``). Persistent once armed, like the
+        training-side flip."""
+        for _ in self._take(step, ("flip_logits",)):
+            self._flip_logits = True
+        if self._flip_logits and tokens:
+            tokens = list(tokens)
+            tokens[0] = int(tokens[0]) ^ 1
+        return tokens
+
+
+def flip_state_bucket(state, bucket: int, plan=None):
+    """Set the low bit of one element of ``state.buffers[bucket]`` — the
+    injected silent corruption `GuardedTrainer._attempt` applies to the
+    state ENTERING a step when a ``flip`` fault is armed.
+
+    The flipped element is the bucket's LAST REAL parameter (`plan`
+    gives the bucket's true ``size``; without a plan, the buffer's last
+    element). One low mantissa bit is a ~2^-23 relative perturbation:
+    every downstream float32 reduction (matmul accumulations, the loss
+    mean) rounds it away for multiple steps, so the loss-bits sentinel
+    stays blind — while the bucket's EXACT uint32 wraparound checksum
+    differs at the very next step's in-program fingerprint. (A flip in
+    the padded tail would be even quieter, but the bucketed optimizer
+    rebuilds the pad region on every update, so it never survives into
+    the post-update fingerprint the sentinel votes on.)
+
+    Idempotent by construction (``|=``, not XOR): re-applying on every
+    attempt keeps the corruption persistent without toggling itself off.
+    Returns ``(new_state, bucket_used, element_index)``."""
+    import jax
+
+    nbuckets = len(state.buffers)
+    if nbuckets == 0:
+        return state, None, None
+    bucket = min(max(int(bucket), 0), nbuckets - 1)
+    buf = state.buffers[bucket]
+    # deliberate sync: fault injection materializes the bucket to flip a
+    # bit in host memory — chaos-run-only, never a production step path
+    arr = np.array(jax.device_get(buf))  # dearlint: disable=hot-path-sync
+    flat = arr.reshape(-1)
+    idx = flat.size - 1
+    if plan is not None and getattr(plan, "buckets", None):
+        # last element inside the bucket's true size (the flat buffer
+        # may carry a padded tail beyond it)
+        idx = min(int(plan.buckets[bucket].size) - 1, idx)
+    words = flat.view(np.uint32) if arr.dtype.itemsize == 4 else None
+    if words is not None:
+        words[idx] |= np.uint32(1)
+    else:  # non-4-byte dtypes: flip the low bit of the raw byte
+        raw = flat.view(np.uint8)
+        raw[idx * arr.dtype.itemsize] |= np.uint8(1)
+    new_buf = jax.device_put(arr, getattr(buf, "sharding", None))
+    buffers = list(state.buffers)
+    buffers[bucket] = new_buf
+    return state._replace(buffers=tuple(buffers)), bucket, idx

@@ -180,7 +180,10 @@ class ElasticVerdict(NamedTuple):
     reconfigured: bool = False   # a shrink committed during this sync
     admitted: tuple = ()         # ranks admitted during this sync
     lost: tuple = ()             # ranks dropped during this sync
-    drained: tuple = ()          # ranks that announced a planned departure
+    drained: tuple = ()         # ranks that announced a planned departure
+    sdc_suspects: tuple = ()    # (rank, bucket) fingerprint-vote losers
+    hosts: tuple = ()           # (rank, host-identity) ledger-key pairs
+    sdc_voted: bool = False     # enough voters reached this sync to blame
 
     @property
     def membership_changed(self) -> bool:
@@ -833,6 +836,21 @@ class ElasticCluster:
         if timeout_s is None:
             timeout_s = float(os.environ.get(REJOIN_TIMEOUT_ENV, "")
                               or max(10 * self.timeout_s, 60.0))
+        # SDC probation gate (resilience.sdc): a host the quarantine
+        # ledger holds must pass the known-answer self-test BEFORE the
+        # rejoin request is even filed — a silently-corrupting host must
+        # not get as far as the admission barrier. A relaunched seat on a
+        # fresh host passes trivially (no ledger state).
+        from dear_pytorch_tpu.resilience import sdc as _sdc
+        if _sdc.sdc_enabled():
+            _host = _sdc.host_identity(self.rank)
+            _ledger = _sdc.SdcSentinel.from_env(rank=self.rank)
+            if _ledger is not None and not _sdc.probation_gate(
+                    _ledger.ledger, _host):
+                raise ClusterError(
+                    f"rank {self.rank} on host {_host} is quarantined in "
+                    "the SDC ledger and failed (or was refused) the "
+                    "probation self-test — rejoin denied")
         nonce = uuid.uuid4().hex[:12]
         req_key = f"{self._ns}/rejoin/req/{self.rank}"
         self._transport.set(req_key, json.dumps(
@@ -878,6 +896,8 @@ class ElasticCluster:
         step: Optional[int] = None,
         preempted: bool = False,
         draining: bool = False,
+        sdc_fingerprint: str = "",
+        host: str = "",
     ) -> ElasticVerdict:
         """The per-check-interval member sync: any-rank-unhealthy, the
         desync sentinel, preemption propagation — and the membership
@@ -898,6 +918,7 @@ class ElasticCluster:
         payload = json.dumps({
             "ok": bool(ok), "fp": fingerprint, "pre": bool(preempted),
             "drain": bool(draining),
+            "sfp": sdc_fingerprint, "host": host,
             "rejoin": self._poll_rejoin_requests(),
         })
         try:
@@ -914,9 +935,10 @@ class ElasticCluster:
                 # wider than the observed-missing seed)
                 lost=tuple(m for m in members0
                            if m not in view.members))
-        unhealthy, fps, desync, any_pre = evaluate_health_views(
-            self.members, views, step=step,
-            scope=f"elastic (epoch {epoch0})")
+        unhealthy, fps, desync, any_pre, suspects, hosts, voted = (
+            evaluate_health_views(
+                members0, views, step=step,
+                scope=f"elastic (epoch {epoch0})"))
         announced = tuple(r for r, v in zip(members0, views)
                           if v.get("drain"))
         drains = announced
@@ -942,10 +964,11 @@ class ElasticCluster:
                 "the planned shrink; exiting after the emergency save",
                 self.rank, step)
             return ElasticVerdict(
-                ok=not unhealthy and not desync,
+                ok=not unhealthy and not desync and not suspects,
                 unhealthy_ranks=unhealthy, desync=desync,
                 any_preempted=any_pre, fingerprints=fps,
-                epoch=self.epoch, members=self.members, drained=drains)
+                epoch=self.epoch, members=self.members, drained=drains,
+                sdc_suspects=suspects, hosts=hosts, sdc_voted=voted)
         if drains:
             # planned shrink: commit NOW — no timeout window, the 2PC
             # runs over the survivors only (the drainer never proposes)
@@ -969,12 +992,14 @@ class ElasticCluster:
         moved = self.epoch != epoch0
         lost = tuple(m for m in members0 if m not in self.members)
         return ElasticVerdict(
-            ok=not unhealthy and not desync and not admitted and not moved,
+            ok=(not unhealthy and not desync and not admitted
+                and not moved and not suspects),
             unhealthy_ranks=unhealthy, desync=desync,
             any_preempted=any_pre, fingerprints=fps,
             epoch=self.epoch, members=self.members, admitted=admitted,
             reconfigured=moved and not admitted, lost=lost,
-            drained=drains)
+            drained=drains, sdc_suspects=suspects, hosts=hosts,
+            sdc_voted=voted)
 
     def consensus_restore_step(
         self, local_steps: Optional[Sequence[int]],

@@ -168,11 +168,16 @@ class ScalePolicy:
         return decision
 
     def decide(self, *, live_world: int, live_ranks: Tuple[int, ...] = (),
-               draining: Tuple[int, ...] = (),
+               draining: Tuple[int, ...] = (), quarantined: int = 0,
                ) -> Optional[ScaleDecision]:
         """One policy tick. ``live_world`` counts running ranks (draining
-        included), ``draining`` the ranks already being drained. Returns
-        the single action the supervisor should take now, or None."""
+        included), ``draining`` the ranks already being drained, and
+        ``quarantined`` the hosts held in the SDC quarantine ledger
+        (`resilience.sdc`): each one shrinks the usable pool, so the
+        capacity hint is capped — asking a spot pool for machines the SDC
+        sentinel has impounded just thrashes admit/evict churn against
+        hosts that will be refused a seat. Returns the single action the
+        supervisor should take now, or None."""
         now = self._clock()
         hint = read_capacity_file(self.capacity_file)
         if hint is None:
@@ -199,6 +204,20 @@ class ScalePolicy:
         if hint.target_world is None:
             return None
         target = self._clamp(hint.target_world)
+        # quarantined hosts are out of the pool until probation readmits
+        # them: cap the usable world BEFORE hysteresis, so the capped
+        # value is what must hold stable (a readmission mid-dwell simply
+        # restarts the clock at the larger target)
+        if quarantined > 0:
+            ceiling = (self.max_world if self.max_world is not None
+                       else target) - int(quarantined)
+            capped = max(min(target, ceiling), self.min_world)
+            if capped < target:
+                logger.warning(
+                    "scale policy: target %d capped to %d — %d host(s) "
+                    "quarantined in the SDC ledger", target, capped,
+                    quarantined)
+                target = capped
         # hysteresis leg 1: the hint must hold stable
         if target != self._hint_value:
             self._hint_value, self._hint_since = target, now

@@ -25,7 +25,9 @@ Lifecycle under the fleet substrate (`launch/supervisor.py`):
     latency: a straggling replica), ``hang``, ``exc`` (crash-for-
     relaunch), ``preempt`` (self-SIGTERM into the drain path), and
     ``corrupt_resp`` (one response's bytes corrupted AFTER signing, so
-    the router's checksum catches it).
+    the router's checksum catches it). ``flip_logits`` flips tokens
+    BEFORE signing — the checksum verifies clean; only the router's SDC
+    shadow-replay vote (`resilience.sdc`) can catch it.
 
 Telemetry: ``serve.replica_served`` per response written (two-lookup
 disabled gate, scripts/check_telemetry_overhead.py). Under
@@ -60,11 +62,20 @@ class ReplicaServer:
     def __init__(self, root: str, rank: int, engine, *, version: int = 0,
                  quality: float = 1.0, injector=None, preemption=None,
                  feedback=None, poll_s: float = 0.005,
-                 heartbeat_s: float = 0.2):
+                 heartbeat_s: float = 0.2, host: Optional[str] = None):
         self.root = os.path.abspath(root)
         self.rank = int(rank)
         self.engine = engine
         self.version = int(version)
+        # host identity for the SDC quarantine ledger (resilience.sdc):
+        # strikes follow the MACHINE, not the replica rank — the
+        # heartbeat carries it so the router's shadow-replay arbiter can
+        # convict the right host
+        if host is None:
+            from dear_pytorch_tpu.resilience import sdc as _sdc
+
+            host = _sdc.host_identity(self.rank)
+        self.host = host
         # the load-time quality probe for THIS version's weights
         # (`serving.weights.params_finite_fraction`): stamped into every
         # heartbeat and response so the router's canary verdict can score
@@ -119,6 +130,7 @@ class ReplicaServer:
             "ts": time.time(),
             "pid": os.getpid(),
             "incarnation": self.incarnation,
+            "host": self.host,
             "version": self.version,
             "quality": self.quality,
             "draining": self.draining,
@@ -201,8 +213,13 @@ class ReplicaServer:
         return taken
 
     def _write_response(self, fin) -> None:
-        self._write_payload(fin.request_id,
-                            [int(t) for t in fin.tokens],
+        tokens = [int(t) for t in fin.tokens]
+        if self.injector is not None:
+            # `flip_logits` lands HERE — before signing — so the payload
+            # verifies clean at the router and only the shadow-replay
+            # vote (`resilience.sdc`) can catch the damage
+            tokens = self.injector.corrupt_tokens(self.served + 1, tokens)
+        self._write_payload(fin.request_id, tokens,
                             prefill_s=getattr(fin, "prefill_s", None),
                             decode_s=getattr(fin, "decode_s", None),
                             trace=getattr(fin, "trace", None))

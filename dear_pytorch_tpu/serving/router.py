@@ -60,6 +60,23 @@ store-side rollback (`serving.weights.mark_rolled_back`) and drives the
 PR-11 drain/backfill machinery in reverse. The verdict is pure
 arithmetic over observed responses — no randomness, no wall-clock
 thresholds — so replaying the same response stream reproduces it.
+
+**SDC shadow replay** (``shadow_every`` / ``DEAR_SDC_SHADOW_EVERY``,
+`resilience.sdc`): the response checksum only proves the bytes survived
+the wire — a replica whose chip silently corrupts its logits signs the
+wrong tokens correctly, so the checksum verifies clean. The serving twin
+of the training fleet's fingerprint vote: every ``shadow_every``-th
+verified response is re-decoded on a SECOND replica (greedy decode makes
+the comparison exact); a mismatch dispatches a third-replica arbiter and
+the 3-way token majority convicts the odd replica out. The culprit's
+HOST lands in the same durable quarantine ledger the training side
+writes (``sdc_ledger``), the replica is fenced from all future dispatch
+(its in-flight work re-queues), and ``on_sdc(rank, host)`` fires once so
+the harness can drive the existing drain/backfill path. Shadow and
+arbiter probes are internal requests — never ``accepted``, so the
+zero-drop gate and admission accounting are untouched. Counters:
+``sdc.shadow_replays`` / ``sdc.shadow_verified`` /
+``sdc.shadow_mismatches`` / ``sdc.shadow_skipped``.
 """
 
 from __future__ import annotations
@@ -186,7 +203,8 @@ class _Pending:
 
 class _Replica:
     __slots__ = ("rank", "incarnation", "version", "last_wall_ts",
-                 "draining", "healthy", "inflight", "seen_t")
+                 "draining", "healthy", "inflight", "seen_t", "host",
+                 "quarantined")
 
     def __init__(self, rank):
         self.rank = rank
@@ -197,6 +215,8 @@ class _Replica:
         self.healthy = False
         self.inflight: set = set()
         self.seen_t = 0.0
+        self.host = ""          # heartbeat-reported machine identity
+        self.quarantined = False  # SDC shadow-replay conviction fence
 
 
 class ReplicaRouter:
@@ -205,7 +225,9 @@ class ReplicaRouter:
     def __init__(self, root: str, *, admission: AdmissionController,
                  slots_per_replica: int = 4, health_timeout_s: float = 6.0,
                  poll_s: float = 0.02, canary: Optional[
-                     "CanaryController"] = None, on_canary=None):
+                     "CanaryController"] = None, on_canary=None,
+                 shadow_every: Optional[int] = None, sdc_ledger=None,
+                 on_sdc=None):
         self.root = os.path.abspath(root)
         self.admission = admission
         self.canary = canary
@@ -213,6 +235,30 @@ class ReplicaRouter:
         # I/O: mark_rolled_back + capacity-file drains in the harness)
         self.on_canary = on_canary
         self.canary_verdicts: List[tuple] = []
+        # -- SDC shadow replay (resilience.sdc, docs/RESILIENCE.md):
+        # every `shadow_every`-th verified response is re-decoded on a
+        # SECOND replica. Greedy decode is deterministic, so the vote is
+        # exact: a token mismatch dispatches a third-replica arbiter, the
+        # 3-way majority convicts the odd one out, and the culprit's HOST
+        # goes into the durable quarantine ledger — the same ledger the
+        # training fleet's fingerprint vote writes. Shadow/arbiter
+        # requests are internal: they never enter `accepted`, so the
+        # zero-drop gate and admission accounting are untouched.
+        if shadow_every is None:
+            raw = os.environ.get("DEAR_SDC_SHADOW_EVERY", "").strip()
+            shadow_every = int(raw) if raw else 0
+        self.shadow_every = max(int(shadow_every), 0)
+        self.sdc_ledger = sdc_ledger
+        # fires once per conviction as (rank, host), outside the lock —
+        # the harness points it at the drain/backfill machinery
+        self.on_sdc = on_sdc
+        self._shadow_meta: Dict[str, dict] = {}  # internal rid -> case
+        self._shadow_count = 0   # verified primary responses seen
+        self.shadow_replays = 0
+        self.shadow_verified = 0
+        self.shadow_mismatches = 0
+        self.shadow_skipped = 0
+        self.sdc_convictions: List[tuple] = []   # (rank, host)
         self.slots_per_replica = int(slots_per_replica)
         self.health_timeout_s = float(health_timeout_s)
         self.poll_s = float(poll_s)
@@ -319,7 +365,8 @@ class ReplicaRouter:
     def healthy_replicas(self) -> List[int]:
         with self._lock:
             return sorted(r.rank for r in self._replicas.values()
-                          if r.healthy and not r.draining)
+                          if r.healthy and not r.draining
+                          and not r.quarantined)
 
     def fleet_versions(self) -> Dict[int, Optional[int]]:
         with self._lock:
@@ -351,6 +398,11 @@ class ReplicaRouter:
                                else round(pct(0.99) * 1e3, 2)),
             "healthy": self.healthy_replicas(),
             "canary_verdicts": list(self.canary_verdicts),
+            "shadow_replays": self.shadow_replays,
+            "shadow_verified": self.shadow_verified,
+            "shadow_mismatches": self.shadow_mismatches,
+            "shadow_skipped": self.shadow_skipped,
+            "sdc_convictions": list(self.sdc_convictions),
         }
 
     # -- the routing loop ----------------------------------------------------
@@ -431,6 +483,20 @@ class ReplicaRouter:
                         and incarnation != rep.incarnation):
                     # restart observed: its inbox may have been cleared
                     self._reclaim_locked(rep, "reincarnated")
+                    if rep.quarantined:
+                        # a backfilled incarnation is a different seat
+                        # occupant: lift the conviction fence iff the
+                        # seat's host is not (or no longer) quarantined
+                        # in the ledger. The ledger read is two tiny
+                        # file reads and fires once per backfill, not
+                        # per scan.
+                        host = doc.get("host") or rep.host
+                        if (self.sdc_ledger is None
+                                or not self.sdc_ledger.quarantined(host)):
+                            rep.quarantined = False
+                            if tr.enabled:
+                                tr.event("sdc.serving_unfence",
+                                         replica=rank, host=host)
                 if (rep.version is not None and version is not None
                         and version > rep.version):
                     # the rolling restart's purpose: this replica now
@@ -447,6 +513,7 @@ class ReplicaRouter:
                 rep.incarnation = incarnation
                 if version is not None:
                     rep.version = version
+                rep.host = doc.get("host") or rep.host
                 rep.last_wall_ts = float(doc.get("ts", 0.0))
                 rep.draining = bool(doc.get("draining"))
                 was_healthy = rep.healthy
@@ -509,10 +576,24 @@ class ReplicaRouter:
             with self._lock:
                 targets = [r for r in self._replicas.values()
                            if r.healthy and not r.draining
+                           and not r.quarantined
                            and len(r.inflight) < self.slots_per_replica]
                 if not self._pending or not targets:
                     return
-                targets = self._canary_filter_locked(targets)
+                meta = self._shadow_meta.get(self._pending[0])
+                if meta is not None:
+                    # a shadow/arbiter must land on a replica whose
+                    # answer it is NOT double-checking; with no eligible
+                    # second (or third) opinion free right now, it steps
+                    # aside so real traffic keeps flowing
+                    eligible = [r for r in targets
+                                if r.rank not in meta["avoid"]]
+                    if not eligible:
+                        self._pending.rotate(-1)
+                        return
+                    targets = eligible
+                else:
+                    targets = self._canary_filter_locked(targets)
                 rep = min(targets, key=lambda r: (len(r.inflight), r.rank))
                 rid = self._pending.popleft()
                 record = self._requests[rid].record
@@ -593,6 +674,16 @@ class ReplicaRouter:
                 except OSError:
                     pass
                 continue
+            meta = self._shadow_meta.get(rid)
+            if meta is not None:
+                # an internal shadow/arbiter response: adjudicate and
+                # drop — it never touches completion accounting
+                self._finish_internal(rid, meta, doc)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
             now_wall = time.time()
             service_s = time.monotonic() - pend.submitted_t
             with self._lock:
@@ -618,6 +709,10 @@ class ReplicaRouter:
                 tr.count("serve.completed")
                 if missed:
                     tr.count("serve.deadline_missed")
+            if self.shadow_every and "error" not in doc:
+                self._shadow_count += 1
+                if self._shadow_count % self.shadow_every == 0:
+                    self._spawn_shadow(rid, pend.record, doc)
             if self.canary is not None:
                 self.canary.observe(doc.get("model_version"), service_s,
                                     doc.get("quality"))
@@ -667,3 +762,155 @@ class ReplicaRouter:
                 os.unlink(path)
             except OSError:
                 pass
+
+    # -- SDC shadow replay (resilience.sdc) ----------------------------------
+
+    def _eligible_shadow_ranks(self, avoid) -> List[int]:
+        with self._lock:
+            return sorted(r.rank for r in self._replicas.values()
+                          if r.healthy and not r.draining
+                          and not r.quarantined and r.rank not in avoid)
+
+    def _enqueue_internal(self, meta: dict) -> None:
+        """Queue a shadow/arbiter re-decode as an internal request: a
+        real `_Pending` (so death-reclaim and checksum verification
+        apply unchanged) that is never `accepted` (zero-drop gate and
+        admission untouched)."""
+        srid = "sdc" + uuid.uuid4().hex[:13]
+        record = {
+            "id": srid,
+            "prompt": list(meta["prompt"]),
+            "max_new_tokens": int(meta["max_new_tokens"]),
+            "deadline_ts": None,
+            "trace": _dtrace.new_trace().to_dict(),
+        }
+        pend = _Pending(record, time.monotonic(), None)
+        with self._lock:
+            self._requests[srid] = pend
+            self._shadow_meta[srid] = meta
+            self._pending.append(srid)
+
+    def _spawn_shadow(self, rid: str, record: dict, doc: dict) -> None:
+        tr = _telemetry.get_tracer()
+        primary = doc.get("replica")
+        avoid = set() if primary is None else {int(primary)}
+        if not self._eligible_shadow_ranks(avoid):
+            self.shadow_skipped += 1
+            if tr.enabled:
+                tr.count("sdc.shadow_skipped")
+            return
+        self.shadow_replays += 1
+        if tr.enabled:
+            tr.count("sdc.shadow_replays")
+        self._enqueue_internal({
+            "kind": "shadow",
+            "primary": rid,
+            "prompt": list(record["prompt"]),
+            "max_new_tokens": record["max_new_tokens"],
+            "tokens": [int(t) for t in doc.get("tokens") or []],
+            "replica": primary,
+            "avoid": avoid,
+        })
+
+    def _finish_internal(self, rid: str, meta: dict, doc: dict) -> None:
+        tr = _telemetry.get_tracer()
+        with self._lock:
+            rank = self._assigned.pop(rid, None)
+            if rank is not None and rank in self._replicas:
+                self._replicas[rank].inflight.discard(rid)
+            self._requests.pop(rid, None)
+            self._shadow_meta.pop(rid, None)
+        if rank is None:
+            # the serving replica died between answering and our read —
+            # the response still names who produced it
+            rank = doc.get("replica")
+        if "error" in doc:
+            # the re-decode itself failed: no comparable evidence either
+            # way — drop the probe, never the verdict
+            self.shadow_skipped += 1
+            if tr.enabled:
+                tr.count("sdc.shadow_skipped")
+            return
+        tokens = [int(t) for t in doc.get("tokens") or []]
+        if meta["kind"] == "shadow":
+            if tokens == meta["tokens"]:
+                self.shadow_verified += 1
+                if tr.enabled:
+                    tr.count("sdc.shadow_verified")
+                return
+            # greedy decode is deterministic: two replicas disagreeing
+            # on the same prompt means one of them is corrupting — a
+            # third replica breaks the tie
+            self.shadow_mismatches += 1
+            if tr.enabled:
+                tr.count("sdc.shadow_mismatches")
+                tr.event("sdc.shadow_mismatch", request=meta["primary"],
+                         primary_replica=meta["replica"],
+                         shadow_replica=rank)
+            avoid = set(meta["avoid"]) | ({rank} if rank is not None
+                                          else set())
+            if not self._eligible_shadow_ranks(avoid):
+                self.shadow_skipped += 1
+                if tr.enabled:
+                    tr.count("sdc.shadow_skipped")
+                return
+            self._enqueue_internal({
+                "kind": "arbiter",
+                "primary": meta["primary"],
+                "prompt": meta["prompt"],
+                "max_new_tokens": meta["max_new_tokens"],
+                "tokens": meta["tokens"],
+                "replica": meta["replica"],
+                "shadow_tokens": tokens,
+                "shadow_replica": rank,
+                "avoid": avoid,
+            })
+            return
+        # the arbiter's verdict: 3-way exact-token majority
+        if tokens == meta["tokens"] and tokens != meta["shadow_tokens"]:
+            culprit = meta["shadow_replica"]
+        elif tokens == meta["shadow_tokens"] and tokens != meta["tokens"]:
+            culprit = meta["replica"]
+        else:
+            # three distinct answers: no majority, no conviction — the
+            # next shadow probe gets another chance
+            culprit = None
+        if tr.enabled:
+            tr.event("sdc.shadow_arbitration", request=meta["primary"],
+                     culprit=-1 if culprit is None else int(culprit),
+                     arbiter=rank)
+        if culprit is not None:
+            self._convict_replica(int(culprit), request=meta["primary"],
+                                  arbiter=rank)
+
+    def _convict_replica(self, rank: int, **info) -> None:
+        """Strike a corrupting replica into the durable quarantine
+        ledger (host-keyed — the machine, not the seat) and fence it
+        from all future dispatch; its in-flight work is re-queued."""
+        with self._lock:
+            rep = self._replicas.get(rank)
+            if rep is None or rep.quarantined:
+                return
+            rep.quarantined = True
+            host = rep.host or f"replica-{rank}"
+            self._reclaim_locked(rep, "sdc_quarantined")
+        self.sdc_convictions.append((rank, host))
+        tr = _telemetry.get_tracer()
+        if tr.enabled:
+            tr.event("sdc.serving_conviction", replica=rank, host=host,
+                     **info)
+        if self.sdc_ledger is not None:
+            self.sdc_ledger.convict(
+                host, rank=rank, bucket=-1, step=len(self.completed),
+                source="serving_shadow")
+        if self.on_sdc is not None:
+            try:
+                self.on_sdc(rank, host)
+            except Exception:  # noqa: BLE001 — a broken drain hook must
+                #               not stop response collection; the
+                #               dispatch-side fence already protects
+                #               traffic
+                import logging
+
+                logging.getLogger("dear_pytorch_tpu").exception(
+                    "router: on_sdc hook failed")

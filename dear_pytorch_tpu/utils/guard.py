@@ -94,6 +94,7 @@ from dear_pytorch_tpu.observability import flight as _flight
 from dear_pytorch_tpu.observability import tracer as _telemetry
 from dear_pytorch_tpu.resilience import cluster as _cluster
 from dear_pytorch_tpu.resilience import inject as _inject
+from dear_pytorch_tpu.resilience import sdc as _sdc
 from dear_pytorch_tpu.utils import checkpoint as ckpt
 
 logger = logging.getLogger("dear_pytorch_tpu")
@@ -194,6 +195,16 @@ class GuardedTrainer:
         # caller owns the streamer's lifecycle; `finalize` only flushes.
         self._streamer = streamer
         self._pending_reshard = False
+        # SDC sentinel (resilience.sdc): per-bucket fingerprint voting on
+        # the health exchange, the replay arbiter over the rollback path,
+        # and the host-keyed quarantine ledger. Armed by DEAR_SDC on
+        # coordinated runs only — the vote needs peers.
+        self._sdc: Optional[_sdc.SdcSentinel] = None
+        self._sdc_drain = False
+        if self._coordinator is not None and _sdc.sdc_enabled():
+            sdc_rank = getattr(self._coordinator, "rank",
+                               getattr(self._coordinator, "index", None))
+            self._sdc = _sdc.SdcSentinel.from_env(rank=sdc_rank)
         # run-health layer: flight ring (enabled alongside telemetry; see
         # the _flight property), anomaly detectors on the check cadence,
         # and — on coordinated runs — the digest aggregation that rides
@@ -674,6 +685,27 @@ class GuardedTrainer:
         to diverge between the two call sites."""
         ds = _dtrace.get_stream()
         t0 = time.monotonic() if ds.enabled else 0.0
+        if self._injector is not None:
+            flip = self._injector.flip_bucket_for(self.steps_seen + 1)
+            if flip is not None:
+                # silent-corruption injection: a bit-flip in the bucket
+                # state entering this step — the corrupted value is
+                # validly checksummed everywhere downstream (wire
+                # integrity cannot see it) and sits in the bucket's
+                # padded tail (the loss-bits sentinel cannot either);
+                # only the cross-rank fingerprint vote can. Applied on
+                # the INPUT state so the in-program fingerprint of this
+                # step reflects it — a deterministic fault reproduces on
+                # the post-rollback replay and convicts.
+                state, used, idx = _inject.flip_state_bucket(
+                    state, flip, plan=getattr(self.ts, "plan", None))
+                if tr.enabled:
+                    tr.count("faults.sdc_flips")
+                if used is not None:
+                    logger.warning(
+                        "guard: injected SDC bit-flip at attempt %d — "
+                        "bucket %d element %d",
+                        self.steps_seen + 1, used, idx)
         new_state, metrics = self.ts.step(state, batch)
         self.steps_seen += 1
         is_ckpt = self.steps_seen % self.checkpoint_every == 0
@@ -863,6 +895,20 @@ class GuardedTrainer:
             if healthy and metrics is not None:
                 fp = _cluster.ClusterCoordinator.fingerprint(
                     jax.device_get(metrics["loss"]))
+            sfp = ""
+            if self._sdc is not None and healthy and metrics is not None:
+                # the per-bucket checksums were computed IN-PROGRAM by
+                # the train step; this is the lazy gather, paid only at
+                # check cadence (same host sync as the loss fingerprint)
+                words = metrics.get("sdc_fp")
+                dcn = getattr(self.ts, "dcn", None)
+                extra = getattr(dcn, "last_mean_fp", "") if dcn else ""
+                if words is not None or extra:
+                    # deliberate sync: a tiny uint32[buckets] vector at
+                    # health-sync cadence, never per step
+                    sfp = self._sdc.local_fingerprint(
+                        None if words is None
+                        else jax.device_get(words), extra)  # dearlint: disable=hot-path-sync
             pre_req = (self._preemption is not None
                        and self._preemption.requested
                        and not self._preempt_handled)
@@ -870,10 +916,14 @@ class GuardedTrainer:
             # shrink (spot semantics: each reclaimed rank gets its own
             # signal) instead of propagating full-fleet preemption;
             # DEAR_PREEMPT_DRAIN=0 restores propagate-and-save-everywhere
-            drain = pre_req and self._drain_on_preempt
+            drain = (pre_req and self._drain_on_preempt
+                     or self._sdc_drain)
             sync_kwargs = dict(
                 ok=local_ok, fingerprint=fp, step=self.steps_seen,
                 preempted=pre_req and not drain)
+            if self._sdc is not None:
+                sync_kwargs["sdc_fingerprint"] = sfp
+                sync_kwargs["host"] = self._sdc.host
             if drain:
                 sync_kwargs["draining"] = True
             try:
@@ -913,6 +963,48 @@ class GuardedTrainer:
                 raise
             if verdict.any_preempted:
                 self._peer_preempt = True
+            if self._sdc is not None:
+                hosts_by_rank = {
+                    int(r): h
+                    for r, h in getattr(verdict, "hosts", ()) if h}
+                acts = self._sdc.note_votes(
+                    getattr(verdict, "sdc_suspects", ()), hosts_by_rank,
+                    step=self.steps_seen,
+                    voted=getattr(verdict, "sdc_voted", False))
+                if acts["opened"]:
+                    logger.critical(
+                        "guard: SDC case opened against host(s) %s at "
+                        "step %d — the coordinated rollback is the "
+                        "replay arbiter (deterministic re-run from the "
+                        "last verified checkpoint on suspect AND peers)",
+                        acts["opened"], self.steps_seen)
+                if acts["struck"]:
+                    logger.warning(
+                        "guard: SDC replay came back clean for host(s) "
+                        "%s — transient fault, strike recorded",
+                        acts["struck"])
+                if acts["convicted"]:
+                    logger.critical(
+                        "guard: SDC conviction — host(s) %s quarantined "
+                        "in the ledger", acts["convicted"])
+                if self._sdc.drain_requested and not self._sdc_drain:
+                    # THIS host was convicted: fence checkpoint saves and
+                    # announce a planned-shrink drain at the next sync
+                    self._sdc_drain = True
+                    logger.critical(
+                        "guard: host %s is quarantined — draining via "
+                        "planned shrink; checkpoint saves fenced",
+                        self._sdc.host)
+            if getattr(verdict, "self_draining", False) and self._sdc_drain:
+                # the survivors acknowledged the quarantine drain and are
+                # committing the planned shrink without me. NO emergency
+                # save — this host's state is the corrupt copy; the
+                # supervisor reads the exit code as "backfill this seat
+                # on a FRESH host".
+                raise _sdc.SdcQuarantined(
+                    f"host {self._sdc.host} is quarantined in the SDC "
+                    "ledger; planned-shrink drain committed — exiting "
+                    "for backfill on a fresh host")
             if getattr(verdict, "self_draining", False):
                 # the fleet acknowledged my drain announcement and is
                 # committing the planned shrink without me: emergency-save
@@ -1038,7 +1130,7 @@ class GuardedTrainer:
                     out["preempt_checkpoint_step"] = self._preempt_saved_step
             return restored, out
 
-        if is_ckpt and self._save(new_state):
+        if is_ckpt and not self._sdc_drain and self._save(new_state):
             # persisted healthy progress: a future rollback is a NEW
             # incident, not a continuation of an old one. A FAILED async
             # save must not reset the counter — nothing was persisted, and

@@ -73,16 +73,32 @@ ELASTIC_REJOIN_ENV = "DEAR_ELASTIC_REJOIN"
 ELASTIC_RPS_ENV = "DEAR_ELASTIC_RANKS_PER_SLICE"
 
 
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def _import_scale():
     """The policy lives in the package (`resilience.scale`) so its
     counters are audited with everything else; the supervisor is runnable
     from anywhere, so bootstrap the repo root onto sys.path first."""
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = _repo_root()
     if repo not in sys.path:
         sys.path.insert(0, repo)
     from dear_pytorch_tpu.resilience import scale
 
     return scale
+
+
+def _import_sdc():
+    """`resilience.sdc` is jax-free at module scope (the self-test imports
+    jax lazily, and runs in a subprocess anyway) — safe for the
+    supervisor's no-jax parent process."""
+    repo = _repo_root()
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from dear_pytorch_tpu.resilience import sdc
+
+    return sdc
 
 
 class ElasticSupervisor:
@@ -134,6 +150,115 @@ class ElasticSupervisor:
         self.events: List[tuple] = []    # (what, rank) policy/churn audit
         self._pid_dir = os.path.join(self.elastic_dir, "supervisor", "pids")
         os.makedirs(self._pid_dir, exist_ok=True)
+        # -- SDC quarantine (docs/RESILIENCE.md "SDC sentinel"): the
+        # supervisor owns HOST IDENTITY. Rank ids are seats; strikes and
+        # convictions in the SDC ledger are charged to the host a seat is
+        # on, so a relaunched rank on the same host INHERITS its ledger
+        # state. The pool is persisted under <dir>/supervisor/hosts/<rank>
+        # so identity survives a supervisor restart, and each spawn
+        # exports it as DEAR_SDC_HOST.
+        self.sdc_active = self.base_env.get("DEAR_SDC", "") == "1"
+        self._host_dir = os.path.join(self.elastic_dir, "supervisor",
+                                      "hosts")
+        os.makedirs(self._host_dir, exist_ok=True)
+        self._hosts: Dict[int, str] = {}
+        for name in os.listdir(self._host_dir):
+            try:
+                with open(os.path.join(self._host_dir, name)) as f:
+                    self._hosts[int(name)] = f.read().strip()
+            except (ValueError, OSError):
+                continue
+        self._host_seq = 0
+        self._ledger = None              # lazy resilience.sdc.SdcLedger
+        self._probation: Dict[str, subprocess.Popen] = {}
+        self._probation_done: set = set()  # hosts ever sent to probation
+
+    # -- host identity & the SDC quarantine ledger ---------------------------
+
+    def _mint_host(self) -> str:
+        """A fresh host id no seat has ever used (stand-in for asking the
+        cluster manager for a different machine)."""
+        used = set(self._hosts.values())
+        while True:
+            self._host_seq += 1
+            host = f"host-{self._host_seq}"
+            if host not in used:
+                return host
+
+    def _set_host(self, rank: int, host: str) -> None:
+        self._hosts[rank] = host
+        with open(os.path.join(self._host_dir, str(rank)), "w") as f:
+            f.write(host)
+
+    def ledger(self):
+        """The durable quarantine ledger (first-writer-wins records under
+        <dir>/sdc) — the same store every worker rank appends to."""
+        if self._ledger is None:
+            sdc = _import_sdc()
+            root = self.base_env.get(sdc.LEDGER_ENV) or os.path.join(
+                self.elastic_dir, "sdc")
+            self._ledger = sdc.ledger_from_dir(root)
+        return self._ledger
+
+    def _seat_host(self, rank: int) -> str:
+        """The host a seat will run on next. A quarantined host is NEVER
+        re-seated: the ledger is consulted before every (re)launch and a
+        convicted host is swapped for a fresh one — it can only come back
+        through the probation self-test, and even then only via a worker's
+        own rejoin gate."""
+        host = self._hosts.get(rank)
+        if host is None:
+            host = self._mint_host()
+            self._set_host(rank, host)
+        if self.sdc_active and self.ledger().quarantined(host):
+            fresh = self._mint_host()
+            self._log(
+                f"supervisor: host {host} (rank {rank}) is quarantined in "
+                f"the SDC ledger — re-seating on fresh host {fresh}")
+            self.events.append(("sdc_reseat", rank))
+            self._start_probation(host)
+            self._set_host(rank, fresh)
+            host = fresh
+        return host
+
+    def _start_probation(self, host: str) -> None:
+        """Kick off the known-answer self-test for a quarantined host,
+        once per host, without blocking supervision: a subprocess runs
+        `resilience.sdc --selftest` and writes the readmission record
+        itself iff the burn-in passes."""
+        if not self.sdc_active or host in self._probation_done:
+            return
+        self._probation_done.add(host)
+        sdc = _import_sdc()
+        root = self.base_env.get(sdc.LEDGER_ENV) or os.path.join(
+            self.elastic_dir, "sdc")
+        env = dict(self.base_env)
+        env["PYTHONPATH"] = _repo_root() + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env[sdc.HOST_ENV] = host
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dear_pytorch_tpu.resilience.sdc",
+             "--selftest", "--ledger", root, "--host", host],
+            env=env)
+        self._probation[host] = proc
+        self.events.append(("sdc_probation", host))
+        self._log(f"supervisor: probation self-test started for "
+                  f"quarantined host {host} pid={proc.pid}")
+
+    def _reap_probation(self) -> None:
+        for host, proc in list(self._probation.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            del self._probation[host]
+            if rc == 0:
+                self.events.append(("sdc_readmit", host))
+                self._log(f"supervisor: host {host} passed the probation "
+                          "self-test — readmitted in the SDC ledger")
+            else:
+                self.events.append(("sdc_probation_failed", host))
+                self._log(f"supervisor: host {host} FAILED the probation "
+                          f"self-test rc={rc} — stays quarantined")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -142,6 +267,7 @@ class ElasticSupervisor:
         env[ELASTIC_DIR_ENV] = self.elastic_dir
         env[ELASTIC_RANK_ENV] = str(rank)
         env[ELASTIC_WORLD_ENV] = str(self.nprocs)
+        env["DEAR_SDC_HOST"] = self._seat_host(rank)
         if self.ranks_per_slice is not None:
             env[ELASTIC_RPS_ENV] = str(self.ranks_per_slice)
         if rejoin:
@@ -241,9 +367,12 @@ class ElasticSupervisor:
             # waited out their whole rejoin timeout against a dead fleet)
             return
         live = tuple(sorted(self._procs))
+        quarantined = (len(self.ledger().quarantined_hosts())
+                       if self.sdc_active else 0)
         decision = self.policy.decide(
             live_world=len(live), live_ranks=live,
-            draining=tuple(sorted(self._draining & set(live))))
+            draining=tuple(sorted(self._draining & set(live))),
+            quarantined=quarantined)
         if decision is None:
             return
         if decision.kind == "scale_up":
@@ -282,7 +411,33 @@ class ElasticSupervisor:
                     self.events.append(("drained_dirty", rank))
                     self._final_rc[rank] = 0  # a requested removal is
                     #                           not a job failure
+                host = self._hosts.get(rank)
+                if self.sdc_active and host \
+                        and self.ledger().quarantined(host):
+                    # the seat is now empty and its host sits in the
+                    # quarantine ledger: the scale policy holds the
+                    # backfill (capacity cap) until a readmission, so
+                    # the probation self-test must start NOW — waiting
+                    # for a re-seat attempt would deadlock against the
+                    # cap that quarantine itself imposes
+                    self._start_probation(host)
                 self._backfill.append(rank)
+                continue
+            if rc == 75:  # resilience.sdc.QUARANTINE_RC: the worker
+                # convicted its OWN host in the ledger, committed a
+                # planned membership shrink, and exited for backfill — a
+                # requested removal, so no relaunch budget is burned. The
+                # seat respawns immediately; `_seat_host` sees the
+                # quarantined host and swaps in a fresh one (and starts
+                # the old host's probation self-test).
+                self._log(
+                    f"supervisor: rank {rank} exited rc=75 (SDC "
+                    "quarantine drain); respawning the seat on a fresh "
+                    "host")
+                self.events.append(("sdc_quarantine", rank))
+                self._final_rc[rank] = 0
+                time.sleep(self.relaunch_delay_s)
+                self._spawn(rank, rejoin=True)
                 continue
             if rc == 0:
                 self._log(f"supervisor: rank {rank} finished cleanly")
@@ -302,6 +457,7 @@ class ElasticSupervisor:
                 f"({self.relaunches.get(rank, 0) + 1}/{self.max_relaunches})"
                 f" in {self.relaunch_delay_s:.1f}s")
             self._relaunch(rank)
+        self._reap_probation()
         self._policy_tick()
         return bool(self._procs)
 
@@ -323,6 +479,15 @@ class ElasticSupervisor:
                 self._procs.clear()
                 return 124
             time.sleep(poll_s)
+        # the fleet is done; give any in-flight probation self-test a
+        # bounded window to write its readmission record (it is a short
+        # known-answer burn-in, not a training job)
+        for host, proc in list(self._probation.items()):
+            try:
+                proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._reap_probation()
         bad = {r: rc for r, rc in self._final_rc.items() if rc != 0}
         if bad:
             self._log(f"supervisor: failed rank exits: {bad}")

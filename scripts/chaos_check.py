@@ -665,6 +665,547 @@ def run_elastic(nprocs: int, checkpoint_every: int,
     return summary
 
 
+def run_worker_sdc(checkpoint_every: int, workdir: str) -> dict:
+    """One rank of the SDC storm (spawned — and re-seated after a
+    quarantine — by `launch/supervisor.py`). Mirrors `run_worker_elastic`
+    with the fingerprint sentinel armed (``DEAR_SDC=1``): rank 1 carries
+    a persistent ``flip`` fault (a low bit in a bucket's padded tail —
+    invisible to wire checksums and the loss-bits sentinel), the
+    fingerprint vote localizes it, the coordinated rollback replays it,
+    the conviction drains this rank via planned shrink, and the process
+    exits `resilience.sdc.QUARANTINE_RC` after writing an
+    ``sdc_exit_rank<r>.json`` forensics record. The supervisor's
+    backfill re-enters on a FRESH host through the normal rejoin path
+    (minus the fault: a new host does not inherit the stuck lane)."""
+    import importlib.util
+    import json
+
+    os.environ["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    os.environ["DEAR_CKPT_SHARED"] = "0"
+    from dear_pytorch_tpu import _jax_compat
+
+    _jax_compat.set_cpu_device_count(4, scrub_env=True)
+
+    import jax
+    import numpy as np
+
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.ops.fused_sgd import fused_sgd
+    from dear_pytorch_tpu.resilience import inject as INJ
+    from dear_pytorch_tpu.resilience import membership as M
+    from dear_pytorch_tpu.resilience import sdc as SDC
+    from dear_pytorch_tpu.runtime import build as RB
+    from dear_pytorch_tpu.runtime import pipeline as P
+    from dear_pytorch_tpu.tuning.autotune import AutoTuner
+    from dear_pytorch_tpu.utils import checkpoint as ckpt
+    from dear_pytorch_tpu.utils.guard import GuardedTrainer
+
+    eh_spec = importlib.util.spec_from_file_location(
+        "dear_elastic_harness",
+        os.path.join(REPO, "tests", "elastic_harness.py"))
+    EH = importlib.util.module_from_spec(eh_spec)
+    eh_spec.loader.exec_module(EH)
+
+    rejoining = M.ElasticCluster.rejoining_by_env()
+    if rejoining:
+        # the backfilled seat runs on a FRESH host (the supervisor
+        # minted a new DEAR_SDC_HOST): the stuck-lane flip belongs to
+        # the quarantined hardware, not to the rank id — re-arming it
+        # here would corrupt the fresh host too
+        os.environ.pop(INJ.FAULT_ENV, None)
+    cluster = M.ElasticCluster.from_env(max_candidates=256)
+    rank, world0 = cluster.rank, cluster.world
+    post_steps = int(os.environ.get("DEAR_CHAOS_ELASTIC_POST", "4"))
+    ckpt_dir = os.path.join(workdir, f"rank{rank}", "ckpts")
+    tracer = T.get_tracer()
+
+    # rank-targeted SDC fault: own_rank comes from the supervisor
+    # contract (jax.process_index() is 0 on every rank here)
+    raw = os.environ.get(INJ.FAULT_ENV, "").strip()
+    injector = (INJ.FaultInjector(INJ.parse_faults(raw), own_rank=rank)
+                if raw else None)
+
+    params = _mlp_params(jax.random.PRNGKey(0))
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:cluster.world]),
+                             ("dp",))
+    tuner = AutoTuner(
+        _loss_fn, params, strategy="bo", threshold_mb=0.0008,
+        interval=10**9, mesh=mesh, donate=False,
+        optimizer=fused_sgd(lr=0.05, momentum=0.9),
+    )
+    spec = P.SyntheticSpec((
+        P.Field("x", (12, 12), RB.KIND_NORMAL_F32, 0.0, 1.0),
+    ))
+    pipe = P.NumpyPipeline(spec, seed=123, shard=cluster.index,
+                           num_shards=cluster.world)
+
+    guard = GuardedTrainer(
+        tuner.ts, ckpt_dir, params,
+        check_every=1, checkpoint_every=checkpoint_every, max_keep=1000,
+        max_recoveries=16, coordinator=cluster, pipeline=pipe,
+        injector=injector,
+    )
+    EH.attach_elastic(guard, tuner)
+    rollback_steps = []
+    guard.on_rollback = lambda c, at: rollback_steps.append(at)
+    sentinel = guard._sdc
+
+    resumed_at = None
+    t_target = None
+    if rejoining:
+        state, resumed_at, _ = EH.reenter(cluster, tuner, guard, ckpt_dir)
+        t_target = guard.steps_seen + post_steps
+    else:
+        state = tuner.init(params)
+
+    def _expected_flip_bucket(plan, requested=0):
+        # mirror inject.flip_state_bucket's clamp so the parent can
+        # assert the vote localized the EXACT bucket flipped
+        buckets = list(getattr(plan, "buckets", None) or ())
+        if not buckets:
+            return None
+        return min(max(int(requested), 0), len(buckets) - 1)
+
+    try:
+        # nobody self-SIGKILLs in this storm (the sentinel evicts the
+        # convicted rank); the sentinel's drain raises out of the loop
+        state, m = EH.run_loop(
+            cluster, guard, pipe, state,
+            lambda i: _data(jax.random.PRNGKey(100 + i), n=12), tracer,
+            rejoining=rejoining, kill=(-1, 10**9), post=post_steps,
+            t_target=t_target,
+        )
+    except SDC.SdcQuarantined as exc:
+        counters = tracer.counters()
+        record = {
+            "rank": rank,
+            "host": sentinel.host if sentinel is not None else "",
+            "reason": str(exc),
+            "expected_flip_bucket": _expected_flip_bucket(
+                getattr(guard.ts, "plan", None)),
+            "ckpt_steps": [int(s) for s in ckpt.valid_steps(ckpt_dir)],
+            "rollback_steps": rollback_steps,
+            "counters": {k: v for k, v in counters.items()
+                         if k.startswith(("sdc.", "faults.", "guard.",
+                                          "cluster."))},
+        }
+        tmp = os.path.join(workdir, f"sdc_exit_rank{rank}.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, os.path.join(workdir, f"sdc_exit_rank{rank}.json"))
+        print(f"CHAOS_SDC_QUARANTINED rank={rank} " + json.dumps(record),
+              flush=True)
+        sys.exit(SDC.QUARANTINE_RC)
+
+    counters = tracer.counters()
+    verdict = {
+        "rank": rank,
+        "rejoined": bool(rejoining),
+        "host": sentinel.host if sentinel is not None else "",
+        "epoch": cluster.epoch,
+        "members": list(cluster.members),
+        "resumed_at": resumed_at,
+        "rollback_steps": rollback_steps,
+        "final_step": int(jax.device_get(state.step)),
+        "final_loss": float(m.get("loss", float("nan"))),
+        "steps_seen": guard.steps_seen,
+        "plan_world": guard.ts.plan.world,
+        "sdc_convicted": (sorted(sentinel.convicted)
+                          if sentinel is not None else []),
+        "ckpt_steps": [int(s) for s in ckpt.valid_steps(ckpt_dir)],
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith(("cluster.", "guard.", "sdc.",
+                                      "faults."))},
+    }
+    views = cluster.exchange("chaos.verdict", json.dumps(
+        [verdict["final_step"], verdict["final_loss"], verdict["epoch"]]))
+    verdict["lockstep"] = all(
+        json.loads(v) == json.loads(views[0]) for v in views)
+    with open(os.path.join(workdir, f"verdict_rank{rank}.json.tmp"),
+              "w") as f:
+        json.dump(verdict, f)
+    os.replace(os.path.join(workdir, f"verdict_rank{rank}.json.tmp"),
+               os.path.join(workdir, f"verdict_rank{rank}.json"))
+    print(f"CHAOS_SDC rank={rank}/{world0} " + json.dumps(verdict),
+          flush=True)
+    return verdict
+
+
+def run_sdc(checkpoint_every: int, workdir: str | None) -> dict:  # noqa: C901
+    #                                 — one storm, on purpose in one narrative
+    """Parent of the SDC storm — the silent-data-corruption acceptance
+    gate, in two legs sharing one ledger design:
+
+    **Training leg.** 3 supervised ranks train with the fingerprint
+    sentinel armed while rank 1 carries a persistent ``flip`` fault (one
+    low bit in a bucket's padded tail: wire checksums re-sign it, the
+    loss-bits sentinel is deterministically blind). Gates: the vote
+    localizes (rank 1, the flipped bucket) within one check interval;
+    the rollback replay reproduces it and convicts; the convicted rank
+    drains via planned shrink and exits rc 75; the supervisor re-seats
+    the rank on a FRESH host (the quarantined host never re-seated) and
+    launches the old host's probation self-test, which readmits it; the
+    backfill rejoins and every member finishes in lockstep; no corrupt
+    step was ever checkpointed.
+
+    **Serving leg.** A 3-replica supervised fleet serves closed-loop
+    traffic with the router's shadow replay on every response; replica 1
+    corrupts tokens AFTER response signing (``flip_logits`` — the
+    checksum verifies). Gates: the exact-token vote catches it, the
+    third-replica arbiter convicts replica 1 into the same ledger shape,
+    the router fences it (zero dropped requests), the drained seat's
+    backfill is HELD by the quarantine capacity cap until the probation
+    self-test readmits the host, then serving resumes at full strength.
+    """
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    from dear_pytorch_tpu.resilience import sdc as SDC
+
+    workdir = workdir or tempfile.mkdtemp(prefix="dear_chaos_sdc_")
+    os.makedirs(workdir, exist_ok=True)
+    failures: list[str] = []
+    nprocs, flip_at, post_steps = 3, 5, 4
+    sup_mod = CC.load_supervisor()
+
+    # -- leg 1: training — fingerprint vote, replay blame, quarantine -----
+    train_dir = os.path.join(workdir, "train")
+    os.makedirs(train_dir, exist_ok=True)
+    elastic_dir = os.path.join(train_dir, "elastic")
+    env = dict(os.environ)
+    env.pop("DEAR_NUM_CPU_DEVICES", None)
+    env.pop("DEAR_TRACE_RANK", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env["DEAR_TELEMETRY"] = "1"
+    env["DEAR_SDC"] = "1"
+    env["DEAR_CHAOS_ELASTIC_POST"] = str(post_steps)
+    # rank 1's stuck lane: a persistent low-bit flip in a padded bucket
+    # tail from attempt `flip_at` on — every downstream checksum
+    # re-signs the corrupt bytes, only the cross-rank fingerprint vote
+    # can see them
+    env["DEAR_FAULTS"] = f"flip@{flip_at}:0:r1"
+    env.setdefault("DEAR_CLUSTER_TIMEOUT_SECS", "30")
+    sup = sup_mod.ElasticSupervisor(
+        nprocs,
+        [sys.executable, os.path.abspath(__file__), "--worker", "--sdc",
+         "--checkpoint-every", str(checkpoint_every),
+         "--workdir", train_dir],
+        elastic_dir=elastic_dir, env=env,
+        max_relaunches=1,
+    ).start()
+    rc = sup.wait(deadline_s=420)
+
+    _check(rc == 0, f"supervisor exits 0 (got {rc})", failures)
+    _check(("sdc_quarantine", 1) in sup.events,
+           "the convicted rank exited through the quarantine drain "
+           f"(rc 75) ({sup.events})", failures)
+    _check(("sdc_reseat", 1) in sup.events,
+           "the supervisor re-seated rank 1 on a fresh host "
+           "(quarantined host never re-seated)", failures)
+    exit_path = os.path.join(train_dir, "sdc_exit_rank1.json")
+    exit_rec = None
+    if _check(os.path.exists(exit_path),
+              "the quarantined incarnation wrote its forensics record",
+              failures):
+        with open(exit_path) as f:
+            exit_rec = json.load(f)
+    verdicts = {}
+    for r in range(nprocs):
+        path = os.path.join(train_dir, f"verdict_rank{r}.json")
+        if not os.path.exists(path):
+            failures.append(f"rank {r} wrote no verdict")
+            continue
+        with open(path) as f:
+            verdicts[r] = json.load(f)
+    summary = {"passed": False, "workdir": workdir, "verdicts": verdicts,
+               "failures": failures}
+    if len(verdicts) != nprocs or exit_rec is None:
+        return summary
+
+    bad_host = exit_rec["host"]
+    flip_bucket = exit_rec["expected_flip_bucket"]
+    ledger = SDC.ledger_from_dir(os.path.join(elastic_dir, "sdc"))
+    events = ledger.events(bad_host)
+    convictions = [e for e in events if e.get("kind") == "conviction"]
+    _check(bool(convictions),
+           f"the ledger convicted host {bad_host} ({events})", failures)
+    if convictions:
+        c = convictions[0]
+        _check(c.get("rank") == 1,
+               f"blame localized to the injected rank ({c})", failures)
+        _check(flip_bucket is not None and c.get("bucket") == flip_bucket,
+               f"the vote localized the flipped bucket (ledger "
+               f"{c.get('bucket')}, flipped {flip_bucket})", failures)
+    _check(exit_rec["counters"].get("faults.sdc_flips", 0) >= 2,
+           "the flip fired on the original attempt AND the replay — the "
+           "deterministic fault reproduced "
+           f"({exit_rec['counters'].get('faults.sdc_flips', 0)} firings)",
+           failures)
+    # zero corrupted steps reachable from anything published: the
+    # quarantined incarnation's newest persisted checkpoint predates the
+    # first corrupt attempt (saves were fenced from the conviction on)
+    _check(all(s < flip_at for s in exit_rec["ckpt_steps"]),
+           f"no corrupt step was ever checkpointed "
+           f"({exit_rec['ckpt_steps']} all < {flip_at})", failures)
+    expect_restore = (flip_at - 1) - (flip_at - 1) % checkpoint_every
+    _check(bool(exit_rec["rollback_steps"])
+           and all(s == expect_restore
+                   for s in exit_rec["rollback_steps"]),
+           f"the replay re-ran from the last verified checkpoint "
+           f"{expect_restore} ({exit_rec['rollback_steps']})", failures)
+    _check(("sdc_probation", bad_host) in sup.events,
+           f"the probation self-test launched for {bad_host}", failures)
+    _check(("sdc_readmit", bad_host) in sup.events,
+           f"host {bad_host} passed the known-answer self-test and was "
+           "readmitted", failures)
+    _check(not ledger.quarantined(bad_host),
+           "the ledger shows the readmission", failures)
+    for r, v in verdicts.items():
+        _check(v["epoch"] == 2 and v["members"] == list(range(nprocs)),
+               f"rank {r} ends at epoch 2, full membership "
+               f"(epoch {v['epoch']}, members {v['members']})", failures)
+        _check(v["lockstep"], f"rank {r} finished in lockstep", failures)
+        _check(v["final_step"] >= expect_restore + post_steps
+               and v["final_step"] == verdicts[0]["final_step"],
+               f"rank {r} continued past quarantine + rejoin to step "
+               f"{v['final_step']}", failures)
+        # the backfilled seat restores through `reenter` (consensus
+        # restore, not a guard rollback) so its list may be empty; every
+        # rollback that DID happen must land on the verified checkpoint
+        _check((bool(v["rollback_steps"]) or r == 1)
+               and all(s == expect_restore for s in v["rollback_steps"]),
+               f"rank {r} rollbacks all landed on the newest verified "
+               f"checkpoint {expect_restore} ({v['rollback_steps']})",
+               failures)
+    survivors = [verdicts[r] for r in range(nprocs) if r != 1]
+    for v in survivors:
+        c = v["counters"]
+        _check(c.get("sdc.votes", 0) >= 1
+               and c.get("cluster.sdc_suspects_detected", 0) >= 1,
+               f"rank {v['rank']} voted and detected the divergence "
+               "within one check interval", failures)
+        _check(v["sdc_convicted"] == [bad_host],
+               f"rank {v['rank']} convicted exactly the injected host "
+               f"({v['sdc_convicted']})", failures)
+    # the ledger write is first-writer-wins: exactly ONE rank's
+    # convict() lands (and counts) — and every rank races, including
+    # the corrupt one (whose counters live in its rc-75 exit record,
+    # not a survivor verdict). The fleet-wide total is what matters.
+    fleet_counters = [v["counters"] for v in survivors]
+    fleet_counters.append(exit_rec["counters"])
+    _check(sum(c.get("sdc.convictions", 0)
+               for c in fleet_counters) >= 1
+           and sum(c.get("sdc.quarantines", 0)
+                   for c in fleet_counters) >= 1,
+           "the fleet recorded the conviction + quarantine", failures)
+    rv = verdicts[1]
+    _check(rv["rejoined"] and rv["resumed_at"] == expect_restore,
+           f"the backfilled seat rejoined and resumed at the "
+           f"fleet-agreed step ({rv['resumed_at']})", failures)
+    _check(bool(rv["host"]) and rv["host"] != bad_host,
+           f"the backfill landed on a FRESH host "
+           f"({rv['host']} != {bad_host})", failures)
+    _check(rv["counters"].get("sdc.votes", 0) >= 1,
+           "the fingerprint exchange survived the shrink/rejoin epochs "
+           "(the backfilled rank votes again)", failures)
+
+    # -- leg 2: serving — shadow replay, arbiter, fence, held backfill ----
+    from dear_pytorch_tpu.observability import tracer as T
+    from dear_pytorch_tpu.resilience.scale import ScalePolicy
+    from dear_pytorch_tpu.serving.admission import (
+        AdmissionController, SheddingError,
+    )
+    from dear_pytorch_tpu.serving.router import ReplicaRouter
+
+    serve_root = os.path.join(workdir, "serve")
+    os.makedirs(serve_root, exist_ok=True)
+    serve_dir = os.path.join(serve_root, "fleet")
+    store_dir = os.path.join(serve_root, "store")
+    serve_elastic = os.path.join(serve_root, "elastic")
+    capacity = os.path.join(serve_root, "capacity.json")
+    write_capacity = CC.capacity_writer(capacity)
+    write_capacity({"target_world": 3})
+
+    env2 = dict(os.environ)
+    env2.pop("DEAR_NUM_CPU_DEVICES", None)
+    env2.pop("DEAR_TRACE_RANK", None)
+    env2["PYTHONPATH"] = REPO + os.pathsep + env2.get("PYTHONPATH", "")
+    env2["JAX_PLATFORMS"] = "cpu"
+    env2["DEAR_DISABLE_DISTRIBUTED"] = "1"
+    env2["DEAR_TELEMETRY"] = "1"
+    env2["DEAR_SDC"] = "1"
+    env2["DEAR_SERVE_DIR"] = serve_dir
+    env2["DEAR_SERVE_STORE"] = store_dir
+    env2["DEAR_SERVE_SLOTS"] = "4"
+    env2["DEAR_SERVE_DEADLINE"] = "600"
+    env2["DEAR_SERVE_PREFILL_CHUNK"] = "4"
+    # replica 1's stuck lane: token flips AFTER response signing from
+    # its 3rd response on — the wire checksum verifies; only the shadow
+    # replay's exact-token vote can see it
+    env2["DEAR_FAULTS"] = "flip_logits@3:r1"
+
+    pub = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--serve-publish", "--version", "1", "--workdir", serve_root],
+        env=env2, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120)
+    _check(pub.returncode == 0,
+           f"weight v1 published: {pub.stdout[-800:]}", failures)
+
+    policy = ScalePolicy(capacity_file=capacity, hysteresis_s=0.5,
+                         max_world=3)
+    sup2 = sup_mod.ElasticSupervisor(
+        3,
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--serve-replica", "--workdir", serve_root],
+        elastic_dir=serve_elastic, env=env2,
+        max_relaunches=2, relaunch_window_s=120.0, policy=policy,
+    ).start()
+
+    ledger2 = SDC.ledger_from_dir(os.path.join(serve_elastic, "sdc"))
+    sdc_hits: list[tuple] = []
+
+    def on_sdc(rank, host):
+        # the conviction callback is the operator hook: the stuck lane
+        # stays with the quarantined HOST, so the relaunch env sheds the
+        # fault (a backfill is a fresh/readmitted host), and the
+        # quarantined seat drains for backfill
+        sdc_hits.append((rank, host))
+        sup2.base_env.pop("DEAR_FAULTS", None)
+        write_capacity({"target_world": 3, "drain": [rank]})
+
+    prev_tracer = T._tracer
+    T.set_tracer(T.Tracer([T.MemoryExporter()]))
+    admission = AdmissionController(max_depth=16)
+    router = ReplicaRouter(serve_dir, admission=admission,
+                           slots_per_replica=4, health_timeout_s=5.0,
+                           shadow_every=1, sdc_ledger=ledger2,
+                           on_sdc=on_sdc).start()
+    fleet = CC.FleetPump([sup2], failures, deadline_s=300.0)
+    pump = fleet.pump
+    stop = threading.Event()
+    client_failures: list[str] = []
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            prompt = [(i * 7 + k) % 61 for k in range(4 + i % 3)]
+            try:
+                rid = router.submit(prompt, max_new_tokens=3,
+                                    deadline_s=60.0)
+            except SheddingError:
+                time.sleep(0.1)
+                continue
+            try:
+                router.result(rid, timeout=180.0)
+            except TimeoutError:
+                client_failures.append(f"serve req {i}: no response")
+            i += 1
+            time.sleep(0.05)
+
+    th = threading.Thread(target=client, daemon=True)
+    try:
+        _check(pump(lambda: len(router.healthy_replicas()) >= 3,
+                    "3 replicas healthy", 180.0),
+               "serving fleet of 3 replicas is up", failures)
+        th.start()
+        _check(pump(lambda: router.sdc_convictions,
+                    "shadow replay convicts", 180.0),
+               "the shadow-replay arbiter convicted the corrupting "
+               "replica", failures)
+        convicted = list(router.sdc_convictions)
+        _check(bool(convicted) and convicted[0][0] == 1,
+               f"the conviction localized to the injected replica "
+               f"({convicted})", failures)
+        bad_serve_host = convicted[0][1] if convicted else ""
+        evs2 = ledger2.events(bad_serve_host)
+        _check(any(e.get("kind") == "conviction"
+                   and e.get("source") == "serving_shadow"
+                   for e in evs2),
+               f"the serving conviction landed in the shared ledger "
+               f"shape ({evs2})", failures)
+        _check(1 not in router.healthy_replicas(),
+               "the convicted replica is fenced from dispatch", failures)
+        _check(pump(lambda: ("drained", 1) in sup2.events
+                    or ("drained_dirty", 1) in sup2.events,
+                    "quarantined replica drained", 120.0),
+               "the quarantined seat drained for backfill", failures)
+
+        def spawns_of_1():
+            # every path that would re-seat rank 1: a policy scale-up
+            # backfill or an exit-code relaunch
+            return sum(1 for e in sup2.events
+                       if e in (("scale_up", 1), ("relaunch", 1)))
+
+        spawns_at_drain = spawns_of_1()
+        _check(pump(lambda: ("sdc_readmit", bad_serve_host)
+                    in sup2.events, "probation readmit", 120.0),
+               f"the serving host {bad_serve_host} passed probation and "
+               "was readmitted", failures)
+        _check(spawns_of_1() == spawns_at_drain,
+               "the quarantine capacity cap HELD the backfill until "
+               "readmission (no re-seat while quarantined)", failures)
+        _check(pump(lambda: spawns_of_1() > spawns_at_drain
+                    and 1 in router.healthy_replicas(),
+                    "backfill after readmit", 180.0),
+               "the readmitted seat was backfilled and serves again",
+               failures)
+        before = len(router.completed)
+        _check(pump(lambda: len(router.completed) > before,
+                    "traffic after quarantine", 60.0),
+               "responses completed after the conviction (continuous "
+               "serving)", failures)
+        stop.set()
+        th.join(timeout=240)
+        _check(pump(lambda: not router.open_requests(),
+                    "all accepted requests answered", 120.0),
+               "zero dropped requests across the conviction "
+               f"(open={sorted(router.open_requests())})", failures)
+        _check(not client_failures,
+               f"no client timed out ({client_failures[:4]})", failures)
+        stats = router.stats()
+        _check(stats["shadow_replays"] >= 3
+               and stats["shadow_verified"] >= 1,
+               f"shadow replays ran and verified clean responses "
+               f"(replays={stats['shadow_replays']}, "
+               f"verified={stats['shadow_verified']})", failures)
+        _check(stats["shadow_mismatches"] >= 1,
+               "the post-signing corruption was caught by the "
+               "exact-token vote "
+               f"(mismatches={stats['shadow_mismatches']})", failures)
+    finally:
+        stop.set()
+        sup2.policy = None  # shutdown must not be 'lost capacity'
+        sup2.kill_all(signal.SIGTERM)  # drain path: clean exits
+        t_end = time.monotonic() + 60.0
+        while sup2.poll() and time.monotonic() < t_end:
+            time.sleep(0.1)
+        if sup2._procs:
+            sup2.kill_all(signal.SIGKILL)
+        serve_stats = router.stats()
+        router.close()
+        counters2 = T.get_tracer().counters()
+        T.set_tracer(prev_tracer)
+
+    summary.update({
+        "passed": not failures,
+        "failures": failures,
+        "bad_train_host": bad_host,
+        "sdc_hits": sdc_hits,
+        "serve_stats": {k: serve_stats.get(k) for k in (
+            "completed", "shadow_replays", "shadow_verified",
+            "shadow_mismatches", "shadow_skipped", "sdc_convictions")},
+        "sdc_counters": {k: v for k, v in sorted(counters2.items())
+                         if k.startswith("sdc.")},
+    })
+    return summary
+
+
 def _load_harness():
     import importlib.util
 
@@ -1929,9 +2470,12 @@ def run_worker_serve_replica(workdir: str) -> dict:
     # default load walks past corrupt AND rolled-back versions: a
     # backfill after a canary rollback lands on the last good version
     params, version = W.load_params(store)
-    # load-time quality probe: the canary's per-version gauge (a NaN-
-    # poisoned bad_version publish reads 0.0 here and fails the verdict)
-    quality = W.params_finite_fraction(params)
+    # load-time quality probe: the canary's per-version gauge is a real
+    # held-out-perplexity eval (a NaN-poisoned bad_version publish reads
+    # 0.0 here and fails the verdict; finite-but-damaged weights move
+    # the gauge too — strictly more sensitive than the old
+    # finite-fraction placeholder)
+    quality = W.held_out_headroom(params)
     model, _cfg = _serve_model()
     engine = DecodeEngine(
         model, params,
@@ -3336,6 +3880,16 @@ def main(argv=None) -> int:
                          "accepted-then-lost requests, zero training "
                          "progress lost, and a feedback-freshness "
                          "ceiling")
+    ap.add_argument("--sdc", action="store_true",
+                    help="SDC storm: a 3-rank fleet trains with the "
+                         "fingerprint sentinel while one rank carries a "
+                         "persistent padded-tail bit flip (checksums "
+                         "blind); the vote must localize (rank, bucket), "
+                         "the rollback replay must convict, the host "
+                         "must quarantine-drain + probation-readmit, "
+                         "and a serving fleet must catch a post-signing "
+                         "token corruption via shadow replay into the "
+                         "same ledger")
     ap.add_argument("--online-trainer", action="store_true",
                     help=argparse.SUPPRESS)  # internal: one trainer rank
     ap.add_argument("--cold-start", action="store_true",
@@ -3350,6 +3904,20 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)  # internal: one storm rank
     args = ap.parse_args(argv)
 
+    if args.worker and args.sdc:
+        # one SDC-storm rank: the verdict / forensics file is the
+        # output; a quarantine exits QUARANTINE_RC for the supervisor
+        run_worker_sdc(
+            checkpoint_every=args.checkpoint_every, workdir=args.workdir)
+        return 0
+    if args.sdc:
+        summary = run_sdc(checkpoint_every=args.checkpoint_every,
+                          workdir=args.workdir)
+        print(json.dumps({k: v for k, v in summary.items()
+                          if k != "verdicts"}))
+        print("CHAOS CHECK " + ("PASSED" if summary["passed"]
+                                else "FAILED"))
+        return 0 if summary["passed"] else 1
     if args.worker and args.serve_publish:
         summary = run_serve_publish(args.version, workdir=args.workdir)
         return 0 if summary["passed"] else 1
@@ -3464,6 +4032,7 @@ if __name__ == "__main__":
         sys.exit(main())
     if "--elastic" in sys.argv or "--autoscale" in sys.argv \
             or "--serve" in sys.argv or "--online" in sys.argv \
+            or "--sdc" in sys.argv \
             or "--multislice-flap" in sys.argv \
             or "--multislice-degraded" in sys.argv:
         # parent of the elastic/autoscale/serving/online storms: likewise

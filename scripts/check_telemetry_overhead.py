@@ -310,6 +310,30 @@ def main(argv=None) -> int:
             ds.emit("dcn.round", dur_s=1e-3, cat="comm", trace=ctx,
                     step=1, mem_epoch=0, included=2, world=2)
 
+    # SDC-sentinel gate, the way utils/guard.py's health-sync path runs
+    # it when DEAR_SDC is off: the per-bucket fingerprint itself is
+    # IN-PROGRAM (compiled into the step when armed, simply absent from
+    # the program otherwise — zero host cost either way, no device
+    # sync), so the only recurring host shape is one attribute check on
+    # the sentinel slot plus the standard tracer gate for the vote
+    # counters. That check must budget like every other step-path gate.
+    class _SdcSlot:
+        sentinel = None
+
+    _sdc_slot = _SdcSlot()
+
+    def sdc_disabled_gate():
+        if _sdc_slot.sentinel is not None:  # pragma: no cover
+            tr = T.get_tracer()
+            if tr.enabled:
+                tr.count("sdc.votes")
+
+    def sdc_enabled_site():
+        tr = live
+        if tr.enabled:
+            tr.count("sdc.votes")
+            tr.count("sdc.suspected", 0)
+
     # plan-tuner decision-loop gate, the way tuning/autotune.py's step
     # path runs it once the search has FINISHED (or never started): the
     # per-step cost must be one attribute check + return — the tuner
@@ -365,6 +389,8 @@ def main(argv=None) -> int:
     td_disabled_ns = _bench(trace_dcn_disabled_gate, args.iters)
     td_enabled_ns = _bench(trace_dcn_enabled_site,
                            max(args.iters // 10, 1))
+    sdc_disabled_ns = _bench(sdc_disabled_gate, args.iters)
+    sdc_enabled_ns = _bench(sdc_enabled_site, max(args.iters // 10, 1))
     tuner_finished_ns = _bench(plan_tuner_finished_gate, args.iters)
     overhead_ns = max(disabled_ns - baseline_ns, 0.0)
 
@@ -410,6 +436,8 @@ def main(argv=None) -> int:
         "trace_tick_enabled_ns_per_call": round(tt_enabled_ns, 1),
         "trace_dcn_disabled_ns_per_call": round(td_disabled_ns, 1),
         "trace_dcn_enabled_ns_per_call": round(td_enabled_ns, 1),
+        "sdc_disabled_ns_per_call": round(sdc_disabled_ns, 1),
+        "sdc_enabled_ns_per_call": round(sdc_enabled_ns, 1),
         "tuner_finished_ns_per_call": round(tuner_finished_ns, 1),
         "disabled_overhead_ns": round(overhead_ns, 1),
         "budget_ns": args.budget_ns,
@@ -429,6 +457,7 @@ def main(argv=None) -> int:
                and dj_disabled_ns <= args.budget_ns
                and tt_disabled_ns <= args.budget_ns
                and td_disabled_ns <= args.budget_ns
+               and sdc_disabled_ns <= args.budget_ns
                and tuner_finished_ns <= args.budget_ns),
     }
     print(json.dumps(out))

@@ -32,6 +32,13 @@ CI when a simulated delta points the wrong way:
                                 rank skip over stall on both traces
                                 with the same ladder shape (rollbacks,
                                 skips, escalations, rejoins).
+  (sdc policy)                  shadow-replay quarantine orderings on a
+                                fixed corrupt-replica trace: a looser
+                                shadow cadence never exposes fewer
+                                corrupted responses or detects faster,
+                                a bigger strike budget never
+                                quarantines earlier, and the policy
+                                sweep ranks the tightest cadence first.
   (storm)                       a 1000-rank / 8-slice slice-loss storm
                                 must resolve to lockstep with exactly
                                 one shrink epoch + one admission epoch
@@ -376,6 +383,58 @@ def check_trace_calibration(sim, checks, skips):
     return None
 
 
+def check_sdc_policy(sim, checks):
+    """SDC quarantine-policy orderings (resilience.sdc, PR-20): the
+    shadow-replay cadence is the detection budget, so on one fixed
+    corrupt-replica trace the simulator must rank it the only way
+    physics allows — shadowing less often can never expose FEWER
+    corrupted responses or detect FASTER, raising the strike budget can
+    never quarantine EARLIER, and the policy sweep must put the
+    tightest cadence (fewest exposed) first. Deterministic trace, no
+    bands: pure monotonicity."""
+    topo = sim.SimTopology(num_slices=1, chips_per_slice=WORLD)
+    trace = sim.TrafficTrace.poisson(rps=200.0, duration_s=2.0,
+                                     prompt_tokens=16, decode_tokens=4,
+                                     seed=3)
+    kw = dict(replicas=3, corrupt_replica=1, corrupt_at_s=0.5)
+    cadence = {se: sim.simulate_sdc(topo, trace, shadow_every=se, **kw)
+               for se in (1, 2, 4)}
+    mono_ok = all(
+        cadence[a]["exposed"] <= cadence[b]["exposed"]
+        and cadence[a]["detect_s"] is not None
+        and cadence[b]["detect_s"] is not None
+        and cadence[a]["detect_s"] <= cadence[b]["detect_s"]
+        and cadence[a]["quarantined_at_s"] is not None
+        for a, b in ((1, 2), (2, 4)))
+    strikes = {st: sim.simulate_sdc(topo, trace, shadow_every=2,
+                                    strike_threshold=st, **kw)
+               for st in (1, 2, 3)}
+    strike_ok = all(
+        strikes[a]["quarantined_at_s"] is not None
+        and strikes[b]["quarantined_at_s"] is not None
+        and strikes[a]["quarantined_at_s"]
+        <= strikes[b]["quarantined_at_s"]
+        for a, b in ((1, 2), (2, 3)))
+    ranked = sim.sweep_sdc_policies(topo, trace,
+                                    shadow_everys=(1, 2, 4),
+                                    strike_thresholds=(1,), **kw)
+    sweep_ok = (ranked[0]["shadow_every"] == 1
+                and ranked[0]["exposed"]
+                == min(r["exposed"] for r in ranked)
+                and all(r["readmit_at_s"] is not None for r in ranked))
+    checks.append({
+        "name": "sdc_policy_orderings",
+        "detect_s_by_cadence": {se: cadence[se]["detect_s"]
+                                for se in cadence},
+        "exposed_by_cadence": {se: cadence[se]["exposed"]
+                               for se in cadence},
+        "quarantine_s_by_strikes": {st: strikes[st]["quarantined_at_s"]
+                                    for st in strikes},
+        "ok": bool(mono_ok and strike_ok and sweep_ok),
+    })
+    return None
+
+
 def check_storm(sim, checks, budget_s):
     t0 = time.perf_counter()
     out = sim.run_membership_storm(world=1000, ranks_per_slice=125,
@@ -421,7 +480,8 @@ def main(argv=None) -> int:
                lambda: check_gather_dtype(sim, checks),
                lambda: check_serving(sim, checks, skips),
                lambda: check_degraded_dcn(sim, checks),
-               lambda: check_trace_calibration(sim, checks, skips)):
+               lambda: check_trace_calibration(sim, checks, skips),
+               lambda: check_sdc_policy(sim, checks)):
         try:
             infra = fn()
         except Exception as exc:  # noqa: BLE001
